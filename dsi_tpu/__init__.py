@@ -1,0 +1,32 @@
+"""dsi_tpu — a TPU-native MapReduce framework.
+
+A from-scratch rebuild of the capability surface of
+``aatmiyasilwal/Distributed-Systems-Implemented`` (a Go MapReduce framework in the
+MIT 6.5840 style; see SURVEY.md), redesigned TPU-first:
+
+* Control plane: a pull-based coordinator/worker protocol over a Unix-domain
+  socket (reference: ``mr/coordinator.go``, ``mr/worker.go``, ``mr/rpc.go``),
+  implemented as host-side Python with the same task state machine, 10 s
+  straggler re-queue, and atomic temp-file-rename commit discipline.
+* Data plane: for the host backend, hash-partitioned intermediate files on a
+  shared filesystem (reference: ``mr-X-Y`` JSON files, ``mr/worker.go:81-92``);
+  for the TPU backend, on-chip tokenize/hash/bucket/segment-reduce kernels
+  (JAX/XLA) with ``jax.lax.all_to_all`` over the device mesh replacing the
+  file shuffle when more than one device is present.
+* Apps: the two-symbol ``Map``/``Reduce`` plugin contract
+  (reference: ``mrapps/wc.go:21,41``, loader ``main/mrworker.go:34-51``),
+  loaded from Python modules instead of Go ``.so`` plugins.
+
+Package layout:
+  mr/        core framework: coordinator, worker, rpc, sequential oracle
+  apps/      application plugins (wc, grep, indexer, crash, ...)
+  ops/       single-device TPU kernels (tokenize, hash, segment reduce)
+  parallel/  device mesh, shard_map all_to_all shuffle, multi-chip pipeline
+  backends/  host (reference-semantics) and tpu execution backends
+  utils/     config, corpus generation, atomic IO, codecs, tracing
+  cli/       process entry points (mrcoordinator, mrworker, mrsequential)
+"""
+
+__version__ = "0.1.0"
+
+from dsi_tpu.mr.types import KeyValue  # noqa: F401

@@ -1,0 +1,57 @@
+"""Fault-injection app: word count whose Map/Reduce randomly kill the worker.
+
+Not in the reference repo, but the reference *mechanism* it exercises is
+(presumed-dead-by-timeout re-queue, mr/coordinator.go:70-77,99-106; idempotent
+atomic-rename commits, mr/worker.go:91,148), SURVEY.md §4 flags the missing
+crash test as a gap to fill, and BASELINE.json's configs name it.  Modeled on
+the MIT lab's crash.go: with some probability the task process exits
+immediately; with some probability it stalls long enough to trigger the
+straggler re-queue.
+
+Because Reduce is invoked once per distinct key (thousands of times per
+reduce task), a naive per-invocation crash probability would make reduce tasks
+statistically unable to ever finish.  Each worker process therefore plays the
+crash lottery at most DSI_CRASH_MAX_PLAYS times (default 3) over its lifetime;
+respawned workers get a fresh allowance.
+
+Env knobs: DSI_CRASH_EXIT_PROB (default 0.25), DSI_CRASH_STALL_PROB (default
+0.2), DSI_CRASH_STALL_S (default 3.0), DSI_CRASH_MAX_PLAYS (default 3).
+Randomness is seeded per-process.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import List
+
+from dsi_tpu.mr.types import KeyValue
+from dsi_tpu.apps import wc
+
+_rng = random.Random(os.getpid() ^ int(time.time() * 1e6))
+_plays = 0
+
+
+def _maybe_crash() -> None:
+    global _plays
+    if _plays >= int(os.environ.get("DSI_CRASH_MAX_PLAYS", "3")):
+        return
+    _plays += 1
+    exit_prob = float(os.environ.get("DSI_CRASH_EXIT_PROB", "0.25"))
+    stall_prob = float(os.environ.get("DSI_CRASH_STALL_PROB", "0.2"))
+    r = _rng.random()
+    if r < exit_prob:
+        os._exit(1)  # die without cleanup: no completion RPC, no commit
+    elif r < exit_prob + stall_prob:
+        time.sleep(float(os.environ.get("DSI_CRASH_STALL_S", "3.0")))
+
+
+def Map(filename: str, contents: str) -> List[KeyValue]:
+    _maybe_crash()
+    return wc.Map(filename, contents)
+
+
+def Reduce(key: str, values: List[str]) -> str:
+    _maybe_crash()
+    return wc.Reduce(key, values)

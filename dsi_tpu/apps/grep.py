@@ -1,0 +1,38 @@
+"""Distributed grep app — a *working* version of the reference's intent.
+
+Reference: ``mrapps/dgrep.go`` documents distributed grep but is
+non-functional: its symbols are unexported (``grepMap``/``grepReduce``,
+dgrep.go:18,44), its Map signature takes ``(contents, pattern)`` instead of
+the loader's ``(filename, contents)`` contract (main/mrworker.go:39-41), and
+no pattern plumbing exists.  SURVEY.md §2 (C8) directs this rebuild to ship a
+working grep with the pattern supplied out-of-band.
+
+Pattern: the ``DSI_GREP_PATTERN`` environment variable (a Python regex;
+default matches nothing).  Map emits ``{matching_line, ""}`` per matching
+line, like the reference's per-line regex match (dgrep.go:27-35).  Reduce
+returns the number of occurrences of the line across the corpus (the
+reference's ``return key`` would print the line twice per the "%v %v" output
+format; a count is the useful, deliberate choice — documented deviation).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from dsi_tpu.mr.types import KeyValue
+
+
+def _pattern() -> "re.Pattern[str]":
+    return re.compile(os.environ.get("DSI_GREP_PATTERN", r"(?!x)x"))
+
+
+def Map(filename: str, contents: str) -> List[KeyValue]:
+    pat = _pattern()
+    return [KeyValue(line, "") for line in contents.split("\n")
+            if pat.search(line)]
+
+
+def Reduce(key: str, values: List[str]) -> str:
+    return str(len(values))

@@ -1,0 +1,25 @@
+"""Inverted-index app (string-valued reduce).
+
+Not present in the reference repo, but targeted by BASELINE.json's configs
+("mrapps/indexer.go inverted-index build (string-valued reduce)") — the MIT
+6.5840 lab app the reference derives from.  Map emits one ``{word, document}``
+pair per word per document (deduplicated within the document); Reduce returns
+``"<count> <doc1>,<doc2>,..."`` with documents sorted and deduplicated.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from dsi_tpu.mr.types import KeyValue
+from dsi_tpu.apps.wc import WORD_RE
+
+
+def Map(filename: str, contents: str) -> List[KeyValue]:
+    words = sorted(set(WORD_RE.findall(contents)))
+    return [KeyValue(w, filename) for w in words]
+
+
+def Reduce(key: str, values: List[str]) -> str:
+    docs = sorted(set(values))
+    return f"{len(docs)} {','.join(docs)}"

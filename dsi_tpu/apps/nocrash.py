@@ -1,0 +1,7 @@
+"""The crash app's oracle twin: identical output, no fault injection.
+
+The differential crash test runs the sequential oracle with `nocrash` and the
+distributed system with `crash`; outputs must still byte-compare equal.
+"""
+
+from dsi_tpu.apps.wc import Map, Reduce  # noqa: F401

@@ -1,0 +1,29 @@
+"""Word count app.
+
+Reference: ``mrapps/wc.go`` — Map splits contents into maximal runs of Unicode
+letters (``strings.FieldsFunc`` with ``!unicode.IsLetter``, wc.go:21-34; note
+this splits on digits and underscores too) and emits ``{word, "1"}`` per word;
+Reduce returns ``strconv.Itoa(len(values))`` (wc.go:41-44).
+
+``WORD_RE`` = ``[^\\W\\d_]+`` is Python for "one or more Unicode letters":
+``\\w`` minus digits minus underscore, i.e. the same token class as Go's
+``unicode.IsLetter`` runs (identical on ASCII; both are Unicode category L on
+the letters that matter here).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from dsi_tpu.mr.types import KeyValue
+
+WORD_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+
+
+def Map(filename: str, contents: str) -> List[KeyValue]:
+    return [KeyValue(w, "1") for w in WORD_RE.findall(contents)]
+
+
+def Reduce(key: str, values: List[str]) -> str:
+    return str(len(values))

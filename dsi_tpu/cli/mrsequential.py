@@ -1,0 +1,29 @@
+"""Sequential oracle entry point.
+
+Reference: ``main/mrsequential.go:25-31`` — argv is a plugin followed by input
+files; output is a single ``mr-out-0``.
+
+Usage: python -m dsi_tpu.cli.mrsequential <app> inputfiles...
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from dsi_tpu.mr.plugin import load_plugin
+from dsi_tpu.mr.sequential import run_sequential
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("app")
+    p.add_argument("files", nargs="+")
+    p.add_argument("--out", default="mr-out-0")
+    args = p.parse_args(argv)
+    mapf, reducef = load_plugin(args.app)
+    run_sequential(mapf, reducef, args.files, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,36 @@
+"""Worker process entry point.
+
+Reference: ``main/mrworker.go:19-28`` — argv is one plugin; load its
+Map/Reduce, then run the worker loop.  Extended with ``--backend=tpu``
+(the BASELINE.json north-star flag) routing execution to the JAX backend.
+
+Usage: python -m dsi_tpu.cli.mrworker [--backend host|tpu] <app-name-or-path.py>
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr.plugin import load_plugin
+from dsi_tpu.mr.worker import worker_loop
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--backend", choices=("host", "tpu"), default="host")
+    p.add_argument("app")
+    args = p.parse_args(argv)
+    mapf, reducef = load_plugin(args.app)
+    cfg = JobConfig(backend=args.backend)
+    runner = None
+    if args.backend == "tpu":
+        from dsi_tpu.backends.tpu import TpuTaskRunner
+
+        runner = TpuTaskRunner.for_app(args.app)
+    worker_loop(mapf, reducef, cfg, task_runner=runner)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

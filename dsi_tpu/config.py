@@ -1,0 +1,68 @@
+"""Typed configuration for the framework.
+
+The reference has no config system: nReduce is the literal 10
+(``main/mrcoordinator.go:23``), the straggler timeout 10 s
+(``mr/coordinator.go:71,100``), the done-poll and exit-grace 1 s
+(``main/mrcoordinator.go:25,28``), and the socket path a constant
+(``mr/rpc.go:37-41``).  SURVEY.md §5 calls for a small typed config with
+those values as defaults — this is it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+
+
+def default_socket_path(workdir: str | None = None) -> str:
+    """Unix-domain socket path for the coordinator.
+
+    Reference: ``coordinatorSock()`` returns ``/var/tmp/824-mr-<uid>``
+    (``mr/rpc.go:37-41``).  That per-UID name prevents concurrent jobs on one
+    machine (noted in ``main/test-mr-many.sh:10-11``); we additionally hash the
+    working directory into the name so independent jobs (and parallel test
+    sandboxes) never collide.  Overridable via ``DSI_MR_SOCKET``.
+    """
+    env = os.environ.get("DSI_MR_SOCKET")
+    if env:
+        return env
+    wd = os.path.abspath(workdir or os.getcwd())
+    tag = hashlib.md5(wd.encode()).hexdigest()[:8]
+    return f"/var/tmp/dsi-mr-{os.getuid()}-{tag}"
+
+
+@dataclasses.dataclass(frozen=True)
+class JobConfig:
+    """Everything the coordinator + workers need for one MapReduce job."""
+
+    # Number of reduce partitions.  Reference default: 10
+    # (main/mrcoordinator.go:23).
+    n_reduce: int = 10
+
+    # Straggler re-queue threshold, seconds.  Reference: 10 s goroutine sleep
+    # (mr/coordinator.go:71,100).
+    task_timeout_s: float = 10.0
+
+    # Coordinator Done() poll interval and post-done grace, seconds
+    # (main/mrcoordinator.go:25,28).
+    done_poll_s: float = 1.0
+    exit_grace_s: float = 1.0
+
+    # Worker sleep when told "waiting" (TaskStatus=2).  The reference worker
+    # busy-polls with no backoff (no case 2 in mr/worker.go:54-162) — SURVEY.md
+    # §3.3 flags this as a defect to fix; output is unaffected.
+    wait_sleep_s: float = 0.2
+
+    # Directory where mr-X-Y and mr-out-Y files live.  Reference: the cwd.
+    workdir: str = "."
+
+    # Execution backend for map/reduce tasks: "host" (reference semantics,
+    # pure Python) or "tpu" (JAX kernels for TPU-aware apps).
+    backend: str = "host"
+
+    # Coordinator socket path ("" -> default_socket_path(workdir)).
+    socket_path: str = ""
+
+    def sock(self) -> str:
+        return self.socket_path or default_socket_path(self.workdir)

@@ -1,0 +1,3 @@
+from dsi_tpu.mr.types import KeyValue, TaskStatus  # noqa: F401
+from dsi_tpu.mr.coordinator import Coordinator, make_coordinator  # noqa: F401
+from dsi_tpu.mr.worker import worker_loop  # noqa: F401

@@ -1,0 +1,160 @@
+"""Coordinator: job state + pull-based task scheduler + RPC server.
+
+Reference: ``mr/coordinator.go`` (entire file, 160 LoC).  Same state machine:
+
+* per-task logs with states 0=untouched / 1=in-progress / 2=completed
+  (coordinator.go:16,20),
+* map tasks are assigned first; **no reduce task is assigned until every map
+  has completed** — the `cMap == nMap` barrier (coordinator.go:47,79), which is
+  load-bearing for correctness (reduce must see all mr-*-r files),
+* a task in-progress for `task_timeout_s` (10 s) is re-queued for another
+  worker — presumed-dead-by-timeout fault tolerance (coordinator.go:70-77,
+  99-106),
+* `Done()` is `c_reduce == n_reduce` under the lock (coordinator.go:138-142).
+
+Two reference defects documented in SURVEY.md §5 are fixed here (both
+output-invariant):
+
+1. **Unique-transition completion counting.**  The reference increments
+   `cMap`/`cReduce` on every completion RPC (coordinator.go:30-31,38-39), so a
+   re-queued task finished by two workers double-counts and can prematurely
+   satisfy the map barrier or `Done()`.  We count only the first transition of
+   a task's log to COMPLETED.
+2. The waiting busy-poll fix lives in the worker (see worker.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr import rpc
+from dsi_tpu.mr.types import (LOG_COMPLETED, LOG_IN_PROGRESS, LOG_UNTOUCHED,
+                              TaskStatus)
+
+
+class Coordinator:
+    """Owns all job state; hands out tasks on pull (mr/coordinator.go:14-25)."""
+
+    def __init__(self, files: List[str], n_reduce: int, config: JobConfig | None = None):
+        self.config = config or JobConfig(n_reduce=n_reduce)
+        self.files = list(files)
+        self.n_map = len(files)
+        self.c_map = 0
+        self.map_log = [LOG_UNTOUCHED] * self.n_map
+        self.n_reduce = n_reduce
+        self.c_reduce = 0
+        self.reduce_log = [LOG_UNTOUCHED] * n_reduce
+        self.mu = threading.Lock()
+        self._timers: set[threading.Timer] = set()
+        self._server: Optional[rpc.RpcServer] = None
+
+    # ---- RPC handlers (the wire API, mr/coordinator.go:27-114) ----
+
+    def request_task(self, args: dict) -> dict:
+        """Assign a map task, a reduce task, "waiting", or "done"
+        (mr/coordinator.go:43-114)."""
+        reply = {"TaskStatus": int(TaskStatus.WAITING), "NMap": self.n_map,
+                 "CMap": 0, "NReduce": self.n_reduce, "CReduce": 0, "Filename": ""}
+        with self.mu:
+            if self.c_map < self.n_map:
+                tba = self._first_untouched(self.map_log)
+                if tba is None:
+                    reply["TaskStatus"] = int(TaskStatus.WAITING)  # :58-60
+                else:
+                    self.map_log[tba] = LOG_IN_PROGRESS  # :62
+                    reply["TaskStatus"] = int(TaskStatus.MAP)
+                    reply["Filename"] = self.files[tba]
+                    reply["CMap"] = tba
+                    self._arm_timeout(self.map_log, tba)  # :70-77
+            elif self.c_reduce < self.n_reduce:  # map barrier passed (:79)
+                tba = self._first_untouched(self.reduce_log)
+                if tba is None:
+                    reply["TaskStatus"] = int(TaskStatus.WAITING)
+                else:
+                    self.reduce_log[tba] = LOG_IN_PROGRESS
+                    reply["TaskStatus"] = int(TaskStatus.REDUCE)
+                    reply["CReduce"] = tba
+                    self._arm_timeout(self.reduce_log, tba)  # :99-106
+            else:
+                reply["TaskStatus"] = int(TaskStatus.DONE)  # :109-112
+        return reply
+
+    def map_complete(self, args: dict) -> dict:
+        """Reference: RecieveMapComplete [sic] (mr/coordinator.go:27-33), with
+        the unique-transition counting fix."""
+        t = int(args["TaskNumber"])
+        with self.mu:
+            if self.map_log[t] != LOG_COMPLETED:  # fix: count first completion only
+                self.map_log[t] = LOG_COMPLETED
+                self.c_map += 1
+        return {}
+
+    def reduce_complete(self, args: dict) -> dict:
+        """Reference: RecieveReduceComplete [sic] (mr/coordinator.go:35-41)."""
+        t = int(args["TaskNumber"])
+        with self.mu:
+            if self.reduce_log[t] != LOG_COMPLETED:
+                self.reduce_log[t] = LOG_COMPLETED
+                self.c_reduce += 1
+        return {}
+
+    # ---- internals ----
+
+    @staticmethod
+    def _first_untouched(log: list[int]) -> Optional[int]:
+        for i, s in enumerate(log):  # linear scan, mr/coordinator.go:50-55
+            if s == LOG_UNTOUCHED:
+                return i
+        return None
+
+    def _arm_timeout(self, log: list[int], task_id: int) -> None:
+        """Presumed-dead-by-timeout: after task_timeout_s, if the task is still
+        in-progress, reset it to untouched for reassignment
+        (mr/coordinator.go:70-77,99-106 — goroutine + sleep; here a Timer)."""
+
+        def requeue() -> None:
+            with self.mu:
+                if log[task_id] == LOG_IN_PROGRESS:
+                    log[task_id] = LOG_UNTOUCHED
+                self._timers.discard(t)
+
+        t = threading.Timer(self.config.task_timeout_s, requeue)
+        t.daemon = True
+        t.start()
+        self._timers.add(t)
+
+    # ---- lifecycle (mr/coordinator.go:121-160) ----
+
+    def serve(self) -> None:
+        """Start the RPC server (reference (*Coordinator).server())."""
+        self._server = rpc.RpcServer(self.config.sock(), {
+            "Coordinator.RequestTask": self.request_task,
+            # Reference names, [sic] typo preserved as aliases for wire parity:
+            "Coordinator.RecieveMapComplete": self.map_complete,
+            "Coordinator.RecieveReduceComplete": self.reduce_complete,
+            "Coordinator.MapComplete": self.map_complete,
+            "Coordinator.ReduceComplete": self.reduce_complete,
+        })
+        self._server.start()
+
+    def done(self) -> bool:
+        """Job-completion poll (mr/coordinator.go:138-142)."""
+        with self.mu:
+            return self.c_reduce == self.n_reduce
+
+    def close(self) -> None:
+        for t in list(self._timers):
+            t.cancel()
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+
+def make_coordinator(files: List[str], n_reduce: int,
+                     config: JobConfig | None = None) -> Coordinator:
+    """Construct state and start the RPC server (mr/coordinator.go:149-160)."""
+    c = Coordinator(files, n_reduce, config)
+    c.serve()
+    return c

@@ -1,0 +1,44 @@
+"""App plugin loading.
+
+Reference: Go plugins built with ``-buildmode=plugin``; ``loadPlugin`` opens
+the .so and looks up exactly two exported symbols, ``Map`` and ``Reduce``
+(``main/mrworker.go:34-51``, duplicated in ``main/mrsequential.go:93-110``).
+
+Here a "plugin" is a Python module — either a registered name under
+``dsi_tpu.apps`` (wc, grep, indexer, crash, ...) or a filesystem path to a
+``.py`` file.  The two-symbol contract is preserved: the module must expose
+``Map(filename: str, contents: str) -> list[KeyValue]`` and
+``Reduce(key: str, values: list[str]) -> str``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+from typing import Tuple
+
+from dsi_tpu.mr.worker import MapFn, ReduceFn
+
+
+def load_plugin(name_or_path: str) -> Tuple[MapFn, ReduceFn]:
+    if name_or_path.endswith(".py") or os.sep in name_or_path:
+        spec = importlib.util.spec_from_file_location(
+            "dsi_mr_app_" + os.path.basename(name_or_path).removesuffix(".py"),
+            name_or_path)
+        if spec is None or spec.loader is None:
+            raise SystemExit(f"cannot load plugin {name_or_path}")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        try:
+            mod = importlib.import_module(f"dsi_tpu.apps.{name_or_path}")
+        except ImportError as e:
+            raise SystemExit(
+                f"cannot load plugin {name_or_path!r}: {e} "
+                f"(registered apps: wc, grep, indexer, crash, nocrash)")
+    try:
+        mapf, reducef = mod.Map, mod.Reduce  # the two-symbol lookup (mrworker.go:39-47)
+    except AttributeError as e:
+        raise SystemExit(f"cannot find Map/Reduce in {name_or_path}: {e}")
+    return mapf, reducef

@@ -1,0 +1,142 @@
+"""RPC control plane: framed-JSON request/response over a Unix-domain socket.
+
+Reference wire layer: Go ``net/rpc`` + ``rpc.HandleHTTP`` served by
+``http.Serve`` on a Unix socket (``mr/coordinator.go:121-132``), client dialing
+fresh per call (``mr/worker.go:172-188``), arg/reply structs in ``mr/rpc.go``.
+
+This is a deliberate re-design, not a translation: instead of Go's
+HTTP-framed gob RPC we use a minimal length-prefixed JSON protocol —
+4-byte big-endian length, then a UTF-8 JSON object.  Request:
+``{"method": str, "args": {...}}``; response: ``{"ok": bool, "reply": {...},
+"error": str|null}``.  Semantics preserved from the reference:
+
+* one dial per call (``mr/worker.go:175``),
+* the server handles calls concurrently (``go http.Serve``,
+  ``mr/coordinator.go:131``) — here a thread per connection,
+* a dial failure after the coordinator exits is fatal to the worker
+  (``log.Fatal``, ``mr/worker.go:176-178``) — surfaced as
+  :class:`CoordinatorGone`.
+
+The wire field names (``TaskStatus``, ``NMap``, ``CMap``, ``NReduce``,
+``CReduce``, ``Filename``, ``TaskNumber``) are kept identical to
+``mr/rpc.go:18-33`` so the protocol is recognizably the same.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict
+
+_LEN = struct.Struct(">I")
+_MAX_FRAME = 16 << 20
+
+
+class CoordinatorGone(Exception):
+    """Raised when the coordinator socket cannot be dialed (reference:
+    worker's log.Fatal on dial error, mr/worker.go:176-178)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"frame too large: {n}")
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+class RpcServer:
+    """Threaded RPC server over a Unix-domain socket.
+
+    Mirrors ``(*Coordinator).server()`` (mr/coordinator.go:121-132): removes a
+    stale socket file, listens, and serves in background threads.
+    """
+
+    def __init__(self, socket_path: str, methods: Dict[str, Callable[[dict], dict]]):
+        self.socket_path = socket_path
+        self.methods = dict(methods)
+        try:
+            os.remove(socket_path)  # mr/coordinator.go:126
+        except OSError:
+            pass
+
+        handler_methods = self.methods
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one request per connection (dial-per-call)
+                try:
+                    req = _recv_frame(self.request)
+                    fn = handler_methods.get(req.get("method", ""))
+                    if fn is None:
+                        _send_frame(self.request, {"ok": False, "reply": None,
+                                                   "error": f"no such method: {req.get('method')}"})
+                        return
+                    reply = fn(req.get("args") or {})
+                    _send_frame(self.request, {"ok": True, "reply": reply, "error": None})
+                except (ConnectionError, json.JSONDecodeError, OSError):
+                    pass  # client vanished mid-call; the 10 s requeue covers it
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server(socket_path, Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="dsi-mr-rpc", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            os.remove(self.socket_path)
+        except OSError:
+            pass
+
+
+def call(socket_path: str, method: str, args: dict | None = None,
+         timeout: float = 60.0) -> tuple[bool, dict | None]:
+    """One RPC: dial, send, receive, close.
+
+    Returns ``(ok, reply)`` like the reference's ``call()`` helper
+    (mr/worker.go:172-188).  Raises :class:`CoordinatorGone` if the socket
+    cannot be dialed — the reference worker dies here (log.Fatal), and our
+    worker loop treats it as job-over.
+    """
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    try:
+        try:
+            sock.connect(socket_path)
+        except OSError as e:
+            raise CoordinatorGone(f"dialing {socket_path}: {e}") from e
+        try:
+            _send_frame(sock, {"method": method, "args": args or {}})
+            resp = _recv_frame(sock)
+        except (OSError, ConnectionError, json.JSONDecodeError):
+            return False, None  # RPC-level failure -> ok=false (worker.go:186-188)
+        if not resp.get("ok"):
+            return False, None
+        return True, resp.get("reply")
+    finally:
+        sock.close()

@@ -1,0 +1,34 @@
+"""Core record and protocol types.
+
+Reference: ``KeyValue{Key, Value string}`` (``mr/worker.go:17-20``) and the
+``TaskStatus`` integer protocol 0=map, 1=reduce, 2=waiting, 3=done
+(``mr/rpc.go:22-33``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple
+
+
+class KeyValue(NamedTuple):
+    """The record type apps produce and consume (mr/worker.go:17-20)."""
+
+    key: str
+    value: str
+
+
+class TaskStatus(enum.IntEnum):
+    """Wire-level task status (mr/rpc.go:23: 0 map, 1 reduce, 2 wait, 3 done)."""
+
+    MAP = 0
+    REDUCE = 1
+    WAITING = 2
+    DONE = 3
+
+
+# Task-log states inside the coordinator (mr/coordinator.go:16: 0 never
+# touched, 1 in-progress, 2 completed).
+LOG_UNTOUCHED = 0
+LOG_IN_PROGRESS = 1
+LOG_COMPLETED = 2

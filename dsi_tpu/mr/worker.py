@@ -1,0 +1,193 @@
+"""Worker: pull-loop task executor.
+
+Reference: ``mr/worker.go`` (188 LoC).  Same loop: request a task; execute a
+map or reduce task; report completion; exit when the coordinator says DONE or
+becomes unreachable (``worker.go:46-165``).  Same data-plane contract:
+
+* map writes NReduce intermediate files ``mr-<m>-<r>``, JSON records, committed
+  by temp-file + atomic rename (worker.go:81-92),
+* the partitioner is ``fnv32a(key) & 0x7fffffff  %  NReduce`` — bit-for-bit the
+  reference's ``ihash`` (worker.go:33-37,76),
+* reduce reads every ``mr-*-<r>``, *tolerating missing files*
+  (worker.go:106-108), sorts by key, groups runs of equal keys, calls
+  ``reducef(key, values)``, writes lines ``f"{key} {output}\n"`` — the Go
+  ``"%v %v\n"`` format (worker.go:144) — commits ``mr-out-<r>`` atomically,
+  then garbage-collects its intermediates (worker.go:151-154).
+
+Intermediate record encoding: one JSON object per line, ``{"Key": k,
+"Value": v}`` — byte-compatible with Go's ``json.Encoder`` stream of
+``mr.KeyValue`` (worker.go:84-90).
+
+Deviation (SURVEY.md §3.3, output-invariant): on TaskStatus=WAITING the
+reference busy-polls over RPC with no backoff (no case 2 in its switch);
+we sleep ``wait_sleep_s`` between polls.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, List, Sequence
+
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr import rpc
+from dsi_tpu.mr.types import KeyValue, TaskStatus
+from dsi_tpu.utils.atomicio import atomic_write
+
+MapFn = Callable[[str, str], List[KeyValue]]
+ReduceFn = Callable[[str, List[str]], str]
+
+
+def fnv32a(data: bytes) -> int:
+    """FNV-1a 32-bit hash, exactly Go's hash/fnv.New32a (worker.go:33-37)."""
+    h = 0x811C9DC5
+    for b in data:
+        h ^= b
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def ihash(key: str) -> int:
+    """Reference ihash: fnv32a(key) & 0x7fffffff (worker.go:33-37)."""
+    return fnv32a(key.encode("utf-8")) & 0x7FFFFFFF
+
+
+def intermediate_name(map_task: int, reduce_task: int, workdir: str = ".") -> str:
+    return os.path.join(workdir, f"mr-{map_task}-{reduce_task}")
+
+
+def output_name(reduce_task: int, workdir: str = ".") -> str:
+    return os.path.join(workdir, f"mr-out-{reduce_task}")
+
+
+def write_intermediates(kva: Sequence[KeyValue], map_task: int, n_reduce: int,
+                        workdir: str = ".") -> None:
+    """Partition by ihash and commit NReduce files atomically
+    (worker.go:74-92)."""
+    buckets: list[list[KeyValue]] = [[] for _ in range(n_reduce)]
+    for kv in kva:
+        buckets[ihash(kv.key) % n_reduce].append(kv)
+    for r, bucket in enumerate(buckets):
+        with atomic_write(intermediate_name(map_task, r, workdir)) as f:
+            for kv in bucket:
+                f.write(json.dumps({"Key": kv.key, "Value": kv.value}))
+                f.write("\n")
+
+
+def read_intermediates(reduce_task: int, n_map: int,
+                       workdir: str = ".") -> list[KeyValue]:
+    """Read all mr-<i>-<r>, skipping missing files (worker.go:102-121)."""
+    out: list[KeyValue] = []
+    for i in range(n_map):
+        path = intermediate_name(i, reduce_task, workdir)
+        try:
+            f = open(path, "r")
+        except OSError:
+            continue  # tolerated: worker.go:106-108
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    break  # truncated record: reference's decoder break (worker.go:117)
+                out.append(KeyValue(obj["Key"], obj["Value"]))
+    return out
+
+
+def group_and_reduce(intermediate: list[KeyValue], reducef: ReduceFn, out) -> None:
+    """Sort by key, group runs of equal keys, reduce, format "%v %v\n"
+    (worker.go:124-146; identical grouping in main/mrsequential.go:59-84)."""
+    intermediate.sort(key=lambda kv: kv.key)
+    i = 0
+    n = len(intermediate)
+    while i < n:
+        j = i + 1
+        while j < n and intermediate[j].key == intermediate[i].key:
+            j += 1
+        values = [intermediate[k].value for k in range(i, j)]
+        out.write(f"{intermediate[i].key} {reducef(intermediate[i].key, values)}\n")
+        i = j
+
+
+def run_map_task(mapf: MapFn, filename: str, map_task: int, n_reduce: int,
+                 workdir: str = ".") -> None:
+    """One map task: read the split, run the app map, partition + commit
+    (worker.go:55-92)."""
+    with open(filename, "rb") as f:
+        contents = f.read().decode("utf-8", errors="replace")
+    kva = mapf(filename, contents)
+    write_intermediates(kva, map_task, n_reduce, workdir)
+
+
+def run_reduce_task(reducef: ReduceFn, reduce_task: int, n_map: int,
+                    workdir: str = ".") -> None:
+    """One reduce task: gather, sort, group, reduce, commit, GC
+    (worker.go:99-154)."""
+    intermediate = read_intermediates(reduce_task, n_map, workdir)
+    with atomic_write(output_name(reduce_task, workdir)) as out:
+        group_and_reduce(intermediate, reducef, out)
+    for i in range(n_map):  # GC intermediates, errors ignored (worker.go:151-154)
+        try:
+            os.remove(intermediate_name(i, reduce_task, workdir))
+        except OSError:
+            pass
+
+
+def worker_loop(mapf: MapFn, reducef: ReduceFn,
+                config: JobConfig | None = None,
+                task_runner=None) -> None:
+    """The worker's task loop (mr.Worker, worker.go:43-165).
+
+    `task_runner`, if given, is an object with run_map/run_reduce methods used
+    instead of the host-Python execution above — this is the backend seam the
+    TPU path plugs into (backends/tpu.py).
+    """
+    cfg = config or JobConfig()
+    sock = cfg.sock()
+    tasks_done = 0
+    while True:
+        try:
+            ok, reply = rpc.call(sock, "Coordinator.RequestTask", {"TaskNumber": 0})
+        except rpc.CoordinatorGone as e:
+            # Coordinator exited; the reference worker dies here
+            # (worker.go:176-178).  Normal at end-of-job; noteworthy if this
+            # worker never got a single task.
+            if tasks_done == 0:
+                import sys
+                print(f"mrworker: coordinator unreachable: {e}", file=sys.stderr)
+            break
+        if not ok or reply is None or reply["TaskStatus"] == int(TaskStatus.DONE):
+            break  # worker.go:51-53
+        status = reply["TaskStatus"]
+        if status == int(TaskStatus.MAP):
+            if task_runner is not None:
+                task_runner.run_map(mapf, reply["Filename"], reply["CMap"],
+                                    reply["NReduce"], cfg.workdir)
+            else:
+                run_map_task(mapf, reply["Filename"], reply["CMap"],
+                             reply["NReduce"], cfg.workdir)
+            tasks_done += 1
+            try:
+                rpc.call(sock, "Coordinator.RecieveMapComplete",
+                         {"TaskNumber": reply["CMap"]})
+            except rpc.CoordinatorGone:
+                break
+        elif status == int(TaskStatus.REDUCE):
+            if task_runner is not None:
+                task_runner.run_reduce(reducef, reply["CReduce"], reply["NMap"],
+                                       cfg.workdir)
+            else:
+                run_reduce_task(reducef, reply["CReduce"], reply["NMap"],
+                                cfg.workdir)
+            tasks_done += 1
+            try:
+                rpc.call(sock, "Coordinator.RecieveReduceComplete",
+                         {"TaskNumber": reply["CReduce"]})
+            except rpc.CoordinatorGone:
+                break
+        else:  # WAITING — sleep instead of the reference's RPC busy-poll
+            time.sleep(cfg.wait_sleep_s)

@@ -1,0 +1,43 @@
+"""Atomic file commit: write to a temp file, then rename.
+
+Reference: map-side intermediate commit ``os.CreateTemp`` + ``os.Rename``
+(``mr/worker.go:83,91``) and reduce-side output commit (``mr/worker.go:127,148``).
+Atomic rename is the framework's entire checkpoint/idempotence story
+(SURVEY.md §5): re-executed tasks overwrite with a complete file, last writer
+wins, readers never observe a partial file.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from contextlib import contextmanager
+from typing import IO, Iterator
+
+
+@contextmanager
+def atomic_write(path: str, mode: str = "w") -> Iterator[IO]:
+    """Open a temp file in the destination directory; rename onto `path` on
+    successful exit.  On exception the temp file is removed and nothing is
+    committed (mirrors the reference: a crashed worker leaves no partial
+    mr-X-Y / mr-out-Y file, mr/worker.go:81-92,126-148)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    # The ".tmp-" prefix keeps uncommitted temp files out of the harness's
+    # "mr-out*" merge glob if a worker dies (os._exit) mid-write.
+    fd, tmp = tempfile.mkstemp(prefix=".tmp-" + os.path.basename(path) + ".", dir=d)
+    f = os.fdopen(fd, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.rename(tmp, path)  # atomic commit
+    except BaseException:
+        try:
+            f.close()
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        raise

@@ -1,0 +1,65 @@
+"""Per-task timing + optional JAX profiler hooks.
+
+The reference has NO tracing/profiling of any kind (SURVEY.md §5: only
+log.Fatalf on errors).  This is the new observability layer SURVEY.md calls
+for: lightweight wall-clock phase timers usable from the worker and the bench
+harness, and a context manager gating ``jax.profiler`` traces behind an env
+var so production runs pay nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import Dict, Iterator
+
+
+class PhaseTimer:
+    """Accumulates wall-clock seconds per named phase."""
+
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self, stream=sys.stderr) -> None:
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):
+            stream.write(f"[trace] {name}: {self.totals[name]:.3f}s "
+                         f"(x{self.counts[name]})\n")
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.totals)
+
+
+@contextlib.contextmanager
+def maybe_jax_profile(out_dir: str | None = None) -> Iterator[None]:
+    """Wrap a region in jax.profiler.trace when DSI_JAX_PROFILE is set."""
+    target = out_dir or os.environ.get("DSI_JAX_PROFILE")
+    if not target:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(target):
+        yield
+
+
+def log_event(event: str, **fields) -> None:
+    """Structured one-line JSON event log (stderr), off unless DSI_TRACE=1."""
+    if os.environ.get("DSI_TRACE") != "1":
+        return
+    rec = {"t": time.time(), "event": event}
+    rec.update(fields)
+    sys.stderr.write(json.dumps(rec) + "\n")

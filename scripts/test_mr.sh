@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+#
+# Integration test harness — the Python-framework port of the reference's
+# main/test-mr.sh (C12 in SURVEY.md §2): fresh sandbox, sequential oracle,
+# 1 coordinator + 3 workers under timeouts, merged-sorted output byte-compared
+# against the oracle.  Where the reference builds with the Go race detector
+# (test-mr.sh:10,19-22), our concurrency check is the differential comparison
+# itself plus the unit tests' lock discipline (SURVEY.md §4).
+#
+# Usage: scripts/test_mr.sh [app]   (default: wc; also grep, indexer, crash)
+
+set -u
+APP=${1:-wc}
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+PY=${PYTHON:-python3}
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+
+# fresh sandbox cwd (test-mr.sh:13-16)
+SANDBOX=$(mktemp -d /tmp/dsi-mr-test.XXXXXX)
+trap 'rm -rf "$SANDBOX"' EXIT
+cd "$SANDBOX"
+export DSI_MR_SOCKET="$SANDBOX/mr.sock"
+
+# inputs: generated corpus (reference pg-*.txt are not distributed; SURVEY §7.1)
+$PY -c "from dsi_tpu.utils.corpus import ensure_corpus; ensure_corpus('inputs', n_files=6, file_size=300000)"
+INPUTS=(inputs/pg-*.txt)
+
+ORACLE_APP=$APP
+EXTRA_COORD_ARGS=()
+if [ "$APP" = crash ]; then
+  ORACLE_APP=nocrash
+  EXTRA_COORD_ARGS=(--task-timeout 2.0)
+  export DSI_CRASH_EXIT_PROB=0.3 DSI_CRASH_STALL_PROB=0.15 DSI_CRASH_STALL_S=2.5
+fi
+if [ "$APP" = grep ]; then
+  export DSI_GREP_PATTERN='[Tt]he'
+fi
+
+# ground truth via the sequential oracle (test-mr.sh:30-31)
+$PY -m dsi_tpu.cli.mrsequential "$ORACLE_APP" "${INPUTS[@]}" --out mr-correct.txt || exit 1
+sort mr-correct.txt | grep . > mr-correct-sorted.txt
+
+echo "--- starting $APP test"
+rm -f mr-out*
+timeout -k 2s 180s $PY -m dsi_tpu.cli.mrcoordinator "${EXTRA_COORD_ARGS[@]}" "${INPUTS[@]}" &
+COORD=$!
+sleep 1  # socket-creation grace (test-mr.sh:39-40)
+
+for _ in 1 2 3; do
+  timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "$APP" &
+done
+
+if [ "$APP" = crash ]; then
+  # keep respawning workers while the coordinator lives (crashed ones die)
+  while kill -0 $COORD 2>/dev/null; do
+    N=$(jobs -rp | wc -l)
+    if [ "$N" -lt 4 ]; then
+      timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "$APP" &
+    fi
+    sleep 0.5
+  done
+fi
+
+wait $COORD
+wait
+
+sort mr-out* | grep . > mr-all.txt   # test-mr.sh:52
+if cmp -s mr-all.txt mr-correct-sorted.txt; then
+  echo "--- $APP test: PASS"
+  exit 0
+else
+  echo "--- $APP output is not the same as the sequential oracle"
+  echo "--- $APP test: FAIL"
+  exit 1
+fi

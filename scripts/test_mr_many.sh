@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+#
+# Flake hunter — port of the reference's main/test-mr-many.sh (C13): run the
+# full suite N times sequentially, abort on first failure.  Unlike the
+# reference (whose per-UID socket forbids parallel trials,
+# test-mr-many.sh:10-11), each trial here sandboxes its own socket, so trials
+# could even run concurrently; we keep them sequential for comparable load.
+#
+# Usage: scripts/test_mr_many.sh <trials> [app]
+
+set -u
+if [ $# -lt 1 ]; then
+  echo "Usage: $0 numTrials [app]"
+  exit 1
+fi
+TRIALS=$1
+APP=${2:-wc}
+HERE=$(cd "$(dirname "$0")" && pwd)
+
+for i in $(seq 1 "$TRIALS"); do
+  echo "*** trial $i of $TRIALS"
+  if ! timeout -k 2s 900s "$HERE/test_mr.sh" "$APP"; then
+    echo "*** FAILED on trial $i"
+    exit 1
+  fi
+done
+echo "*** PASSED all $TRIALS trials"

@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Tests never require TPU hardware: JAX-dependent tests run on a virtual
+8-device CPU mesh (the multi-chip sharding path is validated the same way the
+driver's dryrun does).  These env vars must be set before the first
+``import jax`` anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

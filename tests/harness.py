@@ -1,0 +1,65 @@
+"""Shared helpers for the differential end-to-end tests.
+
+This is the Python form of ``main/test-mr.sh``'s core loop: fresh sandbox,
+oracle run, 1 coordinator + N workers, merge ``sort mr-out* | grep .`` and
+byte-compare with the oracle output (test-mr.sh:13-53).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from typing import List
+
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr.coordinator import make_coordinator
+from dsi_tpu.mr.plugin import load_plugin
+from dsi_tpu.mr.sequential import run_sequential
+from dsi_tpu.mr.worker import worker_loop
+
+
+def merged_output(workdir: str) -> List[str]:
+    """sort mr-out* | grep .  (test-mr.sh:52 — empty lines dropped so
+    per-partition boundaries don't matter)."""
+    lines: List[str] = []
+    for p in sorted(glob.glob(os.path.join(workdir, "mr-out-*"))):
+        with open(p) as f:
+            lines.extend(l for l in f if l.strip())
+    return sorted(lines)
+
+
+def oracle_output(app: str, files, workdir: str) -> List[str]:
+    mapf, reducef = load_plugin(app)
+    out = os.path.join(workdir, "mr-correct.txt")
+    run_sequential(mapf, reducef, files, out)
+    with open(out) as f:
+        return sorted(l for l in f if l.strip())
+
+
+def run_distributed_threads(app: str, files, workdir: str, n_workers: int = 3,
+                            n_reduce: int = 10, timeout_s: float = 60.0,
+                            task_timeout_s: float = 10.0) -> None:
+    """In-process distributed run: coordinator + worker threads sharing cfg."""
+    cfg = JobConfig(n_reduce=n_reduce, workdir=workdir,
+                    task_timeout_s=task_timeout_s,
+                    socket_path=os.path.join(workdir, "mr.sock"),
+                    wait_sleep_s=0.05)
+    mapf, reducef = load_plugin(app)
+    c = make_coordinator(files, n_reduce, cfg)
+    try:
+        workers = [threading.Thread(target=worker_loop, args=(mapf, reducef, cfg),
+                                    daemon=True)
+                   for _ in range(n_workers)]
+        for w in workers:
+            w.start()
+        deadline = time.time() + timeout_s
+        while not c.done():
+            if time.time() > deadline:
+                raise TimeoutError("job did not finish in time")
+            time.sleep(0.05)
+        for w in workers:
+            w.join(timeout=10.0)
+    finally:
+        c.close()

@@ -1,0 +1,94 @@
+"""Coordinator state-machine unit tests.
+
+The reference has no unit tests at all (SURVEY.md §4); these pin the scheduler
+semantics of ``mr/coordinator.go``: map-before-reduce barrier, waiting states,
+straggler re-queue, unique-transition completion counting (the documented
+double-count fix), and done().
+"""
+
+import time
+
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr.coordinator import Coordinator
+from dsi_tpu.mr.types import TaskStatus
+
+
+def mk(files=3, n_reduce=2, timeout=10.0):
+    return Coordinator([f"in-{i}" for i in range(files)], n_reduce,
+                       JobConfig(n_reduce=n_reduce, task_timeout_s=timeout))
+
+
+def test_assigns_all_maps_then_waits():
+    c = mk(files=2, n_reduce=1)
+    r1 = c.request_task({})
+    r2 = c.request_task({})
+    assert r1["TaskStatus"] == TaskStatus.MAP and r2["TaskStatus"] == TaskStatus.MAP
+    assert {r1["CMap"], r2["CMap"]} == {0, 1}
+    assert r1["Filename"] == "in-0" and r1["NReduce"] == 1
+    # all maps assigned but incomplete -> WAITING (coordinator.go:58-60)
+    assert c.request_task({})["TaskStatus"] == TaskStatus.WAITING
+
+
+def test_map_barrier_gates_reduce():
+    c = mk(files=2, n_reduce=2)
+    c.request_task({}); c.request_task({})
+    c.map_complete({"TaskNumber": 0})
+    # one map still outstanding -> still no reduce (coordinator.go:47,79)
+    assert c.request_task({})["TaskStatus"] == TaskStatus.WAITING
+    c.map_complete({"TaskNumber": 1})
+    r = c.request_task({})
+    assert r["TaskStatus"] == TaskStatus.REDUCE
+    assert r["NMap"] == 2
+
+
+def test_done_only_after_all_reduces():
+    c = mk(files=1, n_reduce=2)
+    c.request_task({}); c.map_complete({"TaskNumber": 0})
+    c.request_task({}); c.request_task({})
+    assert not c.done()
+    c.reduce_complete({"TaskNumber": 0})
+    assert not c.done()
+    c.reduce_complete({"TaskNumber": 1})
+    assert c.done()
+    assert c.request_task({})["TaskStatus"] == TaskStatus.DONE
+
+
+def test_straggler_requeue():
+    # presumed-dead-by-timeout: task re-queued after task_timeout_s
+    # (coordinator.go:70-77)
+    c = mk(files=1, n_reduce=1, timeout=0.15)
+    r = c.request_task({})
+    assert r["TaskStatus"] == TaskStatus.MAP
+    assert c.request_task({})["TaskStatus"] == TaskStatus.WAITING
+    time.sleep(0.4)
+    r2 = c.request_task({})
+    assert r2["TaskStatus"] == TaskStatus.MAP and r2["CMap"] == 0
+
+
+def test_completion_beats_requeue_race():
+    # if completion lands before the timer fires, the task must NOT be requeued
+    c = mk(files=1, n_reduce=1, timeout=0.15)
+    c.request_task({})
+    c.map_complete({"TaskNumber": 0})
+    time.sleep(0.4)
+    r = c.request_task({})
+    assert r["TaskStatus"] == TaskStatus.REDUCE  # straight to reduce phase
+
+
+def test_duplicate_completion_not_double_counted():
+    # The reference double-counts duplicate completion RPCs
+    # (coordinator.go:30-31) which can prematurely satisfy the map barrier;
+    # SURVEY.md §5 directs counting unique log transitions only.
+    c = mk(files=2, n_reduce=1)
+    c.request_task({}); c.request_task({})
+    c.map_complete({"TaskNumber": 0})
+    c.map_complete({"TaskNumber": 0})  # duplicate from a re-queued twin
+    assert c.c_map == 1
+    assert c.request_task({})["TaskStatus"] == TaskStatus.WAITING  # barrier holds
+
+
+def test_wire_reply_fields_match_reference_shape():
+    # WorkerReply fields (mr/rpc.go:22-33) are the wire contract.
+    c = mk()
+    r = c.request_task({})
+    assert set(r) == {"TaskStatus", "NMap", "CMap", "NReduce", "CReduce", "Filename"}

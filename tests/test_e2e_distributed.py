@@ -1,0 +1,52 @@
+"""Differential end-to-end tests: distributed output == sequential oracle.
+
+The reference's only test is exactly this check, as a bash script
+(``main/test-mr.sh``): oracle via mrsequential, 1 coordinator + 3 workers,
+``sort mr-out* | grep .`` vs the oracle's sorted output, byte-compared
+(test-mr.sh:30-53).  Here it runs for wc, grep, and indexer, in-process.
+"""
+
+import pytest
+
+from dsi_tpu.utils.corpus import ensure_corpus
+from tests.harness import merged_output, oracle_output, run_distributed_threads
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    return ensure_corpus(str(tmp_path / "inputs"), n_files=5, file_size=60_000)
+
+
+def test_wc_parity(tmp_path, corpus):
+    want = oracle_output("wc", corpus, str(tmp_path))
+    run_distributed_threads("wc", corpus, str(tmp_path))
+    assert merged_output(str(tmp_path)) == want
+    assert len(want) > 1000  # corpus produced a real vocabulary
+
+
+def test_indexer_parity(tmp_path, corpus):
+    want = oracle_output("indexer", corpus, str(tmp_path))
+    run_distributed_threads("indexer", corpus, str(tmp_path))
+    assert merged_output(str(tmp_path)) == want
+
+
+def test_grep_parity(tmp_path, corpus, monkeypatch):
+    monkeypatch.setenv("DSI_GREP_PATTERN", r"[Tt]h")
+    want = oracle_output("grep", corpus, str(tmp_path))
+    assert want  # pattern must actually match something
+    run_distributed_threads("grep", corpus, str(tmp_path))
+    assert merged_output(str(tmp_path)) == want
+
+
+def test_single_worker_parity(tmp_path, corpus):
+    # degenerate parallelism still correct
+    want = oracle_output("wc", corpus, str(tmp_path))
+    run_distributed_threads("wc", corpus, str(tmp_path), n_workers=1)
+    assert merged_output(str(tmp_path)) == want
+
+
+def test_more_workers_than_tasks(tmp_path):
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=2, file_size=10_000)
+    want = oracle_output("wc", files, str(tmp_path))
+    run_distributed_threads("wc", files, str(tmp_path), n_workers=8, n_reduce=3)
+    assert merged_output(str(tmp_path)) == want

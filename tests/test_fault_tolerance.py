@@ -1,0 +1,90 @@
+"""Fault-tolerance tests: the 10 s requeue path under real worker death.
+
+SURVEY.md §4 flags that the reference never tests its own fault-tolerance
+mechanism (coordinator.go:70-77,99-106).  These tests kill real worker
+processes mid-job and assert the job still completes with oracle parity —
+safety coming from atomic temp-file-rename commits (worker.go:91,148) and
+reduce tolerating missing intermediates (worker.go:106-108).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dsi_tpu.utils.corpus import ensure_corpus
+from tests.harness import merged_output, oracle_output
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(args, cwd, env):
+    return subprocess.Popen([sys.executable, "-m", *args], cwd=cwd, env=env,
+                            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+@pytest.mark.slow
+def test_crash_app_parity(tmp_path):
+    """1 coordinator + 4 workers running the crash app (random os._exit and
+    stalls); dead workers are replaced; output must equal the nocrash oracle."""
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=6, file_size=30_000)
+    want = oracle_output("nocrash", files, str(tmp_path))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DSI_MR_SOCKET"] = str(tmp_path / "mr.sock")
+    env["DSI_CRASH_EXIT_PROB"] = "0.3"
+    env["DSI_CRASH_STALL_PROB"] = "0.15"
+    env["DSI_CRASH_STALL_S"] = "2.5"
+    wd = str(tmp_path)
+
+    coord = _spawn(["dsi_tpu.cli.mrcoordinator", "--task-timeout", "2.0",
+                    *files], wd, env)
+    try:
+        time.sleep(0.5)  # socket-creation grace (test-mr.sh:39-40)
+        workers = []
+        deadline = time.time() + 120
+        while coord.poll() is None:
+            if time.time() > deadline:
+                pytest.fail("crash job did not finish in 120s")
+            # keep ~4 live workers, replacing any that crashed
+            workers = [w for w in workers if w.poll() is None]
+            while len(workers) < 4:
+                workers.append(_spawn(["dsi_tpu.cli.mrworker", "crash"], wd, env))
+            time.sleep(0.3)
+        for w in workers:
+            w.wait(timeout=30)
+    finally:
+        if coord.poll() is None:
+            coord.kill()
+    assert merged_output(wd) == want
+
+
+@pytest.mark.slow
+def test_worker_killed_externally(tmp_path):
+    """SIGKILL a healthy worker mid-map; the requeue must recover."""
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=4, file_size=50_000)
+    want = oracle_output("wc", files, str(tmp_path))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DSI_MR_SOCKET"] = str(tmp_path / "mr.sock")
+    wd = str(tmp_path)
+
+    coord = _spawn(["dsi_tpu.cli.mrcoordinator", "--task-timeout", "2.0",
+                    *files], wd, env)
+    try:
+        time.sleep(0.5)
+        victim = _spawn(["dsi_tpu.cli.mrworker", "wc"], wd, env)
+        time.sleep(0.3)
+        victim.kill()  # dies holding an in-progress task
+        survivor = _spawn(["dsi_tpu.cli.mrworker", "wc"], wd, env)
+        coord.wait(timeout=90)
+        survivor.wait(timeout=30)
+    finally:
+        for p in (coord,):
+            if p.poll() is None:
+                p.kill()
+    assert merged_output(wd) == want

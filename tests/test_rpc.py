@@ -1,0 +1,70 @@
+"""RPC layer: framed-JSON over Unix socket, dial-per-call semantics."""
+
+import os
+
+import pytest
+
+from dsi_tpu.mr import rpc
+
+
+def test_roundtrip(tmp_path):
+    sock = str(tmp_path / "s")
+    srv = rpc.RpcServer(sock, {"Echo": lambda a: {"got": a}})
+    srv.start()
+    try:
+        ok, reply = rpc.call(sock, "Echo", {"x": 1})
+        assert ok and reply == {"got": {"x": 1}}
+    finally:
+        srv.close()
+
+
+def test_unknown_method_returns_not_ok(tmp_path):
+    sock = str(tmp_path / "s")
+    srv = rpc.RpcServer(sock, {})
+    srv.start()
+    try:
+        ok, reply = rpc.call(sock, "Nope", {})
+        assert not ok and reply is None
+    finally:
+        srv.close()
+
+
+def test_dial_failure_raises_coordinator_gone(tmp_path):
+    # Reference worker log.Fatals when the coordinator socket is gone
+    # (mr/worker.go:176-178); we surface it as an exception the loop
+    # treats as job-over.
+    with pytest.raises(rpc.CoordinatorGone):
+        rpc.call(str(tmp_path / "missing"), "X", {})
+
+
+def test_stale_socket_file_is_replaced(tmp_path):
+    sock = str(tmp_path / "s")
+    open(sock, "w").close()  # stale file; server must os.remove it first
+    srv = rpc.RpcServer(sock, {"M": lambda a: {}})
+    srv.start()
+    try:
+        ok, _ = rpc.call(sock, "M", {})
+        assert ok
+    finally:
+        srv.close()
+
+
+def test_concurrent_calls(tmp_path):
+    import threading
+    sock = str(tmp_path / "s")
+    srv = rpc.RpcServer(sock, {"Inc": lambda a: {"v": a["v"] + 1}})
+    srv.start()
+    errs = []
+
+    def hit(i):
+        ok, r = rpc.call(sock, "Inc", {"v": i})
+        if not ok or r["v"] != i + 1:
+            errs.append(i)
+
+    try:
+        ts = [threading.Thread(target=hit, args=(i,)) for i in range(32)]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        assert not errs
+    finally:
+        srv.close()
