@@ -1,0 +1,8 @@
+"""Execution backends for worker tasks.
+
+The worker loop (``dsi_tpu/mr/worker.py``) executes tasks on the host by
+default — reference semantics (``mr/worker.go:55-161``).  A backend is an
+object with ``run_map``/``run_reduce`` methods passed as ``task_runner``;
+the TPU backend routes app-declared device kernels through JAX while keeping
+the wire protocol, file formats, and fault-tolerance semantics identical.
+"""

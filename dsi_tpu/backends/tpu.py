@@ -1,0 +1,51 @@
+"""TPU task backend: runs map tasks through app-declared device kernels.
+
+Reference scope: the worker's task execution bodies (``mr/worker.go:55-97``
+map, ``:99-161`` reduce).  Everything around the execution — pull protocol,
+intermediate file naming/format, atomic commit, missing-file tolerance,
+completion RPCs — is untouched; this backend only swaps the *compute* inside
+a task, which is exactly the boundary SURVEY.md §7 step 4 prescribes.
+
+App contract (optional, duck-typed — the plugin boundary stays two-symbol
+for portable apps):
+
+* ``tpu_map(filename: str, raw: bytes) -> list[KeyValue] | None`` — device
+  implementation of the map task.  Returning None means "this input needs
+  the host path" (e.g. non-ASCII text); the runner then falls back to the
+  app's ordinary ``Map`` — correctness never depends on the kernel.
+* ``tpu_reduce(key, values) -> str`` — optional; defaults to the app's
+  ``Reduce``.  For combiner-style apps the reduce phase is tiny (one record
+  per unique key per split), so it stays on the host.
+"""
+
+from __future__ import annotations
+
+from dsi_tpu.mr import worker as w
+from dsi_tpu.mr.plugin import load_plugin_module
+
+
+class TpuTaskRunner:
+    """Backend object for ``worker_loop(task_runner=...)``."""
+
+    def __init__(self, app_module):
+        self.app = app_module
+        self.tpu_map = getattr(app_module, "tpu_map", None)
+        self.tpu_reduce = getattr(app_module, "tpu_reduce", None)
+
+    @classmethod
+    def for_app(cls, name_or_path: str) -> "TpuTaskRunner":
+        return cls(load_plugin_module(name_or_path))
+
+    def run_map(self, mapf, filename: str, map_task: int, n_reduce: int,
+                workdir: str = ".") -> None:
+        with open(filename, "rb") as f:
+            raw = f.read()
+        kva = self.tpu_map(filename, raw) if self.tpu_map else None
+        if kva is None:  # host fallback (worker.go:55-92 semantics)
+            kva = mapf(filename, raw.decode("utf-8", errors="replace"))
+        w.write_intermediates(kva, map_task, n_reduce, workdir)
+
+    def run_reduce(self, reducef, reduce_task: int, n_map: int,
+                   workdir: str = ".") -> None:
+        w.run_reduce_task(self.tpu_reduce or reducef, reduce_task, n_map,
+                          workdir)

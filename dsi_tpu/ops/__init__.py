@@ -1,0 +1,6 @@
+"""Device-side (JAX/XLA) kernels — the TPU execution layer.
+
+These kernels replace the reference's host hot loops (SURVEY.md §3.2-3.3:
+``mapf`` over file contents, the ``ihash`` bucketing loop, sort + group +
+reduce) with fixed-shape, jit-compiled TPU programs.
+"""

@@ -1,0 +1,191 @@
+"""TPU word-count kernel: tokenize + group + count, one fused XLA program.
+
+This is the device replacement for the reference's map-side hot path
+(``mrapps/wc.go:21-34`` tokenization, ``mr/worker.go:74-78`` bucketing) and
+the reduce-side sort/group/count (``mr/worker.go:123-146``), re-designed for
+the TPU execution model rather than translated:
+
+* the whole file chunk lives in HBM as one ``uint8`` vector; every step is a
+  vectorized op over it (no scalar loops, no dynamic shapes),
+* tokens are *maximal runs of ASCII letters* — on ASCII text this is exactly
+  Go's ``strings.FieldsFunc(contents, !unicode.IsLetter)`` (``wc.go:23``);
+  any byte >= 0x80 is detected and reported so the caller can fall back to
+  the host path, keeping Unicode parity without polluting the kernel,
+* grouping is by **exact word bytes**, not by hash: each token's first
+  ``max_word_len`` bytes are packed big-endian into ``max_word_len/4``
+  ``uint32`` lanes and grouped with a multi-key lexicographic ``lax.sort`` +
+  segment-sum — no collision risk, and the packed keys double as the exact
+  word bytes for host-side detokenization (SURVEY.md §7 hard part 1),
+* the partition hash is FNV-1a 32-bit, bit-identical to the reference's
+  ``ihash`` (``mr/worker.go:33-37``), computed on-device per *unique* word.
+
+All shapes are static: the token buffer is ``n//2 + 1`` (a token needs at
+least one letter plus a separator), the unique buffer is ``u_cap``.  Overflow
+(words longer than ``max_word_len``, more uniques than ``u_cap``, non-ASCII
+bytes) is detected exactly and surfaced as scalars; the host wrapper retries
+with a bigger kernel or falls back to the host implementation, so the result
+is always exact.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_FNV_OFFSET = 0x811C9DC5
+_FNV_PRIME = 0x01000193
+_PAD_KEY = 0xFFFFFFFF  # sorts after every real word (ASCII first byte < 0x80)
+
+
+def is_ascii_letter(b: jax.Array) -> jax.Array:
+    """[A-Za-z] mask over uint8 bytes (== unicode.IsLetter on ASCII)."""
+    return ((b >= 65) & (b <= 90)) | ((b >= 97) & (b <= 122))
+
+
+def token_bounds(letter: jax.Array):
+    """Start/end masks for maximal letter runs (vector form of FieldsFunc)."""
+    prev = jnp.concatenate([jnp.zeros((1,), jnp.bool_), letter[:-1]])
+    nxt = jnp.concatenate([letter[1:], jnp.zeros((1,), jnp.bool_)])
+    return letter & ~prev, letter & ~nxt
+
+
+def pack_windows(chunk: jax.Array, start_pos: jax.Array, lengths: jax.Array,
+                 max_word_len: int):
+    """Gather each token's first max_word_len bytes, zero-pad, pack to uint32.
+
+    Big-endian packing keeps uint32 lexicographic order == bytewise order and
+    makes host detokenization a single ``.tobytes()``.
+    """
+    n = chunk.shape[0]
+    k = max_word_len // 4
+    offs = jnp.arange(max_word_len, dtype=jnp.int32)
+    idx = jnp.minimum(start_pos[:, None] + offs[None, :], n - 1)
+    win = chunk[idx].astype(jnp.uint32)
+    mask = offs[None, :] < jnp.minimum(lengths, max_word_len)[:, None]
+    win = jnp.where(mask, win, 0)
+    w4 = win.reshape(-1, k, 4)
+    return (w4[..., 0] << 24) | (w4[..., 1] << 16) | (w4[..., 2] << 8) | w4[..., 3]
+
+
+def fnv1a32_packed(packed: jax.Array, lengths: jax.Array,
+                   max_word_len: int) -> jax.Array:
+    """FNV-1a 32-bit over the packed word bytes — bit-exact Go hash/fnv.New32a
+    (mr/worker.go:33-37).  Unrolled over the static max_word_len."""
+    h = jnp.full(packed.shape[:1], _FNV_OFFSET, jnp.uint32)
+    for j in range(max_word_len):
+        b = (packed[:, j // 4] >> ((3 - (j % 4)) * 8)) & jnp.uint32(0xFF)
+        h = jnp.where(j < lengths, (h ^ b) * jnp.uint32(_FNV_PRIME), h)
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=("max_word_len", "u_cap"))
+def count_words_kernel(chunk: jax.Array, *, max_word_len: int = 16,
+                       u_cap: int = 1 << 17):
+    """Exact unique-word counts over one uint8 chunk (zero-padded tail).
+
+    Returns (packed_u [u_cap, K] uint32, len_u [u_cap] i32, cnt_u [u_cap] i32,
+    fnv_u [u_cap] u32, n_unique i32, max_len i32, has_high bool).
+    """
+    n = chunk.shape[0]
+    k = max_word_len // 4
+    t_cap = n // 2 + 1
+
+    letter = is_ascii_letter(chunk)
+    starts, ends = token_bounds(letter)
+    n_tokens = jnp.sum(starts, dtype=jnp.int32)
+    (start_pos,) = jnp.nonzero(starts, size=t_cap, fill_value=n - 1)
+    (end_pos,) = jnp.nonzero(ends, size=t_cap, fill_value=n - 1)
+    valid = jnp.arange(t_cap, dtype=jnp.int32) < n_tokens
+    lengths = jnp.where(valid, end_pos - start_pos + 1, 0).astype(jnp.int32)
+    max_len = jnp.max(lengths, initial=0)
+
+    packed = pack_windows(chunk, start_pos.astype(jnp.int32), lengths,
+                          max_word_len)
+    packed = jnp.where(valid[:, None], packed, jnp.uint32(_PAD_KEY))
+
+    # Group identical words: K-key lexicographic sort, then run boundaries.
+    sorted_ops = lax.sort(tuple(packed[:, j] for j in range(k)) + (lengths,),
+                          num_keys=k)
+    skeys = jnp.stack(sorted_ops[:k], axis=1)
+    slens = sorted_ops[k]
+    svalid = skeys[:, 0] != jnp.uint32(_PAD_KEY)
+    prev = jnp.concatenate(
+        [jnp.full((1, k), _PAD_KEY, jnp.uint32), skeys[:-1]], axis=0)
+    is_new = jnp.any(skeys != prev, axis=1) & svalid
+    n_unique = jnp.sum(is_new, dtype=jnp.int32)
+    uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    cnt_u = jax.ops.segment_sum(
+        svalid.astype(jnp.int32),
+        jnp.where(svalid, uid, u_cap),
+        num_segments=u_cap + 1)[:u_cap]
+
+    (upos,) = jnp.nonzero(is_new, size=u_cap, fill_value=t_cap - 1)
+    uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
+    packed_u = jnp.where(uvalid[:, None], skeys[upos], 0)
+    len_u = jnp.where(uvalid, slens[upos], 0)
+    fnv_u = fnv1a32_packed(packed_u, len_u, max_word_len)
+    has_high = jnp.any(chunk >= 128)
+    return packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high
+
+
+def _pad_pow2(data: bytes, min_size: int = 256) -> np.ndarray:
+    """Zero-pad to the next power of two so jit caches a few shapes only.
+    Zero bytes are non-letters, so padding can't create or extend tokens."""
+    n = max(min_size, len(data) + 1)
+    size = 1 << (n - 1).bit_length()
+    buf = np.zeros(size, dtype=np.uint8)
+    buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+    return buf
+
+
+def decode_packed(packed_u: np.ndarray, len_u: np.ndarray,
+                  n_unique: int) -> list:
+    """Host detokenization: packed big-endian uint32 rows -> word strings."""
+    rows = np.asarray(packed_u[:n_unique]).astype(">u4")
+    lens = np.asarray(len_u[:n_unique])
+    out = []
+    for i in range(int(n_unique)):
+        out.append(rows[i].tobytes()[:int(lens[i])].decode("ascii"))
+    return out
+
+
+def count_words_host_result(
+        data: bytes, *, max_word_len: int = 16,
+        u_cap: int = 1 << 17) -> Optional[Dict[str, tuple]]:
+    """Run the kernel (retrying with wider kernels on overflow) and return
+    ``{word: (count, ihash)}``.
+
+    Returns None if and only if the text needs the host fallback (non-ASCII
+    bytes, or words longer than 64 bytes); callers must test ``is None`` —
+    letter-free input legitimately returns an empty dict."""
+    chunk = _pad_pow2(data)
+    dev_chunk = jnp.asarray(chunk)
+    # n_unique <= n_tokens <= n//2+1, so never allocate unique buffers past
+    # that (pow2-rounded to keep the jit shape-cache small).
+    hard_cap = 1 << (len(chunk) // 2).bit_length()
+    ladder = (max_word_len, 64) if max_word_len < 64 else (max_word_len,)
+    for mwl in ladder:
+        cap = min(u_cap, hard_cap)
+        while True:
+            packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high = (
+                count_words_kernel(dev_chunk, max_word_len=mwl, u_cap=cap))
+            if bool(has_high):
+                return None
+            if int(n_unique) > cap:
+                cap *= 4
+                continue
+            break
+        if int(max_len) > mwl:
+            continue  # retry with the wider kernel
+        nu = int(n_unique)
+        words = decode_packed(np.asarray(packed_u), np.asarray(len_u), nu)
+        counts = np.asarray(cnt_u[:nu])
+        hashes = np.asarray(fnv_u[:nu]) & 0x7FFFFFFF
+        return {w: (int(counts[i]), int(hashes[i]))
+                for i, w in enumerate(words)}
+    return None

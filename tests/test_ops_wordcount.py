@@ -1,0 +1,83 @@
+"""Kernel-vs-oracle tests for the TPU word-count ops (CPU-mesh JAX).
+
+Oracle: the host wc app semantics (``mrapps/wc.go:21-34`` — maximal letter
+runs) via regex + Counter, and the reference ``ihash`` via the pure-Python
+FNV in ``dsi_tpu.mr.worker``.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import string
+
+import pytest
+
+from dsi_tpu.apps.wc import WORD_RE
+from dsi_tpu.mr.worker import ihash
+from dsi_tpu.ops.wordcount import count_words_host_result
+
+
+def oracle_counts(text: str):
+    return collections.Counter(WORD_RE.findall(text))
+
+
+def check(text: str):
+    res = count_words_host_result(text.encode("ascii"))
+    assert res is not None
+    expect = oracle_counts(text)
+    got = {w: c for w, (c, _) in res.items()}
+    assert got == dict(expect)
+    for w, (_, h) in res.items():
+        assert h == ihash(w), w
+
+
+def test_simple():
+    check("the quick brown fox jumps over the lazy dog the end")
+
+
+def test_empty_and_no_letters():
+    assert count_words_host_result(b"") == {}
+    assert count_words_host_result(b"123 456 !!! \n\t 789") == {}
+
+
+def test_edges():
+    check("word")                      # single word, no separator
+    check("a")                         # 1-byte word
+    check("a b a b a")                 # minimal spacing (token-cap worst case)
+    check("end-of-buffer-word trailing")
+    check("Capital capital CAPITAL cApItAl")
+    check("under_score split3split digits123mixed")
+
+
+def test_long_words_retry_wider_kernel():
+    # > 16 bytes forces the 64-byte kernel retry path.
+    long_word = "supercalifragilisticexpialidocious"  # 34 letters
+    check(f"short {long_word} short {long_word}")
+
+
+def test_very_long_word_falls_back():
+    # > 64 letters: exact handling requires the host path.
+    assert count_words_host_result(b"x" * 100) is None
+
+
+def test_non_ascii_falls_back():
+    assert count_words_host_result("héllo world".encode("utf-8")) is None
+
+
+def test_random_text():
+    rng = random.Random(7)
+    seps = " \n\t.,;:!?0123456789_"
+    pieces = []
+    for _ in range(5000):
+        pieces.append("".join(rng.choice(string.ascii_letters)
+                              for _ in range(rng.randint(1, 14))))
+        pieces.append(rng.choice(seps) * rng.randint(1, 3))
+    check("".join(pieces))
+
+
+@pytest.mark.parametrize("size", [0, 1, 255, 256, 257, 4096])
+def test_padding_boundaries(size):
+    rng = random.Random(size)
+    text = "".join(rng.choice("ab c") for _ in range(size))
+    check(text)
