@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Benchmark: word-count throughput, TPU path vs the sequential oracle.
+
+This measures exactly BASELINE.json's metric — word-count MB/s on a pg-style
+corpus versus the sequential reference semantics (`main/mrsequential.go`),
+with mr-out-* diff parity as a hard gate.  The oracle is this repo's
+line-for-line-semantics port of `main/mrsequential.go:38-86`; the TPU path is
+the fused tokenize/group/count kernel (`dsi_tpu/ops/wordcount.py`) per input
+split + host merge + partitioned `mr-out-<r>` files using the reference's
+`ihash % NReduce` partitioner (`mr/worker.go:33-37,76`).
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": MB/s, "unit": "MB/s", "vs_baseline": speedup}
+`vs_baseline` is TPU MB/s over oracle MB/s measured in the same run on the
+same corpus (the reference publishes no numbers of its own — BASELINE.md).
+Parity failure reports value 0.  Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_FILES = 8
+FILE_SIZE = (2 << 20) - 64  # pads to exactly 2^21 on device
+N_REDUCE = 10
+WORKDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_oracle(files) -> tuple[list, float, float]:
+    from dsi_tpu.apps import wc
+    from dsi_tpu.mr.sequential import run_sequential
+
+    out = os.path.join(WORKDIR, "mr-correct.txt")
+    t0 = time.perf_counter()
+    run_sequential(wc.Map, wc.Reduce, files, out)
+    dt = time.perf_counter() - t0
+    with open(out) as f:
+        lines = sorted(l for l in f if l.strip())
+    total_mb = sum(os.path.getsize(p) for p in files) / 1e6
+    return lines, dt, total_mb / dt
+
+
+def run_tpu(files) -> tuple[list, float, float, dict]:
+    from dsi_tpu.ops.wordcount import count_words_host_result
+    from dsi_tpu.parallel.shuffle import write_partitioned_output
+
+    # Warm-up: compile the kernel on the first split (cached thereafter).
+    with open(files[0], "rb") as f:
+        first = f.read()
+    t0 = time.perf_counter()
+    count_words_host_result(first)
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    merged: dict = {}
+    read_s = kern_s = 0.0
+    for p in files:
+        t1 = time.perf_counter()
+        with open(p, "rb") as f:
+            raw = f.read()
+        read_s += time.perf_counter() - t1
+        t1 = time.perf_counter()
+        res = count_words_host_result(raw)
+        kern_s += time.perf_counter() - t1
+        if res is None:  # host fallback would go here; corpus is ASCII
+            raise RuntimeError(f"kernel fell back on {p}")
+        for w, (c, h) in res.items():
+            if w in merged:
+                merged[w] = (merged[w][0] + c, merged[w][1])
+            else:
+                merged[w] = (c, h % N_REDUCE)
+    t1 = time.perf_counter()
+    write_partitioned_output(merged, N_REDUCE, WORKDIR)
+    write_s = time.perf_counter() - t1
+    dt = time.perf_counter() - t0
+
+    lines = []
+    for r in range(N_REDUCE):
+        with open(os.path.join(WORKDIR, f"mr-out-{r}")) as f:
+            lines.extend(l for l in f if l.strip())
+    total_mb = sum(os.path.getsize(p) for p in files) / 1e6
+    phases = {"compile_s": round(compile_s, 3), "read_s": round(read_s, 3),
+              "kernel_s": round(kern_s, 3), "write_s": round(write_s, 3)}
+    return sorted(lines), dt, total_mb / dt, phases
+
+
+def main() -> None:
+    os.makedirs(WORKDIR, exist_ok=True)
+    from dsi_tpu.utils.corpus import ensure_corpus
+
+    files = ensure_corpus(WORKDIR, n_files=N_FILES, file_size=FILE_SIZE)
+    total_mb = sum(os.path.getsize(p) for p in files) / 1e6
+    log(f"corpus: {len(files)} files, {total_mb:.1f} MB")
+
+    import jax
+
+    log(f"devices: {jax.devices()}")
+
+    oracle_lines, oracle_s, oracle_mbps = run_oracle(files)
+    log(f"oracle (mrsequential semantics): {oracle_s:.2f}s = "
+        f"{oracle_mbps:.2f} MB/s, {len(oracle_lines)} unique words")
+
+    tpu_lines, tpu_s, tpu_mbps, phases = run_tpu(files)
+    log(f"tpu path: {tpu_s:.3f}s = {tpu_mbps:.2f} MB/s  phases={phases}")
+
+    parity = tpu_lines == oracle_lines
+    log(f"parity (sort mr-out-* vs oracle, test-mr.sh:52-53): {parity}")
+    if not parity:
+        for i, (a, b) in enumerate(zip(tpu_lines, oracle_lines)):
+            if a != b:
+                log(f"first diff at {i}: tpu={a!r} oracle={b!r}")
+                break
+        print(json.dumps({"metric": "wc_tpu_throughput", "value": 0,
+                          "unit": "MB/s", "vs_baseline": 0,
+                          "error": "parity mismatch"}))
+        sys.exit(1)
+
+    print(json.dumps({
+        "metric": "wc_tpu_throughput",
+        "value": round(tpu_mbps, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(tpu_mbps / oracle_mbps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

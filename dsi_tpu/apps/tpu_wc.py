@@ -1,0 +1,43 @@
+"""tpu_wc: word count with an on-device map-side combiner.
+
+This is the plugin BASELINE.json's north star calls ``mrapps/tpuwc.go``: the
+same job as ``wc`` (reference ``mrapps/wc.go:21-44``) but the map task's
+tokenize/bucket hot loop (``mr/worker.go:69-78``) runs as the fused TPU
+kernel in ``dsi_tpu/ops/wordcount.py`` via the ``--backend=tpu`` worker flag.
+
+Map emits one record per *unique* word per split, valued with its in-split
+count (a combiner), so Reduce sums counts instead of counting occurrences.
+The merged ``mr-out-*`` output is byte-identical to ``wc``'s — only the
+intermediate record multiplicity differs, which the differential harness
+deliberately ignores (it compares final output, test-mr.sh:52-53).
+
+The host ``Map`` below is the exact fallback the TPU runner uses for
+non-ASCII splits, so correctness never depends on the kernel.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Optional
+
+from dsi_tpu.apps.wc import WORD_RE
+from dsi_tpu.mr.types import KeyValue
+
+
+def Map(filename: str, contents: str) -> List[KeyValue]:
+    counts = Counter(WORD_RE.findall(contents))
+    return [KeyValue(w, str(c)) for w, c in sorted(counts.items())]
+
+
+def Reduce(key: str, values: List[str]) -> str:
+    return str(sum(int(v) for v in values))
+
+
+def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
+    """Device map: fused tokenize/group/count; None -> host fallback."""
+    from dsi_tpu.ops.wordcount import count_words_host_result
+
+    res = count_words_host_result(raw)
+    if res is None:
+        return None
+    return [KeyValue(w, str(c)) for w, (c, _) in sorted(res.items())]

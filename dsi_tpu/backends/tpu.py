@@ -31,6 +31,14 @@ class TpuTaskRunner:
         self.app = app_module
         self.tpu_map = getattr(app_module, "tpu_map", None)
         self.tpu_reduce = getattr(app_module, "tpu_reduce", None)
+        if self.tpu_map is None and self.tpu_reduce is None:
+            import sys
+
+            print(
+                f"mrworker: app {getattr(app_module, '__name__', app_module)} "
+                "declares no tpu_map/tpu_reduce; --backend=tpu will run every "
+                "task on the host path (use the tpu_wc app for the device "
+                "word-count kernel)", file=sys.stderr)
 
     @classmethod
     def for_app(cls, name_or_path: str) -> "TpuTaskRunner":

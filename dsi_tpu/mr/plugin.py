@@ -21,22 +21,47 @@ from typing import Tuple
 from dsi_tpu.mr.worker import MapFn, ReduceFn
 
 
-def load_plugin(name_or_path: str) -> Tuple[MapFn, ReduceFn]:
+def load_plugin_module(name_or_path: str):
+    """Load the app module itself (the .so analogue, mrworker.go:36-38).
+
+    Path-based plugins are cached in sys.modules so a worker that loads the
+    same app twice (e.g. load_plugin + TpuTaskRunner.for_app) gets ONE module
+    instance — module-level state must not fork between the host-fallback
+    Map and tpu_map.
+    """
     if name_or_path.endswith(".py") or os.sep in name_or_path:
-        spec = importlib.util.spec_from_file_location(
-            "dsi_mr_app_" + os.path.basename(name_or_path).removesuffix(".py"),
-            name_or_path)
+        import hashlib
+        import sys
+
+        abspath = os.path.abspath(name_or_path)
+        mod_name = ("dsi_mr_app_"
+                    + os.path.basename(abspath).removesuffix(".py") + "_"
+                    + hashlib.md5(abspath.encode()).hexdigest()[:8])
+        if mod_name in sys.modules:
+            return sys.modules[mod_name]
+        spec = importlib.util.spec_from_file_location(mod_name, abspath)
         if spec is None or spec.loader is None:
             raise SystemExit(f"cannot load plugin {name_or_path}")
         mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        sys.modules[mod_name] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            del sys.modules[mod_name]
+            raise
     else:
         try:
             mod = importlib.import_module(f"dsi_tpu.apps.{name_or_path}")
         except ImportError as e:
             raise SystemExit(
                 f"cannot load plugin {name_or_path!r}: {e} "
-                f"(registered apps: wc, grep, indexer, crash, nocrash)")
+                f"(registered apps: wc, tpu_wc, grep, indexer, crash, "
+                f"nocrash)")
+    return mod
+
+
+def load_plugin(name_or_path: str) -> Tuple[MapFn, ReduceFn]:
+    mod = load_plugin_module(name_or_path)
     try:
         mapf, reducef = mod.Map, mod.Reduce  # the two-symbol lookup (mrworker.go:39-47)
     except AttributeError as e:

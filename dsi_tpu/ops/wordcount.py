@@ -29,7 +29,6 @@ is always exact.
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Optional
 
 import jax
@@ -83,13 +82,17 @@ def fnv1a32_packed(packed: jax.Array, lengths: jax.Array,
     return h
 
 
-@functools.partial(jax.jit, static_argnames=("max_word_len", "u_cap"))
-def count_words_kernel(chunk: jax.Array, *, max_word_len: int = 16,
-                       u_cap: int = 1 << 17):
+def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
+                        u_cap: int = 1 << 17):
     """Exact unique-word counts over one uint8 chunk (zero-padded tail).
 
     Returns (packed_u [u_cap, K] uint32, len_u [u_cap] i32, cnt_u [u_cap] i32,
     fnv_u [u_cap] u32, n_unique i32, max_len i32, has_high bool).
+
+    Not jitted itself so it can be inlined into larger programs (the
+    ``shard_map`` SPMD step in ``dsi_tpu/parallel/shuffle.py`` traces it per
+    device before the ``all_to_all`` shuffle); ``count_words_kernel`` below is
+    the jitted single-chunk entry point.
     """
     n = chunk.shape[0]
     k = max_word_len // 4
@@ -133,6 +136,10 @@ def count_words_kernel(chunk: jax.Array, *, max_word_len: int = 16,
     return packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high
 
 
+count_words_kernel = jax.jit(tokenize_group_core,
+                             static_argnames=("max_word_len", "u_cap"))
+
+
 def _pad_pow2(data: bytes, min_size: int = 256) -> np.ndarray:
     """Zero-pad to the next power of two so jit caches a few shapes only.
     Zero bytes are non-letters, so padding can't create or extend tokens."""
@@ -145,13 +152,18 @@ def _pad_pow2(data: bytes, min_size: int = 256) -> np.ndarray:
 
 def decode_packed(packed_u: np.ndarray, len_u: np.ndarray,
                   n_unique: int) -> list:
-    """Host detokenization: packed big-endian uint32 rows -> word strings."""
-    rows = np.asarray(packed_u[:n_unique]).astype(">u4")
-    lens = np.asarray(len_u[:n_unique])
-    out = []
-    for i in range(int(n_unique)):
-        out.append(rows[i].tobytes()[:int(lens[i])].decode("ascii"))
-    return out
+    """Host detokenization: packed big-endian uint32 rows -> word strings.
+
+    One bulk byteswap + tobytes for the whole table, then cheap slices —
+    no per-row numpy scalar extraction (this sits on bench.py's timed path).
+    """
+    nu = int(n_unique)
+    rows = np.ascontiguousarray(np.asarray(packed_u[:nu])).astype(">u4")
+    buf = rows.tobytes()
+    stride = rows.shape[1] * 4
+    lens = np.asarray(len_u[:nu]).tolist()
+    return [buf[i * stride:i * stride + lens[i]].decode("ascii")
+            for i in range(nu)]
 
 
 def count_words_host_result(

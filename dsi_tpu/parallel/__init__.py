@@ -1,0 +1,13 @@
+"""Multi-device SPMD execution: the on-chip data plane.
+
+The reference's shuffle is NxM JSON files on a shared filesystem
+(``mr/worker.go:81-92, 102-121``).  Here the same exchange is a single
+``jax.lax.all_to_all`` over the ICI mesh inside one compiled SPMD program —
+SURVEY.md §2's prescribed TPU-native equivalent and §7 step 5.
+"""
+
+from dsi_tpu.parallel.shuffle import (  # noqa: F401
+    default_mesh,
+    shard_text,
+    wordcount_sharded,
+)

@@ -1,0 +1,244 @@
+"""SPMD MapReduce step: map + shuffle + reduce as ONE compiled program.
+
+This is the multi-device redesign of the reference's whole data path:
+
+* map phase  = per-device tokenize/group (``tokenize_group_core``), replacing
+  the worker's mapf + bucketing hot loops (``mr/worker.go:69-92``),
+* shuffle    = ``jax.lax.all_to_all`` over the device mesh, replacing the
+  NxM ``mr-<m>-<r>`` intermediate files on a shared filesystem
+  (``mr/worker.go:81-92, 102-121``) — the exchange rides ICI, not disk,
+* reduce     = per-device sort + segment-sum of the received records,
+  replacing the reduce task's decode/sort/group/count
+  (``mr/worker.go:110-146``).
+
+Partitioning semantics are bit-identical to the reference: a word belongs to
+reduce partition ``r = fnv1a32(word) & 0x7fffffff % NReduce``
+(``mr/worker.go:33-37,76``); partitions are mapped to devices round-robin
+(``r % n_dev``), so every device ends up owning exactly the reduce partitions
+``{r : r % n_dev == device}`` and the map-barrier-then-reduce structure of the
+reference (``mr/coordinator.go:47,79``) is preserved *inside* the program: the
+all_to_all is the barrier.
+
+Everything is static-shaped for XLA: the send buffer gives each destination a
+fixed ``u_cap``-row block (a device has at most ``u_cap`` unique words total,
+so a per-destination block of the same size can never overflow); pad rows
+carry key ``0xFFFFFFFF`` which sorts after every real ASCII word.  Exactness
+escapes (non-ASCII bytes, words longer than ``max_word_len``, more uniques
+than ``u_cap``) are returned as per-device flags; the host wrapper retries
+with wider shapes or falls back to the host path, so results are always
+exact (same discipline as ``ops/wordcount.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dsi_tpu.ops.wordcount import (
+    _PAD_KEY,
+    decode_packed,
+    tokenize_group_core,
+)
+
+AXIS = "workers"
+
+
+def default_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D device mesh over the first n (default: all) local devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
+                 max_word_len: int, u_cap: int):
+    """Per-device body (runs under shard_map): map, all_to_all, reduce."""
+    k = max_word_len // 4
+    chunk = chunk.reshape(-1)  # [1, L] block -> [L]
+
+    # ── map: tokenize + local combine (one record per unique word) ──
+    packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high = (
+        tokenize_group_core(chunk, max_word_len=max_word_len, u_cap=u_cap))
+    uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
+    part = (fnv_u & jnp.uint32(0x7FFFFFFF)) % jnp.uint32(n_reduce)
+    dest = jnp.where(uvalid, (part % n_dev).astype(jnp.int32), n_dev)
+
+    # ── build the send buffer: one fixed u_cap-row block per destination ──
+    rows = jnp.concatenate(
+        [packed_u, len_u[:, None].astype(jnp.uint32),
+         cnt_u[:, None].astype(jnp.uint32), part[:, None]], axis=1)
+    order = jnp.argsort(dest, stable=True)
+    sdest = dest[order]
+    srows = rows[order]
+    counts = jnp.bincount(sdest, length=n_dev + 1).astype(jnp.int32)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    pos_in = jnp.arange(u_cap, dtype=jnp.int32) - starts[sdest]
+    flat = jnp.where(sdest < n_dev, sdest * u_cap + pos_in, n_dev * u_cap)
+    pad_row = jnp.concatenate(
+        [jnp.full((k,), _PAD_KEY, jnp.uint32), jnp.zeros((3,), jnp.uint32)])
+    sendbuf = jnp.broadcast_to(pad_row, (n_dev * u_cap + 1, k + 3))
+    sendbuf = sendbuf.at[flat].set(srows)[:n_dev * u_cap]
+
+    # ── shuffle: the mr-X-Y files become one ICI collective ──
+    recv = lax.all_to_all(sendbuf, AXIS, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+    # ── reduce: sort received records by word, sum counts per run ──
+    out_cap = n_dev * u_cap
+    rkeys = tuple(recv[:, j] for j in range(k))
+    rlen = recv[:, k]
+    rcnt = recv[:, k + 1]
+    rpart = recv[:, k + 2]
+    sorted_ops = lax.sort(rkeys + (rlen, rcnt, rpart), num_keys=k)
+    mkeys = jnp.stack(sorted_ops[:k], axis=1)
+    mlen = sorted_ops[k].astype(jnp.int32)
+    mcnt = sorted_ops[k + 1].astype(jnp.int32)
+    mpart = sorted_ops[k + 2]
+    mvalid = mkeys[:, 0] != jnp.uint32(_PAD_KEY)
+    prev = jnp.concatenate(
+        [jnp.full((1, k), _PAD_KEY, jnp.uint32), mkeys[:-1]], axis=0)
+    is_new = jnp.any(mkeys != prev, axis=1) & mvalid
+    m_unique = jnp.sum(is_new, dtype=jnp.int32)
+    uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    tot = jax.ops.segment_sum(
+        jnp.where(mvalid, mcnt, 0), jnp.where(mvalid, uid, out_cap),
+        num_segments=out_cap + 1)[:out_cap]
+    (upos,) = jnp.nonzero(is_new, size=out_cap, fill_value=out_cap - 1)
+    ovalid = jnp.arange(out_cap, dtype=jnp.int32) < m_unique
+    out_keys = jnp.where(ovalid[:, None], mkeys[upos], 0)
+    out_len = jnp.where(ovalid, mlen[upos], 0)
+    out_part = jnp.where(ovalid, mpart[upos], 0)
+
+    scalars = jnp.stack([m_unique, n_unique, max_len,
+                         has_high.astype(jnp.int32)])
+    return (out_keys[None], out_len[None], tot[None], out_part[None],
+            scalars[None])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_dev", "n_reduce", "max_word_len",
+                                    "u_cap", "mesh"))
+def mapreduce_step(chunks: jax.Array, *, n_dev: int, n_reduce: int,
+                   max_word_len: int, u_cap: int, mesh: Mesh):
+    """The full SPMD job step, jitted over the mesh.
+
+    ``chunks``: [n_dev, L] uint8, one zero-padded text shard per device.
+    Returns per-device arrays stacked on axis 0: packed word keys
+    [D, D*u_cap, K], byte lengths, summed counts, reduce-partition ids, and a
+    [D, 4] scalar block (m_unique, n_unique, max_len, has_high).
+    """
+    body = functools.partial(_device_step, n_dev=n_dev, n_reduce=n_reduce,
+                             max_word_len=max_word_len, u_cap=u_cap)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=P(AXIS, None),
+        out_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                   P(AXIS, None), P(AXIS, None)))(chunks)
+
+
+def shard_text(data: bytes, n_shards: int) -> Tuple[np.ndarray, int]:
+    """Split text into n equal-ish device shards, cutting only at non-letter
+    boundaries so no token straddles a shard (SURVEY.md §7 hard part 2), and
+    zero-pad all shards to one power-of-two length.
+
+    Returns ([n_shards, L] uint8, L).
+    """
+    n = len(data)
+    cuts = [0]
+    for i in range(1, n_shards):
+        c = min(i * n // n_shards, n)
+        # Advance past any letter run so data[c-1], data[c] are never both
+        # letters (a cut inside a run would split a token).
+        while 0 < c < n and _is_letter_byte(data[c - 1]) and \
+                _is_letter_byte(data[c]):
+            c += 1
+        cuts.append(min(c, n))
+    cuts.append(n)
+    cuts = sorted(cuts)
+    longest = max(cuts[i + 1] - cuts[i] for i in range(n_shards))
+    size = 1 << max(8, longest.bit_length())
+    out = np.zeros((n_shards, size), dtype=np.uint8)
+    for i in range(n_shards):
+        piece = data[cuts[i]:cuts[i + 1]]
+        out[i, :len(piece)] = np.frombuffer(piece, dtype=np.uint8)
+    return out, size
+
+
+def _is_letter_byte(b: int) -> bool:
+    return (65 <= b <= 90) or (97 <= b <= 122)
+
+
+def wordcount_sharded(
+        data: bytes, mesh: Mesh | None = None, n_reduce: int = 10,
+        max_word_len: int = 16,
+        u_cap: int = 1 << 15) -> Optional[Dict[str, Tuple[int, int]]]:
+    """Count words over the whole corpus with one SPMD program per attempt.
+
+    Returns ``{word: (count, reduce_partition)}`` — exact, or None when the
+    input needs the host path (non-ASCII bytes or words longer than 64).
+    Retries with wider static shapes on capacity overflow, mirroring
+    ``ops.wordcount.count_words_host_result``.
+    """
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    chunks_np, shard_len = shard_text(data, n_dev)
+    chunks = jnp.asarray(chunks_np)
+    hard_cap = 1 << (shard_len // 2).bit_length()
+    ladder = (max_word_len, 64) if max_word_len < 64 else (max_word_len,)
+    for mwl in ladder:
+        cap = min(u_cap, hard_cap)
+        while True:
+            keys, lens, cnts, parts, scal = mapreduce_step(
+                chunks, n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
+                u_cap=cap, mesh=mesh)
+            scal = np.asarray(scal)
+            if scal[:, 3].any():
+                return None  # non-ASCII somewhere -> host fallback
+            if (scal[:, 1] > cap).any():
+                cap *= 4
+                continue
+            break
+        if (scal[:, 2] > mwl).any():
+            continue  # a word overflowed the packed window: widen kernel
+        keys, lens, cnts, parts = (np.asarray(keys), np.asarray(lens),
+                                   np.asarray(cnts), np.asarray(parts))
+        result: Dict[str, Tuple[int, int]] = {}
+        for d in range(n_dev):
+            nu = int(scal[d, 0])
+            for i, w in enumerate(decode_packed(keys[d], lens[d], nu)):
+                result[w] = (int(cnts[d, i]), int(parts[d, i]))
+        return result
+    return None
+
+
+def write_partitioned_output(result: Dict[str, Tuple[int, int]],
+                             n_reduce: int, workdir: str = ".") -> List[str]:
+    """Materialise mr-out-<r> files from a sharded result — same file layout,
+    line format ("%v %v\\n", mr/worker.go:144) and within-file key order the
+    reference's reduce tasks produce (worker.go:124-146)."""
+    import os
+
+    from dsi_tpu.utils.atomicio import atomic_write
+
+    by_part: List[List[Tuple[str, int]]] = [[] for _ in range(n_reduce)]
+    for w, (c, r) in result.items():
+        by_part[r].append((w, c))
+    paths = []
+    for r in range(n_reduce):
+        path = os.path.join(workdir, f"mr-out-{r}")
+        with atomic_write(path) as f:
+            for w, c in sorted(by_part[r]):
+                f.write(f"{w} {c}\n")
+        paths.append(path)
+    return paths

@@ -1,0 +1,60 @@
+"""Measure compile + steady-state cost of the kernel's building blocks on
+the real chip, to direct optimization (not part of the test suite)."""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jaxcache")
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+print("devices", jax.devices(), flush=True)
+rng = np.random.default_rng(0)
+
+
+def bench(name, fn, *args):
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    print(f"{name}: compile {compile_s:.2f}s steady {min(times)*1e3:.1f}ms",
+          flush=True)
+
+
+for n in (1 << 18, 1 << 20):
+    keys = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
+    pay = [jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
+           for _ in range(5)]
+
+    bench(f"sort1op n={n}", jax.jit(lambda x: lax.sort((x,), num_keys=1)),
+          keys)
+    bench(f"sort2op n={n}",
+          jax.jit(lambda x, p: lax.sort((x, p), num_keys=1)), keys, pay[0])
+    bench(f"sort6op n={n}",
+          jax.jit(lambda x, *p: lax.sort((x,) + p, num_keys=4)), keys, *pay)
+    bench(f"argsort n={n}", jax.jit(lambda x: jnp.argsort(x)), keys)
+
+    mask = jnp.asarray(rng.random(n) < 0.3)
+    bench(f"nonzero n={n}",
+          jax.jit(lambda m: jnp.nonzero(m, size=n // 2, fill_value=0)), mask)
+    bench(f"cumsum n={n}", jax.jit(lambda m: jnp.cumsum(m.astype(jnp.int32))),
+          mask)
+
+    idx = jnp.asarray(rng.integers(0, n, size=(n // 2, 16), dtype=np.int32))
+    data = jnp.asarray(rng.integers(0, 255, size=n, dtype=np.uint8))
+    bench(f"gather {n//2}x16", jax.jit(lambda d, i: d[i]), data, idx)
+
+    seg = jnp.asarray(np.sort(rng.integers(0, n // 2, size=n,
+                                           dtype=np.int32)))
+    vals = jnp.asarray(rng.integers(0, 100, size=n, dtype=np.int32))
+    bench(f"segsum n={n}",
+          jax.jit(lambda v, s: jax.ops.segment_sum(v, s, num_segments=n // 2)),
+          vals, seg)

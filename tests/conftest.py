@@ -9,9 +9,22 @@ driver's dryrun does).  These env vars must be set before the first
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points JAX at a TPU: the test
+# suite validates logic and sharding on an 8-device virtual mesh; real-TPU
+# runs happen via bench.py.  DSI_TEST_PLATFORM overrides for TPU smoke runs.
+# The env var alone is not enough when a sitecustomize pre-registers a TPU
+# plugin, so also pin the platform through jax.config before backends init.
+_platform = os.environ.get("DSI_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", _platform)
+except ImportError:
+    pass

@@ -1,0 +1,85 @@
+"""Differential test of the --backend=tpu execution path.
+
+Same discipline as test-mr.sh (oracle vs distributed, merged-sorted-compare,
+test-mr.sh:52-53), but the worker executes map tasks through TpuTaskRunner +
+the tpu_wc device kernel.  Runs on the CPU platform (conftest.py) — the
+kernel is platform-agnostic JAX, so this validates the whole route without
+hardware.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from dsi_tpu.backends.tpu import TpuTaskRunner
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr.coordinator import make_coordinator
+from dsi_tpu.mr.plugin import load_plugin
+from dsi_tpu.mr.worker import worker_loop
+from dsi_tpu.utils.corpus import ensure_corpus
+from tests.harness import merged_output, oracle_output
+
+
+@pytest.mark.slow
+def test_tpu_backend_distributed_parity(tmp_path):
+    wd = str(tmp_path)
+    files = ensure_corpus(os.path.join(wd, "inputs"), n_files=4,
+                          file_size=60_000)
+    want = oracle_output("wc", files, wd)
+
+    cfg = JobConfig(n_reduce=10, workdir=wd,
+                    socket_path=os.path.join(wd, "mr.sock"),
+                    wait_sleep_s=0.05)
+    mapf, reducef = load_plugin("tpu_wc")
+    runner = TpuTaskRunner.for_app("tpu_wc")
+    assert runner.tpu_map is not None
+    c = make_coordinator(files, 10, cfg)
+    try:
+        workers = [
+            threading.Thread(target=worker_loop,
+                             args=(mapf, reducef, cfg),
+                             kwargs={"task_runner": runner}, daemon=True)
+            for _ in range(2)
+        ]
+        for w in workers:
+            w.start()
+        deadline = time.time() + 120
+        while not c.done():
+            assert time.time() < deadline, "tpu-backend job hung"
+            time.sleep(0.05)
+        for w in workers:
+            w.join(timeout=10)
+    finally:
+        c.close()
+
+    assert merged_output(wd) == want
+
+
+def test_tpu_wc_app_host_semantics_match_wc():
+    """tpu_wc's combiner Map + summing Reduce == wc's Map + counting Reduce."""
+    from dsi_tpu.apps import tpu_wc, wc
+
+    text = "the cat and the hat and The end\nthe cat"
+    h = {}
+    for kv in wc.Map("f", text):
+        h.setdefault(kv.key, []).append(kv.value)
+    want = {k: wc.Reduce(k, v) for k, v in h.items()}
+
+    t = {}
+    for kv in tpu_wc.Map("f", text):
+        t.setdefault(kv.key, []).append(kv.value)
+    got = {k: tpu_wc.Reduce(k, v) for k, v in t.items()}
+    assert got == want
+
+
+def test_tpu_map_fallback_on_non_ascii():
+    from dsi_tpu.apps import tpu_wc
+
+    assert tpu_wc.tpu_map("f", "héllo".encode("utf-8")) is None
+    kva = tpu_wc.tpu_map("f", b"plain ascii text plain")
+    assert kva is not None
+    assert {kv.key: kv.value for kv in kva}["plain"] == "2"
