@@ -115,9 +115,13 @@ def main() -> None:
     parity = tpu_lines == oracle_lines
     log(f"parity (sort mr-out-* vs oracle, test-mr.sh:52-53): {parity}")
     if not parity:
-        for i, (a, b) in enumerate(zip(tpu_lines, oracle_lines)):
+        import itertools
+
+        for i, (a, b) in enumerate(
+                itertools.zip_longest(tpu_lines, oracle_lines)):
             if a != b:
-                log(f"first diff at {i}: tpu={a!r} oracle={b!r}")
+                log(f"first diff at line {i}: tpu={a!r} oracle={b!r} "
+                    f"(lines: tpu={len(tpu_lines)} oracle={len(oracle_lines)})")
                 break
         print(json.dumps({"metric": "wc_tpu_throughput", "value": 0,
                           "unit": "MB/s", "vs_baseline": 0,

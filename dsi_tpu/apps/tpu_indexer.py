@@ -1,0 +1,25 @@
+"""tpu_indexer: inverted index with the unique-word extraction on device.
+
+Same job as ``indexer`` (BASELINE.json's string-valued-reduce config): Map
+emits one ``{word, document}`` pair per distinct word per document, Reduce
+returns ``"<count> <doc1>,<doc2>,..."``.  The per-document distinct-word set
+is exactly the unique-word table the fused TPU kernel already produces
+(``dsi_tpu/ops/wordcount.py``), so the device map is the kernel minus the
+counts.  Host ``Map`` is the exact non-ASCII fallback.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from dsi_tpu.apps.indexer import Map, Reduce  # noqa: F401  (host fallback)
+from dsi_tpu.mr.types import KeyValue
+
+
+def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
+    from dsi_tpu.ops.wordcount import count_words_host_result
+
+    res = count_words_host_result(raw)
+    if res is None:
+        return None
+    return [KeyValue(w, filename) for w in sorted(res)]

@@ -42,6 +42,13 @@ class TpuTaskRunner:
 
     @classmethod
     def for_app(cls, name_or_path: str) -> "TpuTaskRunner":
+        import os
+
+        plat = os.environ.get("DSI_JAX_PLATFORM")
+        if plat:  # pin the JAX platform (e.g. cpu for harness runs — the
+            import jax  # env var alone can't override a sitecustomize plugin)
+
+            jax.config.update("jax_platforms", plat)
         return cls(load_plugin_module(name_or_path))
 
     def run_map(self, mapf, filename: str, map_task: int, n_reduce: int,

@@ -1,0 +1,55 @@
+"""CLI for the vmapped crash-test model checker.
+
+Usage: python -m dsi_tpu.cli.crashcheck [-n 1000] [--exit-prob 0.25]
+           [--stall-prob 0.2] [--timeout 10] [--horizon 800]
+           [--platform cpu|tpu|default]
+
+Prints one JSON line of aggregate invariant results (see
+``dsi_tpu/parallel/simulate.py``).  ``--platform cpu`` pins JAX to the host
+CPU before backend init — on this machine the TPU's first-contact compile
+latency makes CPU the right place for quick checks; the TPU is the right
+place for very large fleets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("-n", "--instances", type=int, default=1000)
+    p.add_argument("--exit-prob", type=float, default=0.25)
+    p.add_argument("--stall-prob", type=float, default=0.2)
+    p.add_argument("--timeout", type=int, default=10)
+    p.add_argument("--horizon", type=int, default=800)
+    p.add_argument("--n-map", type=int, default=8)
+    p.add_argument("--n-reduce", type=int, default=10)
+    p.add_argument("--n-workers", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", choices=("cpu", "tpu", "default"),
+                   default="cpu")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif args.platform == "tpu":
+        pass  # whatever accelerator the environment registers
+
+    from dsi_tpu.parallel.simulate import run_crash_model_check
+
+    agg = run_crash_model_check(
+        args.instances, seed=args.seed, n_map=args.n_map,
+        n_reduce=args.n_reduce, n_workers=args.n_workers,
+        timeout=args.timeout, horizon=args.horizon,
+        exit_prob=args.exit_prob, stall_prob=args.stall_prob)
+    print(json.dumps(agg))
+    ok = agg["all_finished"] and agg["all_consistent"] and agg["all_safe"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -166,6 +166,35 @@ def decode_packed(packed_u: np.ndarray, len_u: np.ndarray,
             for i in range(nu)]
 
 
+def exactness_retry(run, shard_len: int, max_word_len: int, u_cap: int):
+    """Shared overflow/retry discipline for the static-shape kernels.
+
+    ``run(mwl, cap)`` executes a kernel attempt and returns
+    ``(has_high, n_unique_max, max_len, payload)`` where the first three are
+    host scalars summarising every shard of the attempt.  Retries with
+    ``cap*4`` while uniques overflow (bounded by the token-count hard cap
+    n//2+1, pow2-rounded to keep the jit shape-cache small), then with a
+    64-byte word window if a word overflowed the packed window.  Returns the
+    successful payload, or None when the input needs the host path
+    (non-ASCII bytes, or words longer than 64)."""
+    hard_cap = 1 << (shard_len // 2).bit_length()
+    ladder = (max_word_len, 64) if max_word_len < 64 else (max_word_len,)
+    for mwl in ladder:
+        cap = min(u_cap, hard_cap)
+        while True:
+            has_high, n_unique_max, max_len, payload = run(mwl, cap)
+            if has_high:
+                return None
+            if n_unique_max > cap:
+                cap *= 4
+                continue
+            break
+        if max_len > mwl:
+            continue  # a word overflowed the packed window: widen kernel
+        return payload
+    return None
+
+
 def count_words_host_result(
         data: bytes, *, max_word_len: int = 16,
         u_cap: int = 1 << 17) -> Optional[Dict[str, tuple]]:
@@ -177,27 +206,20 @@ def count_words_host_result(
     letter-free input legitimately returns an empty dict."""
     chunk = _pad_pow2(data)
     dev_chunk = jnp.asarray(chunk)
-    # n_unique <= n_tokens <= n//2+1, so never allocate unique buffers past
-    # that (pow2-rounded to keep the jit shape-cache small).
-    hard_cap = 1 << (len(chunk) // 2).bit_length()
-    ladder = (max_word_len, 64) if max_word_len < 64 else (max_word_len,)
-    for mwl in ladder:
-        cap = min(u_cap, hard_cap)
-        while True:
-            packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high = (
-                count_words_kernel(dev_chunk, max_word_len=mwl, u_cap=cap))
-            if bool(has_high):
-                return None
-            if int(n_unique) > cap:
-                cap *= 4
-                continue
-            break
-        if int(max_len) > mwl:
-            continue  # retry with the wider kernel
+
+    def run(mwl: int, cap: int):
+        packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high = (
+            count_words_kernel(dev_chunk, max_word_len=mwl, u_cap=cap))
         nu = int(n_unique)
-        words = decode_packed(np.asarray(packed_u), np.asarray(len_u), nu)
-        counts = np.asarray(cnt_u[:nu])
-        hashes = np.asarray(fnv_u[:nu]) & 0x7FFFFFFF
-        return {w: (int(counts[i]), int(hashes[i]))
-                for i, w in enumerate(words)}
-    return None
+
+        def payload():
+            words = decode_packed(np.asarray(packed_u), np.asarray(len_u), nu)
+            counts = np.asarray(cnt_u[:nu])
+            hashes = np.asarray(fnv_u[:nu]) & 0x7FFFFFFF
+            return {w: (int(counts[i]), int(hashes[i]))
+                    for i, w in enumerate(words)}
+
+        return bool(has_high), nu, int(max_len), payload
+
+    payload = exactness_retry(run, len(chunk), max_word_len, u_cap)
+    return None if payload is None else payload()

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dsi_tpu.ops.wordcount import (
     _PAD_KEY,
     decode_packed,
+    exactness_retry,
     tokenize_group_core,
 )
 
@@ -194,32 +195,28 @@ def wordcount_sharded(
     n_dev = mesh.devices.size
     chunks_np, shard_len = shard_text(data, n_dev)
     chunks = jnp.asarray(chunks_np)
-    hard_cap = 1 << (shard_len // 2).bit_length()
-    ladder = (max_word_len, 64) if max_word_len < 64 else (max_word_len,)
-    for mwl in ladder:
-        cap = min(u_cap, hard_cap)
-        while True:
-            keys, lens, cnts, parts, scal = mapreduce_step(
-                chunks, n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
-                u_cap=cap, mesh=mesh)
-            scal = np.asarray(scal)
-            if scal[:, 3].any():
-                return None  # non-ASCII somewhere -> host fallback
-            if (scal[:, 1] > cap).any():
-                cap *= 4
-                continue
-            break
-        if (scal[:, 2] > mwl).any():
-            continue  # a word overflowed the packed window: widen kernel
-        keys, lens, cnts, parts = (np.asarray(keys), np.asarray(lens),
-                                   np.asarray(cnts), np.asarray(parts))
-        result: Dict[str, Tuple[int, int]] = {}
-        for d in range(n_dev):
-            nu = int(scal[d, 0])
-            for i, w in enumerate(decode_packed(keys[d], lens[d], nu)):
-                result[w] = (int(cnts[d, i]), int(parts[d, i]))
-        return result
-    return None
+
+    def run(mwl: int, cap: int):
+        keys, lens, cnts, parts, scal = mapreduce_step(
+            chunks, n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
+            u_cap=cap, mesh=mesh)
+        scal = np.asarray(scal)
+
+        def payload():
+            k, l, c, p = (np.asarray(keys), np.asarray(lens),
+                          np.asarray(cnts), np.asarray(parts))
+            result: Dict[str, Tuple[int, int]] = {}
+            for d in range(n_dev):
+                nu = int(scal[d, 0])
+                for i, w in enumerate(decode_packed(k[d], l[d], nu)):
+                    result[w] = (int(c[d, i]), int(p[d, i]))
+            return result
+
+        return (bool(scal[:, 3].any()), int(scal[:, 1].max()),
+                int(scal[:, 2].max()), payload)
+
+    payload = exactness_retry(run, shard_len, max_word_len, u_cap)
+    return None if payload is None else payload()
 
 
 def write_partitioned_output(result: Dict[str, Tuple[int, int]],

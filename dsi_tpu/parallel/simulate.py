@@ -1,0 +1,236 @@
+"""Vmapped crash-test model checking of the scheduler state machine.
+
+BASELINE.json's fault config asks for "1000 vmapped job instances, randomized
+worker death".  This module expresses the coordinator's entire scheduling +
+fault-tolerance state machine (``mr/coordinator.go``: per-task 0/1/2 logs,
+first-untouched assignment :50-55, map barrier :47,79, presumed-dead-by-
+timeout requeue :70-77,99-106, completion counting :27-41, Done :138-142) as
+a pure, static-shape JAX program over integer state, then ``jax.vmap``s it
+over thousands of PRNG-seeded instances — every instance a full MapReduce job
+with randomized worker crashes, stalls, and duplicate completions, all
+advancing in lockstep on one chip.
+
+This is the TPU-native answer to the reference's race-detector testing
+(``test-mr.sh:10,19-22`` builds with `-race`; SURVEY.md §5): instead of
+hoping 3 OS processes interleave interestingly, we *enumerate* thousands of
+adversarial schedules per second and machine-check the invariants:
+
+* liveness  — every instance reaches Done within the horizon,
+* safety    — Done implies every task log is COMPLETED,
+* barrier   — no reduce task is ever assigned while a map task is incomplete,
+* the reference's double-count defect (counters bumped on every completion
+  RPC, ``mr/coordinator.go:30-31,38-39``) is simulated side-by-side: the
+  checker reports how many instances WOULD have opened the reduce barrier
+  early under the buggy counter, demonstrating why this rebuild counts
+  unique log transitions instead (coordinator.py).
+
+Worker fault model (mirrors apps/crash.py and the MIT crash.go it's modeled
+on): on assignment a worker draws its fate — exit (dies silently; its task
+sits in-progress until the timeout requeues it), stall (finishes after the
+requeue fires, so a second worker may also run the task and one of the two
+completion reports is a duplicate), or normal completion.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+U = 0  # LOG_UNTOUCHED   (mr/coordinator.go task-log states)
+P = 1  # LOG_IN_PROGRESS
+C = 2  # LOG_COMPLETED
+
+
+class SimState(NamedTuple):
+    t: jnp.ndarray                # current tick
+    map_log: jnp.ndarray          # [n_map] {0,1,2}
+    map_deadline: jnp.ndarray     # [n_map] requeue tick for in-progress
+    c_map: jnp.ndarray            # unique-transition completion counter
+    c_map_buggy: jnp.ndarray      # reference-style every-RPC counter
+    reduce_log: jnp.ndarray       # [n_reduce]
+    reduce_deadline: jnp.ndarray
+    c_reduce: jnp.ndarray
+    c_reduce_buggy: jnp.ndarray
+    busy_until: jnp.ndarray       # [n_workers] 0 = idle
+    wkind: jnp.ndarray            # [n_workers] -1 none / 0 map / 1 reduce
+    wtask: jnp.ndarray            # [n_workers]
+    wfate: jnp.ndarray            # [n_workers] 0 ok / 1 stall / 2 exit
+    n_requeues: jnp.ndarray
+    n_duplicates: jnp.ndarray
+    barrier_violation: jnp.ndarray       # bool, checked invariant
+    buggy_early_barrier: jnp.ndarray     # bool, simulated reference defect
+
+
+def _first_untouched(log: jnp.ndarray) -> jnp.ndarray:
+    """Index of the first UNTOUCHED task, or len(log) if none — the
+    coordinator's linear scan (mr/coordinator.go:50-55)."""
+    n = log.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return jnp.min(jnp.where(log == U, idx, n))
+
+
+def _sim_step(state: SimState, key: jnp.ndarray, *, n_workers: int,
+              timeout: int, exit_prob: float, stall_prob: float) -> SimState:
+    n_map = state.map_log.shape[0]
+    n_reduce = state.reduce_log.shape[0]
+    t = state.t + 1
+    tick_key = jax.random.fold_in(key, t)
+
+    # ── 1. presumed-dead-by-timeout requeue (coordinator.go:70-77,99-106) ──
+    map_stale = (state.map_log == P) & (state.map_deadline <= t)
+    red_stale = (state.reduce_log == P) & (state.reduce_deadline <= t)
+    map_log = jnp.where(map_stale, U, state.map_log)
+    reduce_log = jnp.where(red_stale, U, state.reduce_log)
+    n_requeues = state.n_requeues + jnp.sum(map_stale) + jnp.sum(red_stale)
+
+    c_map, c_map_b = state.c_map, state.c_map_buggy
+    c_red, c_red_b = state.c_reduce, state.c_reduce_buggy
+    busy, wkind, wtask, wfate = (state.busy_until, state.wkind, state.wtask,
+                                 state.wfate)
+    map_deadline, reduce_deadline = state.map_deadline, state.reduce_deadline
+    n_dups = state.n_duplicates
+    barrier_viol = state.barrier_violation
+    buggy_early = state.buggy_early_barrier
+
+    # ── 2. completions / silent deaths, serialized in worker order (the
+    #       coordinator mutex serializes RPCs, coordinator.go:28,44) ──
+    for w in range(n_workers):
+        fires = busy[w] == t
+        reports = fires & (wfate[w] != 2)          # exited workers say nothing
+        is_map = reports & (wkind[w] == 0)
+        is_red = reports & (wkind[w] == 1)
+        tm = jnp.clip(wtask[w], 0, n_map - 1)
+        tr = jnp.clip(wtask[w], 0, n_reduce - 1)
+        dup_m = is_map & (map_log[tm] == C)
+        dup_r = is_red & (reduce_log[tr] == C)
+        n_dups = n_dups + dup_m + dup_r
+        # fixed counters: first transition to COMPLETED only (coordinator.py)
+        c_map = c_map + (is_map & ~dup_m)
+        c_red = c_red + (is_red & ~dup_r)
+        # reference counters: every completion RPC (coordinator.go:30-31,38-39)
+        c_map_b = c_map_b + is_map
+        c_red_b = c_red_b + is_red
+        map_log = map_log.at[tm].set(jnp.where(is_map, C, map_log[tm]))
+        reduce_log = reduce_log.at[tr].set(jnp.where(is_red, C,
+                                                     reduce_log[tr]))
+        busy = busy.at[w].set(jnp.where(fires, 0, busy[w]))
+        wkind = wkind.at[w].set(jnp.where(fires, -1, wkind[w]))
+
+    # ── 3. pull-based assignment for idle workers (RequestTask,
+    #       coordinator.go:43-114) ──
+    for w in range(n_workers):
+        idle = busy[w] == 0
+        maps_open = c_map < n_map
+        reds_open = ~maps_open & (c_red < n_reduce)
+        tba_m = _first_untouched(map_log)
+        tba_r = _first_untouched(reduce_log)
+        take_map = idle & maps_open & (tba_m < n_map)
+        take_red = idle & reds_open & (tba_r < n_reduce)
+
+        # invariant: reduce may only be assigned once EVERY map is complete
+        barrier_viol = barrier_viol | (take_red & jnp.any(map_log != C))
+        # the reference's defect, simulated: double counts can satisfy the
+        # cMap==nMap gate (:79) while a map task is still incomplete
+        buggy_early = buggy_early | ((c_map_b >= n_map)
+                                     & jnp.any(map_log != C))
+
+        u = jax.random.uniform(jax.random.fold_in(tick_key, w))
+        fate = jnp.where(u < exit_prob, 2,
+                         jnp.where(u < exit_prob + stall_prob, 1, 0))
+        # ok: 1-3 ticks; stall: past the requeue deadline; exit: dies at +1
+        dur = jnp.where(fate == 1, timeout + 2,
+                        jnp.where(fate == 2, 1,
+                                  1 + (jnp.uint32(u * 977) % 3)
+                                  .astype(jnp.int32)))
+        assigned = take_map | take_red
+        busy = busy.at[w].set(jnp.where(assigned, t + dur, busy[w]))
+        wkind = wkind.at[w].set(jnp.where(take_map, 0,
+                                          jnp.where(take_red, 1, wkind[w])))
+        wtask = wtask.at[w].set(jnp.where(take_map, tba_m,
+                                          jnp.where(take_red, tba_r,
+                                                    wtask[w])))
+        wfate = wfate.at[w].set(jnp.where(assigned, fate, wfate[w]))
+        map_log = map_log.at[jnp.clip(tba_m, 0, n_map - 1)].set(
+            jnp.where(take_map, P, map_log[jnp.clip(tba_m, 0, n_map - 1)]))
+        map_deadline = map_deadline.at[jnp.clip(tba_m, 0, n_map - 1)].set(
+            jnp.where(take_map, t + timeout,
+                      map_deadline[jnp.clip(tba_m, 0, n_map - 1)]))
+        reduce_log = reduce_log.at[jnp.clip(tba_r, 0, n_reduce - 1)].set(
+            jnp.where(take_red, P,
+                      reduce_log[jnp.clip(tba_r, 0, n_reduce - 1)]))
+        reduce_deadline = reduce_deadline.at[
+            jnp.clip(tba_r, 0, n_reduce - 1)].set(
+            jnp.where(take_red, t + timeout,
+                      reduce_deadline[jnp.clip(tba_r, 0, n_reduce - 1)]))
+
+    return SimState(t, map_log, map_deadline, c_map, c_map_b, reduce_log,
+                    reduce_deadline, c_red, c_red_b, busy, wkind, wtask,
+                    wfate, n_requeues, n_dups, barrier_viol, buggy_early)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_map", "n_reduce", "n_workers",
+                                    "timeout", "horizon", "exit_prob",
+                                    "stall_prob"))
+def simulate_job(key: jnp.ndarray, *, n_map: int = 8, n_reduce: int = 10,
+                 n_workers: int = 3, timeout: int = 10, horizon: int = 500,
+                 exit_prob: float = 0.25, stall_prob: float = 0.2):
+    """Run ONE randomized MapReduce job to completion (or the horizon).
+
+    vmap this over a batch of keys for fleet-scale model checking.  Returns a
+    dict of end-state facts and invariant flags.
+    """
+    z = jnp.int32(0)
+    init = SimState(
+        t=z, map_log=jnp.zeros(n_map, jnp.int32),
+        map_deadline=jnp.zeros(n_map, jnp.int32), c_map=z, c_map_buggy=z,
+        reduce_log=jnp.zeros(n_reduce, jnp.int32),
+        reduce_deadline=jnp.zeros(n_reduce, jnp.int32), c_reduce=z,
+        c_reduce_buggy=z, busy_until=jnp.zeros(n_workers, jnp.int32),
+        wkind=jnp.full(n_workers, -1, jnp.int32),
+        wtask=jnp.zeros(n_workers, jnp.int32),
+        wfate=jnp.zeros(n_workers, jnp.int32), n_requeues=z, n_duplicates=z,
+        barrier_violation=jnp.bool_(False), buggy_early_barrier=jnp.bool_(False))
+
+    step = functools.partial(_sim_step, key=key, n_workers=n_workers,
+                             timeout=timeout, exit_prob=exit_prob,
+                             stall_prob=stall_prob)
+    done = lambda s: (s.c_reduce < n_reduce) & (s.t < horizon)  # noqa: E731
+    final = lax.while_loop(done, lambda s: step(s), init)
+
+    finished = final.c_reduce == n_reduce
+    consistent = (jnp.all(final.map_log == C) & jnp.all(final.reduce_log == C)
+                  & (final.c_map == n_map))
+    return {
+        "finished": finished,
+        "consistent": finished & consistent | ~finished,
+        "safe": ~final.barrier_violation,
+        "ticks": final.t,
+        "requeues": final.n_requeues,
+        "duplicates": final.n_duplicates,
+        "buggy_would_break_barrier": final.buggy_early_barrier,
+    }
+
+
+def run_crash_model_check(n_instances: int = 1000, seed: int = 0,
+                          **kwargs) -> dict:
+    """Model-check n_instances randomized jobs in lockstep; aggregate."""
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_instances)
+    out = jax.vmap(lambda k: simulate_job(k, **kwargs))(keys)
+    out = jax.tree.map(lambda x: jax.device_get(x), out)
+    agg = {
+        "instances": n_instances,
+        "all_finished": bool(out["finished"].all()),
+        "all_consistent": bool(out["consistent"].all()),
+        "all_safe": bool(out["safe"].all()),
+        "mean_ticks": float(out["ticks"].mean()),
+        "total_requeues": int(out["requeues"].sum()),
+        "total_duplicate_completions": int(out["duplicates"].sum()),
+        "instances_where_reference_counter_breaks_barrier":
+            int(out["buggy_would_break_barrier"].sum()),
+    }
+    return agg

@@ -14,47 +14,38 @@ from jax import lax
 
 print("devices", jax.devices(), flush=True)
 rng = np.random.default_rng(0)
+N = 1 << 20
 
 
 def bench(name, fn, *args):
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(*args))
+    jax.block_until_ready(fn(*args))
     compile_s = time.perf_counter() - t0
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(*args))
+        jax.block_until_ready(fn(*args))
         times.append(time.perf_counter() - t0)
     print(f"{name}: compile {compile_s:.2f}s steady {min(times)*1e3:.1f}ms",
           flush=True)
 
 
-for n in (1 << 18, 1 << 20):
-    keys = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
-    pay = [jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
-           for _ in range(5)]
+keys = jnp.asarray(rng.integers(0, 2**32, size=N, dtype=np.uint32))
+pay = [jnp.asarray(rng.integers(0, 2**32, size=N, dtype=np.uint32))
+       for _ in range(5)]
+chunk = jnp.asarray(rng.integers(0, 128, size=2 * N, dtype=np.uint8))
+idx = jnp.asarray(rng.integers(0, 2 * N, size=(N, 16), dtype=np.int32))
+mask = jnp.asarray(rng.random(2 * N) < 0.3)
 
-    bench(f"sort1op n={n}", jax.jit(lambda x: lax.sort((x,), num_keys=1)),
-          keys)
-    bench(f"sort2op n={n}",
-          jax.jit(lambda x, p: lax.sort((x, p), num_keys=1)), keys, pay[0])
-    bench(f"sort6op n={n}",
-          jax.jit(lambda x, *p: lax.sort((x,) + p, num_keys=4)), keys, *pay)
-    bench(f"argsort n={n}", jax.jit(lambda x: jnp.argsort(x)), keys)
+bench("sort1op 1M", jax.jit(lambda x: lax.sort((x,), num_keys=1)), keys)
+bench("sort6op 1M",
+      jax.jit(lambda x, *p: lax.sort((x,) + p, num_keys=4)), keys, *pay)
+bench("gather 1Mx16", jax.jit(lambda d, i: d[i]), chunk, idx)
+bench("nonzero 2M->1M",
+      jax.jit(lambda m: jnp.nonzero(m, size=N, fill_value=0)), mask)
+bench("cumsum 2M", jax.jit(lambda m: jnp.cumsum(m.astype(jnp.int32))), mask)
 
-    mask = jnp.asarray(rng.random(n) < 0.3)
-    bench(f"nonzero n={n}",
-          jax.jit(lambda m: jnp.nonzero(m, size=n // 2, fill_value=0)), mask)
-    bench(f"cumsum n={n}", jax.jit(lambda m: jnp.cumsum(m.astype(jnp.int32))),
-          mask)
+from dsi_tpu.ops.wordcount import count_words_kernel  # noqa: E402
 
-    idx = jnp.asarray(rng.integers(0, n, size=(n // 2, 16), dtype=np.int32))
-    data = jnp.asarray(rng.integers(0, 255, size=n, dtype=np.uint8))
-    bench(f"gather {n//2}x16", jax.jit(lambda d, i: d[i]), data, idx)
-
-    seg = jnp.asarray(np.sort(rng.integers(0, n // 2, size=n,
-                                           dtype=np.int32)))
-    vals = jnp.asarray(rng.integers(0, 100, size=n, dtype=np.int32))
-    bench(f"segsum n={n}",
-          jax.jit(lambda v, s: jax.ops.segment_sum(v, s, num_segments=n // 2)),
-          vals, seg)
+bench("full kernel 2M chunk",
+      lambda c: count_words_kernel(c, max_word_len=16, u_cap=1 << 17), chunk)

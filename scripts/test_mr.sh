@@ -7,10 +7,14 @@
 # (test-mr.sh:10,19-22), our concurrency check is the differential comparison
 # itself plus the unit tests' lock discipline (SURVEY.md §4).
 #
-# Usage: scripts/test_mr.sh [app]   (default: wc; also grep, indexer, crash)
+# Usage: scripts/test_mr.sh [app] [backend]
+#   app: wc (default), grep, indexer, crash, tpu_wc, tpu_indexer
+#   backend: host (default) or tpu (worker runs app device kernels; set
+#            DSI_JAX_PLATFORM=cpu to exercise the kernels without a chip)
 
 set -u
 APP=${1:-wc}
+BACKEND=${2:-host}
 REPO=$(cd "$(dirname "$0")/.." && pwd)
 PY=${PYTHON:-python3}
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
@@ -26,6 +30,11 @@ $PY -c "from dsi_tpu.utils.corpus import ensure_corpus; ensure_corpus('inputs', 
 INPUTS=(inputs/pg-*.txt)
 
 ORACLE_APP=$APP
+case "$APP" in
+  tpu_wc) ORACLE_APP=wc ;;          # byte-identical final output to wc
+  tpu_indexer) ORACLE_APP=indexer ;;
+esac
+WORKER_ARGS=(--backend "$BACKEND")
 EXTRA_COORD_ARGS=()
 if [ "$APP" = crash ]; then
   ORACLE_APP=nocrash
@@ -47,7 +56,7 @@ COORD=$!
 sleep 1  # socket-creation grace (test-mr.sh:39-40)
 
 for _ in 1 2 3; do
-  timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "$APP" &
+  timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "${WORKER_ARGS[@]}" "$APP" &
 done
 
 if [ "$APP" = crash ]; then
@@ -55,7 +64,7 @@ if [ "$APP" = crash ]; then
   while kill -0 $COORD 2>/dev/null; do
     N=$(jobs -rp | wc -l)
     if [ "$N" -lt 4 ]; then
-      timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "$APP" &
+      timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "${WORKER_ARGS[@]}" "$APP" &
     fi
     sleep 0.5
   done

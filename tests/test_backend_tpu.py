@@ -83,3 +83,17 @@ def test_tpu_map_fallback_on_non_ascii():
     kva = tpu_wc.tpu_map("f", b"plain ascii text plain")
     assert kva is not None
     assert {kv.key: kv.value for kv in kva}["plain"] == "2"
+
+
+def test_tpu_indexer_matches_host_indexer():
+    from dsi_tpu.apps import indexer, tpu_indexer
+
+    raw = b"apple banana apple Cherry banana apple"
+    host = indexer.Map("doc1", raw.decode())
+    dev = tpu_indexer.tpu_map("doc1", raw)
+    assert dev is not None
+    assert sorted((kv.key, kv.value) for kv in dev) == \
+        sorted((kv.key, kv.value) for kv in host)
+    assert tpu_indexer.tpu_map("d", "naïve".encode("utf-8")) is None
+    # string-valued reduce unchanged
+    assert tpu_indexer.Reduce("w", ["b", "a", "b"]) == "2 a,b"
