@@ -1,0 +1,26 @@
+"""tpu_grep: distributed grep with the line filter on device.
+
+Same job and output as ``grep`` (the working realization of the reference's
+``mrapps/dgrep.go`` intent — see apps/grep.py): Map emits ``{line, ""}`` per
+matching line, Reduce counts occurrences.  When ``DSI_GREP_PATTERN`` is a
+plain ASCII literal, the per-line scan runs as the shifted-compare TPU
+kernel (``ops/grepk.py``); regex patterns fall back to the host Map.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from dsi_tpu.apps.grep import Map, Reduce  # noqa: F401  (host fallback)
+from dsi_tpu.mr.types import KeyValue
+
+
+def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
+    from dsi_tpu.ops.grepk import grep_host_result
+
+    pattern = os.environ.get("DSI_GREP_PATTERN", r"(?!x)x")
+    lines = grep_host_result(raw, pattern)
+    if lines is None:
+        return None
+    return [KeyValue(line, "") for line in lines]

@@ -21,9 +21,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--nreduce", type=int, default=10)  # mrcoordinator.go:23
     p.add_argument("--task-timeout", type=float, default=10.0)
+    p.add_argument("--journal", default="",
+                   help="checkpoint journal path; an existing journal for "
+                        "the same job resumes it (new capability — the "
+                        "reference loses the job on coordinator death)")
     p.add_argument("files", nargs="+")
     args = p.parse_args(argv)
-    cfg = JobConfig(n_reduce=args.nreduce, task_timeout_s=args.task_timeout)
+    cfg = JobConfig(n_reduce=args.nreduce, task_timeout_s=args.task_timeout,
+                    journal_path=args.journal)
     c = make_coordinator(args.files, args.nreduce, cfg)
     while not c.done():  # mrcoordinator.go:24-26
         time.sleep(cfg.done_poll_s)

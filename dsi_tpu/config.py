@@ -64,5 +64,10 @@ class JobConfig:
     # Coordinator socket path ("" -> default_socket_path(workdir)).
     socket_path: str = ""
 
+    # Coordinator checkpoint journal ("" = disabled, reference behavior —
+    # coordinator death kills the job, SURVEY.md §5).  When set, unique task
+    # completions are journaled and a restarted coordinator resumes the job.
+    journal_path: str = ""
+
     def sock(self) -> str:
         return self.socket_path or default_socket_path(self.workdir)

@@ -30,6 +30,7 @@ from typing import List, Optional
 
 from dsi_tpu.config import JobConfig
 from dsi_tpu.mr import rpc
+from dsi_tpu.mr.journal import Journal
 from dsi_tpu.mr.types import (LOG_COMPLETED, LOG_IN_PROGRESS, LOG_UNTOUCHED,
                               TaskStatus)
 
@@ -49,6 +50,23 @@ class Coordinator:
         self.mu = threading.Lock()
         self._timers: set[threading.Timer] = set()
         self._server: Optional[rpc.RpcServer] = None
+
+        # Optional checkpoint/resume (journal.py; disabled by default — the
+        # reference keeps coordinator state purely in-memory).
+        self._journal: Optional[Journal] = None
+        if self.config.journal_path:
+            self._journal = Journal(self.config.journal_path, self.files,
+                                    self.n_reduce)
+            done_maps, done_reduces = self._journal.replay()
+            for t in done_maps:
+                if self.map_log[t] != LOG_COMPLETED:
+                    self.map_log[t] = LOG_COMPLETED
+                    self.c_map += 1
+            for t in done_reduces:
+                if self.reduce_log[t] != LOG_COMPLETED:
+                    self.reduce_log[t] = LOG_COMPLETED
+                    self.c_reduce += 1
+            self._journal.open()
 
     # ---- RPC handlers (the wire API, mr/coordinator.go:27-114) ----
 
@@ -89,6 +107,8 @@ class Coordinator:
             if self.map_log[t] != LOG_COMPLETED:  # fix: count first completion only
                 self.map_log[t] = LOG_COMPLETED
                 self.c_map += 1
+                if self._journal is not None:
+                    self._journal.record("map", t)
         return {}
 
     def reduce_complete(self, args: dict) -> dict:
@@ -98,6 +118,8 @@ class Coordinator:
             if self.reduce_log[t] != LOG_COMPLETED:
                 self.reduce_log[t] = LOG_COMPLETED
                 self.c_reduce += 1
+                if self._journal is not None:
+                    self._journal.record("reduce", t)
         return {}
 
     # ---- internals ----
@@ -150,6 +172,8 @@ class Coordinator:
         if self._server is not None:
             self._server.close()
             self._server = None
+        if self._journal is not None:
+            self._journal.close()
 
 
 def make_coordinator(files: List[str], n_reduce: int,

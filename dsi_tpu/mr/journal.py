@@ -1,0 +1,102 @@
+"""Coordinator checkpoint/resume journal.
+
+The reference has task-level checkpointing only: a task's committed output
+file is its checkpoint, but coordinator state is purely in-memory
+(``mr/coordinator.go:17,21``), so coordinator death kills the job —
+SURVEY.md §5 documents this as the gap to close.  This journal closes it:
+
+* every *unique* task completion is appended as one JSON line (the same
+  transitions the counters count, coordinator.py),
+* on startup with an existing journal for the same job, completed tasks are
+  replayed as COMPLETED — sound because a journaled completion implies the
+  task's output file was already atomically committed to the shared
+  filesystem (``mr/worker.go:91,148`` semantics), so the restarted job
+  simply never re-runs it,
+* tasks in-progress at the crash were never journaled and are handed out
+  afresh, which is exactly the presumed-dead-by-timeout path's semantics.
+
+A header line pins the job identity (input files + n_reduce); resuming with
+a different job is refused rather than silently corrupting state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, TextIO
+
+
+class Journal:
+    """Append-only completion log with atomic-enough line writes."""
+
+    def __init__(self, path: str, files: List[str], n_reduce: int):
+        self.path = path
+        self.files = list(files)
+        self.n_reduce = n_reduce
+        self._fh: Optional[TextIO] = None
+
+    # ---- replay ----
+
+    def replay(self) -> tuple[List[int], List[int]]:
+        """Return (completed map task ids, completed reduce task ids) from an
+        existing journal, after validating the job header.  Empty lists when
+        no journal exists yet."""
+        maps: List[int] = []
+        reduces: List[int] = []
+        if not os.path.exists(self.path):
+            return maps, reduces
+        with open(self.path) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break  # torn tail write: ignore the partial record
+                if i == 0:
+                    if (rec.get("kind") != "header"
+                            or rec.get("files") != self.files
+                            or rec.get("n_reduce") != self.n_reduce):
+                        raise SystemExit(
+                            f"journal {self.path} belongs to a different job "
+                            f"(files/n_reduce mismatch); refusing to resume")
+                    continue
+                if rec.get("kind") == "map":
+                    maps.append(int(rec["task"]))
+                elif rec.get("kind") == "reduce":
+                    reduces.append(int(rec["task"]))
+        return maps, reduces
+
+    # ---- writing ----
+
+    def open(self) -> None:
+        # Repair a torn tail (crash mid-write): truncate to the last
+        # complete line so new records never merge into a partial one.
+        size = os.path.getsize(self.path) if os.path.exists(self.path) else 0
+        if size > 0:
+            with open(self.path, "rb+") as f:
+                data = f.read()
+                if not data.endswith(b"\n"):
+                    keep = data.rfind(b"\n") + 1
+                    f.truncate(keep)
+                    size = keep
+        self._fh = open(self.path, "a")
+        if size == 0:  # empty counts as fresh: a torn header must be rewritten
+            self._write({"kind": "header", "files": self.files,
+                         "n_reduce": self.n_reduce})
+
+    def record(self, kind: str, task: int) -> None:
+        if self._fh is not None:
+            self._write({"kind": kind, "task": task})
+
+    def _write(self, rec: dict) -> None:
+        assert self._fh is not None
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

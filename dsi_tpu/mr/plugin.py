@@ -55,8 +55,8 @@ def load_plugin_module(name_or_path: str):
         except ImportError as e:
             raise SystemExit(
                 f"cannot load plugin {name_or_path!r}: {e} "
-                f"(registered apps: wc, tpu_wc, grep, indexer, tpu_indexer, "
-                f"crash, nocrash)")
+                f"(registered apps: wc, tpu_wc, grep, tpu_grep, indexer, "
+                f"tpu_indexer, crash, nocrash)")
     return mod
 
 

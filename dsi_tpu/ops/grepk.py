@@ -1,0 +1,87 @@
+"""TPU grep kernel: literal substring search over a whole chunk.
+
+Device replacement for the grep app's map hot loop (per-line regex scan,
+reference intent at ``mrapps/dgrep.go:27-35``): the pattern-match mask for
+every byte position is computed with ``len(pattern)`` shifted elementwise
+compares (no gathers, no loops over positions), line membership is a cumsum
+over newline bytes, and per-line match flags are a sorted segment-max —
+the same static-shape, vector-only discipline as ``ops/wordcount.py``.
+
+Scope: fixed ASCII literal patterns without newlines; anything else (regex
+metacharacters, non-ASCII) falls back to the host app — correctness never
+depends on the kernel (``backends/tpu.py`` contract).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsi_tpu.ops.wordcount import _pad_pow2, _shift_left
+
+
+def grep_kernel(chunk: jax.Array, pattern: jax.Array, *, l_cap: int):
+    """Match lines of ``chunk`` containing the literal ``pattern``.
+
+    Returns (line_match [l_cap] i32 flags in line order, n_lines i32,
+    overflow bool).  Lines are '\\n'-delimited; the host maps flags back to
+    text with ``text.split('\\n')``.  Padding zeros can never match
+    (patterns are printable ASCII).
+    """
+    m = pattern.shape[0]
+    match = jnp.ones(chunk.shape[0], jnp.bool_)
+    for j in range(m):  # static unroll over the (short) pattern
+        match &= _shift_left(chunk, j) == pattern[j]
+    is_nl = chunk == 10
+    cum = jnp.cumsum(is_nl.astype(jnp.int32))
+    line_id = cum - is_nl.astype(jnp.int32)  # newlines strictly before i
+    n_lines = cum[-1] + 1
+    overflow = n_lines > l_cap
+    seg = jnp.minimum(line_id, l_cap)
+    line_match = jax.ops.segment_max(
+        match.astype(jnp.int32), seg, num_segments=l_cap + 1,
+        indices_are_sorted=True)[:l_cap]
+    return line_match, n_lines, overflow
+
+
+_grep_jit = jax.jit(grep_kernel, static_argnames=("l_cap",))
+
+
+_REGEX_META = set(".^$*+?{}[]()|\\")
+
+
+def is_literal_pattern(pat: str) -> bool:
+    """True when the regex ``pat`` is a plain literal the kernel can run:
+    no regex metacharacters, ASCII, no newline (a match can then never span
+    lines, and byte-equality search == regex search)."""
+    return (bool(pat) and "\n" not in pat and pat.isascii()
+            and not set(pat) & _REGEX_META)
+
+
+def grep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
+    """Matching lines of ``data`` (split on '\\n', in order), or None when
+    the pattern needs the host regex path.  Retries the static line buffer
+    on overflow (exactness_retry discipline, avg line >= 8 bytes first)."""
+    if not is_literal_pattern(pattern):
+        return None
+    try:
+        text = data.decode("ascii")
+    except UnicodeDecodeError:
+        return None
+    if len(pattern) > len(data):
+        return []  # a literal longer than the data cannot match any line
+    chunk = jnp.asarray(_pad_pow2(data))
+    pat = jnp.asarray(np.frombuffer(pattern.encode("ascii"), dtype=np.uint8))
+    n = int(chunk.shape[0])
+    for l_cap in (max(n // 8, 1), n + 1):  # n+1 lines when every byte is \n
+        line_match, n_lines, overflow = _grep_jit(chunk, pat, l_cap=l_cap)
+        if not bool(overflow):
+            break
+    nl = int(n_lines)
+    flags = np.asarray(line_match[:nl])
+    lines = text.split("\n")
+    assert len(lines) == nl, (len(lines), nl)
+    return [lines[i] for i in range(nl) if flags[i]]

@@ -19,12 +19,27 @@ the TPU execution model rather than translated:
 * the partition hash is FNV-1a 32-bit, bit-identical to the reference's
   ``ihash`` (``mr/worker.go:33-37``), computed on-device per *unique* word.
 
-All shapes are static: the token buffer is ``n//2 + 1`` (a token needs at
-least one letter plus a separator), the unique buffer is ``u_cap``.  Overflow
-(words longer than ``max_word_len``, more uniques than ``u_cap``, non-ASCII
+TPU-shaped design decisions (what makes this fast, not just correct):
+
+* **no random byte-gathers**: the packed key lanes are built for every
+  position at once from shifted copies of the chunk (pure elementwise
+  shifts/ors — HBM-bandwidth bound), instead of gathering ``[tokens, 16]``
+  individual bytes, which XLA lowers to millions of scalar loads on TPU;
+* **token lengths without a gather**: distance-to-next-non-letter for all
+  positions via one reverse ``lax.associative_scan`` (log-depth cumulative
+  min), so a token's length is just ``next_nonletter[i] - i``;
+* **small sort buffer**: tokens are compacted to ``n // t_cap_frac + 1``
+  slots (a token needs ≥ 1 letter + a separator ⇒ ``n//2+1`` is the hard
+  bound; real text is ≥ 4 bytes/token, so the default frac=4 buffer is 2×
+  smaller and the sort — the kernel's dominant cost — 2× cheaper).  If a
+  pathological input overflows the compact buffer the kernel reports it and
+  the wrapper retries at the exact ``n//2+1`` bound.
+
+All shapes are static.  Overflow (words longer than ``max_word_len``, more
+uniques than ``u_cap``, more tokens than the compact buffer, non-ASCII
 bytes) is detected exactly and surfaced as scalars; the host wrapper retries
-with a bigger kernel or falls back to the host implementation, so the result
-is always exact.
+with a bigger kernel or falls back to the host implementation
+(``exactness_retry``), so the result is always exact.
 """
 
 from __future__ import annotations
@@ -46,29 +61,40 @@ def is_ascii_letter(b: jax.Array) -> jax.Array:
     return ((b >= 65) & (b <= 90)) | ((b >= 97) & (b <= 122))
 
 
-def token_bounds(letter: jax.Array):
-    """Start/end masks for maximal letter runs (vector form of FieldsFunc)."""
-    prev = jnp.concatenate([jnp.zeros((1,), jnp.bool_), letter[:-1]])
-    nxt = jnp.concatenate([letter[1:], jnp.zeros((1,), jnp.bool_)])
-    return letter & ~prev, letter & ~nxt
+def _shift_left(x: jax.Array, s: int) -> jax.Array:
+    """x shifted left by s positions, zero-filled: out[i] = x[i+s]."""
+    if s == 0:
+        return x
+    if s >= x.shape[0]:
+        return jnp.zeros_like(x)
+    return jnp.concatenate([x[s:], jnp.zeros((s,), x.dtype)])
 
 
-def pack_windows(chunk: jax.Array, start_pos: jax.Array, lengths: jax.Array,
-                 max_word_len: int):
-    """Gather each token's first max_word_len bytes, zero-pad, pack to uint32.
+def _byte_mask(keep: jax.Array) -> jax.Array:
+    """uint32 mask keeping the first ``keep`` (0..4) big-endian bytes."""
+    return jnp.where(
+        keep >= 4, jnp.uint32(0xFFFFFFFF),
+        jnp.where(keep == 3, jnp.uint32(0xFFFFFF00),
+                  jnp.where(keep == 2, jnp.uint32(0xFFFF0000),
+                            jnp.where(keep == 1, jnp.uint32(0xFF000000),
+                                      jnp.uint32(0)))))
 
-    Big-endian packing keeps uint32 lexicographic order == bytewise order and
-    makes host detokenization a single ``.tobytes()``.
+
+def build_lanes(chunk: jax.Array, length_all: jax.Array, max_word_len: int):
+    """Per-position packed key lanes from shifted chunk copies (no gathers).
+
+    lane_j[i] = big-endian uint32 of bytes chunk[i+4j .. i+4j+3], zero-masked
+    past the token length at i.  Big-endian packing keeps uint32 order ==
+    bytewise order and makes host detokenization one ``.tobytes()``.
     """
-    n = chunk.shape[0]
-    k = max_word_len // 4
-    offs = jnp.arange(max_word_len, dtype=jnp.int32)
-    idx = jnp.minimum(start_pos[:, None] + offs[None, :], n - 1)
-    win = chunk[idx].astype(jnp.uint32)
-    mask = offs[None, :] < jnp.minimum(lengths, max_word_len)[:, None]
-    win = jnp.where(mask, win, 0)
-    w4 = win.reshape(-1, k, 4)
-    return (w4[..., 0] << 24) | (w4[..., 1] << 16) | (w4[..., 2] << 8) | w4[..., 3]
+    c = chunk.astype(jnp.uint32)
+    b32 = ((c << 24) | (_shift_left(c, 1) << 16)
+           | (_shift_left(c, 2) << 8) | _shift_left(c, 3))
+    lanes = []
+    for j in range(max_word_len // 4):
+        keep = jnp.clip(length_all - 4 * j, 0, 4)
+        lanes.append(_shift_left(b32, 4 * j) & _byte_mask(keep))
+    return lanes
 
 
 def fnv1a32_packed(packed: jax.Array, lengths: jax.Array,
@@ -82,62 +108,92 @@ def fnv1a32_packed(packed: jax.Array, lengths: jax.Array,
     return h
 
 
+def group_sorted(skeys_cols: tuple, counts: jax.Array, out_cap: int):
+    """Group adjacent equal rows of lexicographically sorted key columns.
+
+    The shared reduce idiom (run-boundary detect + segment-sum + compact)
+    used by the single-chunk kernel and by the sharded all_to_all merge
+    (parallel/shuffle.py).  ``skeys_cols``: k sorted uint32 columns, PAD
+    rows last; ``counts``: per-row counts to sum within each group.
+
+    Returns (keys2d [t,k], totals [out_cap], upos [out_cap], ovalid
+    [out_cap], n_unique) — callers gather their payloads at ``upos`` and
+    mask with ``ovalid``.
+    """
+    t = skeys_cols[0].shape[0]
+    k = len(skeys_cols)
+    keys = jnp.stack(skeys_cols, axis=1)
+    valid = skeys_cols[0] != jnp.uint32(_PAD_KEY)
+    prev = jnp.concatenate(
+        [jnp.full((1, k), _PAD_KEY, jnp.uint32), keys[:-1]], axis=0)
+    is_new = jnp.any(keys != prev, axis=1) & valid
+    n_unique = jnp.sum(is_new, dtype=jnp.int32)
+    uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    totals = jax.ops.segment_sum(
+        jnp.where(valid, counts, 0), jnp.where(valid, uid, out_cap),
+        num_segments=out_cap + 1, indices_are_sorted=True)[:out_cap]
+    (upos,) = jnp.nonzero(is_new, size=out_cap, fill_value=t - 1)
+    ovalid = jnp.arange(out_cap, dtype=jnp.int32) < n_unique
+    return keys, totals, upos, ovalid, n_unique
+
+
 def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
-                        u_cap: int = 1 << 17):
+                        u_cap: int = 1 << 17, t_cap_frac: int = 4):
     """Exact unique-word counts over one uint8 chunk (zero-padded tail).
 
-    Returns (packed_u [u_cap, K] uint32, len_u [u_cap] i32, cnt_u [u_cap] i32,
-    fnv_u [u_cap] u32, n_unique i32, max_len i32, has_high bool).
+    Returns (packed_u [u_cap, K] uint32, len_u [u_cap] i32, cnt_u [u_cap]
+    i32, fnv_u [u_cap] u32, n_unique i32, max_len i32, has_high bool,
+    token_overflow bool).
 
     Not jitted itself so it can be inlined into larger programs (the
     ``shard_map`` SPMD step in ``dsi_tpu/parallel/shuffle.py`` traces it per
-    device before the ``all_to_all`` shuffle); ``count_words_kernel`` below is
-    the jitted single-chunk entry point.
+    device before the ``all_to_all`` shuffle); ``count_words_kernel`` below
+    is the jitted single-chunk entry point.
     """
     n = chunk.shape[0]
     k = max_word_len // 4
-    t_cap = n // 2 + 1
+    t_cap = n // t_cap_frac + 1
 
+    idx = jnp.arange(n, dtype=jnp.int32)
     letter = is_ascii_letter(chunk)
-    starts, ends = token_bounds(letter)
+    prev_letter = jnp.concatenate([jnp.zeros((1,), jnp.bool_), letter[:-1]])
+    starts = letter & ~prev_letter
     n_tokens = jnp.sum(starts, dtype=jnp.int32)
-    (start_pos,) = jnp.nonzero(starts, size=t_cap, fill_value=n - 1)
-    (end_pos,) = jnp.nonzero(ends, size=t_cap, fill_value=n - 1)
-    valid = jnp.arange(t_cap, dtype=jnp.int32) < n_tokens
-    lengths = jnp.where(valid, end_pos - start_pos + 1, 0).astype(jnp.int32)
-    max_len = jnp.max(lengths, initial=0)
+    token_overflow = n_tokens > t_cap
 
-    packed = pack_windows(chunk, start_pos.astype(jnp.int32), lengths,
-                          max_word_len)
-    packed = jnp.where(valid[:, None], packed, jnp.uint32(_PAD_KEY))
+    # Distance to the next non-letter: token length at every start position.
+    m = jnp.where(letter, n, idx)
+    next_nl = lax.associative_scan(jnp.minimum, m, reverse=True)
+    length_all = (next_nl - idx).astype(jnp.int32)
+
+    lanes = build_lanes(chunk, length_all, max_word_len)
+
+    # Compact to the token buffer: k+1 one-dimensional gathers.
+    (start_pos,) = jnp.nonzero(starts, size=t_cap, fill_value=n - 1)
+    valid = jnp.arange(t_cap, dtype=jnp.int32) < n_tokens
+    lengths = jnp.where(valid, length_all[start_pos], 0)
+    max_len = jnp.max(lengths, initial=0)
+    packed_cols = tuple(
+        jnp.where(valid, lane[start_pos], jnp.uint32(_PAD_KEY))
+        for lane in lanes)
 
     # Group identical words: K-key lexicographic sort, then run boundaries.
-    sorted_ops = lax.sort(tuple(packed[:, j] for j in range(k)) + (lengths,),
-                          num_keys=k)
-    skeys = jnp.stack(sorted_ops[:k], axis=1)
+    sorted_ops = lax.sort(packed_cols + (lengths,), num_keys=k)
+    skeys, totals, upos, ovalid, n_unique = group_sorted(
+        sorted_ops[:k], jnp.ones(t_cap, jnp.int32), u_cap)
     slens = sorted_ops[k]
-    svalid = skeys[:, 0] != jnp.uint32(_PAD_KEY)
-    prev = jnp.concatenate(
-        [jnp.full((1, k), _PAD_KEY, jnp.uint32), skeys[:-1]], axis=0)
-    is_new = jnp.any(skeys != prev, axis=1) & svalid
-    n_unique = jnp.sum(is_new, dtype=jnp.int32)
-    uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
-    cnt_u = jax.ops.segment_sum(
-        svalid.astype(jnp.int32),
-        jnp.where(svalid, uid, u_cap),
-        num_segments=u_cap + 1)[:u_cap]
 
-    (upos,) = jnp.nonzero(is_new, size=u_cap, fill_value=t_cap - 1)
-    uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
-    packed_u = jnp.where(uvalid[:, None], skeys[upos], 0)
-    len_u = jnp.where(uvalid, slens[upos], 0)
+    packed_u = jnp.where(ovalid[:, None], skeys[upos], 0)
+    len_u = jnp.where(ovalid, slens[upos], 0)
     fnv_u = fnv1a32_packed(packed_u, len_u, max_word_len)
     has_high = jnp.any(chunk >= 128)
-    return packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high
+    return (packed_u, len_u, totals, fnv_u, n_unique, max_len, has_high,
+            token_overflow)
 
 
-count_words_kernel = jax.jit(tokenize_group_core,
-                             static_argnames=("max_word_len", "u_cap"))
+count_words_kernel = jax.jit(
+    tokenize_group_core,
+    static_argnames=("max_word_len", "u_cap", "t_cap_frac"))
 
 
 def _pad_pow2(data: bytes, min_size: int = 256) -> np.ndarray:
@@ -208,8 +264,12 @@ def count_words_host_result(
     dev_chunk = jnp.asarray(chunk)
 
     def run(mwl: int, cap: int):
-        packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high = (
-            count_words_kernel(dev_chunk, max_word_len=mwl, u_cap=cap))
+        for frac in (4, 2):  # exact token bound is n//2+1; try compact first
+            (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
+             tok_of) = count_words_kernel(dev_chunk, max_word_len=mwl,
+                                          u_cap=cap, t_cap_frac=frac)
+            if not bool(tok_of):
+                break
         nu = int(n_unique)
 
         def payload():

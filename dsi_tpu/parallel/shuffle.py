@@ -44,6 +44,7 @@ from dsi_tpu.ops.wordcount import (
     _PAD_KEY,
     decode_packed,
     exactness_retry,
+    group_sorted,
     tokenize_group_core,
 )
 
@@ -61,14 +62,15 @@ def default_mesh(n_devices: int | None = None) -> Mesh:
 
 
 def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
-                 max_word_len: int, u_cap: int):
+                 max_word_len: int, u_cap: int, t_cap_frac: int):
     """Per-device body (runs under shard_map): map, all_to_all, reduce."""
     k = max_word_len // 4
     chunk = chunk.reshape(-1)  # [1, L] block -> [L]
 
     # ── map: tokenize + local combine (one record per unique word) ──
-    packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high = (
-        tokenize_group_core(chunk, max_word_len=max_word_len, u_cap=u_cap))
+    (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
+     token_overflow) = tokenize_group_core(
+        chunk, max_word_len=max_word_len, u_cap=u_cap, t_cap_frac=t_cap_frac)
     uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
     part = (fnv_u & jnp.uint32(0x7FFFFFFF)) % jnp.uint32(n_reduce)
     dest = jnp.where(uvalid, (part % n_dev).astype(jnp.int32), n_dev)
@@ -94,52 +96,46 @@ def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
     recv = lax.all_to_all(sendbuf, AXIS, split_axis=0, concat_axis=0,
                           tiled=True)
 
-    # ── reduce: sort received records by word, sum counts per run ──
+    # ── reduce: sort received records by word, sum counts per run
+    #    (shared grouping idiom, ops/wordcount.py group_sorted) ──
     out_cap = n_dev * u_cap
     rkeys = tuple(recv[:, j] for j in range(k))
     rlen = recv[:, k]
     rcnt = recv[:, k + 1]
     rpart = recv[:, k + 2]
     sorted_ops = lax.sort(rkeys + (rlen, rcnt, rpart), num_keys=k)
-    mkeys = jnp.stack(sorted_ops[:k], axis=1)
+    mkeys, tot, upos, ovalid, m_unique = group_sorted(
+        sorted_ops[:k], sorted_ops[k + 1].astype(jnp.int32), out_cap)
     mlen = sorted_ops[k].astype(jnp.int32)
-    mcnt = sorted_ops[k + 1].astype(jnp.int32)
     mpart = sorted_ops[k + 2]
-    mvalid = mkeys[:, 0] != jnp.uint32(_PAD_KEY)
-    prev = jnp.concatenate(
-        [jnp.full((1, k), _PAD_KEY, jnp.uint32), mkeys[:-1]], axis=0)
-    is_new = jnp.any(mkeys != prev, axis=1) & mvalid
-    m_unique = jnp.sum(is_new, dtype=jnp.int32)
-    uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
-    tot = jax.ops.segment_sum(
-        jnp.where(mvalid, mcnt, 0), jnp.where(mvalid, uid, out_cap),
-        num_segments=out_cap + 1)[:out_cap]
-    (upos,) = jnp.nonzero(is_new, size=out_cap, fill_value=out_cap - 1)
-    ovalid = jnp.arange(out_cap, dtype=jnp.int32) < m_unique
     out_keys = jnp.where(ovalid[:, None], mkeys[upos], 0)
     out_len = jnp.where(ovalid, mlen[upos], 0)
     out_part = jnp.where(ovalid, mpart[upos], 0)
 
     scalars = jnp.stack([m_unique, n_unique, max_len,
-                         has_high.astype(jnp.int32)])
+                         has_high.astype(jnp.int32),
+                         token_overflow.astype(jnp.int32)])
     return (out_keys[None], out_len[None], tot[None], out_part[None],
             scalars[None])
 
 
 @functools.partial(jax.jit,
                    static_argnames=("n_dev", "n_reduce", "max_word_len",
-                                    "u_cap", "mesh"))
+                                    "u_cap", "t_cap_frac", "mesh"))
 def mapreduce_step(chunks: jax.Array, *, n_dev: int, n_reduce: int,
-                   max_word_len: int, u_cap: int, mesh: Mesh):
+                   max_word_len: int, u_cap: int, mesh: Mesh,
+                   t_cap_frac: int = 4):
     """The full SPMD job step, jitted over the mesh.
 
     ``chunks``: [n_dev, L] uint8, one zero-padded text shard per device.
     Returns per-device arrays stacked on axis 0: packed word keys
     [D, D*u_cap, K], byte lengths, summed counts, reduce-partition ids, and a
-    [D, 4] scalar block (m_unique, n_unique, max_len, has_high).
+    [D, 5] scalar block (m_unique, n_unique, max_len, has_high,
+    token_overflow).
     """
     body = functools.partial(_device_step, n_dev=n_dev, n_reduce=n_reduce,
-                             max_word_len=max_word_len, u_cap=u_cap)
+                             max_word_len=max_word_len, u_cap=u_cap,
+                             t_cap_frac=t_cap_frac)
     return jax.shard_map(
         body, mesh=mesh,
         in_specs=P(AXIS, None),
@@ -197,10 +193,13 @@ def wordcount_sharded(
     chunks = jnp.asarray(chunks_np)
 
     def run(mwl: int, cap: int):
-        keys, lens, cnts, parts, scal = mapreduce_step(
-            chunks, n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
-            u_cap=cap, mesh=mesh)
-        scal = np.asarray(scal)
+        for frac in (4, 2):  # exact token bound is n//2+1; try compact first
+            keys, lens, cnts, parts, scal = mapreduce_step(
+                chunks, n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
+                u_cap=cap, mesh=mesh, t_cap_frac=frac)
+            scal = np.asarray(scal)
+            if not scal[:, 4].any():
+                break
 
         def payload():
             k, l, c, p = (np.asarray(keys), np.asarray(lens),

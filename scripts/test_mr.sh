@@ -33,6 +33,8 @@ ORACLE_APP=$APP
 case "$APP" in
   tpu_wc) ORACLE_APP=wc ;;          # byte-identical final output to wc
   tpu_indexer) ORACLE_APP=indexer ;;
+  tpu_grep) ORACLE_APP=grep
+            export DSI_GREP_PATTERN=${DSI_GREP_PATTERN:-the} ;;  # literal
 esac
 WORKER_ARGS=(--backend "$BACKEND")
 EXTRA_COORD_ARGS=()

@@ -1,0 +1,137 @@
+"""Coordinator checkpoint/resume: the capability the reference lacks
+(in-memory-only coordinator state, mr/coordinator.go:17,21; SURVEY.md §5)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr.coordinator import Coordinator, make_coordinator
+from dsi_tpu.mr.journal import Journal
+from dsi_tpu.mr.plugin import load_plugin
+from dsi_tpu.mr.worker import worker_loop
+from dsi_tpu.utils.corpus import ensure_corpus
+from tests.harness import merged_output, oracle_output
+
+
+def _cfg(tmp_path, **kw):
+    return JobConfig(workdir=str(tmp_path),
+                     journal_path=os.path.join(str(tmp_path), "journal"),
+                     socket_path=os.path.join(str(tmp_path), "mr.sock"),
+                     wait_sleep_s=0.02, **kw)
+
+
+def test_resume_restores_completions(tmp_path):
+    files = [f"f{i}" for i in range(4)]
+    c1 = Coordinator(files, 5, _cfg(tmp_path))
+    c1.map_complete({"TaskNumber": 1})
+    c1.map_complete({"TaskNumber": 3})
+    c1.map_complete({"TaskNumber": 3})  # duplicate: journaled once
+    c1.close()
+
+    c2 = Coordinator(files, 5, _cfg(tmp_path))
+    assert c2.c_map == 2
+    assert c2.map_log[1] == 2 and c2.map_log[3] == 2
+    assert c2.map_log[0] == 0 and c2.map_log[2] == 0
+    assert c2.c_reduce == 0
+    c2.close()
+
+    # the duplicate completion was journaled exactly once
+    with open(os.path.join(str(tmp_path), "journal")) as f:
+        lines = [l for l in f if '"map"' in l]
+    assert len(lines) == 2
+
+
+def test_resume_refuses_different_job(tmp_path):
+    c1 = Coordinator(["a", "b"], 3, _cfg(tmp_path))
+    c1.map_complete({"TaskNumber": 0})
+    c1.close()
+    with pytest.raises(SystemExit):
+        Journal(os.path.join(str(tmp_path), "journal"),
+                ["a", "DIFFERENT"], 3).replay()
+    with pytest.raises(SystemExit):
+        Journal(os.path.join(str(tmp_path), "journal"), ["a", "b"], 4).replay()
+
+
+def test_torn_tail_line_ignored(tmp_path):
+    c1 = Coordinator(["a", "b"], 3, _cfg(tmp_path))
+    c1.map_complete({"TaskNumber": 0})
+    c1.close()
+    with open(os.path.join(str(tmp_path), "journal"), "a") as f:
+        f.write('{"kind": "map", "ta')  # crash mid-write
+    c2 = Coordinator(["a", "b"], 3, _cfg(tmp_path))
+    assert c2.c_map == 1
+    c2.close()
+
+
+def test_torn_tail_truncated_before_append(tmp_path):
+    """A record appended after a torn tail must not merge into it; the
+    partial line is truncated away, so a THIRD incarnation still replays
+    every completion written after the crash."""
+    c1 = Coordinator(["a", "b", "c"], 3, _cfg(tmp_path))
+    c1.map_complete({"TaskNumber": 0})
+    c1.close()
+    path = os.path.join(str(tmp_path), "journal")
+    with open(path, "a") as f:
+        f.write('{"kind": "map", "task":')  # crash mid-write
+    c2 = Coordinator(["a", "b", "c"], 3, _cfg(tmp_path))
+    assert c2.c_map == 1
+    c2.map_complete({"TaskNumber": 2})
+    c2.close()
+    c3 = Coordinator(["a", "b", "c"], 3, _cfg(tmp_path))
+    assert c3.c_map == 2 and c3.map_log[0] == 2 and c3.map_log[2] == 2
+    c3.close()
+
+
+def test_empty_journal_file_gets_header(tmp_path):
+    """Crash between file creation and header write must not brick resume."""
+    path = os.path.join(str(tmp_path), "journal")
+    open(path, "w").close()  # exists, zero bytes
+    c1 = Coordinator(["a", "b"], 3, _cfg(tmp_path))
+    c1.map_complete({"TaskNumber": 1})
+    c1.close()
+    c2 = Coordinator(["a", "b"], 3, _cfg(tmp_path))
+    assert c2.c_map == 1
+    c2.close()
+
+
+@pytest.mark.slow
+def test_coordinator_death_and_resume_full_job(tmp_path):
+    """Kill the coordinator mid-job; a resumed one finishes with parity."""
+    wd = str(tmp_path)
+    files = ensure_corpus(os.path.join(wd, "inputs"), n_files=6,
+                          file_size=50_000)
+    want = oracle_output("wc", files, wd)
+    mapf, reducef = load_plugin("wc")
+
+    def run_workers(cfg, n=2):
+        ws = [threading.Thread(target=worker_loop, args=(mapf, reducef, cfg),
+                               daemon=True) for _ in range(n)]
+        for w in ws:
+            w.start()
+        return ws
+
+    cfg = _cfg(tmp_path)
+    c1 = make_coordinator(files, 10, cfg)
+    ws = run_workers(cfg)
+    deadline = time.time() + 60
+    while c1.c_map < 3:  # let part of the map phase commit
+        assert time.time() < deadline
+        time.sleep(0.01)
+    c1.close()  # coordinator "dies"; workers exit on CoordinatorGone
+    for w in ws:
+        w.join(timeout=10)
+
+    c2 = make_coordinator(files, 10, cfg)  # resume from the journal
+    assert c2.c_map >= 3  # restored progress, no re-execution of those maps
+    ws = run_workers(cfg)
+    while not c2.done():
+        assert time.time() < deadline, "resumed job hung"
+        time.sleep(0.05)
+    for w in ws:
+        w.join(timeout=10)
+    c2.close()
+
+    assert merged_output(wd) == want

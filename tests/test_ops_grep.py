@@ -1,0 +1,71 @@
+"""Device grep kernel: differential vs the host regex app."""
+
+import os
+
+import pytest
+
+pytest.importorskip("jax")
+
+from dsi_tpu.apps import grep, tpu_grep
+from dsi_tpu.ops.grepk import grep_host_result, is_literal_pattern
+
+TEXT = (b"the quick brown fox\njumps over the lazy dog\n"
+        b"no match here\nfoxes and boxes\n\nfox")
+
+
+def host_lines(data: bytes, pattern: str):
+    os.environ["DSI_GREP_PATTERN"] = pattern
+    try:
+        return [kv.key for kv in grep.Map("f", data.decode())]
+    finally:
+        del os.environ["DSI_GREP_PATTERN"]
+
+
+def test_literal_detection():
+    assert is_literal_pattern("fox")
+    assert is_literal_pattern("lazy dog")
+    assert not is_literal_pattern("[Tt]he")
+    assert not is_literal_pattern("fox.*")
+    assert not is_literal_pattern("")
+    assert not is_literal_pattern("a\nb")
+    assert not is_literal_pattern("héllo")
+
+
+@pytest.mark.parametrize("pat", ["fox", "the", "dog", "zzz", "e", " "])
+def test_kernel_matches_host_regex(pat):
+    assert grep_host_result(TEXT, pat) == host_lines(TEXT, pat)
+
+
+def test_empty_lines_and_final_line():
+    out = grep_host_result(TEXT, "fox")
+    assert out is not None
+    assert out[-1] == "fox"  # final line without trailing newline
+
+
+def test_line_buffer_overflow_retry():
+    data = b"\n" * 3000 + b"needle\n" + b"\n" * 3000
+    assert grep_host_result(data, "needle") == ["needle"]
+
+
+def test_pattern_longer_than_data():
+    assert grep_host_result(b"tiny", "a" * 300) == []
+
+
+def test_regex_falls_back():
+    assert grep_host_result(TEXT, "[Tt]he") is None
+    os.environ["DSI_GREP_PATTERN"] = "[Tt]he"
+    try:
+        assert tpu_grep.tpu_map("f", TEXT) is None  # router: host handles it
+    finally:
+        del os.environ["DSI_GREP_PATTERN"]
+
+
+def test_tpu_map_emits_per_line_records():
+    os.environ["DSI_GREP_PATTERN"] = "fox"
+    try:
+        kva = tpu_grep.tpu_map("f", TEXT)
+    finally:
+        del os.environ["DSI_GREP_PATTERN"]
+    assert [kv.key for kv in kva] == ["the quick brown fox",
+                                      "foxes and boxes", "fox"]
+    assert all(kv.value == "" for kv in kva)

@@ -74,6 +74,20 @@ def test_sharded_wordcount_word_overflow_retries():
     assert res["abcdefghijklmnopqrst"][0] == 50
 
 
+def test_token_overflow_retries_exact_bound():
+    # single-letter tokens at maximum density: n_tokens == n//2, overflowing
+    # the compact frac=4 buffer and forcing the exact n//2+1 retry
+    data = b"a b c d e f g h " * 200
+    res = wordcount_sharded(data, mesh=default_mesh(8), u_cap=256)
+    assert res is not None
+    assert {w: c for w, (c, _) in res.items()} == dict(truth(data))
+
+    from dsi_tpu.ops.wordcount import count_words_host_result
+    single = count_words_host_result(data)
+    assert {w: (c,) for w, (c, _) in single.items()} == \
+        {w: (c,) for w, c in truth(data).items()}
+
+
 def test_sharded_wordcount_non_ascii_falls_back():
     data = "héllo world".encode("utf-8")
     assert wordcount_sharded(data, mesh=default_mesh(8)) is None
