@@ -45,8 +45,9 @@ class Journal:
         reduces: List[int] = []
         if not os.path.exists(self.path):
             return maps, reduces
+        saw_header = False
         with open(self.path) as f:
-            for i, line in enumerate(f):
+            for line in f:
                 line = line.strip()
                 if not line:
                     continue
@@ -54,13 +55,14 @@ class Journal:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     break  # torn tail write: ignore the partial record
-                if i == 0:
+                if not saw_header:  # first non-blank record must be a header
                     if (rec.get("kind") != "header"
                             or rec.get("files") != self.files
                             or rec.get("n_reduce") != self.n_reduce):
                         raise SystemExit(
                             f"journal {self.path} belongs to a different job "
                             f"(files/n_reduce mismatch); refusing to resume")
+                    saw_header = True
                     continue
                 if rec.get("kind") == "map":
                     maps.append(int(rec["task"]))

@@ -77,10 +77,22 @@ def write_intermediates(kva: Sequence[KeyValue], map_task: int, n_reduce: int,
 
 def read_intermediates(reduce_task: int, n_map: int,
                        workdir: str = ".") -> list[KeyValue]:
-    """Read all mr-<i>-<r>, skipping missing files (worker.go:102-121)."""
+    """Read all mr-<i>-<r>, skipping missing files (worker.go:102-121).
+
+    Per-file the native C++ decoder (dsi_tpu/native) is tried first; it
+    returns None for anything it can't prove it parsed completely, in which
+    case the lenient Python decoder below — the reference's exact
+    break-on-bad-record semantics — takes over for that file.
+    """
+    from dsi_tpu import native
+
     out: list[KeyValue] = []
     for i in range(n_map):
         path = intermediate_name(i, reduce_task, workdir)
+        pairs = native.decode_kv_file(path)
+        if pairs is not None:
+            out.extend(KeyValue(k, v) for k, v in pairs)
+            continue
         try:
             f = open(path, "r")
         except OSError:

@@ -1,0 +1,106 @@
+"""Native runtime components (C++, ctypes-bound), with Python fallbacks.
+
+The reference has no native code (SURVEY.md §2: pure Go stdlib), but its
+compiled-Go host runtime is the moral bar for this framework's host paths.
+This package provides natively-accelerated pieces of the host data plane —
+currently the intermediate-file decoder used by every reduce task
+(``mr/worker.go:102-121`` semantics) — built by ``scripts/build_native.sh``
+and loaded lazily.  Every entry point degrades to the pure-Python
+implementation when the library is missing (``DSI_NO_NATIVE=1`` forces
+that), and the C parser defers to Python on any input it cannot prove it
+parsed completely, so native and pure runs can never diverge.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import sys
+import threading
+from typing import List, Optional
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None | bool" = None  # None = not tried, False = absent
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_REPO, "build", "libkvcodec.so")
+
+
+def _load():
+    """Load (building on first use if a toolchain exists) or mark absent."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib or None
+        if os.environ.get("DSI_NO_NATIVE") == "1":
+            _lib = False
+            return None
+        if not os.path.exists(_SO_PATH):
+            script = os.path.join(_REPO, "scripts", "build_native.sh")
+            try:
+                subprocess.run(["bash", script], check=True,
+                               capture_output=True, timeout=120)
+            except Exception as e:  # no compiler / build failure: fall back
+                print(f"dsi_tpu.native: build unavailable ({e}); "
+                      "using pure-Python data plane", file=sys.stderr)
+                _lib = False
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.kv_decode_file.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.kv_decode_file.argtypes = [ctypes.c_char_p,
+                                           ctypes.POINTER(ctypes.c_size_t)]
+            lib.kv_arena_free.restype = None
+            lib.kv_arena_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+            _lib = lib
+        except OSError as e:
+            print(f"dsi_tpu.native: load failed ({e}); "
+                  "using pure-Python data plane", file=sys.stderr)
+            _lib = False
+        return _lib or None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_kv_file(path: str) -> Optional[List[tuple]]:
+    """Decode one mr-X-Y intermediate file natively.
+
+    Returns a list of (key, value) string pairs, or None when the caller
+    must use the Python decoder (library unavailable, IO error — including
+    the tolerated missing-file case — or the strict parser stopped early).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t()
+    ptr = lib.kv_decode_file(path.encode(), ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    n, complete = struct.unpack_from("<II", arena, 0)
+    if not complete:
+        return None  # lenient Python decoder takes over (never diverge)
+    out: List[tuple] = []
+    off = 8
+    try:
+        for _ in range(n):
+            klen, vlen = struct.unpack_from("<II", arena, off)
+            off += 8
+            key = arena[off:off + klen].decode("utf-8")
+            off += klen
+            val = arena[off:off + vlen].decode("utf-8")
+            off += vlen
+            out.append((key, val))
+    except (UnicodeDecodeError, struct.error):
+        # e.g. a lone-surrogate \uXXXX escape: json.dumps emits it, strict
+        # UTF-8 rejects it.  Never diverge — let the Python decoder decide.
+        return None
+    return out

@@ -1,0 +1,229 @@
+// Native decoder for the intermediate-file data plane.
+//
+// The reference's reduce path decodes NMap JSON-lines files of
+// {"Key": ..., "Value": ...} records per reduce task (mr/worker.go:102-121)
+// — the host-side hot loop of the distributed data plane.  This implements
+// that decode natively: one call parses a whole file into a length-prefixed
+// arena the Python side slices into records, replacing a per-line
+// json.loads + dict + KeyValue round trip.
+//
+// Semantics mirror the reference decoder exactly: parsing stops silently at
+// the first malformed record (the Go json.Decoder `break` on error,
+// worker.go:117 — a torn tail from a crashed writer is ignored), and a
+// missing file is the *caller's* tolerated case (worker.go:106-108).
+//
+// Arena layout (little-endian): u32 n_records, u32 complete_flag, then per
+// record u32 klen, u32 vlen, key bytes, value bytes.  Strings are UTF-8;
+// JSON escapes including \uXXXX surrogate pairs are decoded.
+// complete_flag=1 means the parse reached EOF cleanly; 0 means this strict
+// parser stopped early — the Python wrapper then re-decodes the file with
+// the (more lenient) reference-semantics decoder so native vs pure-Python
+// runs can never diverge.
+//
+// Build: scripts/build_native.sh (g++ -O2 -shared -fPIC).  The Python
+// wrapper (dsi_tpu/native/__init__.py) falls back to the pure-Python
+// decoder whenever the library is unavailable or declines an input.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  bool skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+    return p < end;
+  }
+
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  // Append one UTF-8 encoded code point.
+  static void put_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+      out.push_back((char)cp);
+    } else if (cp < 0x800) {
+      out.push_back((char)(0xC0 | (cp >> 6)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back((char)(0xE0 | (cp >> 12)));
+      out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back((char)(0xF0 | (cp >> 18)));
+      out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool hex4(uint32_t* out) {
+    if (end - p < 4) return false;
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) {
+      char c = p[i];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= (uint32_t)(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= (uint32_t)(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= (uint32_t)(c - 'A' + 10);
+      else return false;
+    }
+    p += 4;
+    *out = v;
+    return true;
+  }
+
+  // Parse a JSON string (opening quote consumed by caller? no: consumes it).
+  bool str(std::string& out) {
+    if (!skip_ws() || *p != '"') return false;
+    p++;
+    out.clear();
+    while (p < end) {
+      unsigned char c = (unsigned char)*p;
+      if (c == '"') {
+        p++;
+        return true;
+      }
+      if (c == '\\') {
+        p++;
+        if (p >= end) return false;
+        char e = *p++;
+        switch (e) {
+          case '"': out.push_back('"'); break;
+          case '\\': out.push_back('\\'); break;
+          case '/': out.push_back('/'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case 'n': out.push_back('\n'); break;
+          case 'r': out.push_back('\r'); break;
+          case 't': out.push_back('\t'); break;
+          case 'u': {
+            uint32_t cp;
+            if (!hex4(&cp)) return false;
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
+              if (end - p >= 6 && p[0] == '\\' && p[1] == 'u') {
+                p += 2;
+                uint32_t lo;
+                if (!hex4(&lo)) return false;
+                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                } else {
+                  put_utf8(out, cp);  // unpaired; emit both as-is
+                  cp = lo;
+                }
+              }
+            }
+            put_utf8(out, cp);
+            break;
+          }
+          default:
+            return false;
+        }
+      } else if (c < 0x20) {
+        // Raw control characters are invalid inside JSON strings — Python's
+        // strict json.loads rejects them too; staying equally strict keeps
+        // native and pure-Python torn-file behavior identical.
+        return false;
+      } else {
+        out.push_back((char)c);
+        p++;
+      }
+    }
+    return false;
+  }
+
+  // One {"Key": k, "Value": v} record (field order fixed — both this
+  // framework's writer and Go's struct encoder emit Key then Value).
+  bool record(std::string& k, std::string& v) {
+    if (!skip_ws() || *p != '{') return false;
+    p++;
+    if (!skip_ws() || !lit("\"Key\"")) return false;
+    if (!skip_ws() || *p != ':') return false;
+    p++;
+    if (!str(k)) return false;
+    if (!skip_ws() || *p != ',') return false;
+    p++;
+    if (!skip_ws() || !lit("\"Value\"")) return false;
+    if (!skip_ws() || *p != ':') return false;
+    p++;
+    if (!str(v)) return false;
+    if (!skip_ws() || *p != '}') return false;
+    p++;
+    skip_ws();
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Parse a JSON-lines KV file into an arena (see header comment).
+// Returns nullptr only on IO/allocation failure; malformed content yields
+// the records parsed before the first bad line (reference break semantics).
+uint8_t* kv_decode_file(const char* path, size_t* out_len) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return nullptr; }
+  long sz = ftell(f);
+  if (sz < 0) { fclose(f); return nullptr; }
+  rewind(f);
+  std::string buf;
+  buf.resize((size_t)sz);
+  if (sz > 0 && fread(&buf[0], 1, (size_t)sz, f) != (size_t)sz) {
+    fclose(f);
+    return nullptr;
+  }
+  fclose(f);
+
+  std::string arena;
+  arena.resize(8);  // n_records + complete_flag, patched at the end
+  uint32_t n = 0, complete = 1;
+  std::string k, v;
+  const char* p = buf.data();
+  const char* bend = buf.data() + buf.size();
+  while (p < bend) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(bend - p));
+    const char* line_end = nl ? nl : bend;
+    Parser ws{p, line_end};
+    ws.skip_ws();
+    if (ws.p != line_end) {  // non-blank line (blank lines are tolerated)
+      Parser ps{p, line_end};
+      if (!ps.record(k, v) || ps.p != line_end) {
+        complete = 0;  // strict parse stopped early: wrapper re-decodes
+        break;
+      }
+      uint32_t kl = (uint32_t)k.size(), vl = (uint32_t)v.size();
+      arena.append((const char*)&kl, 4);
+      arena.append((const char*)&vl, 4);
+      arena.append(k);
+      arena.append(v);
+      n++;
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  memcpy(&arena[0], &n, 4);
+  memcpy(&arena[4], &complete, 4);
+
+  uint8_t* out = (uint8_t*)malloc(arena.size());
+  if (!out) return nullptr;
+  memcpy(out, arena.data(), arena.size());
+  *out_len = arena.size();
+  return out;
+}
+
+void kv_arena_free(uint8_t* p) { free(p); }
+
+}  // extern "C"

@@ -55,9 +55,11 @@ _REGEX_META = set(".^$*+?{}[]()|\\")
 
 def is_literal_pattern(pat: str) -> bool:
     """True when the regex ``pat`` is a plain literal the kernel can run:
-    no regex metacharacters, ASCII, no newline (a match can then never span
-    lines, and byte-equality search == regex search)."""
-    return (bool(pat) and "\n" not in pat and pat.isascii()
+    printable ASCII (0x20..0x7E) only — control bytes could match the
+    chunk's zero padding — and no regex metacharacters; a match can then
+    never span lines, and byte-equality search == regex search."""
+    return (bool(pat)
+            and all(0x20 <= ord(c) <= 0x7E for c in pat)
             and not set(pat) & _REGEX_META)
 
 

@@ -283,3 +283,41 @@ def count_words_host_result(
 
     payload = exactness_retry(run, len(chunk), max_word_len, u_cap)
     return None if payload is None else payload()
+
+
+def count_words_many(datas, *, max_word_len: int = 16,
+                     u_cap: int = 1 << 17) -> list:
+    """Pipelined multi-split word count: launch the kernel for EVERY split
+    before synchronizing on any, so host↔device transfers and device compute
+    overlap (JAX async dispatch).  Splits whose optimistic first attempt
+    overflowed re-run through the full retry ladder (rare).
+
+    Returns one ``{word: (count, ihash)} | None`` per input, same contract
+    as ``count_words_host_result``.
+    """
+    launches = []
+    for data in datas:
+        chunk = _pad_pow2(data)
+        cap = min(u_cap, 1 << (len(chunk) // 2).bit_length())
+        launches.append((data, cap,
+                         count_words_kernel(jnp.asarray(chunk),
+                                            max_word_len=max_word_len,
+                                            u_cap=cap, t_cap_frac=4)))
+    results = []
+    for data, cap, out in launches:
+        (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
+         tok_of) = out
+        if bool(has_high):
+            results.append(None)
+            continue
+        if bool(tok_of) or int(n_unique) > cap or int(max_len) > max_word_len:
+            results.append(count_words_host_result(
+                data, max_word_len=max_word_len, u_cap=u_cap))
+            continue
+        nu = int(n_unique)
+        words = decode_packed(np.asarray(packed_u), np.asarray(len_u), nu)
+        counts = np.asarray(cnt_u[:nu])
+        hashes = np.asarray(fnv_u[:nu]) & 0x7FFFFFFF
+        results.append({w: (int(counts[i]), int(hashes[i]))
+                        for i, w in enumerate(words)})
+    return results

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Build the native runtime components (dsi_tpu/native/*.cpp) into build/.
+# The framework works without them (pure-Python fallbacks); when present
+# they accelerate the host-side data plane.
+set -eu
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+mkdir -p "$REPO/build"
+# Build to a temp name + atomic rename: concurrent workers may trigger the
+# lazy first-use build simultaneously, and no process may ever dlopen a
+# half-written .so.
+TMP="$REPO/build/.libkvcodec.$$.tmp"
+g++ -O2 -Wall -shared -fPIC -std=c++17 \
+    -o "$TMP" "$REPO/dsi_tpu/native/kvcodec.cpp"
+mv -f "$TMP" "$REPO/build/libkvcodec.so"
+echo "built $REPO/build/libkvcodec.so"

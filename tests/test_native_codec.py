@@ -1,0 +1,124 @@
+"""Native C++ KV decoder: differential against the Python json path.
+
+The invariant under test: for every input, the native decoder either
+produces exactly what the Python decoder produces, or declines (None) so
+the Python decoder runs — native and pure runs can never diverge.
+"""
+
+import json
+import os
+
+import pytest
+
+from dsi_tpu import native
+from dsi_tpu.mr.types import KeyValue
+from dsi_tpu.mr.worker import read_intermediates, write_intermediates
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def python_decode(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                break
+            out.append((obj["Key"], obj["Value"]))
+    return out
+
+
+def write_records(path, records):
+    with open(path, "w") as f:
+        for k, v in records:
+            f.write(json.dumps({"Key": k, "Value": v}) + "\n")
+
+
+TRICKY = [
+    ("plain", "1"),
+    ('quote"inside', "back\\slash"),
+    ("tab\there", "new\nline"),
+    ("unicode: héllo wörld", "emoji: \U0001F600"),  # surrogate pair as \uXXXX
+    ("control \x01\x1f", "\b\f\r"),
+    ("", ""),
+    ("ключ", "значение"),
+]
+
+
+def test_roundtrip_tricky_strings(tmp_path):
+    path = os.path.join(str(tmp_path), "kv")
+    write_records(path, TRICKY)
+    got = native.decode_kv_file(path)
+    assert got == python_decode(path) == TRICKY
+
+
+def test_large_file_equivalence(tmp_path):
+    path = os.path.join(str(tmp_path), "kv")
+    records = [(f"word{i % 997}", str(i)) for i in range(20000)]
+    write_records(path, records)
+    assert native.decode_kv_file(path) == python_decode(path)
+
+
+def test_torn_tail_defers_to_python(tmp_path):
+    path = os.path.join(str(tmp_path), "kv")
+    write_records(path, [("a", "1"), ("b", "2")])
+    with open(path, "a") as f:
+        f.write('{"Key": "c", "Val')  # crashed writer
+    # strict parser can't prove completeness -> defers
+    assert native.decode_kv_file(path) is None
+    assert python_decode(path) == [("a", "1"), ("b", "2")]
+
+
+def test_missing_file_defers(tmp_path):
+    assert native.decode_kv_file(os.path.join(str(tmp_path), "nope")) is None
+
+
+def test_blank_lines_tolerated(tmp_path):
+    path = os.path.join(str(tmp_path), "kv")
+    with open(path, "w") as f:
+        f.write('\n{"Key": "a", "Value": "1"}\n\n   \n'
+                '{"Key": "b", "Value": "2"}\n')
+    assert native.decode_kv_file(path) == [("a", "1"), ("b", "2")]
+
+
+def test_read_intermediates_native_vs_python(tmp_path):
+    wd = str(tmp_path)
+    kva = [KeyValue(k, v) for k, v in TRICKY] * 50
+    write_intermediates(kva, map_task=0, n_reduce=3, workdir=wd)
+    write_intermediates(kva, map_task=1, n_reduce=3, workdir=wd)
+    for r in range(3):
+        os.environ["DSI_NO_NATIVE"] = "1"
+        try:
+            native._lib = None  # reset the load cache
+            py = read_intermediates(r, 2, wd)
+        finally:
+            del os.environ["DSI_NO_NATIVE"]
+        native._lib = None
+        nat = read_intermediates(r, 2, wd)
+        assert nat == py
+        assert sum(len(read_intermediates(q, 2, wd)) for q in range(3)) \
+            == len(kva) * 2
+
+
+def test_lone_surrogate_defers(tmp_path):
+    """json.dumps emits \\ud800 for lone surrogates; strict UTF-8 can't
+    represent them — native must defer, not crash the reduce path."""
+    path = os.path.join(str(tmp_path), "kv")
+    with open(path, "w") as f:
+        f.write(json.dumps({"Key": "bad\ud800", "Value": "1"}) + "\n")
+    assert native.decode_kv_file(path) is None
+
+
+def test_raw_control_char_matches_python_strictness(tmp_path):
+    path = os.path.join(str(tmp_path), "kv")
+    with open(path, "w") as f:
+        f.write('{"Key": "ok", "Value": "1"}\n')
+        f.write('{"Key": "bad\tchar", "Value": "2"}\n')  # raw tab: invalid
+        f.write('{"Key": "after", "Value": "3"}\n')
+    assert native.decode_kv_file(path) is None  # strict stop -> defer
+    assert python_decode(path) == [("ok", "1")]  # python breaks there too

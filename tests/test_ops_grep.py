@@ -69,3 +69,11 @@ def test_tpu_map_emits_per_line_records():
     assert [kv.key for kv in kva] == ["the quick brown fox",
                                       "foxes and boxes", "fox"]
     assert all(kv.value == "" for kv in kva)
+
+
+def test_control_byte_pattern_rejected():
+    # NUL would match the chunk's zero padding; control bytes must route to
+    # the host regex path
+    assert not is_literal_pattern("\x00")
+    assert not is_literal_pattern("a\x01b")
+    assert grep_host_result(b"abc\x00x\ndef", "\x00") is None

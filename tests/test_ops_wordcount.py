@@ -15,7 +15,7 @@ import pytest
 
 from dsi_tpu.apps.wc import WORD_RE
 from dsi_tpu.mr.worker import ihash
-from dsi_tpu.ops.wordcount import count_words_host_result
+from dsi_tpu.ops.wordcount import count_words_host_result, count_words_many
 
 
 def oracle_counts(text: str):
@@ -81,3 +81,19 @@ def test_padding_boundaries(size):
     rng = random.Random(size)
     text = "".join(rng.choice("ab c") for _ in range(size))
     check(text)
+
+
+def test_count_words_many_pipelined():
+    """Pipelined multi-split path: same results as per-split calls,
+    including per-split fallbacks and overflow retries."""
+    datas = [
+        b"alpha beta alpha",
+        "héllo".encode("utf-8"),          # non-ASCII -> None
+        b"abcdefghijklmnopqrstuvwx " * 40,      # 24-byte word -> wide retry
+        b"a b c " * 300,                        # token-dense -> t_cap retry
+        b"",
+    ]
+    many = count_words_many(datas)
+    solo = [count_words_host_result(d) for d in datas]
+    assert many == solo
+    assert many[1] is None and many[0]["alpha"] == (2, many[0]["alpha"][1])
