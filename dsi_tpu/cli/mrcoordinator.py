@@ -30,6 +30,14 @@ def main(argv=None) -> int:
     cfg = JobConfig(n_reduce=args.nreduce, task_timeout_s=args.task_timeout,
                     journal_path=args.journal)
     c = make_coordinator(args.files, args.nreduce, cfg)
+    addr = c.address()
+    if addr and addr.startswith("tcp:"):
+        import sys
+
+        # With tcp:HOST:0 the port is kernel-assigned; tell the operator
+        # what workers should set DSI_MR_SOCKET to.
+        print(f"mrcoordinator: listening on {addr}",
+              file=sys.stderr, flush=True)
     while not c.done():  # mrcoordinator.go:24-26
         time.sleep(cfg.done_poll_s)
     time.sleep(cfg.exit_grace_s)  # mrcoordinator.go:28

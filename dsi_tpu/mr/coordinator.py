@@ -161,6 +161,10 @@ class Coordinator:
         })
         self._server.start()
 
+    def address(self) -> Optional[str]:
+        """The dialable control-plane address, or None before serve()."""
+        return self._server.address if self._server is not None else None
+
     def done(self) -> bool:
         """Job-completion poll (mr/coordinator.go:138-142)."""
         with self.mu:

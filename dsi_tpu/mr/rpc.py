@@ -20,6 +20,15 @@ HTTP-framed gob RPC we use a minimal length-prefixed JSON protocol —
 The wire field names (``TaskStatus``, ``NMap``, ``CMap``, ``NReduce``,
 ``CReduce``, ``Filename``, ``TaskNumber``) are kept identical to
 ``mr/rpc.go:18-33`` so the protocol is recognizably the same.
+
+Transports: a Unix-domain socket (the reference's live path) or TCP — the
+reference carries a commented-out TCP variant for multi-host operation
+(``mr/coordinator.go:124``, ``mr/worker.go:173``); here it is a first-class
+address form.  Addresses are strings: ``tcp:HOST:PORT`` selects TCP
+(``tcp:0.0.0.0:7777`` to listen on all interfaces; workers on other hosts
+then use ``tcp:<coordinator-host>:7777`` via ``DSI_MR_SOCKET``); anything
+else is a Unix socket path.  The filesystem data plane must be shared
+(NFS etc.) for multi-host runs, exactly as the reference assumes.
 """
 
 from __future__ import annotations
@@ -39,6 +48,37 @@ _MAX_FRAME = 16 << 20
 class CoordinatorGone(Exception):
     """Raised when the coordinator socket cannot be dialed (reference:
     worker's log.Fatal on dial error, mr/worker.go:176-178)."""
+
+
+def parse_address(addr: str):
+    """``tcp:HOST:PORT`` -> ("tcp", (host, port)); anything else is a Unix
+    socket path -> ("unix", path).  Raises ValueError with a usable message
+    on a malformed TCP address (callers on the dial path wrap it)."""
+    if addr.startswith("tcp:"):
+        host, _, port = addr[4:].rpartition(":")
+        try:
+            return "tcp", (host or "0.0.0.0", int(port))
+        except ValueError:
+            raise ValueError(
+                f"malformed TCP address {addr!r}: want tcp:HOST:PORT") from None
+    return "unix", addr
+
+
+def _reachable_host(bind_host: str) -> str:
+    """A host other machines can dial when we bound a wildcard address."""
+    if bind_host not in ("0.0.0.0", "", "::"):
+        return bind_host
+    try:
+        # Routing trick: connect() on UDP picks the outbound interface
+        # without sending a packet.
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return socket.gethostname()
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -64,7 +104,7 @@ def _recv_frame(sock: socket.socket) -> Any:
 
 
 class RpcServer:
-    """Threaded RPC server over a Unix-domain socket.
+    """Threaded RPC server over a Unix-domain socket or TCP.
 
     Mirrors ``(*Coordinator).server()`` (mr/coordinator.go:121-132): removes a
     stale socket file, listens, and serves in background threads.
@@ -73,10 +113,12 @@ class RpcServer:
     def __init__(self, socket_path: str, methods: Dict[str, Callable[[dict], dict]]):
         self.socket_path = socket_path
         self.methods = dict(methods)
-        try:
-            os.remove(socket_path)  # mr/coordinator.go:126
-        except OSError:
-            pass
+        self._kind, target = parse_address(socket_path)
+        if self._kind == "unix":
+            try:
+                os.remove(socket_path)  # mr/coordinator.go:126
+            except OSError:
+                pass
 
         handler_methods = self.methods
 
@@ -94,13 +136,26 @@ class RpcServer:
                 except (ConnectionError, json.JSONDecodeError, OSError):
                     pass  # client vanished mid-call; the 10 s requeue covers it
 
-        class Server(socketserver.ThreadingUnixStreamServer):
+        base = (socketserver.ThreadingTCPServer if self._kind == "tcp"
+                else socketserver.ThreadingUnixStreamServer)
+
+        class Server(base):
             daemon_threads = True
             allow_reuse_address = True
 
-        self._server = Server(socket_path, Handler)
+        self._server = Server(target, Handler)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="dsi-mr-rpc", daemon=True)
+
+    @property
+    def address(self) -> str:
+        """A dialable address: real port when bound to port 0, and a
+        reachable host substituted when bound to a wildcard (0.0.0.0 echoed
+        back would dial the *worker's* loopback on another machine)."""
+        if self._kind == "tcp":
+            host, port = self._server.server_address[:2]
+            return f"tcp:{_reachable_host(host)}:{port}"
+        return self.socket_path
 
     def start(self) -> None:
         self._thread.start()
@@ -108,10 +163,11 @@ class RpcServer:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
-        try:
-            os.remove(self.socket_path)
-        except OSError:
-            pass
+        if self._kind == "unix":
+            try:
+                os.remove(self.socket_path)
+            except OSError:
+                pass
 
 
 def call(socket_path: str, method: str, args: dict | None = None,
@@ -123,11 +179,16 @@ def call(socket_path: str, method: str, args: dict | None = None,
     cannot be dialed — the reference worker dies here (log.Fatal), and our
     worker loop treats it as job-over.
     """
-    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        kind, target = parse_address(socket_path)
+    except ValueError as e:
+        raise CoordinatorGone(str(e)) from None
+    family = socket.AF_INET if kind == "tcp" else socket.AF_UNIX
+    sock = socket.socket(family, socket.SOCK_STREAM)
     sock.settimeout(timeout)
     try:
         try:
-            sock.connect(socket_path)
+            sock.connect(target)
         except OSError as e:
             raise CoordinatorGone(f"dialing {socket_path}: {e}") from e
         try:

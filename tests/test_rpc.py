@@ -68,3 +68,89 @@ def test_concurrent_calls(tmp_path):
         assert not errs
     finally:
         srv.close()
+
+
+def test_tcp_roundtrip():
+    """TCP transport: the reference's commented-out multi-host variant
+    (mr/coordinator.go:124, mr/worker.go:173) as a first-class address."""
+    calls = []
+    srv = rpc.RpcServer("tcp:127.0.0.1:0",
+                        {"Echo": lambda a: (calls.append(a) or a)})
+    srv.start()
+    try:
+        addr = srv.address
+        assert addr.startswith("tcp:127.0.0.1:")
+        ok, reply = rpc.call(addr, "Echo", {"x": 42})
+        assert ok and reply == {"x": 42} and calls == [{"x": 42}]
+        ok, reply = rpc.call(addr, "NoSuch", {})
+        assert not ok
+    finally:
+        srv.close()
+
+
+def test_tcp_dead_port_raises_coordinator_gone():
+    import pytest as _pytest
+
+    with _pytest.raises(rpc.CoordinatorGone):
+        rpc.call("tcp:127.0.0.1:1", "Echo", {})
+
+
+def test_tcp_end_to_end_job(tmp_path):
+    """Full distributed job with the control plane on TCP."""
+    import os as _os
+
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr.coordinator import make_coordinator
+    from dsi_tpu.mr.plugin import load_plugin
+    from dsi_tpu.mr.worker import worker_loop
+    from dsi_tpu.utils.corpus import ensure_corpus
+    from tests.harness import merged_output, oracle_output
+    import threading
+    import time as _time
+
+    wd = str(tmp_path)
+    files = ensure_corpus(_os.path.join(wd, "inputs"), n_files=3,
+                          file_size=40_000)
+    want = oracle_output("wc", files, wd)
+    cfg = JobConfig(n_reduce=5, workdir=wd, socket_path="tcp:127.0.0.1:0",
+                    wait_sleep_s=0.02)
+    c = make_coordinator(files, 5, cfg)
+    worker_cfg = JobConfig(n_reduce=5, workdir=wd,
+                           socket_path=c.address(), wait_sleep_s=0.02)
+    mapf, reducef = load_plugin("wc")
+    try:
+        ws = [threading.Thread(target=worker_loop,
+                               args=(mapf, reducef, worker_cfg), daemon=True)
+              for _ in range(2)]
+        for w in ws:
+            w.start()
+        deadline = _time.time() + 60
+        while not c.done():
+            assert _time.time() < deadline
+            _time.sleep(0.05)
+        for w in ws:
+            w.join(timeout=10)
+    finally:
+        c.close()
+    assert merged_output(wd) == want
+
+
+def test_malformed_tcp_address_is_coordinator_gone():
+    import pytest as _pytest
+
+    with _pytest.raises(rpc.CoordinatorGone):
+        rpc.call("tcp:myhost", "Echo", {})  # operator typo: no port
+    with _pytest.raises(ValueError):
+        rpc.parse_address("tcp:")
+
+
+def test_wildcard_bind_advertises_reachable_host():
+    srv = rpc.RpcServer("tcp:0.0.0.0:0", {"Ping": lambda a: {}})
+    srv.start()
+    try:
+        host = srv.address[4:].rpartition(":")[0]
+        assert host not in ("0.0.0.0", "", "::")
+        ok, _ = rpc.call(srv.address, "Ping", {})
+        assert ok
+    finally:
+        srv.close()
