@@ -65,8 +65,17 @@ def parse_address(addr: str):
 
 
 def _reachable_host(bind_host: str) -> str:
-    """A host other machines can dial when we bound a wildcard address."""
-    if bind_host not in ("0.0.0.0", "", "::"):
+    """A host other machines can dial when we bound a wildcard address.
+
+    ``DSI_MR_ADVERTISE`` overrides (the reliable answer on multi-homed or
+    containerized hosts); otherwise the UDP-connect routing trick picks the
+    outbound interface, falling back to the hostname — which may resolve to
+    loopback on some distros, hence the override.
+    """
+    env = os.environ.get("DSI_MR_ADVERTISE")
+    if env:
+        return env
+    if bind_host not in ("0.0.0.0", ""):
         return bind_host
     try:
         # Routing trick: connect() on UDP picks the outbound interface
@@ -125,6 +134,10 @@ class RpcServer:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one request per connection (dial-per-call)
                 try:
+                    # A peer that connects and never sends (port scanner,
+                    # stalled NAT) must not pin a handler thread + fd
+                    # forever — remotely reachable once bound to TCP.
+                    self.request.settimeout(60.0)
                     req = _recv_frame(self.request)
                     fn = handler_methods.get(req.get("method", ""))
                     if fn is None:
@@ -161,7 +174,8 @@ class RpcServer:
         self._thread.start()
 
     def close(self) -> None:
-        self._server.shutdown()
+        if self._thread.is_alive():  # shutdown() hangs unless serve_forever runs
+            self._server.shutdown()
         self._server.server_close()
         if self._kind == "unix":
             try:

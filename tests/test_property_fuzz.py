@@ -1,0 +1,100 @@
+"""Property-based fuzzing of the exactness-critical paths.
+
+The framework's central promise is byte-exact parity with the reference
+semantics for ARBITRARY inputs (SURVEY.md §4's differential-oracle
+discipline).  These properties throw adversarial inputs — random bytes,
+pathological token shapes, hostile JSON strings — at the device kernels and
+the native codec and require agreement with the trivially-correct host
+implementations.
+"""
+
+import collections
+import json
+import os
+import re
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+jax = pytest.importorskip("jax")
+
+from dsi_tpu import native
+from dsi_tpu.mr.worker import ihash
+from dsi_tpu.ops.grepk import grep_host_result, is_literal_pattern
+from dsi_tpu.ops.wordcount import count_words_host_result
+
+ASCII_WORDS = re.compile(r"[A-Za-z]+")
+
+# Text drawn from a tiny alphabet maximizes boundary collisions: runs of
+# letters vs separators, words at chunk edges, token-dense pathologies.
+dense_text = st.text(alphabet="ab XY.\n\t0", min_size=0, max_size=2000)
+ascii_bytes = st.binary(min_size=0, max_size=1500).map(
+    lambda b: bytes(x & 0x7F for x in b))
+
+
+@settings(max_examples=60, deadline=None)
+@given(dense_text)
+def test_wordcount_kernel_matches_counter(text):
+    data = text.encode("ascii")
+    res = count_words_host_result(data, u_cap=256)
+    assert res is not None
+    want = collections.Counter(ASCII_WORDS.findall(text))
+    assert {w: c for w, (c, _) in res.items()} == dict(want)
+    for w, (_, h) in res.items():
+        assert h == ihash(w)
+
+
+@settings(max_examples=40, deadline=None)
+@given(ascii_bytes)
+def test_wordcount_kernel_arbitrary_ascii_bytes(data):
+    res = count_words_host_result(data, u_cap=256)
+    assert res is not None
+    want = collections.Counter(
+        ASCII_WORDS.findall(data.decode("ascii", "ignore")))
+    # NUL and control bytes are non-letters for the kernel; the regex over
+    # the decoded text sees the same token boundaries.
+    assert {w: c for w, (c, _) in res.items()} == dict(want)
+
+
+@settings(max_examples=40, deadline=None)
+@given(dense_text, st.text(alphabet="abX .", min_size=1, max_size=6))
+def test_grep_kernel_matches_regex(text, pat):
+    data = text.encode("ascii")
+    got = grep_host_result(data, pat)
+    if not is_literal_pattern(pat):
+        assert got is None
+        return
+    want = [line for line in text.split("\n") if pat in line]
+    assert got == want
+
+
+json_strings = st.text(min_size=0, max_size=50)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.tuples(json_strings, json_strings), max_size=30))
+def test_native_codec_never_diverges(tmp_path_factory, records):
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    d = tmp_path_factory.mktemp("kv")
+    path = os.path.join(str(d), "kv")
+    with open(path, "w") as f:
+        for k, v in records:
+            try:
+                f.write(json.dumps({"Key": k, "Value": v}) + "\n")
+            except (ValueError, UnicodeEncodeError):
+                return  # unencodable (should not happen for str)
+    nat = native.decode_kv_file(path)
+    py = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                break
+            py.append((obj["Key"], obj["Value"]))
+    # native either agrees exactly or declines
+    assert nat is None or nat == py

@@ -154,3 +154,32 @@ def test_wildcard_bind_advertises_reachable_host():
         assert ok
     finally:
         srv.close()
+
+
+def test_advertise_override(monkeypatch):
+    monkeypatch.setenv("DSI_MR_ADVERTISE", "coord.example.net")
+    srv = rpc.RpcServer("tcp:0.0.0.0:0", {"Ping": lambda a: {}})
+    try:
+        assert srv.address.startswith("tcp:coord.example.net:")
+    finally:
+        srv.close()
+
+
+def test_silent_peer_does_not_pin_handler_threads():
+    """A connected-but-mute TCP peer must be timed out server-side."""
+    import socket as _socket
+    import threading as _threading
+
+    srv = rpc.RpcServer("tcp:127.0.0.1:0", {"Ping": lambda a: {}})
+    srv.start()
+    try:
+        mute = _socket.create_connection(
+            tuple(rpc.parse_address(srv.address)[1]))
+        # server still serves real clients while the mute peer idles
+        ok, _ = rpc.call(srv.address, "Ping", {})
+        assert ok
+        mute.close()
+        before = _threading.active_count()
+        assert before < 50  # no thread pile-up
+    finally:
+        srv.close()
