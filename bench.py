@@ -13,92 +13,222 @@ Prints ONE JSON line on stdout:
   {"metric": ..., "value": MB/s, "unit": "MB/s", "vs_baseline": speedup}
 `vs_baseline` is TPU MB/s over oracle MB/s measured in the same run on the
 same corpus (the reference publishes no numbers of its own — BASELINE.md).
-Parity failure reports value 0.  Diagnostics go to stderr.
+
+Robustness discipline (the always-emit-a-verdict rule of the reference's
+harness, test-mr.sh:55-59): the oracle runs FIRST and needs no accelerator,
+so its MB/s is always captured; the TPU half runs in a watchdog subprocess
+(the axon device-init path has been observed to hang > 25 min) with bounded
+retries and a global deadline, and every failure mode still emits the JSON
+line — with the measured `oracle_mbps` and an `error` field — before exit.
+Diagnostics go to stderr.
+
+Environment knobs:
+  DSI_BENCH_TPU_TIMEOUTS  per-attempt child timeouts, seconds (default
+                          "900,420,240" — first attempt covers a cold
+                          ~454 s axon compile; later ones assume the
+                          persistent cache is warm)
+  DSI_BENCH_DEADLINE_S    global wall budget for the TPU half (default 1500)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 # Persistent compile cache: the TPU path's programs compile once per corpus
 # shape; later bench runs (and the driver's) skip straight to execution.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jaxcache"))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jaxcache"))
 
 N_FILES = 8
 FILE_SIZE = (2 << 20) - 64  # pads to exactly 2^21 on device
 N_REDUCE = 10
-WORKDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench")
+WORKDIR = os.path.join(REPO, ".bench")
+ORACLE_OUT = os.path.join(WORKDIR, "mr-correct.txt")
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_oracle(files) -> tuple[list, float, float]:
+def run_oracle(files) -> tuple[float, float]:
+    """Sequential oracle (mrsequential.go:38-86 semantics); pure host CPU."""
     from dsi_tpu.apps import wc
     from dsi_tpu.mr.sequential import run_sequential
+    from dsi_tpu.utils.tracing import Span
 
-    out = os.path.join(WORKDIR, "mr-correct.txt")
-    t0 = time.perf_counter()
-    run_sequential(wc.Map, wc.Reduce, files, out)
-    dt = time.perf_counter() - t0
-    with open(out) as f:
-        lines = sorted(l for l in f if l.strip())
+    with Span("bench.oracle") as pt:
+        run_sequential(wc.Map, wc.Reduce, files, ORACLE_OUT)
+    dt = pt.elapsed_s
     total_mb = sum(os.path.getsize(p) for p in files) / 1e6
-    return lines, dt, total_mb / dt
+    return dt, total_mb / dt
 
 
-def run_tpu(files) -> tuple[list, float, float, dict]:
+def tpu_child(result_path: str) -> int:
+    """Child-process body: device init + kernel path + parity check.
+
+    Everything that can hang (axon backend init, compiles) happens here, so
+    the parent's kill-on-timeout recovers from any of it.  Writes a JSON
+    result to ``result_path``; parent treats a missing file as failure.
+    """
     from dsi_tpu.ops.wordcount import count_words_host_result, count_words_many
     from dsi_tpu.parallel.shuffle import write_partitioned_output
+    from dsi_tpu.utils.corpus import ensure_corpus
+    from dsi_tpu.utils.tracing import Span
 
-    # Warm-up: compile the kernel on the first split (cached thereafter).
+    def emit(obj: dict) -> None:
+        with open(result_path + ".tmp", "w") as f:
+            json.dump(obj, f)
+        os.replace(result_path + ".tmp", result_path)
+
+    # Same deterministic list as the parent's oracle run — NOT a directory
+    # glob, which would sweep in stale pg-*.txt files from an older corpus
+    # configuration and guarantee a parity mismatch.
+    files = ensure_corpus(WORKDIR, n_files=N_FILES, file_size=FILE_SIZE)
+
+    import jax
+    t0 = time.perf_counter()
+    try:
+        devices = jax.devices()
+    except RuntimeError as e:
+        emit({"error": f"device init failed: {e}"})
+        return 1
+    init_s = time.perf_counter() - t0
+    platform = devices[0].platform
+    log(f"child: devices={devices} init={init_s:.1f}s")
+
+    # Warm-up: compile the kernel on the first split.  The corpus pads every
+    # file to the same 2^21 shape, so this is the ONLY compile; the timed
+    # path below re-invokes the cached executable.
     with open(files[0], "rb") as f:
         first = f.read()
-    t0 = time.perf_counter()
-    count_words_host_result(first)
-    compile_s = time.perf_counter() - t0
+    with Span("bench.compile") as pt:
+        count_words_host_result(first)
+    compile_s = pt.elapsed_s
 
-    t0 = time.perf_counter()
-    t1 = time.perf_counter()
-    raws = []
-    for p in files:
-        with open(p, "rb") as f:
-            raws.append(f.read())
-    read_s = time.perf_counter() - t1
+    t_all = time.perf_counter()
+    with Span("bench.read") as pt:
+        raws = []
+        for p in files:
+            with open(p, "rb") as f:
+                raws.append(f.read())
+    read_s = pt.elapsed_s
 
-    t1 = time.perf_counter()
-    merged: dict = {}
-    for p, res in zip(files, count_words_many(raws)):
-        if res is None:  # host fallback would go here; corpus is ASCII
-            raise RuntimeError(f"kernel fell back on {p}")
-        for w, (c, h) in res.items():
-            if w in merged:
-                merged[w] = (merged[w][0] + c, merged[w][1])
-            else:
-                merged[w] = (c, h % N_REDUCE)
-    kern_s = time.perf_counter() - t1
+    with Span("bench.kernel") as pt:
+        merged: dict = {}
+        for p, res in zip(files, count_words_many(raws)):
+            if res is None:  # host fallback would go here; corpus is ASCII
+                emit({"error": f"kernel fell back on {p}", "permanent": True})
+                return 1
+            for w, (c, h) in res.items():
+                if w in merged:
+                    merged[w] = (merged[w][0] + c, merged[w][1])
+                else:
+                    merged[w] = (c, h % N_REDUCE)
+    kern_s = pt.elapsed_s
 
-    t1 = time.perf_counter()
-    write_partitioned_output(merged, N_REDUCE, WORKDIR)
-    write_s = time.perf_counter() - t1
-    dt = time.perf_counter() - t0
+    with Span("bench.write") as pt:
+        write_partitioned_output(merged, N_REDUCE, WORKDIR)
+    write_s = pt.elapsed_s
+    dt = time.perf_counter() - t_all
 
-    lines = []
+    tpu_lines = []
     for r in range(N_REDUCE):
         with open(os.path.join(WORKDIR, f"mr-out-{r}")) as f:
-            lines.extend(l for l in f if l.strip())
+            tpu_lines.extend(l for l in f if l.strip())
+    tpu_lines.sort()
+    with open(ORACLE_OUT) as f:
+        oracle_lines = sorted(l for l in f if l.strip())
+
+    parity = tpu_lines == oracle_lines
+    if not parity:
+        import itertools
+        for i, (a, b) in enumerate(
+                itertools.zip_longest(tpu_lines, oracle_lines)):
+            if a != b:
+                log(f"first diff at line {i}: tpu={a!r} oracle={b!r} (lines:"
+                    f" tpu={len(tpu_lines)} oracle={len(oracle_lines)})")
+                break
+
     total_mb = sum(os.path.getsize(p) for p in files) / 1e6
-    phases = {"compile_s": round(compile_s, 3), "read_s": round(read_s, 3),
-              "kernel_s": round(kern_s, 3), "write_s": round(write_s, 3)}
-    return sorted(lines), dt, total_mb / dt, phases
+    emit({"tpu_s": round(dt, 3), "tpu_mbps": round(total_mb / dt, 2),
+          "parity": parity, "platform": platform,
+          "phases": {"init_s": round(init_s, 1),
+                     "compile_s": round(compile_s, 3),
+                     "read_s": round(read_s, 3),
+                     "kernel_s": round(kern_s, 3),
+                     "write_s": round(write_s, 3)}})
+    return 0
+
+
+def run_tpu_watchdogged() -> dict:
+    """Run the TPU half in a subprocess with per-attempt timeouts and a
+    global deadline; return its result dict or {"error": ...}."""
+    # Malformed env knobs must not break the always-emit-a-verdict
+    # contract: fall back to defaults rather than raising past main().
+    try:
+        timeouts = [
+            float(x) for x in os.environ.get(
+                "DSI_BENCH_TPU_TIMEOUTS", "900,420,240").split(",")]
+    except ValueError:
+        log("ignoring malformed DSI_BENCH_TPU_TIMEOUTS")
+        timeouts = [900.0, 420.0, 240.0]
+    try:
+        budget_s = float(os.environ.get("DSI_BENCH_DEADLINE_S", "1500"))
+    except ValueError:
+        log("ignoring malformed DSI_BENCH_DEADLINE_S")
+        budget_s = 1500.0
+    deadline = time.monotonic() + budget_s
+    result_path = os.path.join(WORKDIR, "tpu-result.json")
+    last_err = "no attempt ran"
+    for attempt, budget in enumerate(timeouts, 1):
+        remaining = deadline - time.monotonic()
+        if remaining < 60:
+            last_err += f"; global deadline reached before attempt {attempt}"
+            break
+        budget = min(budget, remaining)
+        try:
+            os.remove(result_path)
+        except OSError:
+            pass
+        log(f"tpu attempt {attempt}/{len(timeouts)} (timeout {budget:.0f}s)")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--tpu-child",
+             result_path], stdout=sys.stderr)
+        timed_out = False
+        try:
+            rc = proc.wait(timeout=budget)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rc = proc.wait()
+            timed_out = True
+        if os.path.exists(result_path):
+            # Even after a timeout: the child writes its result atomically as
+            # its LAST act, so a child that measured successfully but hung in
+            # interpreter/JAX teardown still produced a valid verdict.
+            with open(result_path) as f:
+                res = json.load(f)
+            if "error" not in res:
+                return res
+            if res.get("permanent"):
+                # Deterministic failure (kernel fallback on this corpus):
+                # retrying cannot change the outcome.
+                return res
+            last_err = f"attempt {attempt}: {res['error']}"
+        elif timed_out:
+            last_err = f"attempt {attempt} timed out after {budget:.0f}s"
+        else:
+            last_err = f"attempt {attempt} exited rc={rc} with no result"
+        log(last_err)
+        if attempt < len(timeouts):  # no point cooling down after the last
+            time.sleep(min(15.0, max(0.0, deadline - time.monotonic())))
+    return {"error": last_err}
 
 
 def main() -> None:
@@ -109,57 +239,39 @@ def main() -> None:
     total_mb = sum(os.path.getsize(p) for p in files) / 1e6
     log(f"corpus: {len(files)} files, {total_mb:.1f} MB")
 
-    import jax
-
-    devices = None
-    for attempt in range(3):  # the TPU relay can be transiently unavailable
-        try:
-            devices = jax.devices()
-            break
-        except RuntimeError as e:
-            log(f"device init attempt {attempt + 1}/3 failed: {e}")
-            if attempt < 2:
-                time.sleep(60)
-    if devices is None:
-        print(json.dumps({"metric": "wc_tpu_throughput", "value": 0,
-                          "unit": "MB/s", "vs_baseline": 0,
-                          "error": "accelerator unavailable"}))
-        sys.exit(1)
-    platform = devices[0].platform
-    log(f"devices: {devices}")
-
-    oracle_lines, oracle_s, oracle_mbps = run_oracle(files)
+    oracle_s, oracle_mbps = run_oracle(files)
     log(f"oracle (mrsequential semantics): {oracle_s:.2f}s = "
-        f"{oracle_mbps:.2f} MB/s, {len(oracle_lines)} unique words")
+        f"{oracle_mbps:.2f} MB/s")
 
-    tpu_lines, tpu_s, tpu_mbps, phases = run_tpu(files)
-    log(f"tpu path: {tpu_s:.3f}s = {tpu_mbps:.2f} MB/s  phases={phases}")
-
-    parity = tpu_lines == oracle_lines
-    log(f"parity (sort mr-out-* vs oracle, test-mr.sh:52-53): {parity}")
-    if not parity:
-        import itertools
-
-        for i, (a, b) in enumerate(
-                itertools.zip_longest(tpu_lines, oracle_lines)):
-            if a != b:
-                log(f"first diff at line {i}: tpu={a!r} oracle={b!r} "
-                    f"(lines: tpu={len(tpu_lines)} oracle={len(oracle_lines)})")
-                break
+    res = run_tpu_watchdogged()
+    if "error" in res:
         print(json.dumps({"metric": "wc_tpu_throughput", "value": 0,
                           "unit": "MB/s", "vs_baseline": 0,
+                          "oracle_mbps": round(oracle_mbps, 2),
+                          "error": res["error"]}))
+        sys.exit(1)
+    log(f"tpu path: {res['tpu_s']:.3f}s = {res['tpu_mbps']:.2f} MB/s  "
+        f"phases={res['phases']}")
+    log(f"parity (sort mr-out-* vs oracle, test-mr.sh:52-53): {res['parity']}")
+    if not res["parity"]:
+        print(json.dumps({"metric": "wc_tpu_throughput", "value": 0,
+                          "unit": "MB/s", "vs_baseline": 0,
+                          "oracle_mbps": round(oracle_mbps, 2),
                           "error": "parity mismatch"}))
         sys.exit(1)
 
     print(json.dumps({
         "metric": "wc_tpu_throughput",
-        "value": round(tpu_mbps, 2),
+        "value": res["tpu_mbps"],
         "unit": "MB/s",
-        "vs_baseline": round(tpu_mbps / oracle_mbps, 2),
-        "platform": platform,
+        "vs_baseline": round(res["tpu_mbps"] / oracle_mbps, 2),
+        "platform": res["platform"],
         "oracle_mbps": round(oracle_mbps, 2),
+        "phases": res["phases"],
     }))
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--tpu-child":
+        sys.exit(tpu_child(sys.argv[2]))
     main()

@@ -22,6 +22,14 @@ def main(argv=None) -> int:
     p.add_argument("app")
     args = p.parse_args(argv)
     mapf, reducef = load_plugin(args.app)
+    # Build/load the native decoder NOW, before the task loop: the first
+    # lazy build (up to 120 s of g++) must not land inside a live reduce
+    # task, where it would blow straight through the coordinator's 10 s
+    # requeue timeout and cause spurious task duplication (worst on NFS
+    # fleets where many hosts race the same build).
+    from dsi_tpu import native
+
+    native.available()
     cfg = JobConfig(backend=args.backend)
     runner = None
     if args.backend == "tpu":

@@ -64,10 +64,21 @@ class Journal:
                             f"(files/n_reduce mismatch); refusing to resume")
                     saw_header = True
                     continue
-                if rec.get("kind") == "map":
-                    maps.append(int(rec["task"]))
-                elif rec.get("kind") == "reduce":
-                    reduces.append(int(rec["task"]))
+                kind = rec.get("kind")
+                if kind not in ("map", "reduce"):
+                    continue
+                task = rec.get("task")
+                # Require an actual int (bool is an int subclass; floats
+                # would silently truncate to a DIFFERENT task id) and
+                # range-check before use: a corrupted-but-parseable id would
+                # otherwise crash __init__ (IndexError) or, if negative,
+                # silently mark the WRONG task completed via Python negative
+                # indexing into map_log/reduce_log.
+                bound = len(self.files) if kind == "map" else self.n_reduce
+                if (not isinstance(task, int) or isinstance(task, bool)
+                        or not 0 <= task < bound):
+                    break  # corrupt record: stop replay like a torn tail
+                (maps if kind == "map" else reduces).append(task)
         return maps, reduces
 
     # ---- writing ----

@@ -25,29 +25,68 @@ Transports: a Unix-domain socket (the reference's live path) or TCP — the
 reference carries a commented-out TCP variant for multi-host operation
 (``mr/coordinator.go:124``, ``mr/worker.go:173``); here it is a first-class
 address form.  Addresses are strings: ``tcp:HOST:PORT`` selects TCP
-(``tcp:0.0.0.0:7777`` to listen on all interfaces; workers on other hosts
-then use ``tcp:<coordinator-host>:7777`` via ``DSI_MR_SOCKET``); anything
-else is a Unix socket path.  The filesystem data plane must be shared
-(NFS etc.) for multi-host runs, exactly as the reference assumes.
+(prefer ``tcp:127.0.0.1:7777`` unless workers really are on other hosts;
+those then use ``tcp:<coordinator-host>:7777`` via ``DSI_MR_SOCKET``);
+anything else is a Unix socket path.  The filesystem data plane must be
+shared (NFS etc.) for multi-host runs, exactly as the reference assumes.
+
+**Authentication.** The RPC surface accepts task-completion reports, so an
+unauthenticated TCP listener would let any reachable peer corrupt job
+output.  When ``DSI_MR_SECRET`` is set (or a ``secret=`` is passed
+explicitly), every request frame must carry a matching ``"auth"`` field;
+mismatches are rejected before method dispatch.  Binding TCP on a
+non-loopback interface without a secret is refused outright — Unix sockets
+and loopback keep the reference's no-auth behavior (the reference never
+enabled TCP at all, mr/coordinator.go:124).
+
+**Dial robustness.** The reference treats any dial failure as
+"coordinator gone" (``log.Fatal``, mr/worker.go:176-188) — but its Go
+runtime sits behind a 128-backlog listener, so a *busy* coordinator never
+looks like a dead one.  Our ``call()`` keeps that distinction explicit:
+transient dial errors (EAGAIN from a full accept queue, ECONNREFUSED races,
+ECONNRESET) are retried with bounded exponential backoff;
+:class:`CoordinatorGone` is raised only when the failure persists through
+the retry budget (or the socket path is simply absent).
 """
 
 from __future__ import annotations
 
+import errno
+import hmac
 import json
 import os
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 16 << 20
 
+# Dial errors worth retrying: a full accept backlog (EAGAIN/ECONNABORTED), a
+# listener mid-restart (ECONNREFUSED while the socket path still exists), or
+# a reset race.  ENOENT (no socket file) is NOT here: that is the genuine
+# coordinator-gone signal on the Unix transport.
+_TRANSIENT_DIAL_ERRNOS = frozenset({
+    errno.EAGAIN, errno.EWOULDBLOCK, errno.ECONNREFUSED, errno.ECONNRESET,
+    errno.ECONNABORTED, errno.EINTR,
+})
+_DIAL_ATTEMPTS = 6
+_DIAL_BACKOFF_S = 0.05  # doubled per attempt: ~1.6 s worst-case total
+
 
 class CoordinatorGone(Exception):
     """Raised when the coordinator socket cannot be dialed (reference:
     worker's log.Fatal on dial error, mr/worker.go:176-178)."""
+
+
+class AuthError(CoordinatorGone):
+    """The server rejected our auth token.  A worker with a missing or
+    wrong DSI_MR_SECRET can never make progress, so this is fatal like
+    CoordinatorGone — but it must be LOUD: a silent exit here looks exactly
+    like normal end-of-job and the fleet quietly shrinks to zero."""
 
 
 def parse_address(addr: str):
@@ -79,15 +118,25 @@ def _reachable_host(bind_host: str) -> str:
         return bind_host
     try:
         # Routing trick: connect() on UDP picks the outbound interface
-        # without sending a packet.
+        # without sending a packet.  A public address (8.8.8.8) selects the
+        # default route; an RFC1918 probe would pick an unrelated interface
+        # on hosts with no 10/8 route.
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         try:
-            s.connect(("10.255.255.255", 1))
+            s.connect(("8.8.8.8", 53))
             return s.getsockname()[0]
         finally:
             s.close()
     except OSError:
-        return socket.gethostname()
+        import sys
+        try:
+            host = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            host = socket.gethostname()
+        print(f"dsi-mr: cannot determine outbound interface; advertising "
+              f"{host!r} — set DSI_MR_ADVERTISE if workers cannot dial it",
+              file=sys.stderr)
+        return host
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -119,10 +168,21 @@ class RpcServer:
     stale socket file, listens, and serves in background threads.
     """
 
-    def __init__(self, socket_path: str, methods: Dict[str, Callable[[dict], dict]]):
+    def __init__(self, socket_path: str,
+                 methods: Dict[str, Callable[[dict], dict]],
+                 secret: str | None = None):
         self.socket_path = socket_path
         self.methods = dict(methods)
         self._kind, target = parse_address(socket_path)
+        secret = secret if secret is not None else os.environ.get("DSI_MR_SECRET")
+        if (self._kind == "tcp" and not secret
+                and target[0] not in ("127.0.0.1", "localhost", "::1")):
+            raise ValueError(
+                f"refusing to bind {socket_path!r} without authentication: "
+                "the RPC surface accepts task-completion reports, so an open "
+                "TCP listener lets any peer corrupt job output. Set "
+                "DSI_MR_SECRET (workers need the same value) or bind "
+                "tcp:127.0.0.1:PORT.")
         if self._kind == "unix":
             try:
                 os.remove(socket_path)  # mr/coordinator.go:126
@@ -139,6 +199,15 @@ class RpcServer:
                     # forever — remotely reachable once bound to TCP.
                     self.request.settimeout(60.0)
                     req = _recv_frame(self.request)
+                    # Compare utf-8 bytes: compare_digest(str, str) raises
+                    # TypeError on non-ASCII, which would crash the handler
+                    # and turn an auth mismatch into a silent connection drop.
+                    if secret and not hmac.compare_digest(
+                            str(req.get("auth", "")).encode("utf-8"),
+                            secret.encode("utf-8")):
+                        _send_frame(self.request, {"ok": False, "reply": None,
+                                                   "error": "auth failed"})
+                        return
                     fn = handler_methods.get(req.get("method", ""))
                     if fn is None:
                         _send_frame(self.request, {"ok": False, "reply": None,
@@ -155,6 +224,10 @@ class RpcServer:
         class Server(base):
             daemon_threads = True
             allow_reuse_address = True
+            # Go's net.Listen backlog is 128; Python's socketserver default
+            # of 5 turns a briefly busy coordinator into spurious EAGAIN
+            # dial failures for the whole fleet.
+            request_queue_size = 128
 
         self._server = Server(target, Handler)
         self._thread = threading.Thread(target=self._server.serve_forever,
@@ -184,33 +257,70 @@ class RpcServer:
                 pass
 
 
+def _dial(kind: str, target, socket_path: str,
+          timeout: float) -> socket.socket:
+    """Connect with bounded retry on transient errors.
+
+    A busy coordinator (full accept backlog → EAGAIN, listener race →
+    ECONNREFUSED) must not be mistaken for a dead one: losing a worker to a
+    transient dial error silently shrinks the fleet for the rest of the job.
+    Retries ``_DIAL_ATTEMPTS`` times with doubling backoff, then gives up
+    with :class:`CoordinatorGone`.  Non-transient errors (ENOENT: socket
+    file gone — the coordinator exited and we are on the reference's
+    log.Fatal path, mr/worker.go:176-178) raise immediately.  Connect
+    *timeouts* are deliberately not retried: a host that silently drops
+    SYNs has already cost one full ``timeout``, and retrying would turn
+    that into ``_DIAL_ATTEMPTS`` times as long.
+    """
+    family = socket.AF_INET if kind == "tcp" else socket.AF_UNIX
+    delay = _DIAL_BACKOFF_S
+    for attempt in range(_DIAL_ATTEMPTS):
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        try:
+            sock.connect(target)
+            return sock
+        except OSError as e:
+            sock.close()
+            transient = e.errno in _TRANSIENT_DIAL_ERRNOS
+            if not transient or attempt == _DIAL_ATTEMPTS - 1:
+                raise CoordinatorGone(f"dialing {socket_path}: {e}") from e
+            time.sleep(delay)
+            delay *= 2
+    raise AssertionError("unreachable")
+
+
 def call(socket_path: str, method: str, args: dict | None = None,
-         timeout: float = 60.0) -> tuple[bool, dict | None]:
+         timeout: float = 60.0, secret: str | None = None) -> tuple[bool, dict | None]:
     """One RPC: dial, send, receive, close.
 
     Returns ``(ok, reply)`` like the reference's ``call()`` helper
     (mr/worker.go:172-188).  Raises :class:`CoordinatorGone` if the socket
-    cannot be dialed — the reference worker dies here (log.Fatal), and our
-    worker loop treats it as job-over.
+    cannot be dialed after the transient-error retry budget — the reference
+    worker dies here (log.Fatal), and our worker loop treats it as job-over.
+    ``secret`` (default ``DSI_MR_SECRET``) is attached as the frame's
+    ``auth`` field for servers that require it.
     """
     try:
         kind, target = parse_address(socket_path)
     except ValueError as e:
         raise CoordinatorGone(str(e)) from None
-    family = socket.AF_INET if kind == "tcp" else socket.AF_UNIX
-    sock = socket.socket(family, socket.SOCK_STREAM)
-    sock.settimeout(timeout)
+    secret = secret if secret is not None else os.environ.get("DSI_MR_SECRET")
+    sock = _dial(kind, target, socket_path, timeout)
     try:
+        req: dict = {"method": method, "args": args or {}}
+        if secret:
+            req["auth"] = secret
         try:
-            sock.connect(target)
-        except OSError as e:
-            raise CoordinatorGone(f"dialing {socket_path}: {e}") from e
-        try:
-            _send_frame(sock, {"method": method, "args": args or {}})
+            _send_frame(sock, req)
             resp = _recv_frame(sock)
         except (OSError, ConnectionError, json.JSONDecodeError):
             return False, None  # RPC-level failure -> ok=false (worker.go:186-188)
         if not resp.get("ok"):
+            if resp.get("error") == "auth failed":
+                raise AuthError(
+                    f"server at {socket_path} rejected our auth token — "
+                    "check DSI_MR_SECRET matches the coordinator's")
             return False, None
         return True, resp.get("reply")
     finally:

@@ -158,18 +158,34 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
     instead of the host-Python execution above — this is the backend seam the
     TPU path plugs into (backends/tpu.py).
     """
+    import sys
+
     cfg = config or JobConfig()
     sock = cfg.sock()
     tasks_done = 0
+
+    def report_complete(method: str, task_number: int) -> bool:
+        """Completion RPC; False means the loop must exit.  An auth
+        rejection is always LOUD — a misconfigured worker must not look
+        like a clean end-of-job exit."""
+        try:
+            rpc.call(sock, method, {"TaskNumber": task_number})
+            return True
+        except rpc.AuthError as e:
+            print(f"mrworker: {e}", file=sys.stderr)
+            return False
+        except rpc.CoordinatorGone:
+            return False
+
     while True:
         try:
             ok, reply = rpc.call(sock, "Coordinator.RequestTask", {"TaskNumber": 0})
         except rpc.CoordinatorGone as e:
             # Coordinator exited; the reference worker dies here
             # (worker.go:176-178).  Normal at end-of-job; noteworthy if this
-            # worker never got a single task.
-            if tasks_done == 0:
-                import sys
+            # worker never got a single task, and always loud for an auth
+            # rejection (see report_complete).
+            if tasks_done == 0 or isinstance(e, rpc.AuthError):
                 print(f"mrworker: coordinator unreachable: {e}", file=sys.stderr)
             break
         if not ok or reply is None or reply["TaskStatus"] == int(TaskStatus.DONE):
@@ -183,10 +199,8 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
                 run_map_task(mapf, reply["Filename"], reply["CMap"],
                              reply["NReduce"], cfg.workdir)
             tasks_done += 1
-            try:
-                rpc.call(sock, "Coordinator.RecieveMapComplete",
-                         {"TaskNumber": reply["CMap"]})
-            except rpc.CoordinatorGone:
+            if not report_complete("Coordinator.RecieveMapComplete",
+                                   reply["CMap"]):
                 break
         elif status == int(TaskStatus.REDUCE):
             if task_runner is not None:
@@ -196,10 +210,8 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
                 run_reduce_task(reducef, reply["CReduce"], reply["NMap"],
                                 cfg.workdir)
             tasks_done += 1
-            try:
-                rpc.call(sock, "Coordinator.RecieveReduceComplete",
-                         {"TaskNumber": reply["CReduce"]})
-            except rpc.CoordinatorGone:
+            if not report_complete("Coordinator.RecieveReduceComplete",
+                                   reply["CReduce"]):
                 break
         else:  # WAITING — sleep instead of the reference's RPC busy-poll
             time.sleep(cfg.wait_sleep_s)

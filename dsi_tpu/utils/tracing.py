@@ -43,6 +43,26 @@ class PhaseTimer:
         return dict(self.totals)
 
 
+class Span:
+    """Times one named region; ``elapsed_s`` is set on exit.
+
+    Emits a ``log_event`` (span name + seconds) so DSI_TRACE=1 runs get a
+    structured timeline for free.
+    """
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.elapsed_s = 0.0
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed_s = time.perf_counter() - self._t0
+        log_event("span", name=self.name, seconds=round(self.elapsed_s, 4))
+
+
 @contextlib.contextmanager
 def maybe_jax_profile(out_dir: str | None = None) -> Iterator[None]:
     """Wrap a region in jax.profiler.trace when DSI_JAX_PROFILE is set."""
