@@ -27,7 +27,11 @@ Environment knobs:
                           "900,420,240" — first attempt covers a cold
                           ~454 s axon compile; later ones assume the
                           persistent cache is warm)
-  DSI_BENCH_DEADLINE_S    global wall budget for the TPU half (default 1500)
+  DSI_BENCH_DEADLINE_S    global wall budget for the TPU half (default
+                          1500).  An attempt only starts if >= 60 s of
+                          budget remain (anything less cannot even cover
+                          device init), so values under 60 disable the TPU
+                          half entirely.
 """
 
 from __future__ import annotations
@@ -226,8 +230,10 @@ def run_tpu_watchdogged() -> dict:
         else:
             last_err = f"attempt {attempt} exited rc={rc} with no result"
         log(last_err)
-        if attempt < len(timeouts):  # no point cooling down after the last
-            time.sleep(min(15.0, max(0.0, deadline - time.monotonic())))
+        # Cool down only when another attempt can actually run afterwards.
+        if (attempt < len(timeouts)
+                and deadline - time.monotonic() >= 60 + 15):
+            time.sleep(15.0)
     return {"error": last_err}
 
 

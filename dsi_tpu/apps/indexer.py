@@ -12,11 +12,11 @@ from __future__ import annotations
 from typing import List
 
 from dsi_tpu.mr.types import KeyValue
-from dsi_tpu.apps.wc import WORD_RE
+from dsi_tpu.apps.wc import tokenize
 
 
 def Map(filename: str, contents: str) -> List[KeyValue]:
-    words = sorted(set(WORD_RE.findall(contents)))
+    words = sorted(set(tokenize(contents)))
     return [KeyValue(w, filename) for w in words]
 
 

@@ -20,12 +20,12 @@ from __future__ import annotations
 from collections import Counter
 from typing import List, Optional
 
-from dsi_tpu.apps.wc import WORD_RE
+from dsi_tpu.apps.wc import tokenize
 from dsi_tpu.mr.types import KeyValue
 
 
 def Map(filename: str, contents: str) -> List[KeyValue]:
-    counts = Counter(WORD_RE.findall(contents))
+    counts = Counter(tokenize(contents))
     return [KeyValue(w, str(c)) for w, c in sorted(counts.items())]
 
 

@@ -5,24 +5,57 @@ letters (``strings.FieldsFunc`` with ``!unicode.IsLetter``, wc.go:21-34; note
 this splits on digits and underscores too) and emits ``{word, "1"}`` per word;
 Reduce returns ``strconv.Itoa(len(values))`` (wc.go:41-44).
 
-``WORD_RE`` = ``[^\\W\\d_]+`` is Python for "one or more Unicode letters":
-``\\w`` minus digits minus underscore, i.e. the same token class as Go's
-``unicode.IsLetter`` runs (identical on ASCII; both are Unicode category L on
-the letters that matter here).
+``tokenize`` matches Go's ``unicode.IsLetter`` exactly: a letter is a code
+point in Unicode category L (Lu/Ll/Lt/Lm/Lo) and nothing else.  A regex like
+``[^\\W\\d_]+`` is NOT equivalent: Python's ``\\w`` additionally admits
+numeral letters (categories Nl/No — Roman numerals, superscript digits) and
+combining marks, which Go splits on — e.g. ``"bⅣc"`` is one Python-regex
+token but two Go words (``Ⅳ`` is Nl).  On ASCII the letter class is exactly
+``[A-Za-z]`` and a compiled regex is used for speed.
 """
 
 from __future__ import annotations
 
 import re
+import unicodedata
 from typing import List
 
 from dsi_tpu.mr.types import KeyValue
 
-WORD_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+ASCII_WORD_RE = re.compile(r"[A-Za-z]+")
+
+
+def is_letter(ch: str) -> bool:
+    """Go ``unicode.IsLetter``: Unicode category L, nothing else."""
+    return unicodedata.category(ch).startswith("L")
+
+
+class _NonLettersToSpace(dict):
+    """``str.translate`` table mapping non-letters to a space, built and
+    memoized lazily per code point (the per-char category lookup happens
+    once per distinct character, not once per character of input)."""
+
+    def __missing__(self, cp: int):
+        out = chr(cp) if is_letter(chr(cp)) else " "
+        self[cp] = out
+        return out
+
+
+_XLATE = _NonLettersToSpace()
+
+
+def tokenize(contents: str) -> List[str]:
+    """Maximal runs of Unicode letters — exactly
+    ``strings.FieldsFunc(contents, !unicode.IsLetter)`` (wc.go:21-34)."""
+    if contents.isascii():
+        return ASCII_WORD_RE.findall(contents)
+    # All whitespace is non-letter, so mapping every non-letter to " " and
+    # splitting on whitespace yields exactly the maximal letter runs.
+    return contents.translate(_XLATE).split()
 
 
 def Map(filename: str, contents: str) -> List[KeyValue]:
-    return [KeyValue(w, "1") for w in WORD_RE.findall(contents)]
+    return [KeyValue(w, "1") for w in tokenize(contents)]
 
 
 def Reduce(key: str, values: List[str]) -> str:

@@ -34,66 +34,97 @@ class Journal:
         self.files = list(files)
         self.n_reduce = n_reduce
         self._fh: Optional[TextIO] = None
+        self._trunc_at: Optional[int] = None  # set by replay()
 
     # ---- replay ----
 
     def replay(self) -> tuple[List[int], List[int]]:
         """Return (completed map task ids, completed reduce task ids) from an
         existing journal, after validating the job header.  Empty lists when
-        no journal exists yet."""
+        no journal exists yet.
+
+        Replay stops at the FIRST corrupt record (torn write, bad JSON, or an
+        out-of-range/non-int task id) and remembers its byte offset so
+        :meth:`open` can truncate the file there.  Without the truncation, a
+        single corrupt mid-file record would poison the journal forever: new
+        completions appended after it could never be replayed, and every
+        restart would re-run them (re-execution is idempotent, so stopping
+        early is always SAFE — truncating just stops it being wasteful)."""
         maps: List[int] = []
         reduces: List[int] = []
+        self._trunc_at: Optional[int] = None
         if not os.path.exists(self.path):
             return maps, reduces
+        with open(self.path, "rb") as f:
+            data = f.read()
         saw_header = False
-        with open(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    break  # torn tail write: ignore the partial record
-                if not saw_header:  # first non-blank record must be a header
-                    if (rec.get("kind") != "header"
-                            or rec.get("files") != self.files
-                            or rec.get("n_reduce") != self.n_reduce):
-                        raise SystemExit(
-                            f"journal {self.path} belongs to a different job "
-                            f"(files/n_reduce mismatch); refusing to resume")
-                    saw_header = True
-                    continue
-                kind = rec.get("kind")
-                if kind not in ("map", "reduce"):
-                    continue
-                task = rec.get("task")
-                # Require an actual int (bool is an int subclass; floats
-                # would silently truncate to a DIFFERENT task id) and
-                # range-check before use: a corrupted-but-parseable id would
-                # otherwise crash __init__ (IndexError) or, if negative,
-                # silently mark the WRONG task completed via Python negative
-                # indexing into map_log/reduce_log.
-                bound = len(self.files) if kind == "map" else self.n_reduce
-                if (not isinstance(task, int) or isinstance(task, bool)
-                        or not 0 <= task < bound):
-                    break  # corrupt record: stop replay like a torn tail
-                (maps if kind == "map" else reduces).append(task)
+        pos = 0
+        while pos < len(data):
+            nl = data.find(b"\n", pos)
+            rec_start = pos
+            if nl == -1:  # torn tail: no terminating newline
+                self._trunc_at = rec_start
+                break
+            line = data[rec_start:nl].strip()
+            pos = nl + 1
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                self._trunc_at = rec_start
+                break
+            if not isinstance(rec, dict):  # valid JSON but not an object
+                self._trunc_at = rec_start
+                break
+            if not saw_header:  # first non-blank record must be a header
+                if (rec.get("kind") != "header"
+                        or rec.get("files") != self.files
+                        or rec.get("n_reduce") != self.n_reduce):
+                    raise SystemExit(
+                        f"journal {self.path} belongs to a different job "
+                        f"(files/n_reduce mismatch); refusing to resume")
+                saw_header = True
+                continue
+            kind = rec.get("kind")
+            if kind not in ("map", "reduce"):
+                self._trunc_at = rec_start
+                break
+            task = rec.get("task")
+            # Require an actual int (bool is an int subclass; floats would
+            # silently truncate to a DIFFERENT task id) and range-check
+            # before use: a corrupted-but-parseable id would otherwise crash
+            # __init__ (IndexError) or, if negative, silently mark the WRONG
+            # task completed via Python negative indexing into map_log/
+            # reduce_log.
+            bound = len(self.files) if kind == "map" else self.n_reduce
+            if (not isinstance(task, int) or isinstance(task, bool)
+                    or not 0 <= task < bound):
+                self._trunc_at = rec_start
+                break
+            (maps if kind == "map" else reduces).append(task)
         return maps, reduces
 
     # ---- writing ----
 
     def open(self) -> None:
-        # Repair a torn tail (crash mid-write): truncate to the last
-        # complete line so new records never merge into a partial one.
+        # Repair corruption found during replay (torn tail or a bad mid-file
+        # record): truncate at the first bad byte so future appends land in
+        # replayable territory.  Falls back to plain torn-tail repair when
+        # open() is used without a prior replay().
         size = os.path.getsize(self.path) if os.path.exists(self.path) else 0
+        trunc_at = getattr(self, "_trunc_at", None)
         if size > 0:
             with open(self.path, "rb+") as f:
-                data = f.read()
-                if not data.endswith(b"\n"):
-                    keep = data.rfind(b"\n") + 1
-                    f.truncate(keep)
-                    size = keep
+                if trunc_at is not None and trunc_at < size:
+                    f.truncate(trunc_at)
+                    size = trunc_at
+                else:
+                    data = f.read()
+                    if not data.endswith(b"\n"):
+                        keep = data.rfind(b"\n") + 1
+                        f.truncate(keep)
+                        size = keep
         self._fh = open(self.path, "a")
         if size == 0:  # empty counts as fresh: a torn header must be rewritten
             self._write({"kind": "header", "files": self.files,

@@ -33,11 +33,17 @@ shared (NFS etc.) for multi-host runs, exactly as the reference assumes.
 **Authentication.** The RPC surface accepts task-completion reports, so an
 unauthenticated TCP listener would let any reachable peer corrupt job
 output.  When ``DSI_MR_SECRET`` is set (or a ``secret=`` is passed
-explicitly), every request frame must carry a matching ``"auth"`` field;
-mismatches are rejected before method dispatch.  Binding TCP on a
-non-loopback interface without a secret is refused outright — Unix sockets
-and loopback keep the reference's no-auth behavior (the reference never
-enabled TCP at all, mr/coordinator.go:124).
+explicitly), every request frame must carry an ``"auth"`` object holding a
+nonce and an HMAC-SHA256 over the frame body keyed by the secret — the
+secret itself never crosses the wire, so a traffic observer cannot extract
+it and forge arbitrary calls.  Mismatches are rejected before method
+dispatch.  Binding TCP on a non-loopback interface without a secret is
+refused outright — Unix sockets and loopback keep the reference's no-auth
+behavior (the reference never enabled TCP at all, mr/coordinator.go:124).
+Limits, stated plainly: frames are not encrypted and there is no replay
+tracking (a captured frame can be re-sent verbatim; completion RPCs are
+idempotent, so replay is a nuisance rather than corruption).  Treat
+non-loopback TCP as suitable for trusted/isolated networks only.
 
 **Dial robustness.** The reference treats any dial failure as
 "coordinator gone" (``log.Fatal``, mr/worker.go:176-188) — but its Go
@@ -75,6 +81,39 @@ _TRANSIENT_DIAL_ERRNOS = frozenset({
 })
 _DIAL_ATTEMPTS = 6
 _DIAL_BACKOFF_S = 0.05  # doubled per attempt: ~1.6 s worst-case total
+
+
+def _canonical_body(method: str, args: dict) -> bytes:
+    """Deterministic bytes both sides MAC over (key order must not matter)."""
+    return json.dumps({"method": method, "args": args},
+                      sort_keys=True, separators=(",", ":")).encode("utf-8")
+
+
+def _auth_mac(secret: str, nonce: str, body: bytes) -> str:
+    return hmac.new(secret.encode("utf-8"), nonce.encode("ascii") + body,
+                    "sha256").hexdigest()
+
+
+def _check_auth(secret: str, req: dict) -> bool:
+    """Verify the request's auth object without ever learning more than
+    pass/fail; malformed auth shapes are just failures."""
+    if not isinstance(req, dict):
+        return False
+    auth = req.get("auth")
+    if not isinstance(auth, dict):
+        return False
+    nonce, mac = auth.get("nonce"), auth.get("mac")
+    if not isinstance(nonce, str) or not isinstance(mac, str):
+        return False
+    try:
+        nonce.encode("ascii")
+    except UnicodeEncodeError:
+        return False
+    want = _auth_mac(secret, nonce,
+                     _canonical_body(req.get("method", ""),
+                                     req.get("args") or {}))
+    return hmac.compare_digest(mac.encode("ascii", "replace"),
+                               want.encode("ascii"))
 
 
 class CoordinatorGone(Exception):
@@ -199,12 +238,12 @@ class RpcServer:
                     # forever — remotely reachable once bound to TCP.
                     self.request.settimeout(60.0)
                     req = _recv_frame(self.request)
-                    # Compare utf-8 bytes: compare_digest(str, str) raises
-                    # TypeError on non-ASCII, which would crash the handler
-                    # and turn an auth mismatch into a silent connection drop.
-                    if secret and not hmac.compare_digest(
-                            str(req.get("auth", "")).encode("utf-8"),
-                            secret.encode("utf-8")):
+                    if not isinstance(req, dict):
+                        _send_frame(self.request,
+                                    {"ok": False, "reply": None,
+                                     "error": "malformed request frame"})
+                        return
+                    if secret and not _check_auth(secret, req):
                         _send_frame(self.request, {"ok": False, "reply": None,
                                                    "error": "auth failed"})
                         return
@@ -310,7 +349,11 @@ def call(socket_path: str, method: str, args: dict | None = None,
     try:
         req: dict = {"method": method, "args": args or {}}
         if secret:
-            req["auth"] = secret
+            nonce = os.urandom(16).hex()
+            req["auth"] = {"nonce": nonce,
+                           "mac": _auth_mac(secret, nonce,
+                                            _canonical_body(method,
+                                                            args or {}))}
         try:
             _send_frame(sock, req)
             resp = _recv_frame(sock)

@@ -85,6 +85,41 @@ def test_torn_tail_truncated_before_append(tmp_path):
     c3.close()
 
 
+def test_corrupt_midfile_record_truncated_for_future_appends(tmp_path):
+    """A corrupt record in the MIDDLE of the journal (bit flip that still
+    ends in newline) stops replay there — safe, re-execution is idempotent —
+    and open() truncates at the corruption so completions appended by the
+    resumed coordinator are replayable by a THIRD incarnation.  Without the
+    truncation the journal is poisoned forever: everything after the bad
+    record is invisible to every future resume."""
+    files = ["a", "b", "c", "d"]
+    c1 = Coordinator(files, 3, _cfg(tmp_path))
+    c1.map_complete({"TaskNumber": 0})
+    c1.map_complete({"TaskNumber": 1})
+    c1.close()
+    path = os.path.join(str(tmp_path), "journal")
+    with open(path, "rb+") as f:
+        data = f.read()
+        # corrupt the SECOND map record (flip its task id out of range),
+        # keeping valid JSON + trailing newline
+        bad = data.replace(b'{"kind": "map", "task": 1}',
+                           b'{"kind": "map", "task": 9}')
+        assert bad != data
+        f.seek(0)
+        f.truncate()
+        f.write(bad)
+
+    c2 = Coordinator(files, 3, _cfg(tmp_path))
+    assert c2.c_map == 1  # replay stopped at the corrupt record
+    c2.map_complete({"TaskNumber": 3})
+    c2.close()
+
+    c3 = Coordinator(files, 3, _cfg(tmp_path))
+    assert c3.c_map == 2  # task 0 (pre-corruption) + task 3 (post-repair)
+    assert c3.map_log[0] == 2 and c3.map_log[3] == 2
+    c3.close()
+
+
 def test_empty_journal_file_gets_header(tmp_path):
     """Crash between file creation and header write must not brick resume."""
     path = os.path.join(str(tmp_path), "journal")

@@ -13,13 +13,13 @@ import string
 
 import pytest
 
-from dsi_tpu.apps.wc import WORD_RE
+from dsi_tpu.apps.wc import tokenize
 from dsi_tpu.mr.worker import ihash
 from dsi_tpu.ops.wordcount import count_words_host_result, count_words_many
 
 
 def oracle_counts(text: str):
-    return collections.Counter(WORD_RE.findall(text))
+    return collections.Counter(tokenize(text))
 
 
 def check(text: str):

@@ -56,6 +56,49 @@ def test_wordcount_kernel_arbitrary_ascii_bytes(data):
     assert {w: c for w, (c, _) in res.items()} == dict(want)
 
 
+# Adversarial Unicode alphabet for tokenizer parity: ASCII letters and
+# separators, Nl numeral letters (Roman numerals — "letters" to Python's \w
+# but NOT to Go's unicode.IsLetter), No numerics, combining marks, CJK,
+# Greek, a Latin-1 ordinal (Lo — a real letter), digits and punctuation.
+unicode_text = st.text(
+    alphabet="ab XY.\n0Ⅳⅻ²½ªµ漢語αβ́̈_-", min_size=0, max_size=800)
+
+
+def go_letter_runs(text):
+    """Rune-level oracle for strings.FieldsFunc(s, !unicode.IsLetter)
+    (mrapps/wc.go:23): maximal runs of Unicode category-L code points."""
+    import unicodedata
+
+    out, cur = [], []
+    for ch in text:
+        if unicodedata.category(ch).startswith("L"):
+            cur.append(ch)
+        elif cur:
+            out.append("".join(cur))
+            cur = []
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+@settings(max_examples=80, deadline=None)
+@given(unicode_text)
+def test_tokenizer_matches_go_isletter_on_unicode(text):
+    from dsi_tpu.apps.wc import tokenize
+
+    assert tokenize(text) == go_letter_runs(text)
+
+
+@settings(max_examples=40, deadline=None)
+@given(unicode_text)
+def test_wc_map_host_path_unicode_parity(text):
+    """The full host Map (the kernel's fallback contract) must produce
+    exactly the Go-semantics words on non-ASCII text too."""
+    from dsi_tpu.apps import wc
+
+    assert [kv.key for kv in wc.Map("f", text)] == go_letter_runs(text)
+
+
 @settings(max_examples=40, deadline=None)
 @given(dense_text, st.text(alphabet="abX .", min_size=1, max_size=6))
 def test_grep_kernel_matches_regex(text, pat):
