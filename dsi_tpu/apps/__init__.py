@@ -1,7 +1,7 @@
 """Application plugins (reference: ``mrapps/``).
 
 Each module exposes the two-symbol contract ``Map``/``Reduce``
-(mrapps/wc.go:21,41).  Registered names: wc, grep, indexer, crash, nocrash.
+(mrapps/wc.go:21,41).  Registered names: wc, grep, indexer, tfidf, crash, nocrash.
 """
 
-REGISTERED = ("wc", "grep", "indexer", "crash", "nocrash")
+REGISTERED = ("wc", "grep", "indexer", "tfidf", "crash", "nocrash")

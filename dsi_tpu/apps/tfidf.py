@@ -1,0 +1,85 @@
+"""TF-IDF app (string-valued reduce over per-document term counts).
+
+Not present in the reference repo; targeted by BASELINE.json's multi-chip
+config ("TF-IDF 10 GB shard (multi-chip all_to_all)").  Composes the two
+existing kernels' semantics — per-document term counts (the wc path,
+``mrapps/wc.go:21-34`` tokenization) and document frequency (the indexer
+path) — into tf-idf scores:
+
+* Map(doc, contents) emits one ``{word, "<doc>\\t<tf>"}`` record per
+  distinct word per document (a combiner: tf is the in-document count),
+* Reduce(word, values) sees one record per document containing the word, so
+  ``df = len(distinct docs)``; it scores each document
+  ``tf * ln(N / df)`` and returns
+  ``"<df> <doc1>:<score1>,<doc2>:<score2>,..."`` with documents sorted.
+
+``N`` (total document count) is job-level config that a per-key reduce
+cannot derive, so it arrives via ``DSI_TFIDF_NDOCS`` — the harness, bench
+and tests set it to the number of input files.  A missing value is a loud
+error: silently wrong idf would defeat the differential-oracle discipline.
+
+``tpu_map`` makes ``--backend=tpu`` route the tokenize/count hot loop
+through the fused device kernel (``dsi_tpu/ops/wordcount.py``); the SPMD
+whole-corpus path lives in ``dsi_tpu/parallel/tfidf.py`` and produces
+byte-identical lines via the shared :func:`format_value`.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from dsi_tpu.apps.wc import tokenize
+from dsi_tpu.mr.types import KeyValue
+
+
+def n_docs_from_env() -> int:
+    raw = os.environ.get("DSI_TFIDF_NDOCS")
+    if not raw:
+        raise RuntimeError(
+            "tfidf needs DSI_TFIDF_NDOCS (total document count) — a per-key "
+            "reduce cannot derive N, and a silently wrong idf would defeat "
+            "output parity checks")
+    return int(raw)
+
+
+def format_value(pairs: Sequence[Tuple[str, int]], n_docs: int) -> str:
+    """The reduce output string: ``"<df> doc:score,..."``, docs sorted.
+
+    Shared by the host Reduce and the SPMD path
+    (``parallel/tfidf.py``) so both produce byte-identical lines;
+    scores are fixed to 6 decimals to keep float formatting deterministic.
+    """
+    by_doc = dict(pairs)  # defensive dedupe; one entry per doc by contract
+    df = len(by_doc)
+    idf = math.log(n_docs / df)
+    scored = ",".join(f"{d}:{tf * idf:.6f}" for d, tf in sorted(by_doc.items()))
+    return f"{df} {scored}"
+
+
+def Map(filename: str, contents: str) -> List[KeyValue]:
+    counts: dict = {}
+    for w in tokenize(contents):
+        counts[w] = counts.get(w, 0) + 1
+    return [KeyValue(w, f"{filename}\t{c}") for w, c in sorted(counts.items())]
+
+
+def Reduce(key: str, values: List[str]) -> str:
+    pairs = []
+    for v in values:
+        doc, _, tf = v.rpartition("\t")
+        pairs.append((doc, int(tf)))
+    return format_value(pairs, n_docs_from_env())
+
+
+def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
+    """Device map for ``--backend=tpu``: the fused tokenize/group/count
+    kernel; None routes non-ASCII documents to the host Map."""
+    from dsi_tpu.ops.wordcount import count_words_host_result
+
+    res = count_words_host_result(raw)
+    if res is None:
+        return None
+    return [KeyValue(w, f"{filename}\t{c}")
+            for w, (c, _) in sorted(res.items())]

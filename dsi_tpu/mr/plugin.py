@@ -56,7 +56,7 @@ def load_plugin_module(name_or_path: str):
             raise SystemExit(
                 f"cannot load plugin {name_or_path!r}: {e} "
                 f"(registered apps: wc, tpu_wc, grep, tpu_grep, indexer, "
-                f"tpu_indexer, crash, nocrash)")
+                f"tpu_indexer, tfidf, crash, nocrash)")
     return mod
 
 

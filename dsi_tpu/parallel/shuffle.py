@@ -61,6 +61,36 @@ def default_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), (AXIS,))
 
 
+def shuffle_rows(rows: jax.Array, dest: jax.Array, *, n_dev: int,
+                 u_cap: int, k: int) -> jax.Array:
+    """Route per-word rows to their destination devices over ICI.
+
+    The shared shuffle primitive of every SPMD job step (word count here,
+    TF-IDF in ``parallel/tfidf.py``): scatter ``rows`` [u_cap, k+p] (k word
+    key lanes + p payload lanes) into one fixed ``u_cap``-row block per
+    destination — a device has at most ``u_cap`` rows total, so a
+    per-destination block of the same size can never overflow — then one
+    ``lax.all_to_all``.  ``dest`` must be ``n_dev`` for invalid rows (they
+    are parked on the scatter's overflow row and dropped).  Pad rows carry
+    key ``0xFFFFFFFF``, which sorts after every real ASCII word.
+    """
+    p = rows.shape[1] - k
+    order = jnp.argsort(dest, stable=True)
+    sdest = dest[order]
+    srows = rows[order]
+    counts = jnp.bincount(sdest, length=n_dev + 1).astype(jnp.int32)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    pos_in = jnp.arange(u_cap, dtype=jnp.int32) - starts[sdest]
+    flat = jnp.where(sdest < n_dev, sdest * u_cap + pos_in, n_dev * u_cap)
+    pad_row = jnp.concatenate(
+        [jnp.full((k,), _PAD_KEY, jnp.uint32), jnp.zeros((p,), jnp.uint32)])
+    sendbuf = jnp.broadcast_to(pad_row, (n_dev * u_cap + 1, k + p))
+    sendbuf = sendbuf.at[flat].set(srows)[:n_dev * u_cap]
+    return lax.all_to_all(sendbuf, AXIS, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+
 def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
                  max_word_len: int, u_cap: int, t_cap_frac: int):
     """Per-device body (runs under shard_map): map, all_to_all, reduce."""
@@ -75,26 +105,11 @@ def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
     part = (fnv_u & jnp.uint32(0x7FFFFFFF)) % jnp.uint32(n_reduce)
     dest = jnp.where(uvalid, (part % n_dev).astype(jnp.int32), n_dev)
 
-    # ── build the send buffer: one fixed u_cap-row block per destination ──
+    # ── shuffle: the mr-X-Y files become one ICI collective ──
     rows = jnp.concatenate(
         [packed_u, len_u[:, None].astype(jnp.uint32),
          cnt_u[:, None].astype(jnp.uint32), part[:, None]], axis=1)
-    order = jnp.argsort(dest, stable=True)
-    sdest = dest[order]
-    srows = rows[order]
-    counts = jnp.bincount(sdest, length=n_dev + 1).astype(jnp.int32)
-    starts = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
-    pos_in = jnp.arange(u_cap, dtype=jnp.int32) - starts[sdest]
-    flat = jnp.where(sdest < n_dev, sdest * u_cap + pos_in, n_dev * u_cap)
-    pad_row = jnp.concatenate(
-        [jnp.full((k,), _PAD_KEY, jnp.uint32), jnp.zeros((3,), jnp.uint32)])
-    sendbuf = jnp.broadcast_to(pad_row, (n_dev * u_cap + 1, k + 3))
-    sendbuf = sendbuf.at[flat].set(srows)[:n_dev * u_cap]
-
-    # ── shuffle: the mr-X-Y files become one ICI collective ──
-    recv = lax.all_to_all(sendbuf, AXIS, split_axis=0, concat_axis=0,
-                          tiled=True)
+    recv = shuffle_rows(rows, dest, n_dev=n_dev, u_cap=u_cap, k=k)
 
     # ── reduce: sort received records by word, sum counts per run
     #    (shared grouping idiom, ops/wordcount.py group_sorted) ──

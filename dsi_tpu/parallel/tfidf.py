@@ -1,0 +1,198 @@
+"""SPMD TF-IDF: per-document device map + all_to_all shuffle, host scoring.
+
+The multi-chip composition BASELINE.json's last config calls for.  Documents
+are processed in waves of ``n_dev`` (one document per device per wave):
+
+* map   = per-device ``tokenize_group_core`` over its document — the same
+  fused kernel as word count, but each unique word row carries the document
+  id and in-document count (tf) as payload lanes,
+* shuffle = ``jax.lax.all_to_all`` routes every (word, doc, tf) row to the
+  device owning the word's reduce partition (``ihash % n_reduce % n_dev``,
+  bit-identical to ``mr/worker.go:33-37,76``), replacing the reference's
+  ``mr-X-Y`` intermediate files exactly as in ``parallel/shuffle.py``,
+* reduce = per-device sort of received rows by word; the host walks the
+  sorted rows per wave, accumulates ``word -> [(doc, tf), ...]`` across
+  waves, and computes ``df``/``tf·ln(N/df)`` at output time via the SAME
+  ``apps.tfidf.format_value`` the host Reduce uses — so the SPMD job's
+  ``mr-out-*`` files are byte-identical to the sequential oracle's.
+
+Cross-wave state is a host dict, NOT device memory: a wave's device
+footprint is bounded by (n_dev x document shard) regardless of corpus size,
+which is what lets the same program scale to the 10 GB config by adding
+waves.  All shapes are static across waves (documents are padded to one
+global power-of-two length) so the whole job compiles exactly one program
+per retry rung.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dsi_tpu.ops.wordcount import (
+    _PAD_KEY,
+    decode_packed,
+    exactness_retry,
+    tokenize_group_core,
+)
+from dsi_tpu.parallel.shuffle import AXIS, default_mesh, shuffle_rows
+
+
+def _tfidf_device_step(chunk: jax.Array, doc_id: jax.Array, *, n_dev: int,
+                       n_reduce: int, max_word_len: int, u_cap: int,
+                       t_cap_frac: int):
+    """Per-device wave body: map its document, all_to_all, sort received."""
+    k = max_word_len // 4
+    chunk = chunk.reshape(-1)
+    doc = doc_id.reshape(())
+
+    (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
+     token_overflow) = tokenize_group_core(
+        chunk, max_word_len=max_word_len, u_cap=u_cap, t_cap_frac=t_cap_frac)
+    uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
+    part = (fnv_u & jnp.uint32(0x7FFFFFFF)) % jnp.uint32(n_reduce)
+    dest = jnp.where(uvalid, (part % n_dev).astype(jnp.int32), n_dev)
+
+    # Send rows: word key lanes + [len, tf, doc, part] payload, routed by
+    # the shared shuffle primitive (parallel/shuffle.py shuffle_rows).
+    rows = jnp.concatenate(
+        [packed_u, len_u[:, None].astype(jnp.uint32),
+         cnt_u[:, None].astype(jnp.uint32),
+         jnp.broadcast_to(doc.astype(jnp.uint32), (u_cap,))[:, None],
+         part[:, None]], axis=1)
+    recv = shuffle_rows(rows, dest, n_dev=n_dev, u_cap=u_cap, k=k)
+
+    # Sort received rows by word so the host walk groups runs linearly; pad
+    # rows (key lane 0xFFFFFFFF, impossible for ASCII words) sort last.
+    cols = tuple(recv[:, j] for j in range(k + 4))
+    sorted_cols = lax.sort(cols, num_keys=k)
+    srecv = jnp.stack(sorted_cols, axis=1)
+    n_rows = jnp.sum(sorted_cols[0] != jnp.uint32(_PAD_KEY),
+                     dtype=jnp.int32)
+
+    scalars = jnp.stack([n_rows, n_unique, max_len,
+                         has_high.astype(jnp.int32),
+                         token_overflow.astype(jnp.int32)])
+    return srecv[None], scalars[None]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_dev", "n_reduce", "max_word_len",
+                                    "u_cap", "t_cap_frac", "mesh"))
+def tfidf_wave_step(chunks: jax.Array, doc_ids: jax.Array, *, n_dev: int,
+                    n_reduce: int, max_word_len: int, u_cap: int, mesh: Mesh,
+                    t_cap_frac: int = 4):
+    """One SPMD wave: ``chunks`` [n_dev, L] uint8 (one zero-padded document
+    per device), ``doc_ids`` [n_dev] int32.  Returns per-device sorted
+    (word, len, tf, doc, part) rows [D, D*u_cap, K+4] and [D, 5] scalars
+    (n_rows, n_unique, max_len, has_high, token_overflow)."""
+    body = functools.partial(_tfidf_device_step, n_dev=n_dev,
+                             n_reduce=n_reduce, max_word_len=max_word_len,
+                             u_cap=u_cap, t_cap_frac=t_cap_frac)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS)),
+        out_specs=(P(AXIS, None, None), P(AXIS, None)))(chunks, doc_ids)
+
+
+def _pad_docs(docs: Sequence[bytes], n_dev: int) -> Tuple[np.ndarray, int]:
+    """All documents to ONE power-of-two length; waves of n_dev rows."""
+    longest = max((len(d) for d in docs), default=1)
+    size = 1 << max(8, longest.bit_length())  # next pow2 > longest-1
+    n_waves = -(-len(docs) // n_dev)
+    out = np.zeros((n_waves * n_dev, size), dtype=np.uint8)
+    for i, d in enumerate(docs):
+        out[i, :len(d)] = np.frombuffer(d, dtype=np.uint8)
+    return out, size
+
+
+def tfidf_sharded(
+        docs: Sequence[bytes], mesh: Mesh | None = None, n_reduce: int = 10,
+        max_word_len: int = 16, u_cap: int = 1 << 15,
+) -> Optional[Dict[str, Tuple[int, List[Tuple[int, int]]]]]:
+    """Whole-corpus TF-IDF over the mesh, waves of n_dev documents.
+
+    Returns ``{word: (reduce_partition, [(doc_index, tf), ...])}`` — exact,
+    or None when any document needs the host path (non-ASCII bytes, words
+    longer than 64).  Same retry discipline as ``wordcount_sharded``.
+    """
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    padded, size = _pad_docs(docs, n_dev)
+    n_waves = padded.shape[0] // n_dev
+
+    def run(mwl: int, cap: int):
+        kk = mwl // 4
+        waves = []
+        agg_high = False
+        agg_nu = 0
+        agg_ml = 0
+        for wv in range(n_waves):
+            chunk = jnp.asarray(padded[wv * n_dev:(wv + 1) * n_dev])
+            ids = jnp.arange(wv * n_dev, (wv + 1) * n_dev, dtype=jnp.int32)
+            for frac in (4, 2):
+                rows, scal = tfidf_wave_step(
+                    chunk, ids, n_dev=n_dev, n_reduce=n_reduce,
+                    max_word_len=mwl, u_cap=cap, mesh=mesh, t_cap_frac=frac)
+                scal_np = np.asarray(scal)
+                if not scal_np[:, 4].any():
+                    break
+            waves.append((np.asarray(rows), scal_np))
+            agg_high = agg_high or bool(scal_np[:, 3].any())
+            agg_nu = max(agg_nu, int(scal_np[:, 1].max()))
+            agg_ml = max(agg_ml, int(scal_np[:, 2].max()))
+            if agg_nu > cap or agg_ml > mwl:
+                break  # this rung's results will be discarded by the retry;
+                # running the remaining waves would be pure waste
+
+        def payload():
+            result: Dict[str, Tuple[int, List[Tuple[int, int]]]] = {}
+            n_real = len(docs)
+            for rows, scal_np in waves:
+                for d in range(n_dev):
+                    nr = int(scal_np[d, 0])
+                    if nr == 0:
+                        continue
+                    r = rows[d, :nr]
+                    words = decode_packed(r[:, :kk], r[:, kk], nr)
+                    tfs = r[:, kk + 1]
+                    dids = r[:, kk + 2]
+                    parts = r[:, kk + 3]
+                    for i, w in enumerate(words):
+                        di = int(dids[i])
+                        if di >= n_real:  # padding document of the last wave
+                            continue
+                        ent = result.get(w)
+                        if ent is None:
+                            result[w] = (int(parts[i]), [(di, int(tfs[i]))])
+                        else:
+                            ent[1].append((di, int(tfs[i])))
+            return result
+
+        return agg_high, agg_nu, agg_ml, payload
+
+    payload = exactness_retry(run, size, max_word_len, u_cap)
+    return None if payload is None else payload()
+
+
+def write_tfidf_output(result: Dict[str, Tuple[int, List[Tuple[int, int]]]],
+                       doc_names: Sequence[str], n_reduce: int,
+                       workdir: str = ".") -> List[str]:
+    """Materialise mr-out-<r> files byte-identical to the host tfidf app's
+    reduce output: scores via the shared ``format_value``, files via the
+    shared partitioned writer (``shuffle.write_partitioned_output``)."""
+    from dsi_tpu.apps.tfidf import format_value
+    from dsi_tpu.parallel.shuffle import write_partitioned_output
+
+    n_docs = len(doc_names)
+    formatted = {
+        w: (format_value([(doc_names[d], tf) for d, tf in pairs], n_docs), r)
+        for w, (r, pairs) in result.items()}
+    return write_partitioned_output(formatted, n_reduce, workdir)

@@ -1,0 +1,86 @@
+"""TF-IDF: host app semantics, framework e2e parity, and the SPMD
+multi-chip path on the virtual 8-device mesh (BASELINE.json's last config).
+"""
+
+import math
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dsi_tpu.apps import tfidf
+from dsi_tpu.utils.corpus import ensure_corpus
+from tests.harness import merged_output, oracle_output, run_distributed_threads
+
+
+def test_map_emits_per_doc_term_counts():
+    kva = tfidf.Map("docA", "red fish blue fish")
+    assert [(kv.key, kv.value) for kv in kva] == [
+        ("blue", "docA\t1"), ("fish", "docA\t2"), ("red", "docA\t1")]
+
+
+def test_reduce_scores_and_formats(monkeypatch):
+    monkeypatch.setenv("DSI_TFIDF_NDOCS", "4")
+    out = tfidf.Reduce("fish", ["docB\t3", "docA\t2"])
+    idf = math.log(4 / 2)
+    assert out == f"2 docA:{2 * idf:.6f},docB:{3 * idf:.6f}"
+
+
+def test_reduce_requires_ndocs(monkeypatch):
+    monkeypatch.delenv("DSI_TFIDF_NDOCS", raising=False)
+    with pytest.raises(RuntimeError, match="DSI_TFIDF_NDOCS"):
+        tfidf.Reduce("w", ["d\t1"])
+
+
+def test_idf_zero_when_word_in_every_doc(monkeypatch):
+    monkeypatch.setenv("DSI_TFIDF_NDOCS", "2")
+    out = tfidf.Reduce("the", ["a\t5", "b\t1"])
+    assert out == "2 a:0.000000,b:0.000000"
+
+
+def test_framework_e2e_matches_sequential_oracle(tmp_path, monkeypatch):
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=5,
+                          file_size=20_000)
+    monkeypatch.setenv("DSI_TFIDF_NDOCS", str(len(files)))
+    want = oracle_output("tfidf", files, str(tmp_path))
+    wd = tmp_path / "dist"
+    os.makedirs(wd)
+    run_distributed_threads("tfidf", files, str(wd), n_workers=3, n_reduce=7)
+    assert merged_output(str(wd)) == want
+
+
+def test_spmd_waves_match_sequential_oracle(tmp_path, monkeypatch):
+    """The multi-chip path: 11 documents in waves over the 8-device virtual
+    mesh (so the last wave has padding documents), all_to_all shuffle,
+    host scoring — mr-out-* byte-identical to the sequential oracle."""
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.tfidf import tfidf_sharded, write_tfidf_output
+
+    n_docs = 11
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=n_docs,
+                          file_size=3_000)
+    monkeypatch.setenv("DSI_TFIDF_NDOCS", str(n_docs))
+    want = oracle_output("tfidf", files, str(tmp_path))
+
+    docs = []
+    for p in files:
+        with open(p, "rb") as f:
+            docs.append(f.read())
+    mesh = default_mesh(8)
+    res = tfidf_sharded(docs, mesh=mesh, n_reduce=10, u_cap=1 << 11)
+    assert res is not None, "SPMD path unexpectedly fell back"
+    wd = tmp_path / "spmd"
+    os.makedirs(wd)
+    write_tfidf_output(res, files, 10, str(wd))
+    assert merged_output(str(wd)) == want
+
+
+def test_spmd_falls_back_on_non_ascii(tmp_path):
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.tfidf import tfidf_sharded
+
+    docs = [b"plain ascii words", "unicode café text".encode("utf-8")]
+    res = tfidf_sharded(docs, mesh=default_mesh(8), n_reduce=5,
+                        u_cap=1 << 8)
+    assert res is None  # caller must route the job to the host path
