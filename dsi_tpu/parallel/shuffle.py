@@ -91,6 +91,30 @@ def shuffle_rows(rows: jax.Array, dest: jax.Array, *, n_dev: int,
                           tiled=True)
 
 
+def map_prologue(chunk: jax.Array, *, n_dev: int, n_reduce: int,
+                 max_word_len: int, u_cap: int, t_cap_frac: int):
+    """Shared per-device map phase: tokenize + combine + partition.
+
+    The one place the reference-parity partition rule lives on device:
+    ``part = fnv1a32(word) & 0x7fffffff % n_reduce`` (mr/worker.go:33-37,76)
+    with destination device ``part % n_dev`` (invalid rows parked on
+    ``n_dev`` for :func:`shuffle_rows`).  Used by the word-count step here
+    and the TF-IDF step (``parallel/tfidf.py``) so the two SPMD jobs cannot
+    drift apart.
+
+    Returns (packed_u, len_u, cnt_u, part, dest, scalars) where scalars =
+    (n_unique, max_len, has_high, token_overflow).
+    """
+    (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
+     token_overflow) = tokenize_group_core(
+        chunk, max_word_len=max_word_len, u_cap=u_cap, t_cap_frac=t_cap_frac)
+    uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
+    part = (fnv_u & jnp.uint32(0x7FFFFFFF)) % jnp.uint32(n_reduce)
+    dest = jnp.where(uvalid, (part % n_dev).astype(jnp.int32), n_dev)
+    return (packed_u, len_u, cnt_u, part, dest,
+            (n_unique, max_len, has_high, token_overflow))
+
+
 def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
                  max_word_len: int, u_cap: int, t_cap_frac: int):
     """Per-device body (runs under shard_map): map, all_to_all, reduce."""
@@ -98,12 +122,10 @@ def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
     chunk = chunk.reshape(-1)  # [1, L] block -> [L]
 
     # ── map: tokenize + local combine (one record per unique word) ──
-    (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
-     token_overflow) = tokenize_group_core(
-        chunk, max_word_len=max_word_len, u_cap=u_cap, t_cap_frac=t_cap_frac)
-    uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
-    part = (fnv_u & jnp.uint32(0x7FFFFFFF)) % jnp.uint32(n_reduce)
-    dest = jnp.where(uvalid, (part % n_dev).astype(jnp.int32), n_dev)
+    packed_u, len_u, cnt_u, part, dest, (
+        n_unique, max_len, has_high, token_overflow) = map_prologue(
+        chunk, n_dev=n_dev, n_reduce=n_reduce, max_word_len=max_word_len,
+        u_cap=u_cap, t_cap_frac=t_cap_frac)
 
     # ── shuffle: the mr-X-Y files become one ICI collective ──
     rows = jnp.concatenate(

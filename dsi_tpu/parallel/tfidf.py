@@ -39,9 +39,13 @@ from dsi_tpu.ops.wordcount import (
     _PAD_KEY,
     decode_packed,
     exactness_retry,
-    tokenize_group_core,
 )
-from dsi_tpu.parallel.shuffle import AXIS, default_mesh, shuffle_rows
+from dsi_tpu.parallel.shuffle import (
+    AXIS,
+    default_mesh,
+    map_prologue,
+    shuffle_rows,
+)
 
 
 def _tfidf_device_step(chunk: jax.Array, doc_id: jax.Array, *, n_dev: int,
@@ -52,12 +56,10 @@ def _tfidf_device_step(chunk: jax.Array, doc_id: jax.Array, *, n_dev: int,
     chunk = chunk.reshape(-1)
     doc = doc_id.reshape(())
 
-    (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
-     token_overflow) = tokenize_group_core(
-        chunk, max_word_len=max_word_len, u_cap=u_cap, t_cap_frac=t_cap_frac)
-    uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
-    part = (fnv_u & jnp.uint32(0x7FFFFFFF)) % jnp.uint32(n_reduce)
-    dest = jnp.where(uvalid, (part % n_dev).astype(jnp.int32), n_dev)
+    packed_u, len_u, cnt_u, part, dest, (
+        n_unique, max_len, has_high, token_overflow) = map_prologue(
+        chunk, n_dev=n_dev, n_reduce=n_reduce, max_word_len=max_word_len,
+        u_cap=u_cap, t_cap_frac=t_cap_frac)
 
     # Send rows: word key lanes + [len, tf, doc, part] payload, routed by
     # the shared shuffle primitive (parallel/shuffle.py shuffle_rows).
@@ -101,15 +103,18 @@ def tfidf_wave_step(chunks: jax.Array, doc_ids: jax.Array, *, n_dev: int,
         out_specs=(P(AXIS, None, None), P(AXIS, None)))(chunks, doc_ids)
 
 
-def _pad_docs(docs: Sequence[bytes], n_dev: int) -> Tuple[np.ndarray, int]:
-    """All documents to ONE power-of-two length; waves of n_dev rows."""
-    longest = max((len(d) for d in docs), default=1)
-    size = 1 << max(8, longest.bit_length())  # next pow2 > longest-1
-    n_waves = -(-len(docs) // n_dev)
-    out = np.zeros((n_waves * n_dev, size), dtype=np.uint8)
-    for i, d in enumerate(docs):
-        out[i, :len(d)] = np.frombuffer(d, dtype=np.uint8)
-    return out, size
+def _wave_chunk(docs: Sequence[bytes], wave: int, n_dev: int,
+                size: int) -> np.ndarray:
+    """Materialise ONE wave's [n_dev, size] padded block lazily — padding
+    the whole corpus up front would allocate n_docs x pow2(longest) bytes
+    (one big document among many small ones inflates it catastrophically);
+    per-wave blocks keep host memory O(wave) with the same static shape."""
+    out = np.zeros((n_dev, size), dtype=np.uint8)
+    for r in range(n_dev):
+        i = wave * n_dev + r
+        if i < len(docs):
+            out[r, :len(docs[i])] = np.frombuffer(docs[i], dtype=np.uint8)
+    return out
 
 
 def tfidf_sharded(
@@ -125,17 +130,23 @@ def tfidf_sharded(
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
-    padded, size = _pad_docs(docs, n_dev)
-    n_waves = padded.shape[0] // n_dev
+    longest = max((len(d) for d in docs), default=1)
+    size = 1 << max(8, longest.bit_length())  # one static shape, all waves
+    n_waves = -(-len(docs) // n_dev)
+    n_real = len(docs)
 
     def run(mwl: int, cap: int):
         kk = mwl // 4
-        waves = []
+        # Fold each wave's rows into the dict AS THE WAVES RUN: host state
+        # stays O(vocabulary x docs-per-word), never O(corpus) of retained
+        # receive blocks.  A retry rung discards the whole dict and starts
+        # fresh, so partial rungs can't leak into the result.
+        result: Dict[str, Tuple[int, List[Tuple[int, int]]]] = {}
         agg_high = False
         agg_nu = 0
         agg_ml = 0
         for wv in range(n_waves):
-            chunk = jnp.asarray(padded[wv * n_dev:(wv + 1) * n_dev])
+            chunk = jnp.asarray(_wave_chunk(docs, wv, n_dev, size))
             ids = jnp.arange(wv * n_dev, (wv + 1) * n_dev, dtype=jnp.int32)
             for frac in (4, 2):
                 rows, scal = tfidf_wave_step(
@@ -144,39 +155,33 @@ def tfidf_sharded(
                 scal_np = np.asarray(scal)
                 if not scal_np[:, 4].any():
                     break
-            waves.append((np.asarray(rows), scal_np))
             agg_high = agg_high or bool(scal_np[:, 3].any())
             agg_nu = max(agg_nu, int(scal_np[:, 1].max()))
             agg_ml = max(agg_ml, int(scal_np[:, 2].max()))
-            if agg_nu > cap or agg_ml > mwl:
-                break  # this rung's results will be discarded by the retry;
-                # running the remaining waves would be pure waste
-
-        def payload():
-            result: Dict[str, Tuple[int, List[Tuple[int, int]]]] = {}
-            n_real = len(docs)
-            for rows, scal_np in waves:
-                for d in range(n_dev):
-                    nr = int(scal_np[d, 0])
-                    if nr == 0:
+            if agg_high or agg_nu > cap or agg_ml > mwl:
+                break  # this rung's results are certain to be discarded
+                # (host fallback or wider retry); more waves = pure waste
+            rows_np = np.asarray(rows)
+            for d in range(n_dev):
+                nr = int(scal_np[d, 0])
+                if nr == 0:
+                    continue
+                r = rows_np[d, :nr]
+                words = decode_packed(r[:, :kk], r[:, kk], nr)
+                tfs = r[:, kk + 1]
+                dids = r[:, kk + 2]
+                parts = r[:, kk + 3]
+                for i, w in enumerate(words):
+                    di = int(dids[i])
+                    if di >= n_real:  # padding document of the last wave
                         continue
-                    r = rows[d, :nr]
-                    words = decode_packed(r[:, :kk], r[:, kk], nr)
-                    tfs = r[:, kk + 1]
-                    dids = r[:, kk + 2]
-                    parts = r[:, kk + 3]
-                    for i, w in enumerate(words):
-                        di = int(dids[i])
-                        if di >= n_real:  # padding document of the last wave
-                            continue
-                        ent = result.get(w)
-                        if ent is None:
-                            result[w] = (int(parts[i]), [(di, int(tfs[i]))])
-                        else:
-                            ent[1].append((di, int(tfs[i])))
-            return result
+                    ent = result.get(w)
+                    if ent is None:
+                        result[w] = (int(parts[i]), [(di, int(tfs[i]))])
+                    else:
+                        ent[1].append((di, int(tfs[i])))
 
-        return agg_high, agg_nu, agg_ml, payload
+        return agg_high, agg_nu, agg_ml, (lambda: result)
 
     payload = exactness_retry(run, size, max_word_len, u_cap)
     return None if payload is None else payload()
