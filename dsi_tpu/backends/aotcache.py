@@ -1,0 +1,189 @@
+"""Persistent AOT executable cache: compile once per machine, ever.
+
+Why this exists: JAX's own persistent compilation cache
+(``JAX_COMPILATION_CACHE_DIR``) never produced a hit on this platform's
+axon-tunneled TPU — round-2 profiling measured a 219.8 s re-compile in every
+fresh process with a same-shape entry sitting in the cache directory
+(VERDICT r2 weakness #1a).  The PJRT client *does* support executable
+serialization (probed: ``serialize``/``deserialize_and_load`` round-trips in
+milliseconds), so this module implements the cache one level up: serialized
+compiled executables on disk, keyed by (platform fingerprint, function
+identity, input avals, static params).
+
+Usage::
+
+    fn = cached_compile("corpus_wc", tokenize_fn, example_args,
+                        static={"u_cap": 1 << 18})
+    out = fn(*args)   # args must match example_args' shapes/dtypes
+
+Every failure path (unserializable backend, corrupt entry, version drift)
+falls back to plain ``jax.jit`` compilation — the cache is a pure
+optimization, never a correctness dependency (the same discipline as the
+kernel fallbacks in ``backends/tpu.py``).
+
+The reference has no compilation step at all (Go builds AOT by nature);
+this is the TPU-native moral equivalent of shipping compiled binaries
+(``main/test-mr.sh:19-22`` builds once per run, not once per process).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import sys
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+# Version tag: bump to invalidate every entry (e.g. after a kernel rewrite
+# that changes semantics without changing shapes).
+_CACHE_VERSION = "aot-v1"
+
+_memo: Dict[str, Callable] = {}
+_memo_lock = threading.Lock()
+
+# Process-wide counters the bench reports (compile_s must be ~0 in any
+# process that found a warm cache — VERDICT r2 task 1a's "done" criterion).
+stats = {"compiled_s": 0.0, "compiles": 0, "loads": 0}
+
+
+def cache_dir() -> str:
+    d = os.environ.get("DSI_AOT_CACHE_DIR")
+    if d:
+        return d
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, ".aotcache")
+
+
+def _platform_fingerprint() -> str:
+    """Identity of the compile target: platform + its version string.
+
+    ``platform_version`` on this stack includes the runtime build and
+    serialization format version ("axon 0.1.0; SerializedExecutable v9;
+    compile-cache v14; ..."), so executables cannot be loaded across
+    incompatible runtime updates — a mismatch simply misses and recompiles.
+    """
+    import jax
+    from jax._src import xla_bridge
+
+    backend = xla_bridge.get_backend()
+    return (f"{jax.__version__}|{backend.platform}|"
+            f"{getattr(backend, 'platform_version', '?')}")
+
+
+def _code_fingerprint(fn: Callable) -> str:
+    """Hash the source files the compiled program's semantics depend on:
+    the function's own module plus any modules it declares via a
+    ``_aot_code_deps`` attribute.  A kernel edit therefore misses the cache
+    and recompiles — a stale executable is never served (a comment-only
+    edit also misses; that one-time recompile is the accepted price)."""
+    import inspect
+
+    h = hashlib.sha256()
+    mods = [inspect.getmodule(fn)]
+    mods += list(getattr(fn, "_aot_code_deps", ()))
+    for mod in mods:
+        try:
+            src = inspect.getsource(mod)
+        except (OSError, TypeError):
+            code = getattr(fn, "__code__", None)
+            src = repr(code.co_code if code else fn)
+        h.update(src.encode())
+    return h.hexdigest()[:16]
+
+
+def _key(name: str, fn: Callable, example_args: Tuple[Any, ...],
+         static: Dict[str, Any]) -> str:
+    import jax
+
+    parts = [_CACHE_VERSION, _platform_fingerprint(), name,
+             _code_fingerprint(fn)]
+    for a in example_args:
+        parts.append(f"{jax.numpy.shape(a)}:{jax.numpy.result_type(a)}")
+    for k in sorted(static):
+        parts.append(f"{k}={static[k]!r}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:32]
+
+
+def _log(msg: str) -> None:
+    if os.environ.get("DSI_AOT_QUIET") != "1":
+        print(f"[aotcache] {msg}", file=sys.stderr, flush=True)
+
+
+def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
+                   static: Dict[str, Any] | None = None,
+                   persist: bool = True) -> Callable:
+    """Return a compiled callable for ``fn`` at ``example_args``' avals.
+
+    ``static`` are keyword arguments baked into the program (and the cache
+    key).  The result accepts positional arrays with exactly the example
+    shapes/dtypes.  Thread-safe; per-process memoized.  ``persist=False``
+    keeps the in-process memo + compile-time accounting but never touches
+    disk (the DSI_AOT_CACHE=0 kill-switch path).
+    """
+    import jax
+
+    static = static or {}
+    key = _key(name, fn, example_args, static)
+    with _memo_lock:
+        hit = _memo.get(key)
+    if hit is not None:
+        return hit
+
+    path = os.path.join(cache_dir(), f"{name}-{key}.aot")
+    jitted = jax.jit(fn, static_argnames=tuple(static or ()))
+
+    loaded = _try_load(path) if persist else None
+    if loaded is None:
+        import time
+
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*example_args, **static).compile()
+        dt = time.perf_counter() - t0
+        stats["compiled_s"] += dt
+        stats["compiles"] += 1
+        _log(f"{name}: compiled in {dt:.1f}s")
+        if persist:
+            _try_save(path, compiled, name)
+        loaded = compiled
+    else:
+        stats["loads"] += 1
+        _log(f"{name}: loaded from {os.path.basename(path)}")
+
+    with _memo_lock:
+        _memo[key] = loaded
+    return loaded
+
+
+def _try_load(path: str):
+    if not os.path.exists(path):
+        return None
+    try:
+        from jax.experimental.serialize_executable import deserialize_and_load
+
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+        return deserialize_and_load(payload, in_tree, out_tree)
+    except Exception as e:  # corrupt / version-drifted entry: recompile
+        _log(f"load failed ({type(e).__name__}: {e}); recompiling")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+
+
+def _try_save(path: str, compiled, name: str) -> None:
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump((payload, in_tree, out_tree), f)
+        os.replace(tmp, path)  # atomic: concurrent writers can't corrupt
+        _log(f"{name}: saved {os.path.getsize(path)} bytes")
+    except Exception as e:  # backend without serialization: plain compile
+        _log(f"save failed ({type(e).__name__}: {e}); continuing uncached")

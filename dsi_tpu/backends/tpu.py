@@ -42,13 +42,9 @@ class TpuTaskRunner:
 
     @classmethod
     def for_app(cls, name_or_path: str) -> "TpuTaskRunner":
-        import os
+        from dsi_tpu.utils.platformpin import pin_platform_from_env
 
-        plat = os.environ.get("DSI_JAX_PLATFORM")
-        if plat:  # pin the JAX platform (e.g. cpu for harness runs — the
-            import jax  # env var alone can't override a sitecustomize plugin)
-
-            jax.config.update("jax_platforms", plat)
+        pin_platform_from_env()  # e.g. cpu for harness runs
         return cls(load_plugin_module(name_or_path))
 
     def run_map(self, mapf, filename: str, map_task: int, n_reduce: int,

@@ -34,6 +34,7 @@ from dsi_tpu.config import JobConfig
 from dsi_tpu.mr import rpc
 from dsi_tpu.mr.types import KeyValue, TaskStatus
 from dsi_tpu.utils.atomicio import atomic_write
+from dsi_tpu.utils.tracing import Span
 
 MapFn = Callable[[str, str], List[KeyValue]]
 ReduceFn = Callable[[str, List[str]], str]
@@ -192,23 +193,28 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
             break  # worker.go:51-53
         status = reply["TaskStatus"]
         if status == int(TaskStatus.MAP):
-            if task_runner is not None:
-                task_runner.run_map(mapf, reply["Filename"], reply["CMap"],
-                                    reply["NReduce"], cfg.workdir)
-            else:
-                run_map_task(mapf, reply["Filename"], reply["CMap"],
-                             reply["NReduce"], cfg.workdir)
+            # Span → DSI_TRACE=1 yields a per-task timeline (the tracing
+            # layer the reference lacks entirely, SURVEY.md §5).
+            with Span("worker.map", task=reply["CMap"],
+                      file=reply["Filename"]):
+                if task_runner is not None:
+                    task_runner.run_map(mapf, reply["Filename"], reply["CMap"],
+                                        reply["NReduce"], cfg.workdir)
+                else:
+                    run_map_task(mapf, reply["Filename"], reply["CMap"],
+                                 reply["NReduce"], cfg.workdir)
             tasks_done += 1
             if not report_complete("Coordinator.RecieveMapComplete",
                                    reply["CMap"]):
                 break
         elif status == int(TaskStatus.REDUCE):
-            if task_runner is not None:
-                task_runner.run_reduce(reducef, reply["CReduce"], reply["NMap"],
-                                       cfg.workdir)
-            else:
-                run_reduce_task(reducef, reply["CReduce"], reply["NMap"],
-                                cfg.workdir)
+            with Span("worker.reduce", task=reply["CReduce"]):
+                if task_runner is not None:
+                    task_runner.run_reduce(reducef, reply["CReduce"],
+                                           reply["NMap"], cfg.workdir)
+                else:
+                    run_reduce_task(reducef, reply["CReduce"], reply["NMap"],
+                                    cfg.workdir)
             tasks_done += 1
             if not report_complete("Coordinator.RecieveReduceComplete",
                                    reply["CReduce"]):

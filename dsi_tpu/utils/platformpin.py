@@ -1,0 +1,23 @@
+"""Pin the JAX platform through jax.config from DSI_JAX_PLATFORM.
+
+Setting the ``JAX_PLATFORMS`` env var is NOT enough on hosts where a
+sitecustomize pre-registers a TPU plugin (observed: the plugin initializes —
+and can hang on a wedged device — even with ``JAX_PLATFORMS=cpu``); pinning
+through ``jax.config`` before the first backend access is the reliable
+override.  One shared helper so every entry point (bench, CLIs, the TPU
+task backend) stays in sync.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env(var: str = "DSI_JAX_PLATFORM") -> str | None:
+    """If env ``var`` is set, route JAX to that platform; returns it."""
+    plat = os.environ.get(var)
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    return plat

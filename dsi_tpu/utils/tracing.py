@@ -1,57 +1,32 @@
-"""Per-task timing + optional JAX profiler hooks.
+"""Per-task timing + structured event log.
 
 The reference has NO tracing/profiling of any kind (SURVEY.md §5: only
 log.Fatalf on errors).  This is the new observability layer SURVEY.md calls
-for: lightweight wall-clock phase timers usable from the worker and the bench
-harness, and a context manager gating ``jax.profiler`` traces behind an env
-var so production runs pay nothing.
+for: ``Span`` wall-clock regions that double as structured events, emitted
+as one-line JSON on stderr when ``DSI_TRACE=1`` (off: zero overhead beyond a
+perf_counter pair).  The worker loop spans every map/reduce task body
+(``mr/worker.py``), so a traced run yields a per-task timeline; ``bench.py``
+spans its oracle/warmup phases the same way.
 """
 
 from __future__ import annotations
 
-import contextlib
 import json
 import os
 import sys
 import time
-from typing import Dict, Iterator
-
-
-class PhaseTimer:
-    """Accumulates wall-clock seconds per named phase."""
-
-    def __init__(self) -> None:
-        self.totals: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
-
-    @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
-
-    def report(self, stream=sys.stderr) -> None:
-        for name in sorted(self.totals, key=self.totals.get, reverse=True):
-            stream.write(f"[trace] {name}: {self.totals[name]:.3f}s "
-                         f"(x{self.counts[name]})\n")
-
-    def as_dict(self) -> Dict[str, float]:
-        return dict(self.totals)
 
 
 class Span:
     """Times one named region; ``elapsed_s`` is set on exit.
 
-    Emits a ``log_event`` (span name + seconds) so DSI_TRACE=1 runs get a
-    structured timeline for free.
+    Emits a ``log_event`` (span name + seconds + any keyword fields) so
+    DSI_TRACE=1 runs get a structured timeline for free.
     """
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str, **fields) -> None:
         self.name = name
+        self.fields = fields
         self.elapsed_s = 0.0
 
     def __enter__(self) -> "Span":
@@ -60,20 +35,8 @@ class Span:
 
     def __exit__(self, *exc) -> None:
         self.elapsed_s = time.perf_counter() - self._t0
-        log_event("span", name=self.name, seconds=round(self.elapsed_s, 4))
-
-
-@contextlib.contextmanager
-def maybe_jax_profile(out_dir: str | None = None) -> Iterator[None]:
-    """Wrap a region in jax.profiler.trace when DSI_JAX_PROFILE is set."""
-    target = out_dir or os.environ.get("DSI_JAX_PROFILE")
-    if not target:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(target):
-        yield
+        log_event("span", name=self.name,
+                  seconds=round(self.elapsed_s, 4), **self.fields)
 
 
 def log_event(event: str, **fields) -> None:

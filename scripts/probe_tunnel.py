@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Probe the axon tunnel's transfer characteristics (the bench's real wall).
+
+This is the consolidated form of the round-3 exploration that drove the
+corpus_wc design; its findings (2026-07-29, single run each — the tunnel's
+bandwidth varies >10x between moments):
+
+* H2D: ~60-80 ms per-call latency at any size; single-shot bandwidth
+  20-150 MB/s and noisy; MANY SMALL ASYNC PUTS PIPELINE (16 x 1 MiB
+  observed at 1.2 GB/s once, 29 MB/s under congestion) — hence
+  corpus_wc uploads the corpus as per-file 2 MiB pieces, all dispatched
+  before any sync.
+* D2H: ~20-25 MB/s sustained regardless of piecing or array rank, ~0.1 s
+  latency per pull, plus a ~0.5-2.8 s one-time first-pull cost per
+  process — hence corpus_wc returns ONE contiguous 1-D uint32 buffer of
+  ~8 B per unique word (position-coded; the host re-slices spellings from
+  its own corpus copy) and bench.py warms the D2H path before timing.
+* np.asarray(dev_arr) caches the value on the array (jax _npy_value):
+  measuring a second pull of the SAME array measures the cache, not the
+  wire.  Every D2H sample here uses a fresh kernel output.
+* Two concurrent clients wedge the device claim; a SIGKILLed client can
+  leave it wedged for a long time.  NEVER run this while anything else
+  (bench, another probe) is on the chip.
+
+Usage: python scripts/probe_tunnel.py [--mb 8]
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=8)
+    args = ap.parse_args()
+    n = args.mb << 20
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"devices={jax.devices()}", flush=True)
+    incr = jax.jit(lambda x, c: x + c)
+
+    # one-time D2H warm (first pull in a process pays ~0.5-2.8 s extra)
+    w = incr(jax.device_put(np.arange(256, dtype=np.uint32), dev),
+             jnp.uint32(1))
+    t0 = time.perf_counter()
+    np.asarray(w)
+    print(f"first-D2H warm: {time.perf_counter() - t0:.3f}s", flush=True)
+
+    # H2D single-shot vs pieced-async
+    host = np.random.randint(0, 255, size=n, dtype=np.uint8)
+    t0 = time.perf_counter()
+    jax.device_put(host, dev).block_until_ready()
+    t = time.perf_counter() - t0
+    print(f"H2D {args.mb} MiB single: {t:.3f}s  {n / t / 1e6:8.1f} MB/s",
+          flush=True)
+
+    pieces = [host[i << 20:(i + 1) << 20] for i in range(args.mb)]
+    t0 = time.perf_counter()
+    ds = jax.device_put(pieces, dev)
+    for d in ds:
+        d.block_until_ready()
+    t = time.perf_counter() - t0
+    print(f"H2D {args.mb} x 1 MiB async: {t:.3f}s  {n / t / 1e6:8.1f} MB/s",
+          flush=True)
+
+    # D2H of a fresh kernel output (no _npy_value cache)
+    src = jax.device_put(host[:n // 4].view(np.uint32), dev)
+    src.block_until_ready()
+    out = incr(src, jnp.uint32(3))
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    np.asarray(out)
+    t = time.perf_counter() - t0
+    print(f"D2H {args.mb // 4} MiB kernel-out: {t:.3f}s  "
+          f"{(n // 4) / t / 1e6:8.1f} MB/s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

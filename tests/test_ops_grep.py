@@ -71,6 +71,27 @@ def test_tpu_map_emits_per_line_records():
     assert all(kv.value == "" for kv in kva)
 
 
+def test_line_count_mismatch_falls_back(monkeypatch):
+    # A host/device line-count disagreement must return None (host regex
+    # path), not crash the worker task mid-job (VERDICT r2 weakness #5).
+    import dsi_tpu.ops.grepk as grepk
+
+    real = grepk._grep_jit
+
+    def skewed(chunk, pat, *, l_cap):
+        line_match, n_lines, overflow = real(chunk, pat, l_cap=l_cap)
+        return line_match, n_lines + 1, overflow
+
+    monkeypatch.setattr(grepk, "_grep_jit", skewed)
+    assert grep_host_result(TEXT, "fox") is None
+
+    # ...and the app-level router then serves the task via the host Map.
+    monkeypatch.setenv("DSI_GREP_PATTERN", "fox")
+    assert tpu_grep.tpu_map("f", TEXT) is None  # worker falls back to Map
+    assert [kv.key for kv in grep.Map("f", TEXT.decode())] == [
+        "the quick brown fox", "foxes and boxes", "fox"]
+
+
 def test_control_byte_pattern_rejected():
     # NUL would match the chunk's zero padding; control bytes must route to
     # the host regex path

@@ -91,6 +91,39 @@ def test_stream_files_separates_documents(tmp_path):
     assert got["word"] == 2 and "wordword" not in got
 
 
+def test_wcstream_cli_matches_sequential_oracle(tmp_path, monkeypatch):
+    """VERDICT r2 task 4: the streaming path must be reachable without
+    importing internals — the wcstream CLI end-to-end vs the oracle."""
+    from dsi_tpu.cli import wcstream
+    from tests.harness import merged_output, oracle_output
+
+    from dsi_tpu.utils.corpus import ensure_corpus
+
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=3,
+                          file_size=20_000)
+    want = oracle_output("wc", files, str(tmp_path))
+    wd = tmp_path / "out"
+    wd.mkdir()
+    rc = wcstream.main(["--nreduce", "10", "--chunk-bytes", "4096",
+                        "--workdir", str(wd)] + files)
+    assert rc == 0
+    assert merged_output(str(wd)) == want
+
+
+def test_wcstream_cli_host_fallback(tmp_path):
+    from dsi_tpu.cli import wcstream
+    from tests.harness import merged_output, oracle_output
+
+    f = tmp_path / "in.txt"
+    f.write_text("café words café and more words", encoding="utf-8")
+    want = oracle_output("wc", [str(f)], str(tmp_path))
+    wd = tmp_path / "out"
+    wd.mkdir()
+    rc = wcstream.main(["--workdir", str(wd), str(f)])
+    assert rc == 0
+    assert merged_output(str(wd)) == want
+
+
 @pytest.mark.slow
 def test_streaming_100mb_bounded_memory():
     """>=100 MB through the 8-device virtual mesh with bounded footprint:
