@@ -33,6 +33,7 @@ from dsi_tpu.mr import rpc
 from dsi_tpu.mr.journal import Journal
 from dsi_tpu.mr.types import (LOG_COMPLETED, LOG_IN_PROGRESS, LOG_UNTOUCHED,
                               TaskStatus)
+from dsi_tpu.utils.tracing import log_event
 
 
 class Coordinator:
@@ -85,7 +86,9 @@ class Coordinator:
                     reply["TaskStatus"] = int(TaskStatus.MAP)
                     reply["Filename"] = self.files[tba]
                     reply["CMap"] = tba
-                    self._arm_timeout(self.map_log, tba)  # :70-77
+                    self._arm_timeout(self.map_log, tba, "map")  # :70-77
+                    log_event("assign", kind="map", task=tba,
+                              file=self.files[tba])
             elif self.c_reduce < self.n_reduce:  # map barrier passed (:79)
                 tba = self._first_untouched(self.reduce_log)
                 if tba is None:
@@ -94,7 +97,8 @@ class Coordinator:
                     self.reduce_log[tba] = LOG_IN_PROGRESS
                     reply["TaskStatus"] = int(TaskStatus.REDUCE)
                     reply["CReduce"] = tba
-                    self._arm_timeout(self.reduce_log, tba)  # :99-106
+                    self._arm_timeout(self.reduce_log, tba, "reduce")  # :99-106
+                    log_event("assign", kind="reduce", task=tba)
             else:
                 reply["TaskStatus"] = int(TaskStatus.DONE)  # :109-112
         return reply
@@ -109,6 +113,9 @@ class Coordinator:
                 self.c_map += 1
                 if self._journal is not None:
                     self._journal.record("map", t)
+                log_event("complete", kind="map", task=t, c_map=self.c_map)
+            else:
+                log_event("duplicate_completion", kind="map", task=t)
         return {}
 
     def reduce_complete(self, args: dict) -> dict:
@@ -120,6 +127,10 @@ class Coordinator:
                 self.c_reduce += 1
                 if self._journal is not None:
                     self._journal.record("reduce", t)
+                log_event("complete", kind="reduce", task=t,
+                          c_reduce=self.c_reduce)
+            else:
+                log_event("duplicate_completion", kind="reduce", task=t)
         return {}
 
     # ---- internals ----
@@ -131,7 +142,7 @@ class Coordinator:
                 return i
         return None
 
-    def _arm_timeout(self, log: list[int], task_id: int) -> None:
+    def _arm_timeout(self, log: list[int], task_id: int, kind: str) -> None:
         """Presumed-dead-by-timeout: after task_timeout_s, if the task is still
         in-progress, reset it to untouched for reassignment
         (mr/coordinator.go:70-77,99-106 — goroutine + sleep; here a Timer)."""
@@ -140,6 +151,8 @@ class Coordinator:
             with self.mu:
                 if log[task_id] == LOG_IN_PROGRESS:
                     log[task_id] = LOG_UNTOUCHED
+                    log_event("requeue", kind=kind, task=task_id,
+                              timeout_s=self.config.task_timeout_s)
                 self._timers.discard(t)
 
         t = threading.Timer(self.config.task_timeout_s, requeue)

@@ -40,10 +40,20 @@ it and forge arbitrary calls.  Mismatches are rejected before method
 dispatch.  Binding TCP on a non-loopback interface without a secret is
 refused outright — Unix sockets and loopback keep the reference's no-auth
 behavior (the reference never enabled TCP at all, mr/coordinator.go:124).
-Limits, stated plainly: frames are not encrypted and there is no replay
-tracking (a captured frame can be re-sent verbatim; completion RPCs are
-idempotent, so replay is a nuisance rather than corruption).  Treat
-non-loopback TCP as suitable for trusted/isolated networks only.
+
+**Replay protection.**  Authenticated frames also carry a timestamp, MACed
+together with the nonce and body.  The server rejects frames older than
+``DSI_MR_AUTH_WINDOW_S`` (default 300 s — generous for honest clock skew)
+and remembers nonces seen inside the window, so a captured frame cannot be
+re-sent to the same server process: too old → stale; inside the window →
+nonce already seen.  The nonce memory is bounded by the window's call
+volume, not job length.  Limits, stated plainly: the guard is per-process
+memory, so a frame captured just before a coordinator restart could be
+replayed against the restarted process inside the window (handlers are
+idempotent and the journal dedups completions, so this is a nuisance, not
+corruption); and frames are not encrypted (an on-path observer reads task
+filenames).  Treat non-loopback TCP as suitable for trusted/isolated
+networks only.
 
 **Dial robustness.** The reference treats any dial failure as
 "coordinator gone" (``log.Fatal``, mr/worker.go:176-188) — but its Go
@@ -89,12 +99,42 @@ def _canonical_body(method: str, args: dict) -> bytes:
                       sort_keys=True, separators=(",", ":")).encode("utf-8")
 
 
-def _auth_mac(secret: str, nonce: str, body: bytes) -> str:
-    return hmac.new(secret.encode("utf-8"), nonce.encode("ascii") + body,
-                    "sha256").hexdigest()
+def _auth_mac(secret: str, nonce: str, ts: str, body: bytes) -> str:
+    msg = nonce.encode("ascii") + b"|" + ts.encode("ascii") + b"|" + body
+    return hmac.new(secret.encode("utf-8"), msg, "sha256").hexdigest()
 
 
-def _check_auth(secret: str, req: dict) -> bool:
+def _auth_window_s() -> float:
+    try:
+        return float(os.environ.get("DSI_MR_AUTH_WINDOW_S", "300"))
+    except ValueError:
+        return 300.0
+
+
+class _ReplayGuard:
+    """Nonces seen inside the freshness window; per-server, lock-protected.
+
+    Memory is bounded by the window's call volume: expired entries are
+    pruned on every insert once the table grows past a small threshold.
+    """
+
+    def __init__(self) -> None:
+        self._seen: Dict[str, float] = {}
+        self._mu = threading.Lock()
+
+    def first_use(self, nonce: str, now: float, window: float) -> bool:
+        with self._mu:
+            if len(self._seen) > 4096:
+                cutoff = now - window
+                self._seen = {n: t for n, t in self._seen.items()
+                              if t >= cutoff}
+            if nonce in self._seen:
+                return False
+            self._seen[nonce] = now
+            return True
+
+
+def _check_auth(secret: str, req: dict, guard: _ReplayGuard | None) -> bool:
     """Verify the request's auth object without ever learning more than
     pass/fail; malformed auth shapes are just failures."""
     if not isinstance(req, dict):
@@ -102,18 +142,28 @@ def _check_auth(secret: str, req: dict) -> bool:
     auth = req.get("auth")
     if not isinstance(auth, dict):
         return False
-    nonce, mac = auth.get("nonce"), auth.get("mac")
-    if not isinstance(nonce, str) or not isinstance(mac, str):
+    nonce, mac, ts = auth.get("nonce"), auth.get("mac"), auth.get("ts")
+    if not (isinstance(nonce, str) and isinstance(mac, str)
+            and isinstance(ts, str)):
         return False
     try:
         nonce.encode("ascii")
-    except UnicodeEncodeError:
+        ts_val = float(ts)
+    except (UnicodeEncodeError, ValueError):
         return False
-    want = _auth_mac(secret, nonce,
+    want = _auth_mac(secret, nonce, ts,
                      _canonical_body(req.get("method", ""),
                                      req.get("args") or {}))
-    return hmac.compare_digest(mac.encode("ascii", "replace"),
-                               want.encode("ascii"))
+    if not hmac.compare_digest(mac.encode("ascii", "replace"),
+                               want.encode("ascii")):
+        return False
+    # Freshness + first-use: a captured frame is either stale (outside the
+    # window) or its nonce is already in the guard (inside it).
+    now = time.time()
+    window = _auth_window_s()
+    if abs(now - ts_val) > window:
+        return False
+    return guard is None or guard.first_use(nonce, now, window)
 
 
 class CoordinatorGone(Exception):
@@ -229,6 +279,7 @@ class RpcServer:
                 pass
 
         handler_methods = self.methods
+        replay_guard = _ReplayGuard()
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one request per connection (dial-per-call)
@@ -243,7 +294,7 @@ class RpcServer:
                                     {"ok": False, "reply": None,
                                      "error": "malformed request frame"})
                         return
-                    if secret and not _check_auth(secret, req):
+                    if secret and not _check_auth(secret, req, replay_guard):
                         _send_frame(self.request, {"ok": False, "reply": None,
                                                    "error": "auth failed"})
                         return
@@ -350,8 +401,9 @@ def call(socket_path: str, method: str, args: dict | None = None,
         req: dict = {"method": method, "args": args or {}}
         if secret:
             nonce = os.urandom(16).hex()
-            req["auth"] = {"nonce": nonce,
-                           "mac": _auth_mac(secret, nonce,
+            ts = repr(time.time())
+            req["auth"] = {"nonce": nonce, "ts": ts,
+                           "mac": _auth_mac(secret, nonce, ts,
                                             _canonical_body(method,
                                                             args or {}))}
         try:

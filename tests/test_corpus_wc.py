@@ -154,6 +154,48 @@ def test_within_partition_order_matches_reference(tmp_path):
         assert keys == sorted(keys)
 
 
+def test_pack6_encode_roundtrip():
+    from dsi_tpu.ops.corpus_wc import pack6_encode
+
+    buf = np.frombuffer(b"The quick brown fox! 00\n" * 8, dtype=np.uint8)
+    assert len(buf) % 4 == 0
+    packed, table = pack6_encode(buf)
+    assert len(packed) == len(buf) * 3 // 4
+    # Host-side inverse of the device decode.
+    b = packed.reshape(-1, 3).astype(np.uint32)
+    v = (b[:, 0] << 16) | (b[:, 1] << 8) | b[:, 2]
+    codes = np.stack([(v >> 18) & 63, (v >> 12) & 63,
+                      (v >> 6) & 63, v & 63], axis=1).reshape(-1)
+    assert np.array_equal(table[codes], buf)
+
+
+def test_pack6_refuses_wide_alphabet():
+    from dsi_tpu.ops.corpus_wc import pack6_encode
+
+    buf = np.arange(256, dtype=np.uint8).repeat(4)
+    assert pack6_encode(buf) is None
+
+
+def test_pack6_path_matches_raw_path():
+    texts = ["the quick brown fox; jumps over the lazy dog.\n" * 20,
+             "alpha beta gamma delta " * 30]
+    raws = [t.encode() for t in texts]
+    raw_res = corpus_wordcount(raws, piece_size=PIECE, pack6=False)
+    p6_res = corpus_wordcount(raws, piece_size=PIECE, pack6=True)
+    assert counts_of(raw_res) == counts_of(p6_res) == oracle(texts)
+    assert np.array_equal(raw_res.pos, p6_res.pos)
+    assert np.array_equal(raw_res.cnt, p6_res.cnt)
+
+
+def test_pack6_falls_back_to_raw_when_alphabet_wide():
+    # >64 distinct byte values but still ASCII letters + punctuation mix:
+    # digits/symbols push the alphabet over 64; counts must still be exact.
+    fill = "".join(chr(c) for c in range(33, 112))  # 79 printable symbols
+    text = f"alpha {fill} beta alpha"
+    res = corpus_wordcount([text.encode()], piece_size=PIECE, pack6=True)
+    assert counts_of(res) == oracle([text])
+
+
 def test_aot_cache_roundtrip_same_result():
     from dsi_tpu.backends import aotcache
 

@@ -219,6 +219,69 @@ def test_auth_secret_from_env(tmp_path, monkeypatch):
         srv.close()
 
 
+def test_auth_replay_rejected(tmp_path):
+    """A captured authenticated frame re-sent verbatim must be rejected
+    (VERDICT r2 weakness #6): the nonce is single-use inside the window."""
+    import socket as _socket
+
+    sock = str(tmp_path / "s")
+    hits = []
+    srv = rpc.RpcServer(sock, {"Ping": lambda a: hits.append(1) or {}},
+                        secret="hunter2")
+    srv.start()
+    try:
+        # Build one valid frame by hand, then send the identical bytes twice.
+        body = rpc._canonical_body("Ping", {})
+        nonce = "aa" * 16
+        ts = repr(__import__("time").time())
+        frame = {"method": "Ping", "args": {},
+                 "auth": {"nonce": nonce, "ts": ts,
+                          "mac": rpc._auth_mac("hunter2", nonce, ts, body)}}
+
+        def send_raw():
+            s = _socket.socket(_socket.AF_UNIX)
+            s.connect(sock)
+            try:
+                rpc._send_frame(s, frame)
+                return rpc._recv_frame(s)
+            finally:
+                s.close()
+
+        first = send_raw()
+        assert first["ok"] and hits == [1]
+        replay = send_raw()
+        assert not replay["ok"] and replay["error"] == "auth failed"
+        assert hits == [1]  # the handler never ran for the replay
+    finally:
+        srv.close()
+
+
+def test_auth_stale_timestamp_rejected(tmp_path):
+    sock = str(tmp_path / "s")
+    srv = rpc.RpcServer(sock, {"Ping": lambda a: {}}, secret="hunter2")
+    srv.start()
+    try:
+        import socket as _socket
+        import time as _time
+
+        body = rpc._canonical_body("Ping", {})
+        nonce = "bb" * 16
+        ts = repr(_time.time() - 3600)  # far outside the 300 s window
+        frame = {"method": "Ping", "args": {},
+                 "auth": {"nonce": nonce, "ts": ts,
+                          "mac": rpc._auth_mac("hunter2", nonce, ts, body)}}
+        s = _socket.socket(_socket.AF_UNIX)
+        s.connect(sock)
+        try:
+            rpc._send_frame(s, frame)
+            resp = rpc._recv_frame(s)
+        finally:
+            s.close()
+        assert not resp["ok"] and resp["error"] == "auth failed"
+    finally:
+        srv.close()
+
+
 def test_dial_retry_survives_late_listener(tmp_path):
     """A transient ECONNREFUSED (listener mid-restart) must be retried, not
     mistaken for a dead coordinator — losing a worker to a transient dial

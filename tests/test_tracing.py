@@ -58,13 +58,21 @@ def test_worker_tasks_emit_timeline(monkeypatch, capsys, tmp_path):
         files.append(str(p))
     run_distributed_threads("wc", files, str(tmp_path), n_workers=2,
                             n_reduce=4)
-    spans = [r for r in _trace_lines(capsys) if r["event"] == "span"]
+    recs = _trace_lines(capsys)
+    spans = [r for r in recs if r["event"] == "span"]
     maps = [r for r in spans if r["name"] == "worker.map"]
     reduces = [r for r in spans if r["name"] == "worker.reduce"]
     assert sorted(r["task"] for r in maps) == [0, 1, 2]
     assert {r["file"] for r in maps} == set(files)
     assert sorted(r["task"] for r in reduces) == [0, 1, 2, 3]
     assert all(r["seconds"] >= 0 for r in spans)
+    # The coordinator side of the timeline: one assign and one complete per
+    # task (no crashes/requeues in this run).
+    assigns = [r for r in recs if r["event"] == "assign"]
+    completes = [r for r in recs if r["event"] == "complete"]
+    assert sorted(r["task"] for r in assigns if r["kind"] == "map") == [0, 1, 2]
+    assert sorted(r["task"] for r in completes
+                  if r["kind"] == "reduce") == [0, 1, 2, 3]
 
 
 def test_no_dead_tracing_api():
