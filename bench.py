@@ -119,6 +119,11 @@ def tpu_child(result_path: str) -> int:
     init_s = time.perf_counter() - t0
     platform = devices[0].platform
     log(f"child: devices={devices} init={init_s:.1f}s")
+    # Tell the watchdog parent init completed: a wedged device claim hangs
+    # inside jax.devices() indefinitely (observed on this platform), and the
+    # parent fails the attempt fast when this marker doesn't appear.
+    with open(result_path + ".init", "w") as f:
+        f.write(f"{init_s:.1f}")
 
     def run_once(pack6: bool):
         phases = {"mode": "pack6" if pack6 else "raw"}
@@ -222,21 +227,44 @@ def run_tpu_watchdogged() -> dict:
             last_err += f"; global deadline reached before attempt {attempt}"
             break
         budget = min(budget, remaining)
-        try:
-            os.remove(result_path)
-        except OSError:
-            pass
+        for suffix in ("", ".init"):
+            try:
+                os.remove(result_path + suffix)
+            except OSError:
+                pass
         log(f"tpu attempt {attempt}/{len(timeouts)} (timeout {budget:.0f}s)")
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--tpu-child",
              result_path], stdout=sys.stderr)
         timed_out = False
+        # Fail fast on a wedged device claim: the child drops a marker file
+        # the moment jax.devices() returns; no marker within the init budget
+        # means the claim is hung and the whole attempt budget would be
+        # wasted inside device init.
         try:
-            rc = proc.wait(timeout=budget)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            rc = proc.wait()
-            timed_out = True
+            init_budget = float(os.environ.get("DSI_BENCH_INIT_TIMEOUT", "180"))
+        except ValueError:
+            init_budget = 180.0
+        init_deadline = time.monotonic() + min(init_budget, budget)
+        attempt_deadline = time.monotonic() + budget
+        rc = None
+        while True:
+            try:
+                rc = proc.wait(timeout=2.0)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            now = time.monotonic()
+            if now >= attempt_deadline or (
+                    not os.path.exists(result_path + ".init")
+                    and now >= init_deadline):
+                proc.kill()
+                rc = proc.wait()
+                timed_out = True
+                if not os.path.exists(result_path + ".init"):
+                    log(f"attempt {attempt}: device init hung "
+                        f">{min(init_budget, budget):.0f}s (wedged claim?)")
+                break
         if os.path.exists(result_path):
             # Even after a timeout: the child writes its result atomically as
             # its LAST act, so a child that measured successfully but hung in
@@ -251,7 +279,11 @@ def run_tpu_watchdogged() -> dict:
                 return res
             last_err = f"attempt {attempt}: {res['error']}"
         elif timed_out:
-            last_err = f"attempt {attempt} timed out after {budget:.0f}s"
+            if not os.path.exists(result_path + ".init"):
+                last_err = (f"attempt {attempt}: device init never completed "
+                            "(wedged claim?)")
+            else:
+                last_err = f"attempt {attempt} timed out after {budget:.0f}s"
         else:
             last_err = f"attempt {attempt} exited rc={rc} with no result"
         log(last_err)

@@ -113,17 +113,19 @@ def _log(msg: str) -> None:
 
 def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
                    static: Dict[str, Any] | None = None,
-                   persist: bool = True) -> Callable:
+                   persist: bool | None = None) -> Callable:
     """Return a compiled callable for ``fn`` at ``example_args``' avals.
 
     ``static`` are keyword arguments baked into the program (and the cache
     key).  The result accepts positional arrays with exactly the example
     shapes/dtypes.  Thread-safe; per-process memoized.  ``persist=False``
     keeps the in-process memo + compile-time accounting but never touches
-    disk (the DSI_AOT_CACHE=0 kill-switch path).
+    disk; the default honors the ``DSI_AOT_CACHE=0`` kill switch.
     """
     import jax
 
+    if persist is None:
+        persist = os.environ.get("DSI_AOT_CACHE", "1") != "0"
     static = static or {}
     key = _key(name, fn, example_args, static)
     with _memo_lock:
@@ -134,12 +136,24 @@ def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
     path = os.path.join(cache_dir(), f"{name}-{key}.aot")
     jitted = jax.jit(fn, static_argnames=tuple(static or ()))
 
+    # Disk persistence is for the real chip (one device per process).  In a
+    # multi-device process (the 8-virtual-CPU test mesh) a deserialized
+    # executable comes back bound to every visible device and then rejects
+    # single-device arguments — so compile in-process instead (still
+    # memoized, still counted in stats).
+    persist = persist and len(jax.devices()) == 1
+
     loaded = _try_load(path) if persist else None
     if loaded is None:
         import time
 
         t0 = time.perf_counter()
-        compiled = jitted.lower(*example_args, **static).compile()
+        # Pin the AOT compile to one device: under a multi-device process
+        # (e.g. the 8-virtual-CPU test mesh) an unpinned lower() targets
+        # every visible device and the executable then demands 8-sharded
+        # args; these are single-chunk kernels, one device by design.
+        with jax.default_device(jax.devices()[0]):
+            compiled = jitted.lower(*example_args, **static).compile()
         dt = time.perf_counter() - t0
         stats["compiled_s"] += dt
         stats["compiles"] += 1

@@ -37,6 +37,7 @@ milliseconds.
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -326,6 +327,7 @@ def corpus_wordcount(raws: Sequence[bytes], *, piece_size: int | None = None,
     return None if payload is None else payload()
 
 
+@functools.lru_cache(maxsize=64)
 def _get_compiled(n_pieces: int, piece_size: int, mwl: int, cap: int,
                   frac: int, use_aot: bool, pack6: bool = False):
     import jax
@@ -343,11 +345,10 @@ def _get_compiled(n_pieces: int, piece_size: int, mwl: int, cap: int,
         fn, name = corpus_kernel, "corpus_wc"
     from dsi_tpu.backends.aotcache import cached_compile
 
-    # persist=False (the DSI_AOT_CACHE=0 kill switch) still memoizes
-    # in-process and accounts compile time in aotcache.stats; it only stops
-    # disk reads/writes.
-    persist = use_aot and os.environ.get("DSI_AOT_CACHE", "1") != "0"
-    return cached_compile(name, fn, example, static=static, persist=persist)
+    # use_aot=False still memoizes in-process and accounts compile time in
+    # aotcache.stats; it only stops disk reads/writes.
+    return cached_compile(name, fn, example, static=static,
+                          persist=None if use_aot else False)
 
 
 def write_corpus_output(res: CorpusResult, n_reduce: int,

@@ -14,12 +14,14 @@ depends on the kernel (``backends/tpu.py`` contract).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+import dsi_tpu.ops.wordcount as _wordcount_mod
 from dsi_tpu.ops.wordcount import _pad_pow2, _shift_left
 
 
@@ -47,7 +49,27 @@ def grep_kernel(chunk: jax.Array, pattern: jax.Array, *, l_cap: int):
     return line_match, n_lines, overflow
 
 
-_grep_jit = jax.jit(grep_kernel, static_argnames=("l_cap",))
+# The AOT cache fingerprints these sources: grep_kernel uses wordcount
+# helpers (_shift_left), so editing them must invalidate stale executables.
+grep_kernel._aot_code_deps = (_wordcount_mod,)
+
+
+@functools.lru_cache(maxsize=64)
+def _grep_compiled(n: int, m: int, l_cap: int):
+    from dsi_tpu.backends.aotcache import cached_compile
+
+    example = (jax.ShapeDtypeStruct((n,), np.uint8),
+               jax.ShapeDtypeStruct((m,), np.uint8))
+    return cached_compile("grep_kernel", grep_kernel, example,
+                          static={"l_cap": l_cap})
+
+
+def _grep_jit(chunk, pattern, *, l_cap: int):
+    """The grep kernel through the persistent AOT executable cache
+    (backends/aotcache.py) — fresh worker processes load the serialized
+    executable instead of re-paying the XLA compile."""
+    fn = _grep_compiled(int(chunk.shape[0]), int(pattern.shape[0]), l_cap)
+    return fn(chunk, pattern)
 
 
 _REGEX_META = set(".^$*+?{}[]()|\\")

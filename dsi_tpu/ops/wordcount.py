@@ -44,6 +44,7 @@ with a bigger kernel or falls back to the host implementation
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional
 
 import jax
@@ -196,6 +197,30 @@ count_words_kernel = jax.jit(
     static_argnames=("max_word_len", "u_cap", "t_cap_frac"))
 
 
+@functools.lru_cache(maxsize=256)
+def _cached_kernel(n: int, max_word_len: int, u_cap: int, t_cap_frac: int):
+    """The single-chunk kernel via the persistent AOT executable cache
+    (backends/aotcache.py): a fresh worker process loads the serialized
+    executable in milliseconds instead of re-paying the XLA compile —
+    essential on platforms where jit compiles run to minutes and every
+    mrworker is its own process (main/test-mr.sh:43-45 spawns three).
+    lru_cached so repeat dispatches skip the cache-key fingerprinting."""
+    from dsi_tpu.backends.aotcache import cached_compile
+
+    example = (jax.ShapeDtypeStruct((n,), np.uint8),)
+    return cached_compile(
+        "wc_kernel", tokenize_group_core, example,
+        static={"max_word_len": max_word_len, "u_cap": u_cap,
+                "t_cap_frac": t_cap_frac})
+
+
+def run_count_kernel(chunk: jax.Array, *, max_word_len: int, u_cap: int,
+                     t_cap_frac: int):
+    """Dispatch one chunk through the AOT-cached executable."""
+    fn = _cached_kernel(int(chunk.shape[0]), max_word_len, u_cap, t_cap_frac)
+    return fn(chunk)
+
+
 def _pad_pow2(data: bytes, min_size: int = 256) -> np.ndarray:
     """Zero-pad to the next power of two so jit caches a few shapes only.
     Zero bytes are non-letters, so padding can't create or extend tokens."""
@@ -266,8 +291,8 @@ def count_words_host_result(
     def run(mwl: int, cap: int):
         for frac in (4, 2):  # exact token bound is n//2+1; try compact first
             (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
-             tok_of) = count_words_kernel(dev_chunk, max_word_len=mwl,
-                                          u_cap=cap, t_cap_frac=frac)
+             tok_of) = run_count_kernel(dev_chunk, max_word_len=mwl,
+                                        u_cap=cap, t_cap_frac=frac)
             if not bool(tok_of):
                 break
         nu = int(n_unique)
@@ -300,9 +325,9 @@ def count_words_many(datas, *, max_word_len: int = 16,
         chunk = _pad_pow2(data)
         cap = min(u_cap, 1 << (len(chunk) // 2).bit_length())
         launches.append((data, cap,
-                         count_words_kernel(jnp.asarray(chunk),
-                                            max_word_len=max_word_len,
-                                            u_cap=cap, t_cap_frac=4)))
+                         run_count_kernel(jnp.asarray(chunk),
+                                          max_word_len=max_word_len,
+                                          u_cap=cap, t_cap_frac=4)))
     results = []
     for data, cap, out in launches:
         (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,

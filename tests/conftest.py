@@ -22,6 +22,17 @@ if _flag not in os.environ.get("XLA_FLAGS", ""):
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Keep test-run AOT executables out of the repo's persistent cache (they are
+# tiny CPU-platform entries; the repo cache is for the chip).
+if "DSI_AOT_CACHE_DIR" not in os.environ:
+    import atexit
+    import shutil
+    import tempfile
+
+    _aot_tmp = tempfile.mkdtemp(prefix="dsi-aot-test-")
+    os.environ["DSI_AOT_CACHE_DIR"] = _aot_tmp
+    atexit.register(shutil.rmtree, _aot_tmp, True)
+
 try:
     import jax
 
