@@ -1,0 +1,138 @@
+"""One-command MapReduce job runner: coordinator + N workers + wait.
+
+The reference requires manual orchestration — one terminal for
+``mrcoordinator``, more for each ``mrworker`` (``main/test-mr.sh:36-45`` is
+that choreography scripted).  This runs the whole job as child processes of
+one command, with the same process-level semantics (separate interpreters,
+the real RPC control plane, the shared-filesystem data plane — NOT threads),
+and exits when the coordinator does.
+
+Usage:
+    python -m dsi_tpu.cli.mrrun [--workers 3] [--nreduce 10]
+        [--backend host|tpu] [--workdir DIR] [--task-timeout S]
+        [--check] <app> inputfiles...
+
+``--check`` additionally runs the sequential oracle and byte-compares the
+merged output (sort mr-out-* | grep ., test-mr.sh:52-53), exiting non-zero
+on a parity failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("app")
+    p.add_argument("files", nargs="+")
+    p.add_argument("--workers", type=int, default=3)
+    p.add_argument("--nreduce", type=int, default=10)
+    p.add_argument("--backend", choices=("host", "tpu"), default="host")
+    p.add_argument("--workdir", default=".")
+    p.add_argument("--task-timeout", type=float, default=10.0)
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="whole-job wall budget, seconds")
+    p.add_argument("--check", action="store_true",
+                   help="run the sequential oracle and verify parity")
+    args = p.parse_args(argv)
+
+    workdir = os.path.abspath(args.workdir)
+    os.makedirs(workdir, exist_ok=True)
+    files = [os.path.abspath(f) for f in args.files]
+    env = dict(os.environ)
+    env.setdefault("DSI_MR_SOCKET", os.path.join(workdir, "mr.sock"))
+
+    # Clear stale outputs so a failed job can't pass --check against a
+    # previous run's files (the reference harness's rm, test-mr.sh:54).
+    for name in os.listdir(workdir):
+        if name.startswith("mr-out-") or name.startswith("mr-correct"):
+            try:
+                os.remove(os.path.join(workdir, name))
+            except OSError:
+                pass
+
+    # Children run WITH cwd=workdir — the reference's data plane is "the
+    # working directory" (mr-X-Y / mr-out-R relative paths), same as the
+    # harness's sandbox cd (test-mr.sh:13-16).
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "dsi_tpu.cli.mrcoordinator",
+         "--nreduce", str(args.nreduce),
+         "--task-timeout", str(args.task_timeout)] + files,
+        env=env, cwd=workdir)
+    deadline = time.monotonic() + args.timeout
+    time.sleep(1.0)  # socket-creation grace (test-mr.sh:39-40)
+
+    worker_cmd = [sys.executable, "-m", "dsi_tpu.cli.mrworker",
+                  "--backend", args.backend, args.app]
+    workers = [subprocess.Popen(worker_cmd, env=env, cwd=workdir)
+               for _ in range(args.workers)]
+
+    rc = 0
+    try:
+        while coord.poll() is None:
+            if time.monotonic() > deadline:
+                print("mrrun: job exceeded --timeout; killing",
+                      file=sys.stderr)
+                rc = 1
+                break
+            # Workers are expendable (the 10 s requeue covers crashes); the
+            # crash app even kills them on purpose — respawn CRASHED
+            # workers to keep the fleet at full strength, as test_mr.sh's
+            # respawner does.  A zero exit is end-of-job, not a crash.
+            for i, w in enumerate(workers):
+                if (w.poll() is not None and w.returncode != 0
+                        and coord.poll() is None):
+                    workers[i] = subprocess.Popen(worker_cmd, env=env,
+                                                  cwd=workdir)
+            time.sleep(0.3)
+    finally:
+        for proc in [coord] + workers:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in [coord] + workers:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    if rc == 0 and coord.returncode not in (0, None):
+        print(f"mrrun: coordinator exited rc={coord.returncode}",
+              file=sys.stderr)
+        rc = 1
+    if rc != 0:
+        return rc
+    if args.check:
+        from dsi_tpu.mr.plugin import load_plugin
+        from dsi_tpu.mr.sequential import run_sequential
+
+        # Oracle twins: fault-injecting / device apps check against their
+        # deterministic host equivalents (scripts/test_mr.sh:32-43).
+        oracle_app = {"crash": "nocrash", "tpu_wc": "wc",
+                      "tpu_indexer": "indexer",
+                      "tpu_grep": "grep"}.get(args.app, args.app)
+        mapf, reducef = load_plugin(oracle_app)
+        oracle_out = os.path.join(workdir, "mr-correct.txt")
+        run_sequential(mapf, reducef, files, oracle_out)
+        got: list = []
+        for r in range(args.nreduce):
+            path = os.path.join(workdir, f"mr-out-{r}")
+            if os.path.exists(path):
+                with open(path) as f:
+                    got.extend(l for l in f if l.strip())
+        with open(oracle_out) as f:
+            want = sorted(l for l in f if l.strip())
+        if sorted(got) != want:
+            print("mrrun: PARITY FAILURE vs sequential oracle",
+                  file=sys.stderr)
+            return 2
+        print("mrrun: parity OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
