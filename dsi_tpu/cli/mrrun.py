@@ -35,6 +35,8 @@ def main(argv=None) -> int:
     p.add_argument("--backend", choices=("host", "tpu"), default="host")
     p.add_argument("--workdir", default=".")
     p.add_argument("--task-timeout", type=float, default=10.0)
+    p.add_argument("--journal", default="",
+                   help="coordinator checkpoint journal (resume support)")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="whole-job wall budget, seconds")
     p.add_argument("--check", action="store_true",
@@ -44,13 +46,23 @@ def main(argv=None) -> int:
     workdir = os.path.abspath(args.workdir)
     os.makedirs(workdir, exist_ok=True)
     files = [os.path.abspath(f) for f in args.files]
+    app = args.app
+    if os.sep in app or app.endswith(".py"):
+        app = os.path.abspath(app)  # workers run with cwd=workdir
+    journal = os.path.abspath(args.journal) if args.journal else ""
     env = dict(os.environ)
     env.setdefault("DSI_MR_SOCKET", os.path.join(workdir, "mr.sock"))
 
     # Clear stale outputs so a failed job can't pass --check against a
-    # previous run's files (the reference harness's rm, test-mr.sh:54).
+    # previous run's files (the reference harness's rm, test-mr.sh:54) —
+    # EXCEPT when resuming from an existing journal: a resumed
+    # coordinator marks journaled tasks completed and never regenerates
+    # their committed mr-out-* files, so those ARE the checkpoint.
+    resuming = bool(journal) and os.path.exists(journal)
     for name in os.listdir(workdir):
-        if name.startswith("mr-out-") or name.startswith("mr-correct"):
+        stale = name.startswith("mr-correct") or (
+            name.startswith("mr-out-") and not resuming)
+        if stale:
             try:
                 os.remove(os.path.join(workdir, name))
             except OSError:
@@ -59,18 +71,24 @@ def main(argv=None) -> int:
     # Children run WITH cwd=workdir — the reference's data plane is "the
     # working directory" (mr-X-Y / mr-out-R relative paths), same as the
     # harness's sandbox cd (test-mr.sh:13-16).
-    coord = subprocess.Popen(
-        [sys.executable, "-m", "dsi_tpu.cli.mrcoordinator",
-         "--nreduce", str(args.nreduce),
-         "--task-timeout", str(args.task_timeout)] + files,
-        env=env, cwd=workdir)
+    coord_cmd = [sys.executable, "-m", "dsi_tpu.cli.mrcoordinator",
+                 "--nreduce", str(args.nreduce),
+                 "--task-timeout", str(args.task_timeout)]
+    if journal:
+        coord_cmd += ["--journal", journal]
+    coord = subprocess.Popen(coord_cmd + files, env=env, cwd=workdir)
     deadline = time.monotonic() + args.timeout
     time.sleep(1.0)  # socket-creation grace (test-mr.sh:39-40)
 
     worker_cmd = [sys.executable, "-m", "dsi_tpu.cli.mrworker",
-                  "--backend", args.backend, args.app]
+                  "--backend", args.backend, app]
     workers = [subprocess.Popen(worker_cmd, env=env, cwd=workdir)
                for _ in range(args.workers)]
+    # A worker that dies crashed (non-zero) is respawned, but an app that
+    # can never start (typo'd name, broken plugin) must not burn the whole
+    # wall budget spawning doomed interpreters 3/sec.  Scaled to job size:
+    # a legitimate crash-app run kills at most ~one worker per task.
+    respawn_budget = max(16, 2 * (len(files) + args.nreduce))
 
     rc = 0
     try:
@@ -87,8 +105,16 @@ def main(argv=None) -> int:
             for i, w in enumerate(workers):
                 if (w.poll() is not None and w.returncode != 0
                         and coord.poll() is None):
+                    if respawn_budget <= 0:
+                        print("mrrun: workers failing repeatedly; giving up",
+                              file=sys.stderr)
+                        rc = 1
+                        break
+                    respawn_budget -= 1
                     workers[i] = subprocess.Popen(worker_cmd, env=env,
                                                   cwd=workdir)
+            if rc:
+                break
             time.sleep(0.3)
     finally:
         for proc in [coord] + workers:

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Summarize a DSI_TRACE=1 event stream into a per-task timeline table.
+
+The tracing layer (dsi_tpu/utils/tracing.py) emits one-line JSON events on
+stderr: coordinator ``assign``/``complete``/``requeue``/
+``duplicate_completion`` and worker ``span`` records.  This turns a captured
+stream into a human-readable account of the job — the observability layer
+the reference lacks entirely (SURVEY.md §5).
+
+Usage:
+    DSI_TRACE=1 python -m dsi_tpu.cli.mrrun --check wc inputs/pg-*.txt \
+        2> trace.log
+    python scripts/trace_timeline.py trace.log
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+
+
+def parse(stream):
+    events = []
+    for line in stream:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "event" in rec and "t" in rec:
+            events.append(rec)
+    return sorted(events, key=lambda r: r["t"])
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    stream = open(argv[0]) if argv else sys.stdin
+    events = parse(stream)
+    if not events:
+        print("no DSI_TRACE events found (run with DSI_TRACE=1, "
+              "capture stderr)", file=sys.stderr)
+        return 1
+    t0 = events[0]["t"]
+
+    spans = defaultdict(list)   # (kind, task) -> [seconds, ...]
+    requeues = []
+    dups = []
+    for r in events:
+        ev = r["event"]
+        if ev == "span" and r.get("name", "").startswith("worker."):
+            kind = r["name"].split(".", 1)[1]
+            spans[(kind, r.get("task"))].append(r.get("seconds", 0.0))
+        elif ev == "requeue":
+            requeues.append(r)
+        elif ev == "duplicate_completion":
+            dups.append(r)
+
+    print(f"{'when':>8}  event")
+    for r in events:
+        ev = r["event"]
+        if ev == "span":
+            name = r.get("name", "?")
+            extra = f" task={r['task']}" if "task" in r else ""
+            print(f"{r['t'] - t0:8.3f}  {name}{extra} "
+                  f"({r.get('seconds', 0):.3f}s)")
+        else:
+            detail = {k: v for k, v in r.items() if k not in ("t", "event")}
+            print(f"{r['t'] - t0:8.3f}  {ev} {detail}")
+
+    print("\nper-task attempt counts (attempts > 1 ⇒ requeue/duplicate):")
+    for (kind, task), secs in sorted(spans.items()):
+        marks = ""
+        if len(secs) > 1:
+            marks = "  <-- executed by multiple workers"
+        print(f"  {kind}[{task}]: {len(secs)} attempt(s), "
+              f"{max(secs):.3f}s max{marks}")
+    print(f"\n{len(requeues)} requeue(s), {len(dups)} duplicate "
+          f"completion(s) absorbed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

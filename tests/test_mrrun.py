@@ -43,6 +43,58 @@ def test_mrrun_crash_app_respawns_and_finishes(tmp_path):
     assert "parity OK" in p.stderr
 
 
+def test_mrrun_bad_app_fails_fast_without_respawn_storm(tmp_path):
+    import time
+
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=2,
+                          file_size=4_000)
+    wd = tmp_path / "job"
+    t0 = time.monotonic()
+    p = _run(["--workers", "2", "--workdir", str(wd), "--timeout", "120",
+              "no_such_app"] + files)
+    elapsed = time.monotonic() - t0
+    assert p.returncode != 0
+    assert "failing repeatedly" in p.stderr
+    assert elapsed < 90  # fails via the respawn cap, not the wall budget
+
+
+def test_mrrun_journal_resume_keeps_committed_outputs(tmp_path):
+    # Resume semantics: with an existing journal, committed mr-out-* files
+    # ARE the checkpoint — the resumed coordinator marks journaled tasks
+    # done and never regenerates them, so mrrun must NOT sweep them (the
+    # no-journal sweep is tested by test_mrrun_reports_coordinator_failure).
+    # Re-execution of the *unjournaled* remainder is covered at the
+    # coordinator level by tests/test_journal.py.
+    from dsi_tpu.mr.journal import Journal
+
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=2,
+                          file_size=10_000)
+    wd = tmp_path / "job"
+    wd.mkdir()
+    jpath = str(wd / "ckpt.journal")
+
+    # A complete run provides the committed outputs of the "crashed" job.
+    p = _run(["--workers", "2", "--workdir", str(wd), "--check", "wc"]
+             + files)
+    assert p.returncode == 0
+    committed = {r: (wd / f"mr-out-{r}").read_text() for r in range(10)}
+
+    j = Journal(jpath, [os.path.abspath(f) for f in files], 10)
+    j.open()
+    for m in range(len(files)):
+        j.record("map", m)
+    for r in range(10):
+        j.record("reduce", r)
+    j.close()
+
+    p = _run(["--workers", "2", "--workdir", str(wd),
+              "--journal", jpath, "--check", "wc"] + files)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "parity OK" in p.stderr
+    for r in range(10):
+        assert (wd / f"mr-out-{r}").read_text() == committed[r]
+
+
 def test_mrrun_reports_coordinator_failure(tmp_path):
     # A coordinator that cannot start (unauthenticated non-loopback TCP is
     # refused, mr/rpc.py) must surface as a non-zero mrrun exit — never a
