@@ -351,31 +351,81 @@ def _get_compiled(n_pieces: int, piece_size: int, mwl: int, cap: int,
                           persist=None if use_aot else False)
 
 
+def render_lines(mat: np.ndarray, lens: np.ndarray,
+                 cnt: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Render ``"<word> <count>\\n"`` lines for every row, fully vectorized.
+
+    Returns (buf [total_bytes] uint8, ends [nu] int64 — exclusive end offset
+    of each row's line in ``buf``).  No per-row Python: word bytes come from
+    one boolean-mask flatten of the byte matrix, count digits from seven
+    vectorized divmods (counts are int64; rows are word-count totals).
+    """
+    nu, width = mat.shape
+    if nu == 0:
+        return np.zeros(0, np.uint8), np.zeros(0, np.int64)
+    c = np.maximum(cnt, 1).astype(np.int64)
+    dlen = np.full(nu, 1, np.int64)
+    p = np.int64(10)
+    while True:  # digits(count): bounded by the corpus' total token count
+        more = c >= p
+        if not more.any():
+            break
+        dlen += more
+        p *= 10
+    max_d = int(dlen.max())
+
+    total = lens + 1 + dlen + 1  # word, space, digits, newline
+    ends = np.cumsum(total)
+    starts = ends - total
+    buf = np.zeros(int(ends[-1]), np.uint8)
+
+    col = np.arange(width)
+    wmask = col < lens[:, None]
+    buf[(starts[:, None] + col)[wmask]] = mat[wmask]
+    buf[starts + lens] = 32  # space
+
+    dcol = np.arange(max_d)
+    dmask = dcol < dlen[:, None]
+    # Most-significant digit first: digit j = cnt // 10^(dlen-1-j) % 10.
+    pow10 = np.power(np.int64(10), np.maximum(dlen[:, None] - 1 - dcol, 0))
+    digits = (cnt.astype(np.int64)[:, None] // pow10) % 10
+    buf[(starts[:, None] + 1 + lens[:, None] + dcol)[dmask]] = \
+        (48 + digits[dmask]).astype(np.uint8)
+    buf[ends - 1] = 10  # newline
+    return buf, ends
+
+
 def write_corpus_output(res: CorpusResult, n_reduce: int,
                         workdir: str = ".") -> List[str]:
     """Materialise mr-out-<r> files straight from the position-coded table.
 
     Device rows arrive in lexicographic word order (the kernel's sort), and
-    ASCII byte order == Python ``sorted`` order on str, so each partition's
-    subsequence is already in the reference's within-file order
-    (``mr/worker.go:124-146``) — no host sort at all.
+    ASCII byte order == Python ``sorted`` order on str, so a stable sort by
+    partition leaves each partition's lines in the reference's within-file
+    order (``mr/worker.go:124-146``).  Everything is vectorized numpy —
+    this sits inside the bench's timed window (~0.3 s of Python loop before,
+    ~30 ms now at 137k unique words).
     """
     from dsi_tpu.utils.atomicio import atomic_write
 
     width = int(res.lens.max(initial=1))
     mat = res.byte_matrix(width)  # built once: hashes + spellings below
     part = res.ihashes(mat) % np.uint32(n_reduce)
-    blob = mat.tobytes()
-    lens = res.lens.tolist()
-    cnts = res.cnt.tolist()
+
+    order = np.argsort(part, kind="stable")
+    buf, ends = render_lines(mat[order], res.lens[order], res.cnt[order])
+    starts = np.concatenate([[0], ends[:-1]]) if len(ends) else ends
+    # Partition boundaries in the reordered row space.
+    counts = np.bincount(part, minlength=n_reduce)
+    row_bounds = np.concatenate([[0], np.cumsum(counts)])
+
     paths = []
     for r in range(n_reduce):
-        idxs = np.nonzero(part == r)[0].tolist()
-        lines = [
-            f"{blob[i * width:i * width + lens[i]].decode('ascii')} {cnts[i]}\n"
-            for i in idxs]
+        lo, hi = int(row_bounds[r]), int(row_bounds[r + 1])
+        lo_b = int(starts[lo]) if lo < hi else 0
+        hi_b = int(ends[hi - 1]) if lo < hi else 0
         path = os.path.join(workdir, f"mr-out-{r}")
-        with atomic_write(path) as f:
-            f.write("".join(lines))
+        with atomic_write(path, mode="wb") as f:
+            f.write(buf[lo_b:hi_b].tobytes())
         paths.append(path)
     return paths
