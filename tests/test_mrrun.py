@@ -95,6 +95,19 @@ def test_mrrun_journal_resume_keeps_committed_outputs(tmp_path):
         assert (wd / f"mr-out-{r}").read_text() == committed[r]
 
 
+def test_mrrun_tpu_backend_parity(tmp_path):
+    # --backend tpu plumbing end-to-end (kernels pinned to host CPU, the
+    # same route scripts/test_mr.sh tpu_wc tpu exercises).
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=2,
+                          file_size=20_000)
+    wd = tmp_path / "job"
+    p = _run(["--workers", "2", "--workdir", str(wd), "--backend", "tpu",
+              "--check", "tpu_wc"] + files,
+             env_extra={"DSI_JAX_PLATFORM": "cpu"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "parity OK" in p.stderr
+
+
 def test_mrrun_reports_coordinator_failure(tmp_path):
     # A coordinator that cannot start (unauthenticated non-loopback TCP is
     # refused, mr/rpc.py) must surface as a non-zero mrrun exit — never a
