@@ -1,6 +1,14 @@
 #!/usr/bin/env bash
-# Watch for the axon device to come back, then run the bench twice
+# Watch for the axon terminal to come back, then run the bench twice
 # (cold process then warm process) to capture the AOT-cache hit evidence.
+#
+# Diagnosis (2026-07-30): when the device is "wedged", the terminal's
+# forwarded ports are simply closed — 8083 is the stateless port
+# jax.devices() uses — so the cheap, side-effect-free recovery signal is a
+# TCP connect to 8083, NOT a JAX client (a killed client mid-claim is
+# itself a wedge hazard).  Only when the port answers do we start a real
+# JAX probe, and then the benches.
+#
 # Single-tenant device: this is the ONLY thing that may touch the chip
 # while it runs.  Logs under /tmp/device_watch/.
 set -u
@@ -8,28 +16,29 @@ REPO=$(cd "$(dirname "$0")/.." && pwd)
 OUT=/tmp/device_watch
 mkdir -p "$OUT"
 cd "$REPO"
-echo "$(date -u +%H:%M:%S) watcher start" >> "$OUT/log"
+echo "$(date -u +%H:%M:%S) watcher start (port-probe mode)" >> "$OUT/log"
 while true; do
-  # Long probe timeout on purpose: killing a JAX client mid-device-claim is
-  # itself a wedge hazard (BASELINE.md), so give a recovering device 240 s
-  # to finish init cleanly; only a still-hung probe gets killed.  Probes are
-  # also spaced 10 min apart to minimize kill events while wedged.
-  if timeout 240 python -c "
+  if timeout 3 bash -c 'echo > /dev/tcp/127.0.0.1/8083' 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) port 8083 open; JAX probe" >> "$OUT/log"
+    if timeout 300 python -c "
 import jax
 d = jax.devices()
 import jax.numpy as jnp
 assert int(jnp.arange(8).sum()) == 28
 print('probe ok', d)
 " >> "$OUT/log" 2>&1; then
-    echo "$(date -u +%H:%M:%S) device back; bench run 1 (cold)" >> "$OUT/log"
-    DSI_BENCH_TPU_TIMEOUTS=900,420,240 python bench.py \
-      > "$OUT/bench1.out" 2> "$OUT/bench1.err"
-    echo "$(date -u +%H:%M:%S) bench1 rc=$? ; run 2 (warm)" >> "$OUT/log"
-    DSI_BENCH_TPU_TIMEOUTS=420,240 python bench.py \
-      > "$OUT/bench2.out" 2> "$OUT/bench2.err"
-    echo "$(date -u +%H:%M:%S) bench2 rc=$? ; watcher done" >> "$OUT/log"
-    break
+      echo "$(date -u +%H:%M:%S) device back; bench run 1 (cold)" >> "$OUT/log"
+      DSI_BENCH_TPU_TIMEOUTS=900,420,240 python bench.py \
+        > "$OUT/bench1.out" 2> "$OUT/bench1.err"
+      echo "$(date -u +%H:%M:%S) bench1 rc=$? ; run 2 (warm)" >> "$OUT/log"
+      DSI_BENCH_TPU_TIMEOUTS=420,240 python bench.py \
+        > "$OUT/bench2.out" 2> "$OUT/bench2.err"
+      echo "$(date -u +%H:%M:%S) bench2 rc=$? ; watcher done" >> "$OUT/log"
+      break
+    fi
+    echo "$(date -u +%H:%M:%S) port open but JAX probe failed" >> "$OUT/log"
+    sleep 120
+  else
+    sleep 60  # port probe is free; check every minute
   fi
-  echo "$(date -u +%H:%M:%S) device still wedged" >> "$OUT/log"
-  sleep 600
 done
