@@ -65,7 +65,21 @@ def output_name(reduce_task: int, workdir: str = ".") -> str:
 def write_intermediates(kva: Sequence[KeyValue], map_task: int, n_reduce: int,
                         workdir: str = ".") -> None:
     """Partition by ihash and commit NReduce files atomically
-    (worker.go:74-92)."""
+    (worker.go:74-92).
+
+    The partition + serialize pass runs through the native C encoder when
+    available (dsi_tpu/native — one pass fusing the per-byte hash,
+    json.dumps, and bucketing loops); the Python path below is the exact
+    fallback, and both produce records every decoder accepts."""
+    from dsi_tpu import native
+
+    blobs = native.encode_partitions(kva, n_reduce)
+    if blobs is not None:
+        for r, blob in enumerate(blobs):
+            with atomic_write(intermediate_name(map_task, r, workdir),
+                              mode="wb") as f:
+                f.write(blob)
+        return
     buckets: list[list[KeyValue]] = [[] for _ in range(n_reduce)]
     for kv in kva:
         buckets[ihash(kv.key) % n_reduce].append(kv)
@@ -95,7 +109,9 @@ def read_intermediates(reduce_task: int, n_map: int,
             out.extend(KeyValue(k, v) for k, v in pairs)
             continue
         try:
-            f = open(path, "r")
+            # Explicit utf-8: the native encoder writes raw UTF-8, and the
+            # locale default must not reinterpret (or reject) those bytes.
+            f = open(path, "r", encoding="utf-8")
         except OSError:
             continue  # tolerated: worker.go:106-108
         with f:

@@ -38,16 +38,24 @@ def _load():
         if os.environ.get("DSI_NO_NATIVE") == "1":
             _lib = False
             return None
-        if not os.path.exists(_SO_PATH):
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "kvcodec.cpp")
+        stale = (not os.path.exists(_SO_PATH)
+                 or (os.path.exists(src)
+                     and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)))
+        if stale:
             script = os.path.join(_REPO, "scripts", "build_native.sh")
             try:
                 subprocess.run(["bash", script], check=True,
                                capture_output=True, timeout=120)
             except Exception as e:  # no compiler / build failure: fall back
-                print(f"dsi_tpu.native: build unavailable ({e}); "
-                      "using pure-Python data plane", file=sys.stderr)
-                _lib = False
-                return None
+                if os.path.exists(_SO_PATH):
+                    pass  # stale-but-working library beats no library
+                else:
+                    print(f"dsi_tpu.native: build unavailable ({e}); "
+                          "using pure-Python data plane", file=sys.stderr)
+                    _lib = False
+                    return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
             lib.kv_decode_file.restype = ctypes.POINTER(ctypes.c_uint8)
@@ -55,8 +63,14 @@ def _load():
                                            ctypes.POINTER(ctypes.c_size_t)]
             lib.kv_arena_free.restype = None
             lib.kv_arena_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+            lib.kv_encode_partitions.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.kv_encode_partitions.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.POINTER(ctypes.c_size_t)]
             _lib = lib
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale .so predating a symbol and a failed
+            # rebuild (no toolchain) — pure-Python fallback, never crash.
             print(f"dsi_tpu.native: load failed ({e}); "
                   "using pure-Python data plane", file=sys.stderr)
             _lib = False
@@ -104,3 +118,54 @@ def decode_kv_file(path: str) -> Optional[List[tuple]]:
         # UTF-8 rejects it.  Never diverge — let the Python decoder decide.
         return None
     return out
+
+
+def encode_partitions(kva, n_reduce: int) -> Optional[List[bytes]]:
+    """Partition + serialize a map task's output natively.
+
+    One C pass computes the reference partitioner (``fnv1a32(key) &
+    0x7fffffff % n_reduce``, mr/worker.go:33-37,76) and renders each
+    partition's JSON-lines blob — the three host hot loops of the map side
+    (per-byte hash, json.dumps per record, bucket appends) fused.
+
+    Returns ``n_reduce`` byte blobs, or None when the caller must use the
+    Python writer (library unavailable, or a key/value that strict UTF-8
+    cannot encode — e.g. surrogates from decode errors)."""
+    lib = _load()
+    if lib is None:
+        return None
+    kva = list(kva)
+    pack = struct.Struct("<II").pack
+    parts: List[bytes] = []
+    try:
+        for kv in kva:
+            kb = kv.key.encode("utf-8")
+            vb = kv.value.encode("utf-8")
+            parts.append(pack(len(kb), len(vb)))
+            parts.append(kb)
+            parts.append(vb)
+    except (UnicodeEncodeError, struct.error):
+        # Surrogates (json.dumps can represent them, raw UTF-8 can't) or a
+        # >=4 GiB string (length field would not fit): Python writer path.
+        return None
+    buf = b"".join(parts)
+    out_len = ctypes.c_size_t()
+    ptr = lib.kv_encode_partitions(buf, len(buf), len(kva), n_reduce,
+                                   ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    (n_parts,) = struct.unpack_from("<I", arena, 0)
+    if n_parts != n_reduce:
+        return None
+    blobs: List[bytes] = []
+    off = 4
+    for _ in range(n_reduce):
+        (bl,) = struct.unpack_from("<I", arena, off)
+        off += 4
+        blobs.append(arena[off:off + bl])
+        off += bl
+    return blobs

@@ -25,7 +25,10 @@ def atomic_write(path: str, mode: str = "w") -> Iterator[IO]:
     # The ".tmp-" prefix keeps uncommitted temp files out of the harness's
     # "mr-out*" merge glob if a worker dies (os._exit) mid-write.
     fd, tmp = tempfile.mkstemp(prefix=".tmp-" + os.path.basename(path) + ".", dir=d)
-    f = os.fdopen(fd, mode)
+    # Text mode pins utf-8: output bytes must not depend on the host locale
+    # (a worker under an ASCII locale would otherwise crash writing any
+    # non-ASCII key, and mixed-locale fleets would diverge).
+    f = os.fdopen(fd, mode, encoding=None if "b" in mode else "utf-8")
     try:
         yield f
         f.flush()

@@ -122,3 +122,84 @@ def test_raw_control_char_matches_python_strictness(tmp_path):
         f.write('{"Key": "after", "Value": "3"}\n')
     assert native.decode_kv_file(path) is None  # strict stop -> defer
     assert python_decode(path) == [("ok", "1")]  # python breaks there too
+
+
+# ---- the native map-side encoder (kv_encode_partitions) ----
+
+def python_write_intermediates(kva, map_task, n_reduce, workdir):
+    """The REAL Python fallback of write_intermediates, forced by disabling
+    the native library for the duration of one call."""
+    prev = native._lib
+    native._lib = False
+    try:
+        write_intermediates(kva, map_task, n_reduce, workdir)
+    finally:
+        native._lib = prev
+
+
+def _decoded_partitions(workdir, map_task, n_reduce):
+    out = []
+    for r in range(n_reduce):
+        p = os.path.join(workdir, f"mr-{map_task}-{r}")
+        out.append(python_decode(p))
+    return out
+
+
+def test_encoder_matches_python_writer_partitions_and_records(tmp_path):
+    kva = [KeyValue(k, v) for k, v in TRICKY * 3] + [
+        KeyValue(f"word{i}", str(i)) for i in range(500)]
+    nat = tmp_path / "native"
+    py = tmp_path / "python"
+    nat.mkdir(), py.mkdir()
+    write_intermediates(kva, 0, 7, str(nat))       # native path (available)
+    python_write_intermediates(kva, 0, 7, str(py))
+    assert _decoded_partitions(str(nat), 0, 7) == \
+        _decoded_partitions(str(py), 0, 7)
+
+
+def test_encoder_blobs_decode_natively_and_with_json(tmp_path):
+    kva = [KeyValue(k, v) for k, v in TRICKY]
+    blobs = native.encode_partitions(kva, 3)
+    assert blobs is not None
+    seen = []
+    for r, blob in enumerate(blobs):
+        p = tmp_path / f"mr-9-{r}"
+        p.write_bytes(blob)
+        nat = native.decode_kv_file(str(p))
+        pyd = python_decode(str(p))
+        assert nat is None or nat == pyd
+        seen.extend(pyd)
+    # Every record lands in exactly one partition, values intact.
+    assert sorted(seen) == sorted(TRICKY)
+
+
+def test_encoder_partitioner_is_reference_ihash(tmp_path):
+    from dsi_tpu.mr.worker import ihash
+
+    kva = [KeyValue(f"k{i}", "") for i in range(200)]
+    blobs = native.encode_partitions(kva, 10)
+    for r, blob in enumerate(blobs):
+        p = tmp_path / f"b{r}"
+        p.write_bytes(blob)
+        for k, _ in python_decode(str(p)):
+            assert ihash(k) % 10 == r
+
+
+def test_encoder_surrogate_defers():
+    # A surrogate (undecodable to strict UTF-8) must route to the Python
+    # writer rather than crash or mangle.
+    kva = [KeyValue("bad\ud800key", "v")]
+    assert native.encode_partitions(kva, 3) is None
+
+
+def test_write_intermediates_native_off_equivalence(tmp_path, monkeypatch):
+    kva = [KeyValue(f"w{i % 37}", str(i)) for i in range(300)]
+    on = tmp_path / "on"
+    off = tmp_path / "off"
+    on.mkdir(), off.mkdir()
+    write_intermediates(kva, 2, 5, str(on))
+    monkeypatch.setattr(native, "_lib", False)  # force pure-Python path
+    write_intermediates(kva, 2, 5, str(off))
+    monkeypatch.setattr(native, "_lib", None)
+    assert _decoded_partitions(str(on), 2, 5) == \
+        _decoded_partitions(str(off), 2, 5)
