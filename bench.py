@@ -173,10 +173,11 @@ def tpu_child(result_path: str) -> int:
 
     tpu_lines = []
     for r in range(N_REDUCE):
-        with open(os.path.join(WORKDIR, f"mr-out-{r}")) as f:
+        with open(os.path.join(WORKDIR, f"mr-out-{r}"),
+                  encoding="utf-8") as f:
             tpu_lines.extend(l for l in f if l.strip())
     tpu_lines.sort()
-    with open(ORACLE_OUT) as f:
+    with open(ORACLE_OUT, encoding="utf-8") as f:
         oracle_lines = sorted(l for l in f if l.strip())
 
     parity = tpu_lines == oracle_lines

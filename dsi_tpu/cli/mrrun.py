@@ -148,9 +148,9 @@ def main(argv=None) -> int:
         for r in range(args.nreduce):
             path = os.path.join(workdir, f"mr-out-{r}")
             if os.path.exists(path):
-                with open(path) as f:
+                with open(path, encoding="utf-8") as f:
                     got.extend(l for l in f if l.strip())
-        with open(oracle_out) as f:
+        with open(oracle_out, encoding="utf-8") as f:
             want = sorted(l for l in f if l.strip())
         if sorted(got) != want:
             print("mrrun: PARITY FAILURE vs sequential oracle",

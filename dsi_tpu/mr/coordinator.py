@@ -25,6 +25,7 @@ output-invariant):
 
 from __future__ import annotations
 
+import heapq
 import threading
 from typing import List, Optional
 
@@ -48,8 +49,25 @@ class Coordinator:
         self.n_reduce = n_reduce
         self.c_reduce = 0
         self.reduce_log = [LOG_UNTOUCHED] * n_reduce
+        # Assignment heaps: lowest untouched index first — the same order
+        # as the reference's linear scan (mr/coordinator.go:50-55), O(log n)
+        # per assignment instead of O(n) (which is O(n^2) across a big
+        # job).  Entries are lazily invalidated: pop until one is still
+        # UNTOUCHED; requeue pushes the index back.
+        self._map_ready = list(range(self.n_map))
+        self._reduce_ready = list(range(n_reduce))
         self.mu = threading.Lock()
-        self._timers: set[threading.Timer] = set()
+        # Straggler watchdog: ONE monitor thread over a deadline heap
+        # replaces the reference's goroutine-per-assignment
+        # (mr/coordinator.go:70-77,99-106) — a per-task Timer thread melts
+        # at ~10^4 tasks (~0.4 ms spawn each, thousands of live threads);
+        # the heap is O(log n) per assignment and one thread total.
+        self._deadlines: list[tuple[float, str, int]] = []
+        self._deadline_cv = threading.Condition(self.mu)
+        self._closing = False
+        self._monitor = threading.Thread(target=self._watchdog,
+                                         name="dsi-mr-watchdog", daemon=True)
+        self._monitor.start()
         self._server: Optional[rpc.RpcServer] = None
 
         # Optional checkpoint/resume (journal.py; disabled by default — the
@@ -78,7 +96,7 @@ class Coordinator:
                  "CMap": 0, "NReduce": self.n_reduce, "CReduce": 0, "Filename": ""}
         with self.mu:
             if self.c_map < self.n_map:
-                tba = self._first_untouched(self.map_log)
+                tba = self._pop_untouched(self._map_ready, self.map_log)
                 if tba is None:
                     reply["TaskStatus"] = int(TaskStatus.WAITING)  # :58-60
                 else:
@@ -86,18 +104,18 @@ class Coordinator:
                     reply["TaskStatus"] = int(TaskStatus.MAP)
                     reply["Filename"] = self.files[tba]
                     reply["CMap"] = tba
-                    self._arm_timeout(self.map_log, tba, "map")  # :70-77
+                    self._arm_timeout(tba, "map")  # :70-77
                     log_event("assign", kind="map", task=tba,
                               file=self.files[tba])
             elif self.c_reduce < self.n_reduce:  # map barrier passed (:79)
-                tba = self._first_untouched(self.reduce_log)
+                tba = self._pop_untouched(self._reduce_ready, self.reduce_log)
                 if tba is None:
                     reply["TaskStatus"] = int(TaskStatus.WAITING)
                 else:
                     self.reduce_log[tba] = LOG_IN_PROGRESS
                     reply["TaskStatus"] = int(TaskStatus.REDUCE)
                     reply["CReduce"] = tba
-                    self._arm_timeout(self.reduce_log, tba, "reduce")  # :99-106
+                    self._arm_timeout(tba, "reduce")  # :99-106
                     log_event("assign", kind="reduce", task=tba)
             else:
                 reply["TaskStatus"] = int(TaskStatus.DONE)  # :109-112
@@ -136,29 +154,56 @@ class Coordinator:
     # ---- internals ----
 
     @staticmethod
-    def _first_untouched(log: list[int]) -> Optional[int]:
-        for i, s in enumerate(log):  # linear scan, mr/coordinator.go:50-55
-            if s == LOG_UNTOUCHED:
+    def _pop_untouched(ready: list[int], log: list[int]) -> Optional[int]:
+        """Lowest untouched task index — the reference's first-match linear
+        scan order (mr/coordinator.go:50-55) at O(log n).  Stale heap
+        entries (task started or finished since pushed) are discarded."""
+        while ready:
+            i = heapq.heappop(ready)
+            if log[i] == LOG_UNTOUCHED:
                 return i
         return None
 
-    def _arm_timeout(self, log: list[int], task_id: int, kind: str) -> None:
-        """Presumed-dead-by-timeout: after task_timeout_s, if the task is still
-        in-progress, reset it to untouched for reassignment
-        (mr/coordinator.go:70-77,99-106 — goroutine + sleep; here a Timer)."""
+    def _arm_timeout(self, task_id: int, kind: str) -> None:
+        """Presumed-dead-by-timeout: after task_timeout_s, if the task is
+        still in-progress, reset it to untouched for reassignment
+        (mr/coordinator.go:70-77,99-106).  Caller holds ``self.mu``."""
+        import time
 
-        def requeue() -> None:
-            with self.mu:
+        entry = (time.monotonic() + self.config.task_timeout_s,
+                 kind, task_id)
+        heapq.heappush(self._deadlines, entry)
+        # Wake the watchdog only when this entry becomes the earliest
+        # deadline (with a constant timeout that means "heap was empty") —
+        # otherwise its current sleep already covers it, and waking it on
+        # every assignment would contend for self.mu on the hot path.
+        if self._deadlines[0] is entry:
+            self._deadline_cv.notify()
+
+    def _watchdog(self) -> None:
+        """The single straggler-monitor thread: sleep until the earliest
+        armed deadline, then requeue any task still in-progress."""
+        import time
+
+        with self._deadline_cv:
+            while not self._closing:
+                if not self._deadlines:
+                    self._deadline_cv.wait()
+                    continue
+                now = time.monotonic()
+                due, kind, task_id = self._deadlines[0]
+                if due > now:
+                    self._deadline_cv.wait(timeout=due - now)
+                    continue
+                heapq.heappop(self._deadlines)
+                log = self.map_log if kind == "map" else self.reduce_log
                 if log[task_id] == LOG_IN_PROGRESS:
                     log[task_id] = LOG_UNTOUCHED
+                    heapq.heappush(
+                        self._map_ready if kind == "map"
+                        else self._reduce_ready, task_id)
                     log_event("requeue", kind=kind, task=task_id,
                               timeout_s=self.config.task_timeout_s)
-                self._timers.discard(t)
-
-        t = threading.Timer(self.config.task_timeout_s, requeue)
-        t.daemon = True
-        t.start()
-        self._timers.add(t)
 
     # ---- lifecycle (mr/coordinator.go:121-160) ----
 
@@ -184,8 +229,9 @@ class Coordinator:
             return self.c_reduce == self.n_reduce
 
     def close(self) -> None:
-        for t in list(self._timers):
-            t.cancel()
+        with self._deadline_cv:
+            self._closing = True
+            self._deadline_cv.notify()
         if self._server is not None:
             self._server.close()
             self._server = None

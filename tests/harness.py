@@ -25,7 +25,7 @@ def merged_output(workdir: str) -> List[str]:
     per-partition boundaries don't matter)."""
     lines: List[str] = []
     for p in sorted(glob.glob(os.path.join(workdir, "mr-out-*"))):
-        with open(p) as f:
+        with open(p, encoding="utf-8") as f:
             lines.extend(l for l in f if l.strip())
     return sorted(lines)
 
@@ -34,7 +34,7 @@ def oracle_output(app: str, files, workdir: str) -> List[str]:
     mapf, reducef = load_plugin(app)
     out = os.path.join(workdir, "mr-correct.txt")
     run_sequential(mapf, reducef, files, out)
-    with open(out) as f:
+    with open(out, encoding="utf-8") as f:
         return sorted(l for l in f if l.strip())
 
 

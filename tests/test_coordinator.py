@@ -92,3 +92,48 @@ def test_wire_reply_fields_match_reference_shape():
     c = mk()
     r = c.request_task({})
     assert set(r) == {"TaskStatus", "NMap", "CMap", "NReduce", "CReduce", "Filename"}
+
+
+def test_large_job_assignment_order_and_requeue():
+    """Scheduler scalability redesign (heap + single watchdog thread): the
+    reference's lowest-index-first assignment order must survive at 10k
+    tasks, and requeued tasks must re-enter in index order."""
+    import time
+
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr.coordinator import Coordinator
+
+    n = 10_000
+    # Long timeout for the bulk-assignment phase: a loaded machine must not
+    # let the watchdog requeue mid-loop and break the order assertion.
+    c = Coordinator([f"f{i}" for i in range(n)], 4,
+                    JobConfig(n_reduce=4, task_timeout_s=600.0))
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            assert c.request_task({})["CMap"] == i
+        assert time.perf_counter() - t0 < 10.0  # O(n log n), not O(n^2)
+    finally:
+        c.close()
+
+    # Requeue order on a small job with a short timeout: tasks 7 and 3
+    # complete; everything else times out and must be reassigned
+    # lowest-index-first, skipping the completed ones.
+    c = Coordinator([f"f{i}" for i in range(10)], 4,
+                    JobConfig(n_reduce=4, task_timeout_s=0.3))
+    try:
+        for i in range(10):
+            assert c.request_task({})["CMap"] == i
+        c.map_complete({"TaskNumber": 7})
+        c.map_complete({"TaskNumber": 3})
+        deadline = time.time() + 10.0
+        reassigned = []
+        while len(reassigned) < 3 and time.time() < deadline:
+            r = c.request_task({})
+            if r["TaskStatus"] == 0:
+                reassigned.append(r["CMap"])
+            else:
+                time.sleep(0.05)
+        assert reassigned == [0, 1, 2]
+    finally:
+        c.close()
