@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import List, Optional
 
 from dsi_tpu.config import JobConfig
@@ -168,8 +169,6 @@ class Coordinator:
         """Presumed-dead-by-timeout: after task_timeout_s, if the task is
         still in-progress, reset it to untouched for reassignment
         (mr/coordinator.go:70-77,99-106).  Caller holds ``self.mu``."""
-        import time
-
         entry = (time.monotonic() + self.config.task_timeout_s,
                  kind, task_id)
         heapq.heappush(self._deadlines, entry)
@@ -183,8 +182,6 @@ class Coordinator:
     def _watchdog(self) -> None:
         """The single straggler-monitor thread: sleep until the earliest
         armed deadline, then requeue any task still in-progress."""
-        import time
-
         with self._deadline_cv:
             while not self._closing:
                 if not self._deadlines:

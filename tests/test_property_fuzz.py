@@ -141,3 +141,102 @@ def test_native_codec_never_diverges(tmp_path_factory, records):
             py.append((obj["Key"], obj["Value"]))
     # native either agrees exactly or declines
     assert nat is None or nat == py
+
+
+# ---- the whole-corpus single-program path (ops/corpus_wc.py) ----
+
+from dsi_tpu.ops.corpus_wc import corpus_wordcount  # noqa: E402
+
+corpus_lists = st.lists(dense_text, min_size=0, max_size=5)
+
+
+def _longest_run(texts):
+    return max((len(w) for t in texts for w in ASCII_WORDS.findall(t)),
+               default=0)
+
+
+@settings(max_examples=40, deadline=None)
+@given(corpus_lists, st.booleans())
+def test_corpus_wordcount_matches_counter(texts, pack6):
+    raws = [t.encode("ascii") for t in texts]
+    res = corpus_wordcount(raws, piece_size=1 << 12, u_cap=256, pack6=pack6)
+    if _longest_run(texts) > 64:
+        assert res is None  # documented escape: host path handles it
+        return
+    assert res is not None
+    want = collections.Counter()
+    for t in texts:
+        want.update(ASCII_WORDS.findall(t))
+    got = {w: c for w, (c, _) in res.to_dict().items()}
+    assert got == dict(want)
+    # Partition ids must be the reference ihash (mr/worker.go:33-37,76).
+    for w, (_, part) in res.to_dict().items():
+        assert part == ihash(w) % 10
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.binary(min_size=1, max_size=1500))
+def test_corpus_wordcount_arbitrary_bytes_exact_or_declines(data):
+    res = corpus_wordcount([data], piece_size=1 << 12, u_cap=256)
+    if any(b >= 0x80 for b in data) or _longest_run(
+            [data.decode("latin-1")]) > 64:
+        assert res is None  # non-ASCII or >64-byte word: host path decides
+        return
+    assert res is not None
+    want = collections.Counter(ASCII_WORDS.findall(data.decode("ascii")))
+    got = {w: c for w, (c, _) in res.to_dict().items()}
+    assert got == dict(want)
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.lists(st.text(alphabet="kq vw,", min_size=0, max_size=300),
+                min_size=1, max_size=4))
+def test_corpus_output_files_match_oracle_lines(tmp_path_factory, texts):
+    from dsi_tpu.ops.corpus_wc import write_corpus_output
+
+    tmp = tmp_path_factory.mktemp("fuzzout")
+    raws = [t.encode() for t in texts]
+    res = corpus_wordcount(raws, piece_size=1 << 12, u_cap=256)
+    if _longest_run(texts) > 64:
+        assert res is None
+        return
+    write_corpus_output(res, 10, str(tmp))
+    got = []
+    for r in range(10):
+        with open(tmp / f"mr-out-{r}", encoding="utf-8") as f:
+            got.extend(l for l in f if l.strip())
+    want = collections.Counter()
+    for t in texts:
+        want.update(ASCII_WORDS.findall(t))
+    assert sorted(got) == sorted(f"{w} {c}\n" for w, c in want.items())
+
+
+# ---- the native map-side encoder (partition + escape + serialize) ----
+
+kv_text = st.text(min_size=0, max_size=60)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.tuples(kv_text, kv_text), min_size=0, max_size=40),
+       st.integers(min_value=1, max_value=12))
+def test_native_encoder_blobs_roundtrip_and_partition(tmp_path_factory,
+                                                      pairs, n_reduce):
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    from dsi_tpu.mr.types import KeyValue
+
+    kva = [KeyValue(k, v) for k, v in pairs]
+    blobs = native.encode_partitions(kva, n_reduce)
+    if blobs is None:  # surrogates etc. — python path handles those
+        return
+    seen = []
+    for r, blob in enumerate(blobs):
+        # Split on \n only — the format's record delimiter (splitlines()
+        # would also split on U+0085/U+2028 INSIDE raw-UTF-8 values).
+        for line in blob.decode("utf-8").split("\n"):
+            if not line:
+                continue
+            obj = json.loads(line)
+            assert ihash(obj["Key"]) % n_reduce == r
+            seen.append((obj["Key"], obj["Value"]))
+    assert sorted(seen) == sorted(pairs)
