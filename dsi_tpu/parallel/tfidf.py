@@ -30,11 +30,12 @@ Host-memory story, stated honestly: the accumulator maps
 footprint as the reference's reduce-side in-memory group
 (``mr/worker.go:110-124`` holds every record of a partition at once), but
 across ALL partitions.  At the 10 GB config (~1e8 postings x ~20 B) this
-needs tens of GB of host RAM; the scale-out story is to run the job per
-reduce-partition slice (the partition id is already on every row), which
-divides the accumulator by n_reduce without touching device code — or to
-spill finished words to disk sorted, as external merge.  Device memory is
-unaffected either way.
+needs tens of GB of host RAM; the scale-out lever is implemented: pass
+``tfidf_sharded(..., partitions={...})`` to accumulate only a slice of the
+reduce partitions (the partition id is already on every row), dividing the
+accumulator by the number of slices without touching device code — the
+slices' union is exactly the full result.  Device memory is unaffected
+either way.
 """
 
 from __future__ import annotations
@@ -153,12 +154,20 @@ def _wave_chunk(docs: Sequence[bytes], idxs: Sequence[int], n_dev: int,
 def tfidf_sharded(
         docs: Sequence[bytes], mesh: Mesh | None = None, n_reduce: int = 10,
         max_word_len: int = 16, u_cap: int = 1 << 15,
+        partitions: Optional[set] = None,
 ) -> Optional[Dict[str, Tuple[int, List[Tuple[int, int]]]]]:
     """Whole-corpus TF-IDF over the mesh, waves of n_dev documents.
 
     Returns ``{word: (reduce_partition, [(doc_index, tf), ...])}`` — exact,
     or None when any document needs the host path (non-ASCII bytes, words
     longer than 64).  Same retry discipline as ``wordcount_sharded``.
+
+    ``partitions`` restricts the host accumulator to those reduce
+    partitions — the module's large-corpus story made concrete: running the
+    job once per partition slice divides the O(total postings) host memory
+    by the number of slices (device work repeats per slice; the partition
+    id rides every shuffled row, so filtering costs nothing extra).  The
+    slices' union is exactly the unfiltered result.
     """
     if mesh is None:
         mesh = default_mesh()
@@ -204,7 +213,14 @@ def tfidf_sharded(
                 if nr == 0:
                     continue
                 r = rows_np[d, :nr]
-                words = decode_packed(r[:, :kk], r[:, kk], nr)
+                if partitions is not None:
+                    # Drop other slices' rows BEFORE decoding: the filter
+                    # must cut the per-slice host cost, not just the dict.
+                    r = r[np.isin(r[:, kk + 3],
+                                  np.fromiter(partitions, dtype=r.dtype))]
+                    if not len(r):
+                        continue
+                words = decode_packed(r[:, :kk], r[:, kk], len(r))
                 tfs = r[:, kk + 1]
                 dids = r[:, kk + 2]
                 parts = r[:, kk + 3]

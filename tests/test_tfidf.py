@@ -131,6 +131,36 @@ def test_outlier_document_compiles_few_shapes(tmp_path, monkeypatch):
     assert got_df == dict(want)
 
 
+def test_partition_slices_union_equals_full_run(tmp_path):
+    """The bounded-host-memory lever: per-partition-slice runs must union
+    to exactly the full result, with each slice holding only its words."""
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.tfidf import tfidf_sharded
+
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=9,
+                          file_size=2_500)
+    docs = []
+    for p in files:
+        with open(p, "rb") as f:
+            docs.append(f.read())
+    mesh = default_mesh(8)
+    full = tfidf_sharded(docs, mesh=mesh, n_reduce=6, u_cap=1 << 11)
+    assert full is not None
+
+    lo = tfidf_sharded(docs, mesh=mesh, n_reduce=6, u_cap=1 << 11,
+                       partitions={0, 1, 2})
+    hi = tfidf_sharded(docs, mesh=mesh, n_reduce=6, u_cap=1 << 11,
+                       partitions={3, 4, 5})
+    assert set(lo) | set(hi) == set(full)
+    assert not set(lo) & set(hi)  # a word lives in exactly one slice
+    for w, (part, pairs) in lo.items():
+        assert part in {0, 1, 2}
+        assert sorted(pairs) == sorted(full[w][1])
+    for w, (part, pairs) in hi.items():
+        assert part in {3, 4, 5}
+        assert sorted(pairs) == sorted(full[w][1])
+
+
 def test_spmd_falls_back_on_non_ascii(tmp_path):
     from dsi_tpu.parallel.shuffle import default_mesh
     from dsi_tpu.parallel.tfidf import tfidf_sharded
