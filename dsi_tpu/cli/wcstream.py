@@ -30,6 +30,9 @@ def main(argv=None) -> int:
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size (default: all local devices)")
     p.add_argument("--workdir", default=".")
+    p.add_argument("--check", action="store_true",
+                   help="run the sequential oracle and verify parity "
+                        "(sort mr-out-* | grep . vs oracle, test-mr.sh:52-53)")
     args = p.parse_args(argv)
 
     from dsi_tpu.utils.platformpin import pin_platform_from_env
@@ -58,6 +61,27 @@ def main(argv=None) -> int:
                 counts[kv.key] = counts.get(kv.key, 0) + 1
         acc = {w: (c, ihash(w) % args.nreduce) for w, c in counts.items()}
     write_partitioned_output(acc, args.nreduce, args.workdir)
+
+    if args.check:
+        import os
+
+        from dsi_tpu.apps import wc
+        from dsi_tpu.mr.sequential import run_sequential
+
+        oracle_out = os.path.join(args.workdir, "mr-correct.txt")
+        run_sequential(wc.Map, wc.Reduce, args.files, oracle_out)
+        got: list = []
+        for r in range(args.nreduce):
+            with open(os.path.join(args.workdir, f"mr-out-{r}"),
+                      encoding="utf-8") as f:
+                got.extend(l for l in f if l.strip())
+        with open(oracle_out, encoding="utf-8") as f:
+            want = sorted(l for l in f if l.strip())
+        if sorted(got) != want:
+            print("wcstream: PARITY FAILURE vs sequential oracle",
+                  file=sys.stderr)
+            return 2
+        print("wcstream: parity OK", file=sys.stderr)
     return 0
 
 

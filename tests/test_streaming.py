@@ -105,8 +105,8 @@ def test_wcstream_cli_matches_sequential_oracle(tmp_path, monkeypatch):
     wd = tmp_path / "out"
     wd.mkdir()
     rc = wcstream.main(["--nreduce", "10", "--chunk-bytes", "4096",
-                        "--workdir", str(wd)] + files)
-    assert rc == 0
+                        "--check", "--workdir", str(wd)] + files)
+    assert rc == 0  # --check exits 2 on a parity failure
     assert merged_output(str(wd)) == want
 
 
