@@ -26,6 +26,7 @@ output-invariant):
 from __future__ import annotations
 
 import heapq
+import os
 import threading
 import time
 from typing import List, Optional
@@ -74,6 +75,8 @@ class Coordinator:
         # Optional checkpoint/resume (journal.py; disabled by default — the
         # reference keeps coordinator state purely in-memory).
         self._journal: Optional[Journal] = None
+        resuming = bool(self.config.journal_path
+                        and os.path.exists(self.config.journal_path))
         if self.config.journal_path:
             self._journal = Journal(self.config.journal_path, self.files,
                                     self.n_reduce)
@@ -87,6 +90,23 @@ class Coordinator:
                     self.reduce_log[t] = LOG_COMPLETED
                     self.c_reduce += 1
             self._journal.open()
+
+        # Clear stale mr-out-* so a leftover file from a PREVIOUS job in the
+        # same cwd can't win the workers' first-writer-wins output commit
+        # (atomicio.py) — preserving reference rerun-overwrites behavior at
+        # job granularity.  NOT on journal resume: there, a
+        # committed-but-unjournaled mr-out-<r> whose intermediates were
+        # already GC'd is the only surviving copy of that partition, and
+        # deleting it would make the re-run reducer commit an empty file;
+        # first_wins keeps the full copy instead (mrrun.py preserves
+        # mr-out-* when resuming for the same reason).
+        if not resuming:
+            for t in range(self.n_reduce):
+                try:
+                    os.remove(os.path.join(self.config.workdir,
+                                           f"mr-out-{t}"))
+                except OSError:
+                    pass
 
     # ---- RPC handlers (the wire API, mr/coordinator.go:27-114) ----
 

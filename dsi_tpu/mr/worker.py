@@ -155,9 +155,18 @@ def run_map_task(mapf: MapFn, filename: str, map_task: int, n_reduce: int,
 def run_reduce_task(reducef: ReduceFn, reduce_task: int, n_map: int,
                     workdir: str = ".") -> None:
     """One reduce task: gather, sort, group, reduce, commit, GC
-    (worker.go:99-154)."""
+    (worker.go:99-154).
+
+    The output commit is FIRST-writer-wins (utils/atomicio.py): a re-queued
+    duplicate of this task that read ``mr-*-<r>`` after this run's GC below
+    would otherwise rename an empty ``mr-out-<r>`` over the full one — the
+    reference's latent duplicate-reduce race (worker.go:148,151-154), which
+    its 10 s timeout hides but a tiny-timeout soak reproduces.  The
+    coordinator clears stale ``mr-out-*`` at job start so reruns in the
+    same cwd still overwrite (reference rerun behavior)."""
     intermediate = read_intermediates(reduce_task, n_map, workdir)
-    with atomic_write(output_name(reduce_task, workdir)) as out:
+    with atomic_write(output_name(reduce_task, workdir),
+                      first_wins=True) as out:
         group_and_reduce(intermediate, reducef, out)
     for i in range(n_map):  # GC intermediates, errors ignored (worker.go:151-154)
         try:

@@ -3,8 +3,25 @@
 Reference: map-side intermediate commit ``os.CreateTemp`` + ``os.Rename``
 (``mr/worker.go:83,91``) and reduce-side output commit (``mr/worker.go:127,148``).
 Atomic rename is the framework's entire checkpoint/idempotence story
-(SURVEY.md §5): re-executed tasks overwrite with a complete file, last writer
-wins, readers never observe a partial file.
+(SURVEY.md §5): re-executed tasks overwrite with a complete file, and readers
+never observe a partial file.
+
+Two commit disciplines:
+
+* default (last-writer-wins ``os.rename``) — the reference's semantics for
+  map intermediates, where every writer produced identical content;
+* ``first_wins=True`` (``os.link``; an existing target wins) — for the
+  reduce output commit.  The reference's last-writer-wins reduce commit has
+  a latent duplicate-execution race (worker.go:148,151-154): a re-queued
+  reduce B that reads ``mr-*-<r>`` *after* the original completer A
+  garbage-collected them sees an empty partition (missing files are
+  tolerated, worker.go:106-108) and renames an EMPTY ``mr-out-<r>`` over
+  A's full one.  Under the reference's 10 s timeout this never fires; under
+  tiny task timeouts the race-soak test catches it losing whole partitions.
+  First-writer-wins closes it: any reducer that observed GC'd inputs
+  necessarily commits after the reducer that did the GC, so its commit is
+  discarded.  Output-invariant vs the reference on every non-racy schedule
+  (duplicate executions of a deterministic reduce produce identical bytes).
 """
 
 from __future__ import annotations
@@ -16,11 +33,16 @@ from typing import IO, Iterator
 
 
 @contextmanager
-def atomic_write(path: str, mode: str = "w") -> Iterator[IO]:
+def atomic_write(path: str, mode: str = "w",
+                 first_wins: bool = False) -> Iterator[IO]:
     """Open a temp file in the destination directory; rename onto `path` on
     successful exit.  On exception the temp file is removed and nothing is
     committed (mirrors the reference: a crashed worker leaves no partial
-    mr-X-Y / mr-out-Y file, mr/worker.go:81-92,126-148)."""
+    mr-X-Y / mr-out-Y file, mr/worker.go:81-92,126-148).
+
+    ``first_wins=True`` commits with ``os.link`` instead: if ``path``
+    already exists the new content is discarded and the existing file kept
+    (see module docstring for why the reduce output needs this)."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     # The ".tmp-" prefix keeps uncommitted temp files out of the harness's
     # "mr-out*" merge glob if a worker dies (os._exit) mid-write.
@@ -34,7 +56,22 @@ def atomic_write(path: str, mode: str = "w") -> Iterator[IO]:
         f.flush()
         os.fsync(f.fileno())
         f.close()
-        os.rename(tmp, path)  # atomic commit
+        if first_wins:
+            try:
+                os.link(tmp, path)  # atomic; fails iff path exists
+            except FileExistsError:
+                pass  # a complete commit already landed; keep it
+            except OSError:
+                # Filesystem without hardlinks (some NFS/CIFS): degrade to
+                # the reference's last-writer-wins rename rather than fail
+                # every commit.  The duplicate-reduce window reopens there,
+                # exactly as in the reference.
+                os.rename(tmp, path)
+                tmp = None
+            if tmp is not None:
+                os.remove(tmp)
+        else:
+            os.rename(tmp, path)  # atomic commit
     except BaseException:
         try:
             f.close()

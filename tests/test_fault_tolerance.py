@@ -88,3 +88,83 @@ def test_worker_killed_externally(tmp_path):
             if p.poll() is None:
                 p.kill()
     assert merged_output(wd) == want
+
+
+def test_duplicate_reduce_after_gc_keeps_full_output(tmp_path):
+    """The reference's latent duplicate-reduce race (worker.go:148,151-154),
+    reproduced deterministically: reducer A commits mr-out-r and GCs the
+    intermediates; a re-queued duplicate B then reads the (now missing,
+    tolerated — worker.go:106-108) intermediates and commits an EMPTY
+    partition.  With last-writer-wins (the reference) B's rename clobbers
+    A's full output — whole partitions vanish, which is exactly what the
+    tiny-timeout race soak caught.  Our first-writer-wins commit
+    (utils/atomicio.py) must keep A's file."""
+    from dsi_tpu.apps.wc import Reduce
+    from dsi_tpu.mr.worker import (KeyValue, run_reduce_task,
+                                   write_intermediates)
+
+    wd = str(tmp_path)
+    kva = [KeyValue(w, "1") for w in ["alpha", "beta", "gamma", "alpha"]]
+    write_intermediates(kva, map_task=0, n_reduce=1, workdir=wd)
+
+    run_reduce_task(Reduce, 0, n_map=1, workdir=wd)   # A: full commit + GC
+    with open(os.path.join(wd, "mr-out-0")) as f:
+        full = f.read()
+    assert "alpha 2" in full
+
+    run_reduce_task(Reduce, 0, n_map=1, workdir=wd)   # B: reads nothing
+    with open(os.path.join(wd, "mr-out-0")) as f:
+        assert f.read() == full, "duplicate reduce clobbered the output"
+
+
+def test_fresh_job_overwrites_stale_outputs(tmp_path):
+    """First-writer-wins must not leak ACROSS jobs: a rerun in the same cwd
+    overwrites previous outputs (reference rerun behavior) because the
+    coordinator clears stale mr-out-* for every task it will run."""
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr.coordinator import Coordinator
+
+    wd = str(tmp_path)
+    stale = os.path.join(wd, "mr-out-0")
+    with open(stale, "w") as f:
+        f.write("stale 1\n")
+    inp = os.path.join(wd, "in.txt")
+    with open(inp, "w") as f:
+        f.write("fresh words here\n")
+    c = Coordinator([inp], 2, JobConfig(n_reduce=2, workdir=wd))
+    try:
+        assert not os.path.exists(stale)
+    finally:
+        c.close()
+
+
+def test_journal_resume_preserves_unjournaled_output(tmp_path):
+    """Resume must NOT clear stale mr-out-*: a reduce that committed its
+    output and GC'd its intermediates right before a coordinator crash —
+    but whose completion RPC never got journaled — leaves mr-out-<r> as the
+    only copy of that partition.  The resumed job re-runs the task; its
+    empty re-commit loses to the surviving file (first-writer-wins)."""
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr.coordinator import Coordinator
+
+    wd = str(tmp_path)
+    inp = os.path.join(wd, "in.txt")
+    with open(inp, "w") as f:
+        f.write("words\n")
+    jpath = os.path.join(wd, "journal")
+    cfg = JobConfig(n_reduce=2, workdir=wd, journal_path=jpath)
+
+    c1 = Coordinator([inp], 2, cfg)   # pre-crash incarnation
+    c1.map_complete({"TaskNumber": 0})
+    c1.close()
+    # The unjournaled-but-committed partition (its intermediates GC'd):
+    survivor = os.path.join(wd, "mr-out-1")
+    with open(survivor, "w") as f:
+        f.write("words 1\n")
+
+    c2 = Coordinator([inp], 2, cfg)   # resume
+    try:
+        assert os.path.exists(survivor), "resume deleted the only copy"
+        assert c2.c_map == 1 and c2.c_reduce == 0
+    finally:
+        c2.close()
