@@ -101,10 +101,14 @@ class Coordinator:
         # first_wins keeps the full copy instead (mrrun.py preserves
         # mr-out-* when resuming for the same reason).
         if not resuming:
-            for t in range(self.n_reduce):
-                try:
-                    os.remove(os.path.join(self.config.workdir,
-                                           f"mr-out-{t}"))
+            try:
+                stale = [n for n in os.listdir(self.config.workdir)
+                         if n.startswith("mr-out-")]
+            except OSError:
+                stale = []
+            for name in stale:  # ALL partitions, incl. a previous job's
+                try:            # higher-numbered ones (n_reduce may shrink)
+                    os.remove(os.path.join(self.config.workdir, name))
                 except OSError:
                     pass
 
