@@ -267,14 +267,23 @@ def run_tpu_watchdogged() -> dict:
             if now >= attempt_deadline or (
                     not os.path.exists(result_path + ".init")
                     and now >= init_deadline):
-                # SIGTERM first: a SIGKILLed JAX client mid-claim wedges
-                # the device for every later process (observed on this
-                # platform; BASELINE.md incident log) — give the child a
-                # grace window to run its PJRT teardown.
-                proc.terminate()
-                try:
-                    rc = proc.wait(timeout=20.0)
-                except subprocess.TimeoutExpired:
+                if os.path.exists(result_path + ".init"):
+                    # Post-init child: SIGTERM + grace so its handler can
+                    # unwind the PJRT client and release the device claim
+                    # (a SIGKILL mid-claim wedges the device for later
+                    # processes — BASELINE.md incident log).
+                    proc.terminate()
+                    try:
+                        rc = proc.wait(timeout=20.0)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        rc = proc.wait()
+                else:
+                    # Init-hang: the child is blocked inside the
+                    # jax.devices() C call, where CPython cannot run the
+                    # SIGTERM handler anyway — waiting 20 s would just burn
+                    # deadline budget before the same SIGKILL.  A polling
+                    # pre-init client holds no claim, so the kill is safe.
                     proc.kill()
                     rc = proc.wait()
                 timed_out = True

@@ -53,16 +53,13 @@ def main(argv=None) -> int:
     env = dict(os.environ)
     env.setdefault("DSI_MR_SOCKET", os.path.join(workdir, "mr.sock"))
 
-    # Clear stale outputs so a failed job can't pass --check against a
-    # previous run's files (the reference harness's rm, test-mr.sh:54) —
-    # EXCEPT when resuming from an existing journal: a resumed
-    # coordinator marks journaled tasks completed and never regenerates
-    # their committed mr-out-* files, so those ARE the checkpoint.
-    resuming = bool(journal) and os.path.exists(journal)
+    # Clear stale oracle files so a failed job can't pass --check against
+    # a previous run's ground truth (the reference harness's rm,
+    # test-mr.sh:54).  mr-out-* lifecycle belongs to the coordinator alone
+    # (Coordinator.__init__ clears stale partitions with the same
+    # resume-awareness) — one owner, one predicate.
     for name in os.listdir(workdir):
-        stale = name.startswith("mr-correct") or (
-            name.startswith("mr-out-") and not resuming)
-        if stale:
+        if name.startswith("mr-correct"):
             try:
                 os.remove(os.path.join(workdir, name))
             except OSError:

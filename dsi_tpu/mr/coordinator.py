@@ -72,11 +72,33 @@ class Coordinator:
         self._monitor.start()
         self._server: Optional[rpc.RpcServer] = None
 
+        # Clear stale mr-out-* so a leftover file from a PREVIOUS job in the
+        # same cwd can't win the workers' first-writer-wins output commit
+        # (atomicio.py) — preserving reference rerun-overwrites behavior at
+        # job granularity.  NOT on journal resume: there, a
+        # committed-but-unjournaled mr-out-<r> whose intermediates were
+        # already GC'd is the only surviving copy of that partition, and
+        # deleting it would make the re-run reducer commit an empty file.
+        # This must happen BEFORE the journal file is created below: a crash
+        # between journal creation and the clear would otherwise look like a
+        # resume forever and skip the clear.
+        resuming = bool(self.config.journal_path
+                        and os.path.exists(self.config.journal_path))
+        if not resuming:
+            try:
+                stale = [n for n in os.listdir(self.config.workdir)
+                         if n.startswith("mr-out-")]
+            except OSError:
+                stale = []
+            for name in stale:  # ALL partitions, incl. a previous job's
+                try:            # higher-numbered ones (n_reduce may shrink)
+                    os.remove(os.path.join(self.config.workdir, name))
+                except OSError:
+                    pass
+
         # Optional checkpoint/resume (journal.py; disabled by default — the
         # reference keeps coordinator state purely in-memory).
         self._journal: Optional[Journal] = None
-        resuming = bool(self.config.journal_path
-                        and os.path.exists(self.config.journal_path))
         if self.config.journal_path:
             self._journal = Journal(self.config.journal_path, self.files,
                                     self.n_reduce)
@@ -90,27 +112,6 @@ class Coordinator:
                     self.reduce_log[t] = LOG_COMPLETED
                     self.c_reduce += 1
             self._journal.open()
-
-        # Clear stale mr-out-* so a leftover file from a PREVIOUS job in the
-        # same cwd can't win the workers' first-writer-wins output commit
-        # (atomicio.py) — preserving reference rerun-overwrites behavior at
-        # job granularity.  NOT on journal resume: there, a
-        # committed-but-unjournaled mr-out-<r> whose intermediates were
-        # already GC'd is the only surviving copy of that partition, and
-        # deleting it would make the re-run reducer commit an empty file;
-        # first_wins keeps the full copy instead (mrrun.py preserves
-        # mr-out-* when resuming for the same reason).
-        if not resuming:
-            try:
-                stale = [n for n in os.listdir(self.config.workdir)
-                         if n.startswith("mr-out-")]
-            except OSError:
-                stale = []
-            for name in stale:  # ALL partitions, incl. a previous job's
-                try:            # higher-numbered ones (n_reduce may shrink)
-                    os.remove(os.path.join(self.config.workdir, name))
-                except OSError:
-                    pass
 
     # ---- RPC handlers (the wire API, mr/coordinator.go:27-114) ----
 
