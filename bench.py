@@ -98,9 +98,15 @@ def tpu_child(result_path: str) -> int:
     from dsi_tpu.utils.tracing import Span
 
     def emit(obj: dict) -> None:
-        with open(result_path + ".tmp", "w") as f:
+        # Per-thread temp name: the init-watchdog thread and the main
+        # thread may both emit around the init deadline; a shared temp
+        # file could tear.  Both os.replace targets are atomic.
+        import threading
+
+        tmp = f"{result_path}.tmp{threading.get_ident()}"
+        with open(tmp, "w") as f:
             json.dump(obj, f)
-        os.replace(result_path + ".tmp", result_path)
+        os.replace(tmp, result_path)
 
     # Same deterministic list as the parent's oracle run — NOT a directory
     # glob, which would sweep in stale pg-*.txt files from an older corpus
@@ -118,12 +124,41 @@ def tpu_child(result_path: str) -> int:
 
     pin_platform_from_env()
     import jax
+
+    # Self-bounded init: a wedged device claim blocks jax.devices() inside
+    # a C call indefinitely (signals deferred, so only SIGKILL from outside
+    # works).  This daemon thread turns that into a clean, fast error
+    # verdict: no claim is held pre-init, so _exit is safe here.
+    # (When run under the full bench, the parent watchdog's init deadline
+    # is the backstop; set this BELOW it — onchip_evidence.sh uses 150 <
+    # the parent's 180 — so the clean child verdict wins the race.)
+    init_timeout = float(os.environ.get("DSI_CHILD_INIT_TIMEOUT", "0") or 0)
+    import threading
+
+    init_settled = threading.Event()  # set once jax.devices() returns/raises
+    if init_timeout > 0:
+        def _init_watchdog():
+            # wait() (not sleep) + a 5 s grace re-check close the race
+            # where init completes right at the deadline: _exit on a
+            # process holding a live claim would wedge the device.
+            if init_settled.wait(init_timeout):
+                return
+            if init_settled.wait(5.0):
+                return
+            emit({"error": f"device init exceeded {init_timeout:.0f}s "
+                           "(outage or wedged claim)"})
+            os._exit(3)
+
+        threading.Thread(target=_init_watchdog, daemon=True).start()
+
     t0 = time.perf_counter()
     try:
         devices = jax.devices()
     except RuntimeError as e:
+        init_settled.set()
         emit({"error": f"device init failed: {e}"})
         return 1
+    init_settled.set()
     init_s = time.perf_counter() - t0
     platform = devices[0].platform
     log(f"child: devices={devices} init={init_s:.1f}s")
@@ -320,6 +355,23 @@ def run_tpu_watchdogged() -> dict:
     return {"error": last_err}
 
 
+def diagnose_tunnel() -> str:
+    """One-line state of the axon tunnel's forwarded ports, so a bench
+    failure record distinguishes an infrastructure outage (ports closed /
+    backend unavailable — BASELINE.md incident log) from a framework bug."""
+    import socket
+
+    states = []
+    for port, name in ((8083, "stateless"), (8082, "session"),
+                       (8113, "compile")):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=3):
+                states.append(f"{name}:{port} open")
+        except OSError:
+            states.append(f"{name}:{port} CLOSED")
+    return "; ".join(states)
+
+
 def main() -> None:
     os.makedirs(WORKDIR, exist_ok=True)
     from dsi_tpu.utils.corpus import ensure_corpus
@@ -337,7 +389,8 @@ def main() -> None:
         print(json.dumps({"metric": "wc_tpu_throughput", "value": 0,
                           "unit": "MB/s", "vs_baseline": 0,
                           "oracle_mbps": round(oracle_mbps, 2),
-                          "error": res["error"]}))
+                          "error": res["error"],
+                          "diagnosis": diagnose_tunnel()}))
         sys.exit(1)
     log(f"tpu path: {res['tpu_s']:.3f}s = {res['tpu_mbps']:.2f} MB/s  "
         f"phases={res['phases']}")

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Collect the round's on-chip evidence, in order, assuming the AOT cache
+# was just warmed (scripts/warm_loop.sh runs this automatically after a
+# successful warm):
+#
+#   1. bench run A — a FRESH process: proves the AOT cache hits
+#      (compile_s < 5, aot_loads >= 2) and records the north-star number.
+#   2. bench run B — repeatability / second sample of the tunnel.
+#   3. scripts/test_mr.sh tpu_wc tpu — the full coordinator/worker/RPC
+#      framework path on the real chip (VERDICT r2 task 3).
+#   4. scripts/test_mr.sh tpu_grep tpu — second app family on-chip.
+#
+# Everything logs under $OUT; nothing else may touch the chip while this
+# runs (single-tenant tunnel).
+set -u
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+cd "$REPO"
+OUT=${1:-/tmp/onchip}
+mkdir -p "$OUT"
+log() { echo "$(date -u +%H:%M:%S) $*" >> "$OUT/log"; }
+
+log "bench run A (fresh process, warm cache)"
+DSI_CHILD_INIT_TIMEOUT=150 timeout -k 30s 1800s \
+  python bench.py > "$OUT/benchA.json" 2> "$OUT/benchA.err"
+log "benchA rc=$? $(cat "$OUT/benchA.json" 2>/dev/null | head -c 200)"
+
+log "bench run B"
+DSI_CHILD_INIT_TIMEOUT=150 timeout -k 30s 1800s \
+  python bench.py > "$OUT/benchB.json" 2> "$OUT/benchB.err"
+log "benchB rc=$? $(cat "$OUT/benchB.json" 2>/dev/null | head -c 200)"
+
+log "harness tpu_wc --backend tpu (on-chip)"
+{ time bash scripts/test_mr.sh tpu_wc tpu ; } \
+  > "$OUT/harness_tpu_wc.log" 2>&1
+log "tpu_wc rc=$? $(tail -c 120 "$OUT/harness_tpu_wc.log" | tr '\n' ' ')"
+
+log "harness tpu_grep --backend tpu (on-chip)"
+{ time bash scripts/test_mr.sh tpu_grep tpu ; } \
+  > "$OUT/harness_tpu_grep.log" 2>&1
+log "tpu_grep rc=$? $(tail -c 120 "$OUT/harness_tpu_grep.log" | tr '\n' ' ')"
+
+log "evidence collection done"

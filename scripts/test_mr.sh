@@ -57,16 +57,31 @@ timeout -k 2s 180s $PY -m dsi_tpu.cli.mrcoordinator "${EXTRA_COORD_ARGS[@]}" "${
 COORD=$!
 sleep 1  # socket-creation grace (test-mr.sh:39-40)
 
-for _ in 1 2 3; do
+RESPAWN_ARGS=("${WORKER_ARGS[@]}")
+if [ "$BACKEND" = tpu ] && [ -z "${DSI_JAX_PLATFORM:-}" ]; then
+  # Real-chip run: the tunneled TPU is single-tenant (two concurrent JAX
+  # clients wedge the device claim — BASELINE.md), so exactly ONE worker
+  # takes the device backend; the other two — and any crash-app respawn —
+  # run the host path.  Both produce identical intermediates, so this
+  # heterogeneous fleet is the reference's 3-worker shape
+  # (test-mr.sh:43-45) with one accelerated member.
+  RESPAWN_ARGS=(--backend host)
   timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "${WORKER_ARGS[@]}" "$APP" &
-done
+  for _ in 1 2; do
+    timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker --backend host "$APP" &
+  done
+else
+  for _ in 1 2 3; do
+    timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "${WORKER_ARGS[@]}" "$APP" &
+  done
+fi
 
 if [ "$APP" = crash ]; then
   # keep respawning workers while the coordinator lives (crashed ones die)
   while kill -0 $COORD 2>/dev/null; do
     N=$(jobs -rp | wc -l)
     if [ "$N" -lt 4 ]; then
-      timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "${WORKER_ARGS[@]}" "$APP" &
+      timeout -k 2s 180s $PY -m dsi_tpu.cli.mrworker "${RESPAWN_ARGS[@]}" "$APP" &
     fi
     sleep 0.5
   done

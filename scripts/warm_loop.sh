@@ -19,21 +19,27 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   echo "$(date -u +%H:%M:%S) attempt $n" >> "$OUT/log"
   # Stale results must not masquerade as this attempt's verdict.
   rm -f "$REPO/.bench/warm-result.json" "$REPO/.bench/warm-result.json.init"
-  # Bounded attempt: a wedged claim hangs inside jax.devices() without
-  # raising (bench.py watchdog notes), so an unbounded attempt would stall
-  # the loop on exactly the condition it retries through.  3600 s covers
-  # any plausible cold compile; TERM (not KILL) lets the child's handler
-  # unwind the claim cleanly.
-  timeout -k 30s 3600s python -u bench.py --tpu-child \
-    "$REPO/.bench/warm-result.json" >> "$OUT/attempt.log" 2>&1
+  # Bounded attempt, two layers: the child's own init watchdog
+  # (DSI_CHILD_INIT_TIMEOUT) converts a wedged-claim init hang into a
+  # clean error verdict in 4 min — so during an outage the loop cycles
+  # quickly — while the outer timeout only backstops a post-init hang;
+  # 3600 s covers any plausible cold compile, and TERM (not KILL) lets
+  # the child's handler unwind the claim cleanly.
+  DSI_CHILD_INIT_TIMEOUT=240 timeout -k 30s 3600s python -u bench.py \
+    --tpu-child "$REPO/.bench/warm-result.json" >> "$OUT/attempt.log" 2>&1
   if [ -f "$REPO/.bench/warm-result.json" ] && \
      ! grep -q '"error"' "$REPO/.bench/warm-result.json"; then
     echo "$(date -u +%H:%M:%S) corpus_wc warm after $n attempts" >> "$OUT/log"
     # Also warm the per-task worker kernels the on-chip harness runs use
     # (tpu_wc / tpu_grep map shapes; see scripts/warm_kernels.py).
-    python scripts/warm_kernels.py >> "$OUT/kernels.log" 2>&1 \
+    timeout -k 30s 3600s python scripts/warm_kernels.py \
+      >> "$OUT/kernels.log" 2>&1 \
       && echo "$(date -u +%H:%M:%S) worker kernels warm" >> "$OUT/log" \
       || echo "$(date -u +%H:%M:%S) warm_kernels FAILED (see kernels.log)" >> "$OUT/log"
+    # Chain straight into the round's on-chip evidence collection: two
+    # bench runs (AOT-hit proof + repeat) and the on-chip harness runs.
+    bash scripts/onchip_evidence.sh /tmp/onchip >> "$OUT/log" 2>&1
+    echo "$(date -u +%H:%M:%S) onchip evidence done (see /tmp/onchip)" >> "$OUT/log"
     exit 0
   fi
   tail -c 300 "$REPO/.bench/warm-result.json" >> "$OUT/log" 2>/dev/null
