@@ -32,14 +32,21 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "$(date -u +%H:%M:%S) corpus_wc warm after $n attempts" >> "$OUT/log"
     # Also warm the per-task worker kernels the on-chip harness runs use
     # (tpu_wc / tpu_grep map shapes; see scripts/warm_kernels.py).
-    timeout -k 30s 3600s python scripts/warm_kernels.py \
-      >> "$OUT/kernels.log" 2>&1 \
-      && echo "$(date -u +%H:%M:%S) worker kernels warm" >> "$OUT/log" \
-      || echo "$(date -u +%H:%M:%S) warm_kernels FAILED (see kernels.log)" >> "$OUT/log"
-    # Chain straight into the round's on-chip evidence collection: two
-    # bench runs (AOT-hit proof + repeat) and the on-chip harness runs.
-    bash scripts/onchip_evidence.sh /tmp/onchip >> "$OUT/log" 2>&1
-    echo "$(date -u +%H:%M:%S) onchip evidence done (see /tmp/onchip)" >> "$OUT/log"
+    if timeout -k 30s 3600s python scripts/warm_kernels.py \
+        >> "$OUT/kernels.log" 2>&1; then
+      echo "$(date -u +%H:%M:%S) worker kernels warm" >> "$OUT/log"
+      # Chain into the round's on-chip evidence collection (two bench
+      # runs + on-chip harness runs) ONLY with a fully warm cache: a
+      # cold-compile worker under the harness's 180 s timeout would be
+      # SIGKILLed mid-claim — the wedge hazard again.  Per-run stamped
+      # dir so a later round can't overwrite this round's evidence.
+      EV="/tmp/onchip/$(date -u +%m%dT%H%M%S)"
+      bash scripts/onchip_evidence.sh "$EV" >> "$OUT/log" 2>&1
+      echo "$(date -u +%H:%M:%S) onchip evidence done (see $EV)" >> "$OUT/log"
+    else
+      echo "$(date -u +%H:%M:%S) warm_kernels FAILED (see kernels.log);" \
+           "skipping on-chip evidence chain" >> "$OUT/log"
+    fi
     exit 0
   fi
   tail -c 300 "$REPO/.bench/warm-result.json" >> "$OUT/log" 2>/dev/null
