@@ -67,7 +67,10 @@ N_FILES = int(os.environ.get("DSI_BENCH_FILES", "8"))
 FILE_SIZE = int(os.environ.get("DSI_BENCH_FILE_SIZE",
                                str((2 << 20) - 64)))  # pads to 2^21 on device
 N_REDUCE = 10
-WORKDIR = os.path.join(REPO, ".bench")
+# Overridable so tests (and ad-hoc small-corpus runs) don't overwrite the
+# canonical .bench corpus/oracle the warm loop's parity checks rely on.
+WORKDIR = (os.environ.get("DSI_BENCH_WORKDIR")
+           or os.path.join(REPO, ".bench"))
 ORACLE_OUT = os.path.join(WORKDIR, "mr-correct.txt")
 
 
