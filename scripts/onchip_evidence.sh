@@ -39,4 +39,9 @@ log "harness tpu_grep --backend tpu (on-chip)"
   > "$OUT/harness_tpu_grep.log" 2>&1
 log "tpu_grep rc=$? $(tail -c 120 "$OUT/harness_tpu_grep.log" | tr '\n' ' ')"
 
+log "harness tpu_indexer --backend tpu (on-chip)"
+{ time bash scripts/test_mr.sh tpu_indexer tpu ; } \
+  > "$OUT/harness_tpu_indexer.log" 2>&1
+log "tpu_indexer rc=$? $(tail -c 120 "$OUT/harness_tpu_indexer.log" | tr '\n' ' ')"
+
 log "evidence collection done"
