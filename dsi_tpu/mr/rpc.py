@@ -411,6 +411,8 @@ def call(socket_path: str, method: str, args: dict | None = None,
             resp = _recv_frame(sock)
         except (OSError, ConnectionError, json.JSONDecodeError):
             return False, None  # RPC-level failure -> ok=false (worker.go:186-188)
+        if not isinstance(resp, dict):
+            return False, None  # non-object frame: treat as RPC failure
         if not resp.get("ok"):
             if resp.get("error") == "auth failed":
                 raise AuthError(

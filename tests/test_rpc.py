@@ -372,3 +372,33 @@ def test_silent_peer_does_not_pin_handler_threads():
         assert before < 50  # no thread pile-up
     finally:
         srv.close()
+
+
+def test_non_object_response_frame_is_rpc_failure():
+    """A server answering with a JSON array (corrupt or hostile) must yield
+    (False, None) — the reference's ok=false path (worker.go:186-188) — not
+    an AttributeError that kills the worker loop."""
+    import socket
+    import struct
+    import threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve_one():
+        conn, _ = srv.accept()
+        with conn:
+            payload = b"[1, 2, 3]"
+            conn.recv(1 << 16)  # drain the request
+            conn.sendall(struct.pack(">I", len(payload)) + payload)
+
+    t = threading.Thread(target=serve_one, daemon=True)
+    t.start()
+    try:
+        ok, reply = rpc.call(f"tcp:127.0.0.1:{port}", "Echo", {})
+        assert (ok, reply) == (False, None)
+    finally:
+        t.join(timeout=5)
+        srv.close()
