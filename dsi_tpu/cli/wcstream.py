@@ -18,6 +18,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -60,11 +61,10 @@ def main(argv=None) -> int:
             for kv in wc.Map(f, text):
                 counts[kv.key] = counts.get(kv.key, 0) + 1
         acc = {w: (c, ihash(w) % args.nreduce) for w, c in counts.items()}
+    os.makedirs(args.workdir, exist_ok=True)
     write_partitioned_output(acc, args.nreduce, args.workdir)
 
     if args.check:
-        import os
-
         from dsi_tpu.apps import wc
         from dsi_tpu.mr.sequential import run_sequential
 

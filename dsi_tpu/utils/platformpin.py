@@ -14,8 +14,16 @@ import os
 
 
 def pin_platform_from_env(var: str = "DSI_JAX_PLATFORM") -> str | None:
-    """If env ``var`` is set, route JAX to that platform; returns it."""
-    plat = os.environ.get(var)
+    """If env ``var`` (or standard ``JAX_PLATFORMS``) is set, route JAX to
+    that platform through jax.config; returns the platform string.
+
+    Honoring ``JAX_PLATFORMS`` here matters: the env var alone is silently
+    ignored by this host's pre-registered TPU plugin (observed: a CLI run
+    with ``JAX_PLATFORMS=cpu`` still initialized — and hung on — the
+    remote TPU backend during an outage), while the config pin is
+    reliable.  So the standard JAX knob behaves as users expect at every
+    entry point that calls this."""
+    plat = os.environ.get(var) or os.environ.get("JAX_PLATFORMS")
     if plat:
         import jax
 

@@ -19,6 +19,12 @@ OUT=${1:-/tmp/onchip}
 mkdir -p "$OUT"
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$OUT/log"; }
 
+# This script exists to measure the CHIP: a stale ambient platform pin
+# (e.g. JAX_PLATFORMS=cpu left over from a soak run) would silently turn
+# every step below into a host run with green-looking logs.
+log "ambient pins before unset: JAX_PLATFORMS='${JAX_PLATFORMS:-}' DSI_JAX_PLATFORM='${DSI_JAX_PLATFORM:-}'"
+unset JAX_PLATFORMS DSI_JAX_PLATFORM
+
 log "bench run A (fresh process, warm cache)"
 DSI_CHILD_INIT_TIMEOUT=150 timeout -k 30s 1800s \
   python bench.py > "$OUT/benchA.json" 2> "$OUT/benchA.err"
@@ -43,5 +49,18 @@ log "harness tpu_indexer --backend tpu (on-chip)"
 { time bash scripts/test_mr.sh tpu_indexer tpu ; } \
   > "$OUT/harness_tpu_indexer.log" 2>&1
 log "tpu_indexer rc=$? $(tail -c 120 "$OUT/harness_tpu_indexer.log" | tr '\n' ' ')"
+
+log "wcstream --check on the chip (single-device mesh; fresh jit, slow ok)"
+# Own corpus under $OUT: regenerating .bench here could desync it from
+# the warm loop's oracle (bench.py owns that workdir and its env knobs).
+python -c "from dsi_tpu.utils.corpus import ensure_corpus; \
+           print(ensure_corpus('$OUT/corpus', n_files=4))" \
+  > "$OUT/corpus.log" 2>&1
+log "corpus rc=$?"
+mkdir -p "$OUT/wcstream-wd"
+timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
+  --workdir "$OUT/wcstream-wd" "$OUT"/corpus/pg-*.txt \
+  > "$OUT/wcstream.log" 2>&1
+log "wcstream rc=$? $(tail -c 160 "$OUT/wcstream.log" | tr '\n' ' ')"
 
 log "evidence collection done"

@@ -58,7 +58,7 @@ COORD=$!
 sleep 1  # socket-creation grace (test-mr.sh:39-40)
 
 RESPAWN_ARGS=("${WORKER_ARGS[@]}")
-if [ "$BACKEND" = tpu ] && [ -z "${DSI_JAX_PLATFORM:-}" ]; then
+if [ "$BACKEND" = tpu ] && [ -z "${DSI_JAX_PLATFORM:-}${JAX_PLATFORMS:-}" ]; then
   # Real-chip run: the tunneled TPU is single-tenant (two concurrent JAX
   # clients wedge the device claim — BASELINE.md), so exactly ONE worker
   # takes the device backend; the other two — and any crash-app respawn —
