@@ -129,7 +129,6 @@ def wordcount_streaming(
         chunks = jnp.asarray(chunks_np)
 
         def run(mwl: int, cap: int):
-            kk = mwl // 4
             for frac in (4, 2):
                 keys, lens, cnts, parts, scal = mapreduce_step(
                     chunks, n_dev=n_dev, n_reduce=n_reduce,
