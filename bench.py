@@ -360,6 +360,16 @@ def run_tpu_watchdogged() -> dict:
             if not os.path.exists(result_path + ".init"):
                 last_err = (f"attempt {attempt}: device init never completed "
                             "(wedged claim?)")
+                probes = probe_tunnel_ports()
+                if not any(up for _, _, up in probes):
+                    # Every tunnel port is closed: further attempts cannot
+                    # init either — stop burning the caller's budget (the
+                    # driver's external timeout is finite) and let the CPU
+                    # fallback produce the verdict sooner.
+                    last_err += ("; all tunnel ports closed "
+                                 f"({diagnose_tunnel(probes)})")
+                    log(last_err)
+                    break
             else:
                 last_err = f"attempt {attempt} timed out after {budget:.0f}s"
         else:
@@ -400,21 +410,28 @@ def run_cpu_fallback() -> dict:
     return {"error": "cpu fallback produced no result"}
 
 
-def diagnose_tunnel() -> str:
-    """One-line state of the axon tunnel's forwarded ports, so a bench
-    failure record distinguishes an infrastructure outage (ports closed /
-    backend unavailable — BASELINE.md incident log) from a framework bug."""
+def probe_tunnel_ports() -> list[tuple[str, int, bool]]:
+    """(name, port, open?) for each forwarded axon tunnel port."""
     import socket
 
-    states = []
+    out = []
     for port, name in ((8083, "stateless"), (8082, "session"),
                        (8113, "compile")):
         try:
             with socket.create_connection(("127.0.0.1", port), timeout=3):
-                states.append(f"{name}:{port} open")
+                out.append((name, port, True))
         except OSError:
-            states.append(f"{name}:{port} CLOSED")
-    return "; ".join(states)
+            out.append((name, port, False))
+    return out
+
+
+def diagnose_tunnel(probes=None) -> str:
+    """One-line state of the axon tunnel's forwarded ports, so a bench
+    failure record distinguishes an infrastructure outage (ports closed /
+    backend unavailable — BASELINE.md incident log) from a framework bug."""
+    return "; ".join(
+        f"{name}:{port} {'open' if up else 'CLOSED'}"
+        for name, port, up in (probes or probe_tunnel_ports()))
 
 
 def main() -> None:
