@@ -1,0 +1,172 @@
+"""Vectorized host-side merge tables for the SPMD paths' per-step outputs.
+
+The streaming word-count and TF-IDF paths produce per-step device tables of
+packed word keys (big-endian uint32 lanes, ``ops/wordcount.py``
+tokenize_group_core) plus payload columns.  Round 3 merged those into Python
+dicts one word at a time — O(rows) interpreter iterations with a string
+decode per row, which VERDICT r3 measured as the scale ceiling of both paths
+(`parallel/streaming.py` weakness #2, `parallel/tfidf.py` weakness #3).
+
+This module replaces the per-row loops with numpy table algebra:
+
+* rows accumulate as raw uint32 arrays (copied out of the step's transfer
+  buffer so no device-shaped block stays alive),
+* merging is one ``np.lexsort`` over the key lanes + run-boundary detection
+  + ``np.add.reduceat`` per compaction window — O(rows log rows) in C,
+* word spellings are decoded ONCE, from the final merged table
+  (vocabulary-sized), via the same bulk ``decode_packed`` the kernels use.
+
+Zero-padded key lanes make width harmonisation trivial: a word packed into
+K lanes and the same word packed into K' > K lanes agree on the first K
+lanes and are zero beyond, so narrower tables are right-padded with zero
+columns before concatenation.
+
+The reference has no analogue (its reduce merge is the in-memory group of
+``mr/worker.go:110-124``); this is that merge re-done as array algebra so
+the host side can keep up with the device side at GB scale.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from dsi_tpu.ops.wordcount import decode_packed
+
+
+def _pad_width(keys: np.ndarray, k: int) -> np.ndarray:
+    """Right-pad packed-key lanes with zero columns to width ``k``."""
+    if keys.shape[1] == k:
+        return keys
+    out = np.zeros((keys.shape[0], k), dtype=np.uint32)
+    out[:, :keys.shape[1]] = keys
+    return out
+
+
+def _group_starts(sorted_keys: np.ndarray) -> np.ndarray:
+    """Start indices of equal-key runs in a lexsorted [n, k] table."""
+    n = len(sorted_keys)
+    boundary = np.empty(n, dtype=bool)
+    boundary[0] = True
+    np.any(sorted_keys[1:] != sorted_keys[:-1], axis=1, out=boundary[1:])
+    return np.flatnonzero(boundary)
+
+
+def _lexsort_rows(keys: np.ndarray) -> np.ndarray:
+    """Row order sorting a [n, k] table lexicographically (lane 0 primary).
+
+    ``np.lexsort`` treats its LAST key as primary, so lanes are passed in
+    reverse.
+    """
+    return np.lexsort(tuple(keys[:, j] for j in range(keys.shape[1] - 1,
+                                                      -1, -1)))
+
+
+class PackedCounts:
+    """Word-count accumulator over packed-key row batches.
+
+    ``add`` ingests per-device step outputs (keys [n, K] uint32, byte
+    lengths, counts, reduce partitions); batches are compacted into one
+    merged table whenever the buffered row count crosses
+    ``compact_rows`` — so host memory is O(vocabulary + window), never
+    O(corpus).  ``finalize`` decodes spellings once and returns the same
+    ``{word: (count, reduce_partition)}`` mapping the dict-based merge
+    produced.
+    """
+
+    def __init__(self, compact_rows: int = 1 << 21):
+        self._bufs: List[Tuple[np.ndarray, np.ndarray, np.ndarray,
+                               np.ndarray]] = []
+        self._pending = 0
+        self._compact_rows = max(1, compact_rows)
+
+    def add(self, keys: np.ndarray, lens: np.ndarray, cnts: np.ndarray,
+            parts: np.ndarray) -> None:
+        if len(keys) == 0:
+            return
+        # Copies detach the rows from the step's full-capacity transfer
+        # buffer; counts widen to int64 so multi-step sums can't wrap.
+        self._bufs.append((
+            np.array(keys, dtype=np.uint32),
+            np.array(lens, dtype=np.int32),
+            np.array(cnts, dtype=np.int64),
+            np.array(parts, dtype=np.int32)))
+        self._pending += len(keys)
+        if self._pending >= self._compact_rows:
+            self._compact()
+
+    def _compact(self) -> None:
+        if len(self._bufs) <= 1:
+            return
+        k = max(b[0].shape[1] for b in self._bufs)
+        keys = np.concatenate([_pad_width(b[0], k) for b in self._bufs])
+        lens = np.concatenate([b[1] for b in self._bufs])
+        cnts = np.concatenate([b[2] for b in self._bufs])
+        parts = np.concatenate([b[3] for b in self._bufs])
+        order = _lexsort_rows(keys)
+        skeys = keys[order]
+        starts = _group_starts(skeys)
+        # len and partition are functions of the word, so first-of-run is
+        # exact; only counts need the segmented sum.
+        self._bufs = [(skeys[starts], lens[order][starts],
+                       np.add.reduceat(cnts[order], starts),
+                       parts[order][starts])]
+        self._pending = len(starts)
+
+    def finalize(self) -> Dict[str, Tuple[int, int]]:
+        self._compact()
+        if not self._bufs:
+            return {}
+        keys, lens, cnts, parts = self._bufs[0]
+        words = decode_packed(keys, lens, len(keys))
+        return {w: (int(c), int(p))
+                for w, c, p in zip(words, cnts.tolist(), parts.tolist())}
+
+
+class PostingsTable:
+    """TF-IDF accumulator over packed (word, tf, doc, part) row batches.
+
+    Rows are retained raw (uint32, ~16+4K bytes each — several times
+    smaller than the Python tuple lists they replace) and grouped once at
+    ``finalize``: one lexsort over the key lanes, run-boundary detection,
+    one bulk spelling decode, and per-word postings sliced out with
+    C-speed ``tolist``/``zip``.  Output matches the dict-based walk:
+    ``{word: (reduce_partition, [(doc_index, tf), ...])}``.
+    """
+
+    def __init__(self):
+        self._bufs: List[np.ndarray] = []
+        self._kk: int | None = None
+
+    def add(self, rows: np.ndarray, kk: int) -> None:
+        """Ingest [n, kk+4] rows: kk key lanes + (len, tf, doc, part)."""
+        if len(rows) == 0:
+            return
+        if self._kk is None:
+            self._kk = kk
+        elif kk != self._kk:  # one retry rung per table by contract
+            raise ValueError(f"mixed key widths: {self._kk} vs {kk}")
+        self._bufs.append(np.array(rows, dtype=np.uint32))
+
+    def finalize(self) -> Dict[str, Tuple[int, List[Tuple[int, int]]]]:
+        if not self._bufs:
+            return {}
+        kk = self._kk
+        rows = np.concatenate(self._bufs) if len(self._bufs) > 1 \
+            else self._bufs[0]
+        keys = rows[:, :kk]
+        order = _lexsort_rows(keys)
+        skeys = keys[order]
+        starts = _group_starts(skeys)
+        ends = np.append(starts[1:], len(rows))
+        lens = rows[order[starts], kk]
+        parts = rows[order[starts], kk + 3]
+        tfs = rows[order, kk + 1].tolist()
+        docs = rows[order, kk + 2].tolist()
+        words = decode_packed(skeys[starts], lens, len(starts))
+        out: Dict[str, Tuple[int, List[Tuple[int, int]]]] = {}
+        for i, w in enumerate(words):
+            s, e = int(starts[i]), int(ends[i])
+            out[w] = (int(parts[i]), list(zip(docs[s:e], tfs[s:e])))
+        return out

@@ -1,0 +1,112 @@
+"""Unit tests for the vectorized host-side merge tables (parallel/merge.py).
+
+These are pure-numpy properties (no mesh needed): the tables must agree
+with a straightforward dict/Counter oracle on random inputs, across
+compaction windows, mixed key widths, and count magnitudes past uint32.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from dsi_tpu.parallel.merge import PackedCounts, PostingsTable
+
+
+def _pack_word(w: str, k: int) -> np.ndarray:
+    """Big-endian uint32 lanes, zero-padded — the kernel's packing
+    (ops/wordcount.py tokenize_group_core)."""
+    raw = w.encode("ascii").ljust(4 * k, b"\0")
+    return np.frombuffer(raw, dtype=">u4").astype(np.uint32)
+
+
+def _rows(words, counts, k):
+    keys = np.stack([_pack_word(w, k) for w in words])
+    lens = np.array([len(w) for w in words], dtype=np.int32)
+    cnts = np.array(counts, dtype=np.int64)
+    parts = np.array([hash(w) % 10 for w in words], dtype=np.int32)
+    return keys, lens, cnts, parts
+
+
+def test_packed_counts_matches_counter_oracle():
+    rng = random.Random(7)
+    vocab = ["".join(rng.choices("abcdefgh", k=rng.randint(1, 12)))
+             for _ in range(200)]
+    oracle: Counter = Counter()
+    acc = PackedCounts(compact_rows=64)  # force many compactions
+    for _ in range(30):
+        batch = rng.choices(vocab, k=rng.randint(1, 50))
+        local = Counter(batch)
+        words = sorted(local)
+        acc.add(*_rows(words, [local[w] for w in words], k=4))
+        oracle.update(local)
+    out = acc.finalize()
+    assert {w: c for w, (c, _) in out.items()} == dict(oracle)
+    # partition column survives the merge and is per-word stable
+    for w, (_, p) in out.items():
+        assert p == hash(w) % 10
+
+
+def test_packed_counts_mixed_key_widths():
+    acc = PackedCounts()
+    # same word arriving from a 16-byte rung (k=4) and a 64-byte rung
+    # (k=16) must merge: zero-padded lanes agree beyond the word
+    acc.add(*_rows(["alpha", "beta"], [2, 3], k=4))
+    acc.add(*_rows(["alpha", "gamma"], [5, 7], k=16))
+    out = acc.finalize()
+    assert {w: c for w, (c, _) in out.items()} == {
+        "alpha": 7, "beta": 3, "gamma": 7}
+
+
+def test_packed_counts_empty_and_large_counts():
+    assert PackedCounts().finalize() == {}
+    acc = PackedCounts()
+    big = (1 << 31) + 5
+    for _ in range(3):
+        acc.add(*_rows(["x"], [big], k=4))
+    assert acc.finalize()["x"][0] == 3 * big  # int64, no uint32 wrap
+
+
+def test_packed_counts_ignores_empty_batches():
+    acc = PackedCounts()
+    acc.add(np.zeros((0, 4), np.uint32), np.zeros(0, np.int32),
+            np.zeros(0, np.int64), np.zeros(0, np.int32))
+    assert acc.finalize() == {}
+
+
+def test_postings_table_matches_dict_oracle():
+    rng = random.Random(11)
+    vocab = ["".join(rng.choices("mnopqr", k=rng.randint(1, 8)))
+             for _ in range(60)]
+    kk = 4
+    oracle: dict = {}
+    table = PostingsTable()
+    for wave in range(10):
+        rows = []
+        for w in set(rng.choices(vocab, k=20)):
+            tf = rng.randint(1, 9)
+            doc = rng.randint(0, 30)
+            part = hash(w) % 10
+            row = np.concatenate([
+                _pack_word(w, kk),
+                np.array([len(w), tf, doc, part], dtype=np.uint32)])
+            rows.append(row)
+            ent = oracle.setdefault(w, (part, []))
+            ent[1].append((doc, tf))
+        table.add(np.stack(rows), kk)
+    out = table.finalize()
+    assert set(out) == set(oracle)
+    for w in oracle:
+        assert out[w][0] == oracle[w][0]
+        assert sorted(out[w][1]) == sorted(oracle[w][1])
+
+
+def test_postings_table_empty_and_width_guard():
+    assert PostingsTable().finalize() == {}
+    t = PostingsTable()
+    t.add(np.zeros((1, 8), np.uint32), 4)
+    with pytest.raises(ValueError):
+        t.add(np.zeros((1, 20), np.uint32), 16)

@@ -147,3 +147,27 @@ def test_streaming_100mb_bounded_memory():
     base_counts = collections.Counter(WORDS.findall(base.decode()))
     want = {w: c * repeats for w, c in base_counts.items()}
     assert {w: c for w, (c, _) in res.items()} == want
+
+
+def test_streaming_aot_path_matches_counter(tmp_path, monkeypatch):
+    """The aot=True bench path (AOT-cached step + full-capacity pack) on a
+    single-device mesh — the exact configuration bench.py's stream row
+    runs on the chip — must agree with the Counter oracle, and the warm
+    pass must cover every program the stream then executes (zero compiles
+    after warming)."""
+    from dsi_tpu.backends import aotcache
+    from dsi_tpu.parallel.streaming import warm_stream_aot
+
+    monkeypatch.setenv("DSI_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    mesh = default_mesh(1)
+    warm_stream_aot(mesh=mesh, chunk_bytes=1 << 14, caps=(1 << 10,))
+    compiles_after_warm = aotcache.stats["compiles"]
+    text = ("portable exact streaming " * 900).encode()
+    res = wordcount_streaming([text], mesh=mesh, n_reduce=10,
+                              chunk_bytes=1 << 14, u_cap=1 << 10, aot=True)
+    assert res is not None
+    want = collections.Counter(WORDS.findall(text.decode()))
+    assert {w: c for w, (c, _) in res.items()} == dict(want)
+    for w, (_, part) in res.items():
+        assert part == ihash(w) % 10
+    assert aotcache.stats["compiles"] == compiles_after_warm
