@@ -13,10 +13,15 @@ The program is compiled through the persistent AOT executable cache
 (`dsi_tpu/backends/aotcache.py`), so only the first-ever process on a
 machine pays the XLA compile.
 
-The timed region runs DSI_BENCH_REPS times (default 5) and the best rep is
-reported — the axon tunnel's transfer bandwidth fluctuates by >10x between
-moments, and min-of-N is the standard way to report a machine's capability
-rather than the tunnel's worst congestion instant.
+The timed region runs DSI_BENCH_REPS times (default 5): the first two reps
+probe the raw and 6-bit-packed upload transports once each, every later
+rep commits to the winner.  The best rep is the headline — the axon
+tunnel's transfer bandwidth fluctuates by >10x between moments, and
+min-of-N is the standard way to report a machine's capability rather than
+the tunnel's worst congestion instant — with the median reported alongside
+(``median_mbps``) so the variance stays visible.  A second row measures
+the bounded-memory streaming path over DSI_BENCH_STREAM_MB (default 64) of
+cycled corpus (``stream_mbps``, with its own exact-count parity gate).
 
 Prints ONE JSON line on stdout:
   {"metric": ..., "value": MB/s, "unit": "MB/s", "vs_baseline": speedup}
@@ -44,7 +49,13 @@ Environment knobs:
                           2100).  An attempt only starts if >= 60 s of
                           budget remain (anything less cannot even cover
                           device init), so values under 60 disable the TPU
-                          half entirely.
+                          half entirely.  The CPU fallback is bounded by
+                          whatever remains of this budget (60 s floor,
+                          900 s cap).
+  DSI_BENCH_STREAM_MB     size of the streaming-path row (default 64;
+                          0 disables it).  The row only runs against a
+                          warm AOT cache and never pre-empts the headline
+                          verdict (which is emitted first).
 """
 
 from __future__ import annotations
@@ -147,24 +158,40 @@ def tpu_child(result_path: str) -> int:
     import threading
 
     init_settled = threading.Event()  # set once jax.devices() returns/raises
+    # The settle lock serializes the watchdog's final decision against the
+    # main thread's completion mark (ADVICE r3: the unlocked re-check left
+    # the whole emit duration as a TOCTOU window): once the main thread
+    # has acquired the lock and set the flag, _exit cannot fire.  The
+    # residual hazard is inherent — the device claim goes live inside the
+    # jax.devices() C call, so a window between the claim appearing and
+    # _settle() acquiring the lock cannot be closed from Python; the 5 s
+    # grace re-check plus this lock make it as narrow as the runtime
+    # allows.
+    settle_lock = threading.Lock()
+
+    def _settle():
+        with settle_lock:
+            init_settled.set()
+
     if init_timeout > 0:
         def _init_watchdog():
-            # wait() (not sleep) + a 5 s grace re-check close the race
-            # where init completes right at the deadline: _exit on a
-            # process holding a live claim would wedge the device.
+            # wait() (not sleep) + a 5 s grace re-check narrow the race
+            # where init completes right at the deadline; the lock below
+            # closes it.
             if init_settled.wait(init_timeout):
                 return
             if init_settled.wait(5.0):
                 return
             emit({"error": f"device init exceeded {init_timeout:.0f}s "
                            "(outage or wedged claim)"})
-            if init_settled.is_set():
-                # Init completed during the emit itself: a verdict file
-                # now wrongly claims failure, but exiting would be worse
-                # (_exit on a live claim wedges the device) — let the
-                # main thread overwrite the verdict with the real one.
-                return
-            os._exit(3)
+            with settle_lock:
+                if init_settled.is_set():
+                    # Init completed during the emit: a verdict file now
+                    # wrongly claims failure, but exiting would be worse
+                    # (_exit on a live claim wedges the device) — let the
+                    # main thread overwrite the verdict with the real one.
+                    return
+                os._exit(3)
 
         threading.Thread(target=_init_watchdog, daemon=True).start()
 
@@ -172,10 +199,10 @@ def tpu_child(result_path: str) -> int:
     try:
         devices = jax.devices()
     except RuntimeError as e:
-        init_settled.set()
+        _settle()
         emit({"error": f"device init failed: {e}"})
         return 1
-    init_settled.set()
+    _settle()
     init_s = time.perf_counter() - t0
     platform = devices[0].platform
     log(f"child: devices={devices} init={init_s:.1f}s")
@@ -216,18 +243,45 @@ def tpu_child(result_path: str) -> int:
     compile_s = aotcache.stats["compiled_s"]
     log(f"warmup {warmup_s:.2f}s (aot: {aotcache.stats})")
 
-    # Reps alternate raw / 6-bit-packed uploads; best-of-N then picks the
-    # winning transport empirically for this moment's tunnel bandwidth.
+    # Transport selection: probe each of raw / 6-bit-packed uploads ONCE,
+    # then commit every remaining rep to the winner (VERDICT r3 weakness
+    # #1: alternating every other rep burned half the reps on a known
+    # loser — pack6 measured ~3x slower than raw whenever the tunnel was
+    # healthy).  Min-of-N still reports the machine's capability; the
+    # median is reported alongside so congestion variance stays visible.
     reps = max(1, int(os.environ.get("DSI_BENCH_REPS", "5")))
+    times_by_mode: dict = {False: [], True: []}
+
+    def pack6_winning() -> bool:
+        t = min(times_by_mode[True], default=1e18)
+        f = min(times_by_mode[False], default=1e18)
+        return t < f
+
+    rep_times = []
     dt, best_phases = None, {}
     for rep in range(reps):
+        if reps >= 2 and rep == 0:
+            pack6 = False
+        elif reps >= 2 and rep == 1:
+            pack6 = True
+        elif rep == 2 and reps > 3 and pack6_winning():
+            # Upset guard: raw is the healthy-tunnel favourite (pack6
+            # measured ~3x slower whenever the link was clean), so a
+            # pack6 probe win usually means raw's single probe landed on
+            # a congestion spike — spend exactly one rep re-probing raw
+            # before committing the rest.
+            pack6 = False
+        else:
+            pack6 = pack6_winning()
         t_all = time.perf_counter()
-        res, phases = run_once(pack6=rep % 2 == 1)
+        res, phases = run_once(pack6=pack6)
         rep_s = time.perf_counter() - t_all
         log(f"rep {rep + 1}/{reps}: {rep_s:.3f}s {phases}")
         if res is None:
             emit({"error": "kernel fell back mid-run", "permanent": True})
             return 1
+        times_by_mode[pack6].append(rep_s)
+        rep_times.append(rep_s)
         if dt is None or rep_s < dt:
             dt, best_phases = rep_s, phases
 
@@ -250,23 +304,131 @@ def tpu_child(result_path: str) -> int:
                     f" tpu={len(tpu_lines)} oracle={len(oracle_lines)})")
                 break
 
+    import statistics
+
     total_mb = sum(os.path.getsize(p) for p in files) / 1e6
+    median_s = statistics.median(rep_times)
     phases = {"init_s": round(init_s, 1),
               "compile_s": round(compile_s, 3),
               "warmup_s": round(warmup_s, 3),
               "aot_loads": aotcache.stats["loads"],
-              "reps": reps}
+              "reps": reps,
+              "median_s": round(median_s, 3)}
     phases.update(best_phases)
-    emit({"tpu_s": round(dt, 3), "tpu_mbps": round(total_mb / dt, 2),
-          "parity": parity, "platform": platform, "phases": phases})
+    result = {"tpu_s": round(dt, 3), "tpu_mbps": round(total_mb / dt, 2),
+              "median_mbps": round(total_mb / median_s, 2),
+              "parity": parity, "platform": platform, "phases": phases}
+    # The headline verdict is complete and durable from here on: emit it
+    # BEFORE the stream row so a parent timeout mid-stream still finds a
+    # valid result file (emit is atomic; last write wins).
+    emit(result)
+    stream_mb = stream_row_mb()
+    if parity and stream_mb > 0:
+        # Provisional marker first: if the stream row is interrupted (the
+        # parent watchdog SIGTERMs a slow stream) or raises, the surviving
+        # verdict still explains the missing row instead of silently
+        # omitting it (the XOR contract test_bench_contract.py locks in).
+        result["stream_skipped"] = ("stream row started but did not "
+                                    "complete (interrupted?)")
+        emit(result)
+        try:
+            stream = run_stream_row(files, compile_s, stream_mb)
+        except Exception as e:  # never trade the headline for the row
+            stream = {"stream_skipped":
+                      f"stream row failed: {type(e).__name__}: {e}"}
+        result.pop("stream_skipped", None)
+        result.update(stream)
+        emit(result)
     return 0
 
 
-def run_tpu_watchdogged() -> dict:
-    """Run the TPU half in a subprocess with per-attempt timeouts and a
-    global deadline; return its result dict or {"error": ...}."""
-    # Malformed env knobs must not break the always-emit-a-verdict
-    # contract: fall back to defaults rather than raising past main().
+def stream_row_mb() -> float:
+    try:
+        return float(os.environ.get("DSI_BENCH_STREAM_MB", "64"))
+    except ValueError:
+        log("ignoring malformed DSI_BENCH_STREAM_MB")
+        return 64.0
+
+
+def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
+    """Measure the bounded-memory streaming path (VERDICT r3 task 8: the
+    headline number alone is the 16.7 MB fused-program special case) by
+    cycling the bench corpus ``stream_mb`` worth through
+    ``wordcount_streaming`` on the process's device mesh, with exact-count
+    parity against the oracle file scaled by the cycle count.
+
+    Always returns either a measured row or a ``stream_skipped`` reason —
+    a missing row in the verdict is a contract violation.  A parity
+    mismatch suppresses the throughput number (a rate for wrong counts
+    must never enter a trend) and ships as a skip reason instead.
+    Cold-process guard: if the corpus phase had to compile (no warm AOT
+    cache), the stream row would add its own remote compiles to an
+    already-slow attempt and risk the parent watchdog's budget — skip and
+    say so; the warm loop (scripts/warm_kernels.py) pre-compiles the
+    stream programs precisely so the driver's run takes this path warm.
+    """
+    if corpus_compile_s > 60:
+        return {"stream_skipped":
+                f"cold process (corpus compile {corpus_compile_s:.0f}s); "
+                "stream row runs only against a warm AOT cache"}
+
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.streaming import stream_files, wordcount_streaming
+    from dsi_tpu.utils.tracing import Span
+
+    corpus_bytes = sum(os.path.getsize(p) for p in files)
+    cycles = max(1, round(stream_mb * 1e6 / corpus_bytes))
+
+    def blocks():
+        for c in range(cycles):
+            if c:
+                yield b"\n"
+            yield from stream_files(files)
+
+    mesh = default_mesh()
+    with Span("bench.stream") as pt:
+        acc = wordcount_streaming(blocks(), mesh=mesh, n_reduce=N_REDUCE,
+                                  chunk_bytes=1 << 20, u_cap=1 << 14,
+                                  aot=True)
+    dt = pt.elapsed_s
+    if acc is None:
+        return {"stream_skipped": "stream needed the host path "
+                                  "(non-ASCII or >64-byte word)"}
+
+    oracle: dict = {}
+    with open(ORACLE_OUT, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                w, _, c = line.rstrip("\n").rpartition(" ")
+                oracle[w] = int(c)
+    parity = (len(acc) == len(oracle)
+              and all(acc.get(w, (0, 0))[0] == c * cycles
+                      for w, c in oracle.items()))
+    mb = corpus_bytes * cycles / 1e6
+    log(f"stream row: {mb:.1f} MB in {dt:.2f}s = {mb / dt:.2f} MB/s "
+        f"(cycles={cycles}, parity={parity})")
+    if not parity:
+        return {"stream_skipped": f"parity mismatch over {mb:.1f} MB "
+                                  f"(throughput suppressed)",
+                "stream_parity": False}
+    return {"stream_mbps": round(mb / dt, 2), "stream_mb": round(mb, 1),
+            "stream_s": round(dt, 2), "stream_parity": True}
+
+
+def global_budget_s() -> float:
+    """The TPU half's wall budget (DSI_BENCH_DEADLINE_S); malformed env
+    must not break the always-emit-a-verdict contract."""
+    try:
+        return float(os.environ.get("DSI_BENCH_DEADLINE_S", "2100"))
+    except ValueError:
+        log("ignoring malformed DSI_BENCH_DEADLINE_S")
+        return 2100.0
+
+
+def run_tpu_watchdogged(deadline: float) -> dict:
+    """Run the TPU half in a subprocess with per-attempt timeouts, bounded
+    by the caller's monotonic ``deadline``; return its result dict or
+    {"error": ...}."""
     try:
         timeouts = [
             float(x) for x in os.environ.get(
@@ -274,12 +436,6 @@ def run_tpu_watchdogged() -> dict:
     except ValueError:
         log("ignoring malformed DSI_BENCH_TPU_TIMEOUTS")
         timeouts = [1200.0, 420.0, 240.0]
-    try:
-        budget_s = float(os.environ.get("DSI_BENCH_DEADLINE_S", "2100"))
-    except ValueError:
-        log("ignoring malformed DSI_BENCH_DEADLINE_S")
-        budget_s = 2100.0
-    deadline = time.monotonic() + budget_s
     result_path = os.path.join(WORKDIR, "tpu-result.json")
     last_err = "no attempt ran"
     for attempt, budget in enumerate(timeouts, 1):
@@ -382,12 +538,19 @@ def run_tpu_watchdogged() -> dict:
     return {"error": last_err}
 
 
-def run_cpu_fallback() -> dict:
+def run_cpu_fallback(deadline: float) -> dict:
     """When every TPU attempt fails (device outage), measure the SAME fused
     pipeline on the CPU backend — one bounded child with the platform
     pinned.  An explicitly-labeled cpu number with the tpu error attached
     is strictly more informative than a bare zero: it separates 'the
-    framework is broken' from 'the tunnel is down'."""
+    framework is broken' from 'the tunnel is down'.
+
+    The wait is bounded by the caller's remaining global budget (with a
+    60 s floor so an exhausted-deadline fallback can still measure a small
+    corpus), capped at the old fixed 900 s — ADVICE r3: an unconditional
+    900 s here pushed worst-case wall time past the outer timeout
+    onchip_evidence.sh wraps around bench.py, SIGKILLing bench before it
+    printed any JSON line."""
     result_path = os.path.join(WORKDIR, "cpu-result.json")
     try:
         os.remove(result_path)
@@ -395,12 +558,14 @@ def run_cpu_fallback() -> dict:
         pass
     env = dict(os.environ)
     env["DSI_JAX_PLATFORM"] = "cpu"
-    log("tpu unavailable; measuring the same pipeline on the cpu backend")
+    budget = min(900.0, max(60.0, deadline - time.monotonic()))
+    log(f"tpu unavailable; measuring the same pipeline on the cpu backend "
+        f"(budget {budget:.0f}s)")
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--tpu-child",
          result_path], stdout=sys.stderr, env=env)
     try:
-        proc.wait(timeout=900.0)
+        proc.wait(timeout=budget)
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.wait()
@@ -446,19 +611,17 @@ def main() -> None:
     log(f"oracle (mrsequential semantics): {oracle_s:.2f}s = "
         f"{oracle_mbps:.2f} MB/s")
 
-    res = run_tpu_watchdogged()
+    budget_s = global_budget_s()
+    deadline = time.monotonic() + budget_s
+    res = run_tpu_watchdogged(deadline)
     tpu_error = None
     if "error" in res and not res.get("permanent"):
         tpu_error = res["error"]
         # Honor the deadline knob here too: under 60 s is the documented
         # "disable the accelerator half" mode and must stay fast — the
         # fallback child would add minutes past the caller's budget.
-        try:
-            fb_budget = float(os.environ.get("DSI_BENCH_DEADLINE_S", "2100"))
-        except ValueError:
-            fb_budget = 2100.0
-        if fb_budget >= 60:
-            res = run_cpu_fallback()
+        if budget_s >= 60:
+            res = run_cpu_fallback(deadline)
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,
@@ -493,6 +656,14 @@ def main() -> None:
         "oracle_mbps": round(oracle_mbps, 2),
         "phases": res["phases"],
     }
+    # Honesty extras (VERDICT r3 task 8): the median alongside the min,
+    # and the streaming-path row (or why it was skipped).
+    if "median_mbps" in res:
+        out["median_mbps"] = res["median_mbps"]
+    for k in ("stream_mbps", "stream_mb", "stream_s", "stream_parity",
+              "stream_skipped"):
+        if k in res:
+            out[k] = res[k]
     if tpu_error:
         # The number above was measured on the CPU FALLBACK backend: the
         # TPU half failed (tunnel outage etc.) and this run proves the

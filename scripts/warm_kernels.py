@@ -9,7 +9,10 @@ runs touch, in ONE process, so harness workers only ever load serialized
 executables:
 
 * ``count_words_host_result`` at the harness split size (tpu_wc map task),
-* ``grep_host_result`` at the same chunk shape (tpu_grep map task).
+* ``grep_host_result`` at the same chunk shape (tpu_grep map task),
+* the streaming step/pack programs bench.py's stream row executes
+  (``parallel/streaming.py warm_stream_aot`` — shapes compiled from
+  structs alone, nothing executed).
 
 Run it once per machine after the corpus_wc warmer; rerun after any kernel
 edit (the cache fingerprints kernel sources and would recompile anyway).
@@ -59,6 +62,18 @@ def main() -> int:
     assert lines is not None
     print(f"grep kernel: {time.perf_counter() - t0:.1f}s "
           f"{len(lines)} matching lines", flush=True)
+
+    # Stream-row programs: bench.py runs wordcount_streaming(aot=True,
+    # chunk_bytes=1<<20, u_cap=1<<14) on the single real device; warm the
+    # start rung plus one x4 widening (the bench corpus's per-chunk
+    # vocabulary can cross 16384).
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.streaming import warm_stream_aot
+
+    t0 = time.perf_counter()
+    warm_stream_aot(mesh=default_mesh(), chunk_bytes=1 << 20,
+                    caps=(1 << 14, 1 << 16))
+    print(f"stream programs: {time.perf_counter() - t0:.1f}s", flush=True)
 
     print(f"aot stats: {aotcache.stats}", flush=True)
     return 0

@@ -63,7 +63,8 @@ def test_disabled_accelerator_half_emits_error_verdict(tmp_path):
 @pytest.mark.slow
 def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
     rc, v = run_bench(tmp_path, {"DSI_BENCH_TPU_TIMEOUTS": "0",
-                                 "DSI_BENCH_DEADLINE_S": "600"})
+                                 "DSI_BENCH_DEADLINE_S": "600",
+                                 "DSI_BENCH_STREAM_MB": "2"})
     assert rc == 0
     assert v["metric"] == "wc_cpu_fallback_throughput"
     assert v["platform"] == "cpu"
@@ -74,3 +75,20 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
     # rounding error scaled by the ratio, so compare relatively.
     assert v["vs_baseline"] == pytest.approx(
         v["value"] / v["oracle_mbps"], rel=0.02)
+    # Honesty extras ride the same verdict line: the median, and either a
+    # measured streaming row (with its own parity gate) or an explicit
+    # skip reason — a silently-absent row is a contract violation.
+    assert v["median_mbps"] > 0
+    assert ("stream_skipped" in v) != ("stream_mbps" in v)
+    if "stream_mbps" in v:
+        assert v["stream_parity"] is True
+        assert v["stream_mb"] >= 2
+
+
+@pytest.mark.slow
+def test_stream_row_disabled_leaves_no_stream_keys(tmp_path):
+    rc, v = run_bench(tmp_path, {"DSI_BENCH_TPU_TIMEOUTS": "0",
+                                 "DSI_BENCH_DEADLINE_S": "600",
+                                 "DSI_BENCH_STREAM_MB": "0"})
+    assert rc == 0
+    assert not any(k.startswith("stream_") for k in v)
