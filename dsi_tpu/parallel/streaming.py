@@ -218,7 +218,8 @@ def wordcount_streaming(
         blocks: Iterable[bytes], mesh: Mesh | None = None,
         n_reduce: int = 10, chunk_bytes: int = 1 << 20,
         max_word_len: int = 16, u_cap: int = 1 << 12,
-        aot: bool = False) -> Optional[Dict[str, Tuple[int, int]]]:
+        aot: bool = False,
+        on_attempt=None) -> Optional[Dict[str, Tuple[int, int]]]:
     """Exact whole-stream word counts with bounded memory.
 
     Returns ``{word: (count, reduce_partition)}``, or None when the stream
@@ -227,6 +228,10 @@ def wordcount_streaming(
     step whose uniques overflow retries itself at a wider capacity without
     disturbing the accumulator (rows are merged only after a step
     succeeds), and the widened capacity sticks for later steps.
+
+    ``on_attempt(max_word_len, u_cap)``, if given, is called before every
+    kernel attempt — observability for the retry ladder (the driver's
+    dryrun uses it to evidence that a capacity retry actually ran).
 
     ``aot=True`` routes both step and pack programs through the persistent
     AOT executable cache and pulls FULL-capacity packed tables (one
@@ -247,6 +252,8 @@ def wordcount_streaming(
 
         def run(mwl: int, cap: int):
             state["cap"] = cap  # last attempt = the one that succeeded
+            if on_attempt is not None:
+                on_attempt(mwl, cap)
             for frac in (4, 2):
                 keys, lens, cnts, parts, scal = step_fn(
                     chunks, n_dev=n_dev, n_reduce=n_reduce,
