@@ -89,6 +89,16 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def env_float(name: str, default: float) -> float:
+    """Float env knob with the always-emit-a-verdict discipline: malformed
+    values fall back to the default (logged) instead of raising."""
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        log(f"ignoring malformed {name}")
+        return default
+
+
 def run_oracle(files) -> tuple[float, float]:
     """Sequential oracle (mrsequential.go:38-86 semantics); pure host CPU."""
     from dsi_tpu.apps import wc
@@ -149,12 +159,7 @@ def tpu_child(result_path: str) -> int:
     # (When run under the full bench, the parent watchdog's init deadline
     # is the backstop; set this BELOW it — onchip_evidence.sh uses 150 <
     # the parent's 180 — so the clean child verdict wins the race.)
-    try:
-        init_timeout = float(
-            os.environ.get("DSI_CHILD_INIT_TIMEOUT", "0") or 0)
-    except ValueError:
-        log("ignoring malformed DSI_CHILD_INIT_TIMEOUT")
-        init_timeout = 0.0
+    init_timeout = env_float("DSI_CHILD_INIT_TIMEOUT", 0.0)
     import threading
 
     init_settled = threading.Event()  # set once jax.devices() returns/raises
@@ -320,17 +325,16 @@ def tpu_child(result_path: str) -> int:
               "parity": parity, "platform": platform, "phases": phases}
     # The headline verdict is complete and durable from here on: emit it
     # BEFORE the stream row so a parent timeout mid-stream still finds a
-    # valid result file (emit is atomic; last write wins).
-    emit(result)
+    # valid result file (emit is atomic; last write wins).  The
+    # provisional marker rides the SAME first emit — a two-emit sequence
+    # would leave a SIGTERM window producing a verdict with no stream key
+    # at all, violating the XOR contract test_bench_contract.py locks in.
     stream_mb = stream_row_mb()
     if parity and stream_mb > 0:
-        # Provisional marker first: if the stream row is interrupted (the
-        # parent watchdog SIGTERMs a slow stream) or raises, the surviving
-        # verdict still explains the missing row instead of silently
-        # omitting it (the XOR contract test_bench_contract.py locks in).
         result["stream_skipped"] = ("stream row started but did not "
                                     "complete (interrupted?)")
-        emit(result)
+    emit(result)
+    if parity and stream_mb > 0:
         try:
             stream = run_stream_row(files, compile_s, stream_mb)
         except Exception as e:  # never trade the headline for the row
@@ -343,11 +347,7 @@ def tpu_child(result_path: str) -> int:
 
 
 def stream_row_mb() -> float:
-    try:
-        return float(os.environ.get("DSI_BENCH_STREAM_MB", "64"))
-    except ValueError:
-        log("ignoring malformed DSI_BENCH_STREAM_MB")
-        return 64.0
+    return env_float("DSI_BENCH_STREAM_MB", 64.0)
 
 
 def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
@@ -416,13 +416,8 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
 
 
 def global_budget_s() -> float:
-    """The TPU half's wall budget (DSI_BENCH_DEADLINE_S); malformed env
-    must not break the always-emit-a-verdict contract."""
-    try:
-        return float(os.environ.get("DSI_BENCH_DEADLINE_S", "2100"))
-    except ValueError:
-        log("ignoring malformed DSI_BENCH_DEADLINE_S")
-        return 2100.0
+    """The TPU half's wall budget (DSI_BENCH_DEADLINE_S)."""
+    return env_float("DSI_BENCH_DEADLINE_S", 2100.0)
 
 
 def run_tpu_watchdogged(deadline: float) -> dict:
@@ -458,10 +453,7 @@ def run_tpu_watchdogged(deadline: float) -> dict:
         # the moment jax.devices() returns; no marker within the init budget
         # means the claim is hung and the whole attempt budget would be
         # wasted inside device init.
-        try:
-            init_budget = float(os.environ.get("DSI_BENCH_INIT_TIMEOUT", "180"))
-        except ValueError:
-            init_budget = 180.0
+        init_budget = env_float("DSI_BENCH_INIT_TIMEOUT", 180.0)
         init_deadline = time.monotonic() + min(init_budget, budget)
         attempt_deadline = time.monotonic() + budget
         rc = None

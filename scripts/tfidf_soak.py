@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""TF-IDF GB-scale soak on the virtual mesh: one measured partition slice.
+
+BASELINE.json's last config is TF-IDF over a 10 GB shard on a v5e-64; this
+host has one core and a virtual mesh, so the honest reachable evidence is a
+measured ~1 GB single-slice run (VERDICT r3 task 4): wall, throughput,
+postings volume, and peak RSS, from which the 10 GB config's cost model is
+extrapolated in BASELINE.md (device work repeats per slice; host memory
+divides by the slice count — parallel/tfidf.py module docs).
+
+Verification at this scale: full oracle parity would cost more than the
+run (it is covered byte-for-byte at test scale, tests/test_tfidf.py), so
+the soak checks structural invariants over everything plus exact posting
+parity for the first --verify-docs documents (host recount).
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python scripts/tfidf_soak.py [--mb 1024] [--slice 5]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=1024)
+    ap.add_argument("--doc-kb", type=int, default=1024)
+    ap.add_argument("--slice", type=int, default=5,
+                    help="accumulate the first N of --n-reduce partitions")
+    ap.add_argument("--n-reduce", type=int, default=10)
+    ap.add_argument("--verify-docs", type=int, default=8)
+    ap.add_argument("--devices", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dsi_tpu.mr.worker import ihash
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.tfidf import tfidf_sharded
+    from dsi_tpu.utils.corpus import ensure_corpus
+
+    n_docs = max(1, (args.mb << 10) // args.doc_kb)
+    doc_bytes = args.doc_kb << 10
+    cdir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".bench", f"tfidf-soak-{args.mb}")
+    t0 = time.perf_counter()
+    paths = ensure_corpus(cdir, n_files=n_docs, file_size=doc_bytes)
+    docs = []
+    for p in paths:
+        with open(p, "rb") as f:
+            docs.append(f.read())
+    gen_s = time.perf_counter() - t0
+    total_mb = sum(len(d) for d in docs) / 1e6
+    print(f"corpus: {len(docs)} docs, {total_mb:.0f} MB "
+          f"(gen+read {gen_s:.1f}s)", file=sys.stderr, flush=True)
+
+    mesh = default_mesh(args.devices)
+    partitions = set(range(args.slice)) if args.slice else None
+    t0 = time.perf_counter()
+    res = tfidf_sharded(docs, mesh=mesh, n_reduce=args.n_reduce,
+                        u_cap=1 << 15, partitions=partitions)
+    wall = time.perf_counter() - t0
+    assert res is not None, "tfidf fell back to host"
+
+    # Structural invariants over the whole result.
+    postings = 0
+    for w, (part, pairs) in res.items():
+        assert 1 <= len(pairs) <= len(docs)
+        if partitions is not None:
+            assert part in partitions, (w, part)
+        postings += len(pairs)
+
+    # Exact parity for the first --verify-docs documents: every sampled
+    # doc's (word -> tf) with an in-slice partition must appear verbatim.
+    sample_ok = True
+    for di in range(min(args.verify_docs, len(docs))):
+        counts: dict = {}
+        for w in re.findall(r"[A-Za-z]+", docs[di].decode()):
+            counts[w] = counts.get(w, 0) + 1
+        for w, tf in counts.items():
+            if partitions is not None and ihash(w) % args.n_reduce \
+                    not in partitions:
+                continue
+            ent = res.get(w)  # a missing word is a mismatch, not a crash
+            got = dict(ent[1]).get(di) if ent else None
+            if got != tf:
+                print(f"sample mismatch: doc {di} word {w!r}: {got} != {tf}",
+                      file=sys.stderr, flush=True)
+                sample_ok = False
+
+    rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    print(json.dumps({
+        "tfidf_mb": round(total_mb, 1), "wall_s": round(wall, 1),
+        "mbps": round(total_mb / wall, 2), "n_docs": len(docs),
+        "slice": f"{args.slice}/{args.n_reduce}" if partitions else "full",
+        "uniques": len(res), "postings": postings,
+        "sample_parity": sample_ok, "peak_rss_mb": round(rss_mb, 1)}))
+    return 0 if sample_ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
