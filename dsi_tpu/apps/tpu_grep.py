@@ -2,9 +2,11 @@
 
 Same job and output as ``grep`` (the working realization of the reference's
 ``mrapps/dgrep.go`` intent — see apps/grep.py): Map emits ``{line, ""}`` per
-matching line, Reduce counts occurrences.  When ``DSI_GREP_PATTERN`` is a
-plain ASCII literal, the per-line scan runs as the shifted-compare TPU
-kernel (``ops/grepk.py``); regex patterns fall back to the host Map.
+matching line, Reduce counts occurrences.  Two device tiers: a plain ASCII
+literal ``DSI_GREP_PATTERN`` runs as the shifted-compare kernel
+(``ops/grepk.py``); fixed-length class patterns (``[Tt]he``, ``w.rd``,
+``^\\d\\d`` …) run as the range-compare kernel (``ops/regexk.py``);
+anything wider falls back to the host Map.
 """
 
 from __future__ import annotations
@@ -18,9 +20,12 @@ from dsi_tpu.mr.types import KeyValue
 
 def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
     from dsi_tpu.ops.grepk import grep_host_result
+    from dsi_tpu.ops.regexk import classgrep_host_result
 
     pattern = os.environ.get("DSI_GREP_PATTERN", r"(?!x)x")
     lines = grep_host_result(raw, pattern)
+    if lines is None:
+        lines = classgrep_host_result(raw, pattern)
     if lines is None:
         return None
     return [KeyValue(line, "") for line in lines]

@@ -25,6 +25,46 @@ import dsi_tpu.ops.wordcount as _wordcount_mod
 from dsi_tpu.ops.wordcount import _pad_pow2, _shift_left
 
 
+def line_flags_from_match(chunk: jax.Array, match: jax.Array, l_cap: int):
+    """Per-position match mask -> per-line flags, shared by the literal
+    kernel here and the class-pattern kernel (``ops/regexk.py``): line
+    membership is a cumsum over newline bytes, per-line flags a sorted
+    segment-max.  Returns (line_match [l_cap] i32 in line order,
+    n_lines i32, overflow bool)."""
+    is_nl = chunk == 10
+    cum = jnp.cumsum(is_nl.astype(jnp.int32))
+    line_id = cum - is_nl.astype(jnp.int32)  # newlines strictly before i
+    n_lines = cum[-1] + 1
+    overflow = n_lines > l_cap
+    seg = jnp.minimum(line_id, l_cap)
+    line_match = jax.ops.segment_max(
+        match.astype(jnp.int32), seg, num_segments=l_cap + 1,
+        indices_are_sorted=True)[:l_cap]
+    return line_match, n_lines, overflow
+
+
+def retry_line_caps(n: int, run):
+    """Shared l_cap rung schedule (exactness_retry discipline): average
+    line >= 8 bytes first, then the n+1 hard bound (every byte a '\\n').
+    ``run(l_cap)`` -> (line_match, n_lines, overflow)."""
+    for l_cap in (max(n // 8, 1), n + 1):
+        line_match, n_lines, overflow = run(l_cap)
+        if not bool(overflow):
+            break
+    return line_match, int(n_lines)
+
+
+def lines_from_flags(text: str, line_match, nl: int) -> Optional[List[str]]:
+    """Map device line flags back to text lines; None on a host/device
+    line-count disagreement (the host path decides — correctness never
+    depends on a kernel, ``backends/tpu.py`` contract)."""
+    flags = np.asarray(line_match[:nl])
+    lines = text.split("\n")
+    if len(lines) != nl:
+        return None
+    return [lines[i] for i in range(nl) if flags[i]]
+
+
 def grep_kernel(chunk: jax.Array, pattern: jax.Array, *, l_cap: int):
     """Match lines of ``chunk`` containing the literal ``pattern``.
 
@@ -37,16 +77,7 @@ def grep_kernel(chunk: jax.Array, pattern: jax.Array, *, l_cap: int):
     match = jnp.ones(chunk.shape[0], jnp.bool_)
     for j in range(m):  # static unroll over the (short) pattern
         match &= _shift_left(chunk, j) == pattern[j]
-    is_nl = chunk == 10
-    cum = jnp.cumsum(is_nl.astype(jnp.int32))
-    line_id = cum - is_nl.astype(jnp.int32)  # newlines strictly before i
-    n_lines = cum[-1] + 1
-    overflow = n_lines > l_cap
-    seg = jnp.minimum(line_id, l_cap)
-    line_match = jax.ops.segment_max(
-        match.astype(jnp.int32), seg, num_segments=l_cap + 1,
-        indices_are_sorted=True)[:l_cap]
-    return line_match, n_lines, overflow
+    return line_flags_from_match(chunk, match, l_cap)
 
 
 # The AOT cache fingerprints these sources: grep_kernel uses wordcount
@@ -100,16 +131,6 @@ def grep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
     chunk = jnp.asarray(_pad_pow2(data))
     pat = jnp.asarray(np.frombuffer(pattern.encode("ascii"), dtype=np.uint8))
     n = int(chunk.shape[0])
-    for l_cap in (max(n // 8, 1), n + 1):  # n+1 lines when every byte is \n
-        line_match, n_lines, overflow = _grep_jit(chunk, pat, l_cap=l_cap)
-        if not bool(overflow):
-            break
-    nl = int(n_lines)
-    flags = np.asarray(line_match[:nl])
-    lines = text.split("\n")
-    if len(lines) != nl:
-        # Host/device line-count disagreement: route the task to the host
-        # regex path instead of crashing it mid-job — correctness never
-        # depends on the kernel (backends/tpu.py contract).
-        return None
-    return [lines[i] for i in range(nl) if flags[i]]
+    line_match, nl = retry_line_caps(
+        n, lambda l_cap: _grep_jit(chunk, pat, l_cap=l_cap))
+    return lines_from_flags(text, line_match, nl)

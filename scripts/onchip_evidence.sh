@@ -48,10 +48,17 @@ log "harness tpu_wc --backend tpu (on-chip)"
   > "$OUT/harness_tpu_wc.log" 2>&1
 log "tpu_wc rc=$? $(tail -c 120 "$OUT/harness_tpu_wc.log" | tr '\n' ' ')"
 
-log "harness tpu_grep --backend tpu (on-chip)"
+log "harness tpu_grep --backend tpu (on-chip, class pattern [Tt]he)"
 { time bash scripts/test_mr.sh tpu_grep tpu ; } \
   > "$OUT/harness_tpu_grep.log" 2>&1
 log "tpu_grep rc=$? $(tail -c 120 "$OUT/harness_tpu_grep.log" | tr '\n' ' ')"
+
+log "harness tpu_grep --backend tpu (on-chip, literal tier)"
+# The class pattern above runs ops/regexk.py; this literal run keeps the
+# tier-1 shifted-compare kernel (ops/grepk.py) covered by the harness too.
+{ time DSI_GREP_PATTERN=the bash scripts/test_mr.sh tpu_grep tpu ; } \
+  > "$OUT/harness_tpu_grep_literal.log" 2>&1
+log "tpu_grep literal rc=$? $(tail -c 120 "$OUT/harness_tpu_grep_literal.log" | tr '\n' ' ')"
 
 log "harness tpu_indexer --backend tpu (on-chip)"
 { time bash scripts/test_mr.sh tpu_indexer tpu ; } \

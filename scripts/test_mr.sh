@@ -34,7 +34,9 @@ case "$APP" in
   tpu_wc) ORACLE_APP=wc ;;          # byte-identical final output to wc
   tpu_indexer) ORACLE_APP=indexer ;;
   tpu_grep) ORACLE_APP=grep
-            export DSI_GREP_PATTERN=${DSI_GREP_PATTERN:-the} ;;  # literal
+            # The reference harness's own pattern (test-mr.sh:47): runs on
+            # device via the class kernel (ops/regexk.py).
+            export DSI_GREP_PATTERN=${DSI_GREP_PATTERN:-[Tt]he} ;;
 esac
 WORKER_ARGS=(--backend "$BACKEND")
 EXTRA_COORD_ARGS=()

@@ -63,6 +63,16 @@ def main() -> int:
     print(f"grep kernel: {time.perf_counter() - t0:.1f}s "
           f"{len(lines)} matching lines", flush=True)
 
+    # Class-pattern grep kernel at the same shape — the tpu_grep harness
+    # default pattern ([Tt]he, ops/regexk.py).
+    from dsi_tpu.ops.regexk import classgrep_host_result
+
+    t0 = time.perf_counter()
+    clines = classgrep_host_result(raw, "[Tt]he")
+    assert clines is not None
+    print(f"classgrep kernel: {time.perf_counter() - t0:.1f}s "
+          f"{len(clines)} matching lines", flush=True)
+
     # Stream-row programs: bench.py runs wordcount_streaming(aot=True,
     # chunk_bytes=1<<20, u_cap=1<<14) on the single real device; warm the
     # start rung plus one x4 widening (the bench corpus's per-chunk

@@ -51,9 +51,18 @@ def test_pattern_longer_than_data():
     assert grep_host_result(b"tiny", "a" * 300) == []
 
 
-def test_regex_falls_back():
+def test_regex_routing_tiers():
+    # Class patterns leave the literal kernel (tier 1) but are now served
+    # on device by the class kernel (tier 2, ops/regexk.py)...
     assert grep_host_result(TEXT, "[Tt]he") is None
     os.environ["DSI_GREP_PATTERN"] = "[Tt]he"
+    try:
+        kva = tpu_grep.tpu_map("f", TEXT)
+        assert kva is not None and all("he" in kv.key for kv in kva)
+    finally:
+        del os.environ["DSI_GREP_PATTERN"]
+    # ...while variable-length regex still routes to the host app.
+    os.environ["DSI_GREP_PATTERN"] = "th+e"
     try:
         assert tpu_grep.tpu_map("f", TEXT) is None  # router: host handles it
     finally:
@@ -76,6 +85,8 @@ def test_line_count_mismatch_falls_back(monkeypatch):
     # path), not crash the worker task mid-job (VERDICT r2 weakness #5).
     import dsi_tpu.ops.grepk as grepk
 
+    import dsi_tpu.ops.regexk as regexk
+
     real = grepk._grep_jit
 
     def skewed(chunk, pat, *, l_cap):
@@ -84,6 +95,23 @@ def test_line_count_mismatch_falls_back(monkeypatch):
 
     monkeypatch.setattr(grepk, "_grep_jit", skewed)
     assert grep_host_result(TEXT, "fox") is None
+
+    # A literal is also a valid class pattern, so tier 2 (regexk) would
+    # otherwise serve the task; skew its line counts the same way to
+    # assert the FULL device->host fallback chain.
+    real_c = regexk._classgrep_compiled
+
+    def skewed_c(n, ranges, a_start, a_end, l_cap):
+        fn = real_c(n, ranges, a_start, a_end, l_cap)
+
+        def wrap(chunk):
+            line_match, n_lines, overflow = fn(chunk)
+            return line_match, n_lines + 1, overflow
+
+        return wrap
+
+    monkeypatch.setattr(regexk, "_classgrep_compiled", skewed_c)
+    assert regexk.classgrep_host_result(TEXT, "fox") is None
 
     # ...and the app-level router then serves the task via the host Map.
     monkeypatch.setenv("DSI_GREP_PATTERN", "fox")
