@@ -12,7 +12,8 @@ never depends on the device kernel.
 
 Usage:
     python -m dsi_tpu.cli.wcstream [--nreduce N] [--chunk-bytes B]
-        [--devices D] [--workdir DIR] inputfiles...
+        [--devices D] [--workdir DIR] [--check] [--aot] [--u-cap U]
+        inputfiles...
 """
 
 from __future__ import annotations
@@ -22,11 +23,21 @@ import os
 import sys
 
 
+def _positive_int(s: str) -> int:
+    """argparse type: capacities/sizes must be >= 1 (a 0 capacity could
+    never widen in the exactness_retry ladder — cap*4 stays 0 — and a
+    negative one breaks kernel shape construction)."""
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("files", nargs="+")
-    p.add_argument("--nreduce", type=int, default=10)
-    p.add_argument("--chunk-bytes", type=int, default=1 << 20,
+    p.add_argument("--nreduce", type=_positive_int, default=10)
+    p.add_argument("--chunk-bytes", type=_positive_int, default=1 << 20,
                    help="per-device bytes per stream step")
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size (default: all local devices)")
@@ -34,6 +45,14 @@ def main(argv=None) -> int:
     p.add_argument("--check", action="store_true",
                    help="run the sequential oracle and verify parity "
                         "(sort mr-out-* | grep . vs oracle, test-mr.sh:52-53)")
+    p.add_argument("--aot", action="store_true",
+                   help="route the stream's programs through the "
+                        "persistent AOT executable cache (single-device "
+                        "axon runs: load serialized executables instead "
+                        "of paying a fresh-process remote compile)")
+    p.add_argument("--u-cap", type=_positive_int, default=1 << 12,
+                   help="starting per-device unique capacity (sticky; "
+                        "widens on overflow)")
     args = p.parse_args(argv)
 
     from dsi_tpu.utils.platformpin import pin_platform_from_env
@@ -46,7 +65,8 @@ def main(argv=None) -> int:
     mesh = default_mesh(args.devices)
     acc = wordcount_streaming(stream_files(args.files), mesh=mesh,
                               n_reduce=args.nreduce,
-                              chunk_bytes=args.chunk_bytes)
+                              chunk_bytes=args.chunk_bytes,
+                              u_cap=args.u_cap, aot=args.aot)
     if acc is None:
         # Host fallback: the sequential oracle semantics, partitioned output.
         print("wcstream: stream needs the host path; running host word count",

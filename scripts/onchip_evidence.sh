@@ -65,7 +65,7 @@ log "harness tpu_indexer --backend tpu (on-chip)"
   > "$OUT/harness_tpu_indexer.log" 2>&1
 log "tpu_indexer rc=$? $(tail -c 120 "$OUT/harness_tpu_indexer.log" | tr '\n' ' ')"
 
-log "wcstream --check on the chip (single-device mesh; fresh jit, slow ok)"
+log "wcstream --check on the chip (single-device mesh, AOT-cached programs)"
 # Own corpus under $OUT: regenerating .bench here could desync it from
 # the warm loop's oracle (bench.py owns that workdir and its env knobs).
 python -c "from dsi_tpu.utils.corpus import ensure_corpus; \
@@ -73,7 +73,12 @@ python -c "from dsi_tpu.utils.corpus import ensure_corpus; \
   > "$OUT/corpus.log" 2>&1
 log "corpus rc=$?"
 mkdir -p "$OUT/wcstream-wd"
+# --u-cap 16384 + --aot MUST stay in lockstep with the rungs
+# scripts/warm_kernels.py pre-compiles (warm_stream_aot caps=(1<<14,1<<16)):
+# a drifting shape here misses the persistent cache and pays the 900s+
+# cold axon compile inside this timeout — the exact hazard --aot avoids.
 timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
+  --aot --u-cap 16384 \
   --workdir "$OUT/wcstream-wd" "$OUT"/corpus/pg-*.txt \
   > "$OUT/wcstream.log" 2>&1
 log "wcstream rc=$? $(tail -c 160 "$OUT/wcstream.log" | tr '\n' ' ')"

@@ -74,9 +74,10 @@ def main() -> int:
           f"{len(clines)} matching lines", flush=True)
 
     # Stream-row programs: bench.py runs wordcount_streaming(aot=True,
-    # chunk_bytes=1<<20, u_cap=1<<14) on the single real device; warm the
-    # start rung plus one x4 widening (the bench corpus's per-chunk
-    # vocabulary can cross 16384).
+    # chunk_bytes=1<<20, u_cap=1<<14) on the single real device, and
+    # onchip_evidence.sh's wcstream step pins --u-cap 16384 to the same
+    # rungs — keep caps here in lockstep with BOTH.  Warm the start rung
+    # plus one x4 widening (per-chunk vocabulary can cross 16384).
     from dsi_tpu.parallel.shuffle import default_mesh
     from dsi_tpu.parallel.streaming import warm_stream_aot
 
