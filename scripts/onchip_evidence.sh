@@ -7,11 +7,15 @@
 #      (compile_s < 5, aot_loads >= 2) and records the north-star number
 #      plus the streaming row (stream_mbps).
 #   2. bench run B — repeatability / second sample of the tunnel.
-#   3. scripts/test_mr.sh tpu_wc tpu — the full coordinator/worker/RPC
+#   3. scripts/probe_tunnel.py — the wire-ceiling measurement that turns
+#      a below-north-star bench into 'machine limit reached' evidence.
+#   4. scripts/test_mr.sh tpu_wc tpu — the full coordinator/worker/RPC
 #      framework path on the real chip (VERDICT r2 task 3).
-#   4. scripts/test_mr.sh tpu_grep tpu — second app family on-chip.
-#   5. scripts/test_mr.sh tpu_indexer tpu — third app family on-chip.
-#   6. wcstream --check — the bounded-memory streaming CLI on the chip.
+#   5. scripts/test_mr.sh tpu_grep tpu — class-pattern tier on-chip, then
+#      a literal-tier run (both device grep kernels covered).
+#   6. scripts/test_mr.sh tpu_indexer tpu — third app family on-chip.
+#   7. wcstream --check --aot — the bounded-memory streaming CLI on the
+#      chip, loading the warmed executables.
 #
 # Everything logs under $OUT; nothing else may touch the chip while this
 # runs (single-tenant tunnel).
@@ -30,8 +34,10 @@ log() { echo "$(date -u +%H:%M:%S) $*" >> "$OUT/log"; }
 # This script exists to measure the CHIP: a stale ambient platform pin
 # (e.g. JAX_PLATFORMS=cpu left over from a soak run) would silently turn
 # every step below into a host run with green-looking logs.
-log "ambient pins before unset: JAX_PLATFORMS='${JAX_PLATFORMS:-}' DSI_JAX_PLATFORM='${DSI_JAX_PLATFORM:-}'"
-unset JAX_PLATFORMS DSI_JAX_PLATFORM
+log "ambient pins before unset: JAX_PLATFORMS='${JAX_PLATFORMS:-}' DSI_JAX_PLATFORM='${DSI_JAX_PLATFORM:-}' DSI_GREP_PATTERN='${DSI_GREP_PATTERN:-}'"
+# DSI_GREP_PATTERN leak would silently demote the class-pattern grep run
+# to the literal kernel, leaving regexk.py with zero on-chip coverage.
+unset JAX_PLATFORMS DSI_JAX_PLATFORM DSI_GREP_PATTERN
 
 log "bench run A (fresh process, warm cache)"
 DSI_CHILD_INIT_TIMEOUT=150 timeout -k 30s 2700s \
@@ -42,6 +48,15 @@ log "bench run B"
 DSI_CHILD_INIT_TIMEOUT=150 timeout -k 30s 2700s \
   python bench.py > "$OUT/benchB.json" 2> "$OUT/benchB.err"
 log "benchB rc=$? $(cat "$OUT/benchB.json" 2>/dev/null | head -c 200)"
+
+log "tunnel wire-ceiling probe (H2D/D2H bandwidth + latency)"
+# VERDICT r3 task 1: if the tunnel physically caps below the ~30 MB/s
+# north star, the verdict must be 'machine limit reached' with the
+# measured ceiling — record it right after the benches, alone on the
+# single-tenant chip like every other step here.
+timeout -k 30s 900s python scripts/probe_tunnel.py --mb 8 \
+  > "$OUT/probe_tunnel.log" 2>&1
+log "probe rc=$? $(tail -c 200 "$OUT/probe_tunnel.log" | tr '\n' ' ')"
 
 log "harness tpu_wc --backend tpu (on-chip)"
 { time bash scripts/test_mr.sh tpu_wc tpu ; } \
