@@ -261,7 +261,9 @@ def exactness_retry(run, shard_len: int, max_word_len: int, u_cap: int):
     hard_cap = 1 << (shard_len // 2).bit_length()
     ladder = (max_word_len, 64) if max_word_len < 64 else (max_word_len,)
     for mwl in ladder:
-        cap = min(u_cap, hard_cap)
+        # Floor of 1: a zero/negative starting capacity could never widen
+        # (0 * 4 == 0) and would re-run the same kernel forever.
+        cap = max(1, min(u_cap, hard_cap))
         while True:
             has_high, n_unique_max, max_len, payload = run(mwl, cap)
             if has_high:
@@ -323,7 +325,9 @@ def count_words_many(datas, *, max_word_len: int = 16,
     launches = []
     for data in datas:
         chunk = _pad_pow2(data)
-        cap = min(u_cap, 1 << (len(chunk) // 2).bit_length())
+        # Same floor as exactness_retry: a zero/negative capacity would
+        # build a degenerate (or shape-invalid) kernel.
+        cap = max(1, min(u_cap, 1 << (len(chunk) // 2).bit_length()))
         launches.append((data, cap,
                          run_count_kernel(jnp.asarray(chunk),
                                           max_word_len=max_word_len,

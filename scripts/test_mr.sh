@@ -8,9 +8,12 @@
 # itself plus the unit tests' lock discipline (SURVEY.md §4).
 #
 # Usage: scripts/test_mr.sh [app] [backend]
-#   app: wc (default), grep, indexer, crash, tpu_wc, tpu_indexer
+#   app: wc (default), grep, indexer, tfidf, crash, tpu_wc, tpu_grep,
+#        tpu_indexer
 #   backend: host (default) or tpu (worker runs app device kernels; set
-#            DSI_JAX_PLATFORM=cpu to exercise the kernels without a chip)
+#            DSI_JAX_PLATFORM=cpu to exercise the kernels without a chip).
+#            tfidf has its own tpu_map, so `test_mr.sh tfidf tpu` is the
+#            device run (no separate tpu_tfidf app name).
 
 set -u
 APP=${1:-wc}
@@ -47,6 +50,11 @@ if [ "$APP" = crash ]; then
 fi
 if [ "$APP" = grep ]; then
   export DSI_GREP_PATTERN='[Tt]he'
+fi
+if [ "$APP" = tfidf ]; then
+  # N (total docs) is job-level config a per-key reduce cannot derive
+  # (apps/tfidf.py n_docs_from_env); the harness knows the input count.
+  export DSI_TFIDF_NDOCS=${#INPUTS[@]}
 fi
 
 # ground truth via the sequential oracle (test-mr.sh:30-31)

@@ -97,3 +97,12 @@ def test_count_words_many_pipelined():
     solo = [count_words_host_result(d) for d in datas]
     assert many == solo
     assert many[1] is None and many[0]["alpha"] == (2, many[0]["alpha"][1])
+
+
+def test_zero_capacity_start_terminates():
+    """A u_cap of 0 must widen through the retry ladder (floor of 1), not
+    re-run the same zero-capacity kernel forever — in both entry points."""
+    res = count_words_host_result(b"alpha beta alpha", u_cap=0)
+    assert res is not None and res["alpha"][0] == 2 and res["beta"][0] == 1
+    many = count_words_many([b"alpha beta alpha", b"beta"], u_cap=0)
+    assert [m["beta"][0] for m in many] == [1, 1]
