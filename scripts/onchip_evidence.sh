@@ -14,7 +14,9 @@
 #   5. scripts/test_mr.sh tpu_grep tpu — class-pattern tier on-chip, then
 #      a literal-tier run (both device grep kernels covered).
 #   6. scripts/test_mr.sh tpu_indexer tpu — third app family on-chip.
-#   7. wcstream --check --aot — the bounded-memory streaming CLI on the
+#   7. scripts/test_mr.sh tfidf tpu — fourth app family (in-module
+#      tpu_map; same warmed kernel shape as tpu_wc).
+#   8. wcstream --check --aot — the bounded-memory streaming CLI on the
 #      chip, loading the warmed executables.
 #
 # Everything logs under $OUT; nothing else may touch the chip while this
@@ -79,6 +81,11 @@ log "harness tpu_indexer --backend tpu (on-chip)"
 { time bash scripts/test_mr.sh tpu_indexer tpu ; } \
   > "$OUT/harness_tpu_indexer.log" 2>&1
 log "tpu_indexer rc=$? $(tail -c 120 "$OUT/harness_tpu_indexer.log" | tr '\n' ' ')"
+
+log "harness tfidf --backend tpu (on-chip, 4th app family)"
+{ time bash scripts/test_mr.sh tfidf tpu ; } \
+  > "$OUT/harness_tfidf.log" 2>&1
+log "tfidf rc=$? $(tail -c 120 "$OUT/harness_tfidf.log" | tr '\n' ' ')"
 
 log "wcstream --check on the chip (single-device mesh, AOT-cached programs)"
 # Own corpus under $OUT: regenerating .bench here could desync it from
