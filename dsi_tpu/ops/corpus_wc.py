@@ -50,6 +50,7 @@ from dsi_tpu.ops.wordcount import (
     exactness_retry,
     group_sorted,
     is_ascii_letter,
+    pack_key_lanes,
 )
 
 # pos<<7|len packing needs pos < 2**25: cap the padded corpus at 32 MiB per
@@ -147,14 +148,20 @@ def _corpus_core(chunk, max_word_len: int, u_cap: int, t_cap_frac: int):
         (start_pos.astype(jnp.uint32) << 7)
         | lengths.astype(jnp.uint32), 0)
 
-    # Stable k-key sort: within a group of equal words the original token
-    # order (ascending position) survives, so each group's FIRST row carries
-    # the word's first occurrence position (its length is group-invariant).
-    sorted_ops = lax.sort(packed_cols + (poslen_tok,),
-                          num_keys=k, is_stable=True)
-    _, totals, upos, ovalid, n_unique = group_sorted(
-        sorted_ops[:k], jnp.ones(t_cap, jnp.int32), u_cap)
-    poslen = jnp.where(ovalid, sorted_ops[k][upos], 0)
+    # Stable sort over the key lanes packed pairwise into uint64s (same
+    # lexicographic order, half the comparator keys — wordcount.py
+    # pack_key_lanes; the sort is this kernel's dominant cost): within a
+    # group of equal words the original token order (ascending position)
+    # survives, so each group's FIRST row carries the word's first
+    # occurrence position (its length is group-invariant).
+    with jax.enable_x64(True):  # u64 operands need the scoped flag
+        keys64 = pack_key_lanes(packed_cols)
+        k64 = len(keys64)
+        sorted_ops = lax.sort(keys64 + (poslen_tok,),
+                              num_keys=k64, is_stable=True)
+        _, totals, upos, ovalid, n_unique = group_sorted(
+            sorted_ops[:k64], jnp.ones(t_cap, jnp.int32), u_cap)
+    poslen = jnp.where(ovalid, sorted_ops[k64][upos], 0)
     rows = jnp.stack([poslen, totals.astype(jnp.uint32)], axis=1)
     has_high = jnp.any(chunk >= 128)
     scalars = jnp.stack([

@@ -109,13 +109,61 @@ def fnv1a32_packed(packed: jax.Array, lengths: jax.Array,
     return h
 
 
+def pack_key_lanes(cols: tuple) -> tuple:
+    """Pack uint32 key lanes pairwise into uint64 keys (lane j is the
+    high word, lane j+1 the low), preserving lexicographic order with
+    half the sort operands and comparator keys — measured ~2x faster in
+    XLA's CPU sort, and never slower on TPU (fewer tuple elements per
+    comparator).  A missing odd tail lane is filled with the PAD
+    constant: order-neutral for real rows (a constant low word) and it
+    keeps pad rows at uint64-max so PAD still sorts last and
+    ``group_sorted``'s max-value pad detection holds.
+
+    uint64 exists only under the x64 flag; the scoped ``jax.enable_x64``
+    context makes these ops real 64-bit without flipping the global
+    default (which would change dtype inference package-wide)."""
+    out = []
+    with jax.enable_x64(True):
+        for j in range(0, len(cols), 2):
+            hi = cols[j].astype(jnp.uint64) << 32
+            lo = (cols[j + 1] if j + 1 < len(cols)
+                  else jnp.full_like(cols[j], _PAD_KEY)).astype(jnp.uint64)
+            out.append(hi | lo)
+    return tuple(out)
+
+
+# A pad row packs to all-ones in every uint64 column (see pack_key_lanes).
+_PAD_KEY64 = 0xFFFFFFFFFFFFFFFF
+
+
+def unpack_key_lanes(cols64, k: int) -> tuple:
+    """Inverse of :func:`pack_key_lanes`: k uint32 lanes back out of the
+    packed uint64 columns."""
+    out = []
+    with jax.enable_x64(True):
+        for j in range(k):
+            w = cols64[j // 2]
+            out.append(((w >> 32) if j % 2 == 0 else w).astype(jnp.uint32))
+    return tuple(out)
+
+
+def unpack_key_rows(rows64: jax.Array, k: int) -> jax.Array:
+    """[n, ceil(k/2)] packed uint64 key rows -> [n, k] uint32 lane rows —
+    the shared unpack-and-restack step after a packed sort+group."""
+    cols = unpack_key_lanes(
+        tuple(rows64[:, j] for j in range(rows64.shape[1])), k)
+    return jnp.stack(cols, axis=1)
+
+
 def group_sorted(skeys_cols: tuple, counts: jax.Array, out_cap: int):
     """Group adjacent equal rows of lexicographically sorted key columns.
 
     The shared reduce idiom (run-boundary detect + segment-sum + compact)
     used by the single-chunk kernel and by the sharded all_to_all merge
-    (parallel/shuffle.py).  ``skeys_cols``: k sorted uint32 columns, PAD
-    rows last; ``counts``: per-row counts to sum within each group.
+    (parallel/shuffle.py).  ``skeys_cols``: k sorted unsigned key columns
+    (uint32 lanes or uint64 packed lane pairs), PAD rows last — a pad row
+    is all-ones in every lane, i.e. the dtype's max in every column;
+    ``counts``: per-row counts to sum within each group.
 
     Returns (keys2d [t,k], totals [out_cap], upos [out_cap], ovalid
     [out_cap], n_unique) — callers gather their payloads at ``upos`` and
@@ -123,10 +171,13 @@ def group_sorted(skeys_cols: tuple, counts: jax.Array, out_cap: int):
     """
     t = skeys_cols[0].shape[0]
     k = len(skeys_cols)
-    keys = jnp.stack(skeys_cols, axis=1)
-    valid = skeys_cols[0] != jnp.uint32(_PAD_KEY)
-    prev = jnp.concatenate(
-        [jnp.full((1, k), _PAD_KEY, jnp.uint32), keys[:-1]], axis=0)
+    dtype = skeys_cols[0].dtype
+    with jax.enable_x64(True):  # 64-bit constants need the scoped flag
+        pad = jnp.array(jnp.iinfo(dtype).max, dtype)  # _PAD_KEY for u32
+        keys = jnp.stack(skeys_cols, axis=1)
+        valid = skeys_cols[0] != pad
+        prev = jnp.concatenate(
+            [jnp.full((1, k), pad, dtype), keys[:-1]], axis=0)
     is_new = jnp.any(keys != prev, axis=1) & valid
     n_unique = jnp.sum(is_new, dtype=jnp.int32)
     uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
@@ -134,6 +185,10 @@ def group_sorted(skeys_cols: tuple, counts: jax.Array, out_cap: int):
         jnp.where(valid, counts, 0), jnp.where(valid, uid, out_cap),
         num_segments=out_cap + 1, indices_are_sorted=True)[:out_cap]
     (upos,) = jnp.nonzero(is_new, size=out_cap, fill_value=t - 1)
+    # Callers run this under the scoped x64 flag (u64 packed keys), where
+    # nonzero yields int64 — pin indices to int32 so they don't drag
+    # 64-bit promotion into the caller's non-x64 ops.
+    upos = upos.astype(jnp.int32)
     ovalid = jnp.arange(out_cap, dtype=jnp.int32) < n_unique
     return keys, totals, upos, ovalid, n_unique
 
@@ -178,13 +233,21 @@ def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
         jnp.where(valid, lane[start_pos], jnp.uint32(_PAD_KEY))
         for lane in lanes)
 
-    # Group identical words: K-key lexicographic sort, then run boundaries.
-    sorted_ops = lax.sort(packed_cols + (lengths,), num_keys=k)
-    skeys, totals, upos, ovalid, n_unique = group_sorted(
-        sorted_ops[:k], jnp.ones(t_cap, jnp.int32), u_cap)
-    slens = sorted_ops[k]
+    # Group identical words: lexicographic sort over the key lanes packed
+    # pairwise into uint64s (pack_key_lanes: same order, half the
+    # comparator keys — the sort is ~3/4 of this kernel's wall on CPU),
+    # then run boundaries; lanes unpack only after compaction to u_cap.
+    with jax.enable_x64(True):  # every op touching u64 operands needs it
+        keys64 = pack_key_lanes(packed_cols)
+        k64 = len(keys64)
+        sorted_ops = lax.sort(keys64 + (lengths,), num_keys=k64)
+        skeys64, totals, upos, ovalid, n_unique = group_sorted(
+            sorted_ops[:k64], jnp.ones(t_cap, jnp.int32), u_cap)
+        slens = sorted_ops[k64]
 
-    packed_u = jnp.where(ovalid[:, None], skeys[upos], 0)
+        packed_u64 = jnp.where(ovalid[:, None], skeys64[upos],
+                               jnp.uint64(0))
+        packed_u = unpack_key_rows(packed_u64, k)
     len_u = jnp.where(ovalid, slens[upos], 0)
     fnv_u = fnv1a32_packed(packed_u, len_u, max_word_len)
     has_high = jnp.any(chunk >= 128)

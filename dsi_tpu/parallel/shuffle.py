@@ -44,7 +44,9 @@ from dsi_tpu.ops.wordcount import (
     _PAD_KEY,
     exactness_retry,
     group_sorted,
+    pack_key_lanes,
     tokenize_group_core,
+    unpack_key_rows,
 )
 
 AXIS = "workers"
@@ -133,18 +135,25 @@ def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
     recv = shuffle_rows(rows, dest, n_dev=n_dev, u_cap=u_cap, k=k)
 
     # ── reduce: sort received records by word, sum counts per run
-    #    (shared grouping idiom, ops/wordcount.py group_sorted) ──
+    #    (shared grouping idiom, ops/wordcount.py group_sorted; key lanes
+    #    packed pairwise into uint64s — same order, half the comparator
+    #    keys, see pack_key_lanes) ──
     out_cap = n_dev * u_cap
-    rkeys = tuple(recv[:, j] for j in range(k))
-    rlen = recv[:, k]
-    rcnt = recv[:, k + 1]
-    rpart = recv[:, k + 2]
-    sorted_ops = lax.sort(rkeys + (rlen, rcnt, rpart), num_keys=k)
-    mkeys, tot, upos, ovalid, m_unique = group_sorted(
-        sorted_ops[:k], sorted_ops[k + 1].astype(jnp.int32), out_cap)
-    mlen = sorted_ops[k].astype(jnp.int32)
-    mpart = sorted_ops[k + 2]
-    out_keys = jnp.where(ovalid[:, None], mkeys[upos], 0)
+    with jax.enable_x64(True):  # every op touching u64 operands needs it
+        rkeys64 = pack_key_lanes(tuple(recv[:, j] for j in range(k)))
+        k64 = len(rkeys64)
+        rlen = recv[:, k]
+        rcnt = recv[:, k + 1]
+        rpart = recv[:, k + 2]
+        sorted_ops = lax.sort(rkeys64 + (rlen, rcnt, rpart), num_keys=k64)
+        mkeys64, tot, upos, ovalid, m_unique = group_sorted(
+            sorted_ops[:k64], sorted_ops[k64 + 1].astype(jnp.int32),
+            out_cap)
+        mlen = sorted_ops[k64].astype(jnp.int32)
+        mpart = sorted_ops[k64 + 2]
+        mkeys64_u = jnp.where(ovalid[:, None], mkeys64[upos],
+                              jnp.uint64(0))
+        out_keys = unpack_key_rows(mkeys64_u, k)
     out_len = jnp.where(ovalid, mlen[upos], 0)
     out_part = jnp.where(ovalid, mpart[upos], 0)
 

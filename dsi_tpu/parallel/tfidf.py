@@ -52,8 +52,10 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dsi_tpu.ops.wordcount import (
-    _PAD_KEY,
+    _PAD_KEY64,
     exactness_retry,
+    pack_key_lanes,
+    unpack_key_lanes,
 )
 from dsi_tpu.parallel.merge import PostingsTable
 from dsi_tpu.parallel.shuffle import (
@@ -87,13 +89,25 @@ def _tfidf_device_step(chunk: jax.Array, doc_id: jax.Array, *, n_dev: int,
          part[:, None]], axis=1)
     recv = shuffle_rows(rows, dest, n_dev=n_dev, u_cap=u_cap, k=k)
 
-    # Sort received rows by word so the host walk groups runs linearly; pad
-    # rows (key lane 0xFFFFFFFF, impossible for ASCII words) sort last.
-    cols = tuple(recv[:, j] for j in range(k + 4))
-    sorted_cols = lax.sort(cols, num_keys=k)
-    srecv = jnp.stack(sorted_cols, axis=1)
-    n_rows = jnp.sum(sorted_cols[0] != jnp.uint32(_PAD_KEY),
-                     dtype=jnp.int32)
+    # Sort received rows by word so the host grouping is one linear pass;
+    # pad rows (key lane 0xFFFFFFFF, impossible for ASCII words) sort
+    # last.  Key lanes sort packed pairwise into uint64s (same order,
+    # half the comparator keys — ops/wordcount.py pack_key_lanes) and
+    # unpack for the uint32 row layout the host table expects.
+    with jax.enable_x64(True):  # every op touching u64 operands needs it
+        keys64 = pack_key_lanes(tuple(recv[:, j] for j in range(k)))
+        k64 = len(keys64)
+        payload = tuple(recv[:, k + j] for j in range(4))
+        sorted_cols = lax.sort(keys64 + payload, num_keys=k64)
+        srecv = jnp.stack(
+            unpack_key_lanes(sorted_cols[:k64], k) + sorted_cols[k64:],
+            axis=1)
+        # Pad detection on the PACKED column: a pad row is all-ones in
+        # every lane, i.e. uint64-max after packing (a real first lane
+        # can be 0xFFFFFFFF only for non-ASCII bytes, which has_high
+        # rejects).
+        not_pad = sorted_cols[0] != jnp.array(_PAD_KEY64, jnp.uint64)
+    n_rows = jnp.sum(not_pad, dtype=jnp.int32)
 
     scalars = jnp.stack([n_rows, n_unique, max_len,
                          has_high.astype(jnp.int32),
