@@ -106,3 +106,39 @@ def test_zero_capacity_start_terminates():
     assert res is not None and res["alpha"][0] == 2 and res["beta"][0] == 1
     many = count_words_many([b"alpha beta alpha", b"beta"], u_cap=0)
     assert [m["beta"][0] for m in many] == [1, 1]
+
+
+def test_pack_key_lanes_order_and_roundtrip():
+    """Packed uint64 sort order must equal the unpacked lexicographic
+    order, and unpack must invert pack — for even and odd lane counts,
+    including PAD rows."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dsi_tpu.ops.wordcount import (_PAD_KEY, pack_key_lanes,
+                                       unpack_key_rows)
+
+    rng = np.random.default_rng(3)
+    for k in (1, 2, 3, 4, 16):
+        n = 257
+        cols_np = rng.integers(0, 0x7F7F7F80, size=(k, n), dtype=np.uint32)
+        # sprinkle PAD rows (all lanes 0xFFFFFFFF), which must sort last
+        pad_rows = rng.choice(n, size=16, replace=False)
+        for j in range(k):
+            cols_np[j, pad_rows] = _PAD_KEY
+        cols = tuple(jnp.asarray(cols_np[j]) for j in range(k))
+
+        packed = pack_key_lanes(cols)
+        assert len(packed) == (k + 1) // 2
+        # roundtrip
+        rows64 = jnp.stack(packed, axis=1)
+        back = np.asarray(unpack_key_rows(rows64, k))
+        assert np.array_equal(back, cols_np.T)
+        # order: argsort by packed columns == lexsort by original lanes
+        packed_np = [np.asarray(p) for p in packed]
+        order_packed = np.lexsort(tuple(reversed(packed_np)))
+        order_lanes = np.lexsort(tuple(reversed(cols_np)))
+        assert np.array_equal(cols_np.T[order_packed],
+                              cols_np.T[order_lanes])
+        # PAD rows sort last under the packed order
+        assert set(order_packed[-16:]) == set(pad_rows)
