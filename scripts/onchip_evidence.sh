@@ -18,6 +18,8 @@
 #      tpu_map; same warmed kernel shape as tpu_wc).
 #   8. wcstream --check --aot — the bounded-memory streaming CLI on the
 #      chip, loading the warmed executables.
+#   9. wcstream --aot over a ~1 GB corpus (4 MiB chunks, warmed shapes) —
+#      the GB-scale on-chip run VERDICT r3 missing #4 asks for.
 #
 # Everything logs under $OUT; nothing else may touch the chip while this
 # runs (single-tenant tunnel).
@@ -104,5 +106,45 @@ timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
   --workdir "$OUT/wcstream-wd" "$OUT"/corpus/pg-*.txt \
   > "$OUT/wcstream.log" 2>&1
 log "wcstream rc=$? $(tail -c 160 "$OUT/wcstream.log" | tr '\n' ' ')"
+
+log "wcstream ~1 GB on the chip (GB-scale single-device stream)"
+# 1024 x 1 MB generated files; --check would double the wall with a host
+# oracle pass over 1 GB, so this step relies on wcstream's own exactness
+# machinery (device counts are exact or the CLI falls back/fails loudly)
+# and records wall time for the throughput story.  4 MiB chunks amortize
+# the tunnel's per-step latency; the shapes are pre-warmed
+# (scripts/warm_kernels.py) so no cold compile runs inside the timeout.
+python -c "from dsi_tpu.utils.corpus import ensure_corpus; \
+           ensure_corpus('$OUT/corpus-1g', n_files=1024, file_size=1048576)" \
+  > "$OUT/corpus-1g.log" 2>&1
+log "corpus-1g rc=$?"
+mkdir -p "$OUT/wcstream-1g-wd"
+# Stale outputs must not masquerade as this run's result (the invariant
+# below would happily sum a previous round's files).
+rm -f "$OUT/wcstream-1g-wd"/mr-out-*
+{ time timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --devices 1 \
+    --aot --u-cap 16384 --chunk-bytes 4194304 \
+    --workdir "$OUT/wcstream-1g-wd" "$OUT"/corpus-1g/pg-*.txt ; } \
+  > "$OUT/wcstream-1g.log" 2>&1
+log "wcstream-1g rc=$? $(tail -c 160 "$OUT/wcstream-1g.log" | tr '\n' ' ')"
+# Total-token invariant (full per-word parity is covered at test scale;
+# this one-pass host count catches gross miscounts at 1 GB for ~1 min):
+python - "$OUT" <<'PY' >> "$OUT/wcstream-1g.log" 2>&1
+import glob, re, sys
+out_dir = sys.argv[1]
+tot = 0
+for p in sorted(glob.glob(f"{out_dir}/corpus-1g/pg-*.txt")):
+    with open(p, "rb") as f:
+        tot += len(re.findall(rb"[A-Za-z]+", f.read()))
+got = 0
+for p in glob.glob(f"{out_dir}/wcstream-1g-wd/mr-out-*"):
+    with open(p) as f:
+        for line in f:
+            if line.strip():
+                got += int(line.rsplit(" ", 1)[1])
+print(f"token-count invariant: corpus={tot} mr-out={got} "
+      f"match={tot == got}", flush=True)
+PY
+log "wcstream-1g invariant: $(tail -n 1 "$OUT/wcstream-1g.log")"
 
 log "evidence collection done"

@@ -82,8 +82,16 @@ def main() -> int:
     from dsi_tpu.parallel.streaming import warm_stream_aot
 
     t0 = time.perf_counter()
-    warm_stream_aot(mesh=default_mesh(), chunk_bytes=1 << 20,
+    mesh = default_mesh()
+    warm_stream_aot(mesh=mesh, chunk_bytes=1 << 20,
                     caps=(1 << 14, 1 << 16))
+    # The GB-scale on-chip stream (onchip_evidence.sh step 9) uses 4 MiB
+    # chunks so per-step wire latency amortizes over 4x the bytes.  Warm
+    # one rung past the corpus's measured worst chunk (~64.3k uniques vs
+    # the 65,536 rung — 1.8% headroom, and file ordering can shift it):
+    # a widening retry on the chip must load, never cold-compile.
+    warm_stream_aot(mesh=mesh, chunk_bytes=1 << 22,
+                    caps=(1 << 14, 1 << 16, 1 << 18))
     print(f"stream programs: {time.perf_counter() - t0:.1f}s", flush=True)
 
     print(f"aot stats: {aotcache.stats}", flush=True)
