@@ -32,7 +32,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "$(date -u +%H:%M:%S) corpus_wc warm after $n attempts" >> "$OUT/log"
     # Also warm the per-task worker kernels the on-chip harness runs use
     # (tpu_wc / tpu_grep map shapes; see scripts/warm_kernels.py).
-    if timeout -k 30s 3600s python scripts/warm_kernels.py \
+    # 7200 s: round 4 widened the warm set to ~17 programs (worker
+    # kernels + both grep tiers + stream shapes at 1 MiB and 4 MiB
+    # chunks); remote axon compiles can run minutes each.
+    if timeout -k 30s 7200s python scripts/warm_kernels.py \
         >> "$OUT/kernels.log" 2>&1; then
       echo "$(date -u +%H:%M:%S) worker kernels warm" >> "$OUT/log"
       # Chain into the round's on-chip evidence collection (two bench
