@@ -38,6 +38,16 @@ def test_grep_parity(tmp_path, corpus, monkeypatch):
     assert merged_output(str(tmp_path)) == want
 
 
+def test_tfidf_parity(tmp_path, corpus, monkeypatch):
+    # N (total docs) is job-level config a per-key reduce cannot derive
+    # (apps/tfidf.py n_docs_from_env) — the harness exports it the same way.
+    monkeypatch.setenv("DSI_TFIDF_NDOCS", str(len(corpus)))
+    want = oracle_output("tfidf", corpus, str(tmp_path))
+    run_distributed_threads("tfidf", corpus, str(tmp_path))
+    assert merged_output(str(tmp_path)) == want
+    assert any(" " in l and ":" in l for l in want)  # df + doc:score rows
+
+
 def test_single_worker_parity(tmp_path, corpus):
     # degenerate parallelism still correct
     want = oracle_output("wc", corpus, str(tmp_path))
