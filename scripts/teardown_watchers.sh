@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# End-of-round teardown: stop the warm/evidence automation so the
+# driver's bench has the single-tenant chip to itself (BASELINE.md
+# round-3 close ritual, now encoded).
+#
+# Kill discipline (the whole point of this script):
+#   * supervisor + warm_loop shells: plain TERM, they hold no device state;
+#   * a PRE-init bench child (no warm-result.json.init marker): blocked in
+#     the jax.devices() C call where SIGTERM is deferred — SIGKILL is safe
+#     (a polling pre-init client holds no claim);
+#   * a POST-init child (marker present): actively holds the device claim —
+#     SIGTERM + bounded wait so its handler can unwind the PJRT client (a
+#     SIGKILL here wedges the chip for the driver's bench).
+set -u
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+INIT_MARKER="$REPO/.bench/warm-result.json.init"
+
+pids_of() { ps -eo pid,args | grep "$1" | grep -v grep | awk '{print $1}'; }
+
+for pat in "[w]hile ! bash scripts/warm_loop.sh" "[w]arm_loop.sh /tmp"; do
+  for pid in $(pids_of "$pat"); do
+    echo "TERM shell $pid"
+    kill "$pid" 2>/dev/null
+  done
+done
+
+for pat in "[b]ench.py --tpu-child" "[w]arm_kernels.py" \
+           "[o]nchip_evidence.sh" "[t]est_mr.sh" "[w]cstream"; do
+  for pid in $(pids_of "$pat"); do
+    if [ -f "$INIT_MARKER" ] || [ "$pat" != "[b]ench.py --tpu-child" ]; then
+      echo "TERM $pid ($pat) + grace"
+      kill "$pid" 2>/dev/null
+      for _ in $(seq 1 25); do
+        kill -0 "$pid" 2>/dev/null || break
+        sleep 1
+      done
+      if kill -0 "$pid" 2>/dev/null; then
+        echo "  still alive after 25s: KILL $pid (accepting wedge risk" \
+             "over leaking a claim holder into the driver's window)"
+        kill -9 "$pid" 2>/dev/null
+      fi
+    else
+      echo "KILL pre-init child $pid (no claim held)"
+      kill -9 "$pid" 2>/dev/null
+    fi
+  done
+done
+
+echo "teardown complete; remaining matching processes:"
+ps -eo pid,args | grep -E "[w]arm_loop|[b]ench.py --tpu-child|[o]nchip" || true
