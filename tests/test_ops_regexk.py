@@ -93,6 +93,46 @@ def test_fuzz_class_patterns_vs_oracle():
         assert got == _oracle(data, pattern), (trial, pattern, lines)
 
 
+def test_fuzz_generated_class_patterns_vs_oracle():
+    """Random patterns BUILT from the supported grammar (not a fixed
+    list): every generated pattern must be accepted and agree with the
+    per-line re.search oracle."""
+    rng = random.Random(29)
+    alphabet = "abcxyzAB01 .,;"
+
+    def gen_atom():
+        r = rng.random()
+        if r < 0.3:
+            return rng.choice("abcxyzAB"), None
+        if r < 0.45:
+            return ".", None
+        if r < 0.6:
+            return rng.choice([r"\d", r"\w", r"\s"]), None
+        neg = "^" if rng.random() < 0.3 else ""
+        items = []
+        for _ in range(rng.randint(1, 3)):
+            if rng.random() < 0.5:
+                lo, hi = sorted(rng.sample("abcdwxyz", 2))
+                items.append(f"{lo}-{hi}")
+            else:
+                items.append(rng.choice("abcxyz019"))
+        return f"[{neg}{''.join(items)}]", None
+
+    for trial in range(40):
+        pattern = "".join(gen_atom()[0]
+                          for _ in range(rng.randint(1, 5)))
+        if rng.random() < 0.2:
+            pattern = "^" + pattern
+        if rng.random() < 0.2:
+            pattern = pattern + "$"
+        lines = ["".join(rng.choices(alphabet, k=rng.randint(0, 24)))
+                 for _ in range(rng.randint(1, 30))]
+        data = "\n".join(lines).encode()
+        got = classgrep_host_result(data, pattern)
+        assert got is not None, (trial, pattern)
+        assert got == _oracle(data, pattern), (trial, pattern, lines)
+
+
 def test_line_buffer_overflow_retries_exactly():
     # every byte a newline: n_lines = n+1 forces the widest l_cap rung
     data = b"\n" * 600 + b"xa\n" * 40
