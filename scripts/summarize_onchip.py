@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Summarize an on-chip evidence directory into a BASELINE.md-ready block.
+
+`scripts/onchip_evidence.sh $OUT` leaves ~10 artifacts (two bench JSON
+lines, a wire probe log, four harness logs, two wcstream logs plus a
+token-count invariant).  This reads one such directory and prints the
+compact, citable summary the round report needs — so the evidence write-up
+is mechanical and nothing gets transcribed by hand.
+
+Usage: python scripts/summarize_onchip.py [/tmp/onchip/<stamp>]
+       (default: the newest directory under /tmp/onchip)
+"""
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _evidence_dir(d: str) -> bool:
+    # A real evidence dir holds the chain log or a bench artifact; the
+    # chain also creates workdirs (corpus/, wcstream-wd/, ...) under a
+    # default-OUT run that must not win the newest-mtime pick.
+    return any(os.path.exists(os.path.join(d, f))
+               for f in ("log", "benchA.json"))
+
+
+def _latest_dir() -> str:
+    cands = [d for d in glob.glob("/tmp/onchip/*") + ["/tmp/onchip"]
+             if os.path.isdir(d) and _evidence_dir(d)]
+    if not cands:
+        sys.exit("no /tmp/onchip evidence directory found")
+    return max(cands, key=os.path.getmtime)
+
+
+def _bench_line(path: str) -> str:
+    try:
+        with open(path) as f:
+            txt = f.read().strip()
+    except OSError:
+        return "  (missing)"
+    # bench.py prints exactly one JSON object on stdout
+    try:
+        d = json.loads(txt.splitlines()[-1])
+    except (ValueError, IndexError):
+        return f"  (unparseable: {txt[-200:]!r})"
+    keys = ("metric", "value", "unit", "vs_baseline", "median_mbps",
+            "platform", "oracle_mbps", "stream_mbps", "stream_mb",
+            "stream_parity", "tpu_error")
+    parts = [f"{k}={d[k]}" for k in keys if k in d]
+    phases = d.get("phases")
+    if phases:
+        parts.append("phases=" + json.dumps(phases))
+    return "  " + "  ".join(parts)
+
+
+def _harness(path: str) -> str:
+    try:
+        with open(path) as f:
+            txt = f.read()
+    except OSError:
+        return "  (missing)"
+    verdict = "PASS" if "PASS" in txt else ("FAIL" if "FAIL" in txt
+                                            else "no verdict")
+    m = re.search(r"^real\s+(\S+)", txt, re.M)
+    wall = m.group(1) if m else "?"
+    return f"  {verdict}  wall={wall}"
+
+
+def _tail(path: str, n: int = 6) -> str:
+    try:
+        with open(path) as f:
+            lines = [ln.rstrip() for ln in f if ln.strip()]
+    except OSError:
+        return "  (missing)"
+    return "\n".join("  " + ln for ln in lines[-n:])
+
+
+def main() -> None:
+    out = sys.argv[1] if len(sys.argv) > 1 else _latest_dir()
+    print(f"== on-chip evidence: {out} ==")
+    print("bench A (fresh process, warm cache):")
+    print(_bench_line(f"{out}/benchA.json"))
+    print("bench B (repeat):")
+    print(_bench_line(f"{out}/benchB.json"))
+    print("wire probe (probe_tunnel.py tail):")
+    print(_tail(f"{out}/probe_tunnel.log"))
+    for name in ("tpu_wc", "tpu_grep", "tpu_grep_literal", "tpu_indexer",
+                 "tfidf"):
+        print(f"harness {name}:{_harness(f'{out}/harness_{name}.log')}")
+    print("wcstream --check (single-device mesh):")
+    print(_tail(f"{out}/wcstream.log", 3))
+    print("wcstream ~1 GB:")
+    print(_tail(f"{out}/wcstream-1g.log", 4))
+    print("chain log:")
+    print(_tail(f"{out}/log", 30))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BrokenPipeError:  # e.g. piped into `head`
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
