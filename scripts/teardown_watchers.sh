@@ -4,30 +4,79 @@
 # round-3 close ritual, now encoded).
 #
 # Kill discipline (the whole point of this script):
-#   * supervisor + warm_loop shells: plain TERM, they hold no device state;
-#   * a PRE-init bench child (no warm-result.json.init marker): blocked in
-#     the jax.devices() C call where SIGTERM is deferred — SIGKILL is safe
+#   * supervisor shells (warm_loop / device_watch / bench_window_loop):
+#     plain TERM, they hold no device state — and they go FIRST, since a
+#     live supervisor respawns a fresh bench the moment its current one
+#     dies (device_watch.sh runs bench1 then bench2; warm_loop retries);
+#   * a PRE-init bench child (no fresh .init marker): blocked in the
+#     jax.devices() C call where SIGTERM is deferred — SIGKILL is safe
 #     (a polling pre-init client holds no claim);
-#   * a POST-init child (marker present): actively holds the device claim —
-#     SIGTERM + bounded wait so its handler can unwind the PJRT client (a
-#     SIGKILL here wedges the chip for the driver's bench).
+#   * a POST-init child (marker written after the process started):
+#     actively holds the device claim — SIGTERM + bounded wait so its
+#     handler can unwind the PJRT client (a SIGKILL here wedges the chip
+#     for the driver's bench).
 set -u
-REPO=$(cd "$(dirname "$0")/.." && pwd)
-INIT_MARKER="$REPO/.bench/warm-result.json.init"
+
+# True process start time in epoch seconds: boot time + starttime ticks.
+# (/proc/<pid> dentry timestamps are NOT usable — they reflect the first
+# lookup, often this very script's ps, not the process start.)  The comm
+# field can contain spaces/parens, so strip through the last ')' first;
+# starttime is overall field 22 = field 20 after pid+comm are removed.
+proc_start_epoch() {  # $1 = pid; prints epoch or fails if process gone
+  local btime rest ticks
+  btime=$(awk '/^btime/{print $2}' /proc/stat)
+  rest=$(sed 's/.*) //' "/proc/$1/stat" 2>/dev/null) || return 1
+  ticks=$(echo "$rest" | awk '{print $20}')
+  [ -n "$ticks" ] || return 1
+  echo $(( btime + ticks / $(getconf CLK_TCK) ))
+}
+
+# Post-init = THIS child's own marker was written during its lifetime.
+# A child's argv is "... bench.py --tpu-child <result_path>"; the marker
+# is <result_path>.init, touched once jax.devices() returns.  Completed
+# runs leave markers behind (cleared only at the next attempt's start),
+# so existence alone proves nothing — mtime must be >= process start;
+# and another child's marker (warm vs tpu result paths) must not vouch
+# for this one.
+post_init() {  # $1 = pid
+  local started rpath m
+  started=$(proc_start_epoch "$1") || return 0  # gone: TERM path, harmless
+  rpath=$(tr '\0' '\n' < "/proc/$1/cmdline" 2>/dev/null | tail -n 1)
+  case "$rpath" in
+    */*) m="$rpath.init" ;;
+    *)   return 0 ;;  # argv unreadable: assume claim held (safe side)
+  esac
+  [ -f "$m" ] && [ "$(stat -c %Y "$m")" -ge "$started" ]
+}
 
 pids_of() { ps -eo pid,args | grep "$1" | grep -v grep | awk '{print $1}'; }
 
-for pat in "[w]hile ! bash scripts/warm_loop.sh" "[w]arm_loop.sh /tmp"; do
+# Phase 1 — supervisor/respawner shells, parents before anything else.
+for pat in "[w]hile ! bash scripts/warm_loop.sh" "[w]arm_loop.sh /tmp" \
+           "[d]evice_watch.sh" "[b]ench_window_loop.sh"; do
   for pid in $(pids_of "$pat"); do
-    echo "TERM shell $pid"
+    echo "TERM shell $pid ($pat)"
     kill "$pid" 2>/dev/null
   done
 done
 
-for pat in "[b]ench.py --tpu-child" "[w]arm_kernels.py" \
-           "[o]nchip_evidence.sh" "[t]est_mr.sh" "[w]cstream"; do
+# Phase 2 — bench drivers before their children: a live `python bench.py`
+# driver respawns a fresh tpu-child when its current one dies (bench.py
+# retry loop), so killing children first would race a respawn past this
+# scan.  The bounded wait below confirms each parent is gone before the
+# child pattern runs.
+# "[i]mport jax" catches device_watch.sh's standalone JAX probe
+# (`timeout 300 python -c "import jax; ..."`); "[p]robe_tunnel.py"
+# catches onchip_evidence.sh's wire probe — both hold a claim once init
+# returns and match no other pattern here.
+for pat in "[p]ython bench.py" "[b]ench.py --tpu-child" "[w]arm_kernels.py" \
+           "[o]nchip_evidence.sh" "[t]est_mr.sh" "[w]cstream" \
+           "[i]mport jax" "[p]robe_tunnel.py"; do
   for pid in $(pids_of "$pat"); do
-    if [ -f "$INIT_MARKER" ] || [ "$pat" != "[b]ench.py --tpu-child" ]; then
+    if [ "$pat" = "[b]ench.py --tpu-child" ] && ! post_init "$pid"; then
+      echo "KILL pre-init child $pid (no claim held)"
+      kill -9 "$pid" 2>/dev/null
+    else
       echo "TERM $pid ($pat) + grace"
       kill "$pid" 2>/dev/null
       for _ in $(seq 1 25); do
@@ -39,12 +88,11 @@ for pat in "[b]ench.py --tpu-child" "[w]arm_kernels.py" \
              "over leaking a claim holder into the driver's window)"
         kill -9 "$pid" 2>/dev/null
       fi
-    else
-      echo "KILL pre-init child $pid (no claim held)"
-      kill -9 "$pid" 2>/dev/null
     fi
   done
 done
 
 echo "teardown complete; remaining matching processes:"
-ps -eo pid,args | grep -E "[w]arm_loop|[b]ench.py --tpu-child|[o]nchip" || true
+ps -eo pid,args | grep -E \
+  "[w]arm_loop|[d]evice_watch|[b]ench_window_loop|[b]ench.py|[o]nchip|[w]arm_kernels|[w]cstream|[i]mport jax|[p]robe_tunnel" \
+  || true
