@@ -76,6 +76,45 @@ def _tail(path: str, n: int = 6) -> str:
     return "\n".join("  " + ln for ln in lines[-n:])
 
 
+def _valid_tpu_verdict(v) -> bool:
+    # bench.py's stdout verdict has no "parity" key; a failed or
+    # parity-mismatched run ships metric=wc_tpu_throughput with value=0
+    # and an "error" key, and an outage run switches the metric to
+    # wc_cpu_fallback_throughput — exclude all of those.
+    return (isinstance(v, dict) and v.get("metric") == "wc_tpu_throughput"
+            and "error" not in v and "tpu_error" not in v
+            and isinstance(v.get("value"), (int, float)) and v["value"] > 0)
+
+
+def _window_samples(path: str) -> None:
+    """Digest bench_window_loop.sh's congestion-window samples, if any."""
+    rows, bad = [], 0
+    try:
+        with open(path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    rows.append(json.loads(ln))
+                except ValueError:  # truncated final line after a TERM
+                    bad += 1
+    except OSError:
+        return
+    good = [r for r in rows if _valid_tpu_verdict(r.get("verdict"))]
+    good.sort(key=lambda r: r["verdict"]["value"])
+    print(f"window samples ({path}): {len(rows)} total, "
+          f"{len(good)} valid TPU verdicts"
+          + (f", {bad} unparseable lines" if bad else ""))
+    if good:
+        best, med = good[-1], good[len(good) // 2]
+        print(f"  best={best['verdict']['value']} MB/s  "
+              f"median={med['verdict']['value']} MB/s  "
+              f"worst={good[0]['verdict']['value']} MB/s")
+        print(f"  best sample: ts={best['ts']} vs_baseline="
+              f"{best['verdict'].get('vs_baseline')} median_mbps="
+              f"{best['verdict'].get('median_mbps')}")
+
+
 def main() -> None:
     out = sys.argv[1] if len(sys.argv) > 1 else _latest_dir()
     print(f"== on-chip evidence: {out} ==")
@@ -94,6 +133,10 @@ def main() -> None:
     print(_tail(f"{out}/wcstream-1g.log", 4))
     print("chain log:")
     print(_tail(f"{out}/log", 30))
+    # Window-loop samples: every OUT dir bench_window_loop.sh was run
+    # with (default /tmp/rebench; operators may stamp their own).
+    for p in sorted(glob.glob("/tmp/rebench*/samples.jsonl")):
+        _window_samples(p)
 
 
 if __name__ == "__main__":
