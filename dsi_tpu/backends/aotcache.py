@@ -111,6 +111,33 @@ def _log(msg: str) -> None:
         print(f"[aotcache] {msg}", file=sys.stderr, flush=True)
 
 
+def is_persisted(name: str, fn: Callable, example_args: Tuple[Any, ...],
+                 static: Dict[str, Any] | None = None) -> bool:
+    """True when a compiled executable for exactly this (platform, source,
+    shapes, static) key is already on disk.  Pure existence probe — no
+    compile, no load, no device work beyond the platform fingerprint
+    (which needs backends initialized, as every caller already has).
+
+    Lets a time-boxed process decide whether touching a program is a
+    millisecond load or a multi-minute remote compile BEFORE committing —
+    on the axon platform a cold compile mid-bench can eat the whole
+    attempt budget (BASELINE.md incident log).
+
+    Mirrors cached_compile's LOAD policy, not just file existence: with
+    the DSI_AOT_CACHE=0 kill switch, or in a multi-device process (where
+    deserialized executables reject single-device args — see
+    cached_compile), the entry on disk would never be loaded, so the
+    honest answer is False."""
+    import jax
+
+    if os.environ.get("DSI_AOT_CACHE", "1") == "0":
+        return False
+    if len(jax.devices()) != 1:
+        return False
+    key = _key(name, fn, example_args, static or {})
+    return os.path.exists(os.path.join(cache_dir(), f"{name}-{key}.aot"))
+
+
 def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
                    static: Dict[str, Any] | None = None,
                    persist: bool | None = None) -> Callable:

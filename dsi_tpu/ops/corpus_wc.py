@@ -51,6 +51,7 @@ from dsi_tpu.ops.wordcount import (
     group_sorted,
     is_ascii_letter,
     pack_key_lanes,
+    rung0_cap,
 )
 
 # pos<<7|len packing needs pos < 2**25: cap the padded corpus at 32 MiB per
@@ -285,13 +286,7 @@ def corpus_wordcount(raws: Sequence[bytes], *, piece_size: int | None = None,
     fits in 64 symbols, transparently reverting to raw bytes when not."""
     import jax
 
-    if piece_size is None:
-        # Smallest power of two holding the largest file plus its separator
-        # byte, capped at 2 MiB — bigger files split into multiple pieces so
-        # uploads stay pieced/async (the tunnel's fast path).
-        longest = max((len(r) for r in raws), default=1)
-        piece_size = min(1 << 21, 1 << max(12, (longest + 1).bit_length()))
-    buf, n_pieces = pack_pieces(raws, piece_size)
+    buf, n_pieces, piece_size = _resolve_pieces(raws, piece_size)
     if n_pieces == 0:
         return CorpusResult(np.zeros(64, np.uint8), *(np.zeros(0, np.int64)
                                                       for _ in range(3)))
@@ -342,28 +337,71 @@ def corpus_wordcount(raws: Sequence[bytes], *, piece_size: int | None = None,
     return None if payload is None else payload()
 
 
-@functools.lru_cache(maxsize=64)
-def _get_compiled(n_pieces: int, piece_size: int, mwl: int, cap: int,
-                  frac: int, use_aot: bool, pack6: bool = False):
+def _resolve_pieces(raws: Sequence[bytes], piece_size: int | None):
+    """Shared piece derivation for the run path and the cache-existence
+    probe — one definition, so the probe's key cannot drift from the key
+    a real run compiles.  Default piece size: smallest power of two
+    holding the largest file plus its separator byte, capped at 2 MiB —
+    bigger files split into multiple pieces so uploads stay pieced/async
+    (the tunnel's fast path)."""
+    if piece_size is None:
+        longest = max((len(r) for r in raws), default=1)
+        piece_size = min(1 << 21, 1 << max(12, (longest + 1).bit_length()))
+    buf, n_pieces = pack_pieces(raws, piece_size)
+    return buf, n_pieces, piece_size
+
+
+def _example_and_fn(n_pieces: int, piece_size: int, pack6: bool):
     import jax
 
-    static = {"max_word_len": mwl, "u_cap": cap, "t_cap_frac": frac}
     if pack6:
         example = tuple(
             jax.ShapeDtypeStruct((piece_size * 3 // 4,), np.uint8)
             for _ in range(n_pieces)) + (
             jax.ShapeDtypeStruct((64,), np.uint8),)
-        fn, name = corpus_kernel_packed, "corpus_wc_p6"
-    else:
-        example = tuple(jax.ShapeDtypeStruct((piece_size,), np.uint8)
-                        for _ in range(n_pieces))
-        fn, name = corpus_kernel, "corpus_wc"
+        return example, corpus_kernel_packed, "corpus_wc_p6"
+    example = tuple(jax.ShapeDtypeStruct((piece_size,), np.uint8)
+                    for _ in range(n_pieces))
+    return example, corpus_kernel, "corpus_wc"
+
+
+@functools.lru_cache(maxsize=64)
+def _get_compiled(n_pieces: int, piece_size: int, mwl: int, cap: int,
+                  frac: int, use_aot: bool, pack6: bool = False):
+    static = {"max_word_len": mwl, "u_cap": cap, "t_cap_frac": frac}
+    example, fn, name = _example_and_fn(n_pieces, piece_size, pack6)
     from dsi_tpu.backends.aotcache import cached_compile
 
     # use_aot=False still memoizes in-process and accounts compile time in
     # aotcache.stats; it only stops disk reads/writes.
     return cached_compile(name, fn, example, static=static,
                           persist=None if use_aot else False)
+
+
+def corpus_executable_persisted(raws: Sequence[bytes], *,
+                                piece_size: int | None = None,
+                                max_word_len: int = 16, u_cap: int = 1 << 18,
+                                pack6: bool = False) -> bool:
+    """True when the rung-0 program ``corpus_wordcount(raws, pack6=...)``
+    would run first is already in the persistent AOT cache — i.e. touching
+    this transport is a millisecond load, not a multi-minute remote
+    compile.  Mirrors corpus_wordcount's shape derivation exactly (same
+    piece_size rule, same first (mwl, cap, frac=4) rung; the bench corpus
+    resolves at rung 0, and on a cold machine rung 0 is the compile that
+    dominates).  Escape cases where the program would not run at all
+    (empty corpus, >2^25 positions, pack6 alphabet overflow) return False."""
+    buf, n_pieces, piece_size = _resolve_pieces(raws, piece_size)
+    if n_pieces == 0 or len(buf) > 1 << _POS_BITS:
+        return False
+    if pack6 and pack6_encode(buf) is None:
+        return False
+    example, fn, name = _example_and_fn(n_pieces, piece_size, pack6)
+    from dsi_tpu.backends.aotcache import is_persisted
+
+    return is_persisted(name, fn, example,
+                        static={"max_word_len": max_word_len,
+                                "u_cap": rung0_cap(len(buf), u_cap),
+                                "t_cap_frac": 4})
 
 
 def render_lines(mat: np.ndarray, lens: np.ndarray,

@@ -310,6 +310,17 @@ def decode_packed(packed_u: np.ndarray, len_u: np.ndarray,
             for i in range(nu)]
 
 
+def rung0_cap(shard_len: int, u_cap: int) -> int:
+    """exactness_retry's starting capacity: ``u_cap`` bounded by the
+    token-count hard cap for this shard length (n//2+1, pow2-rounded to
+    keep the jit shape-cache small), floored at 1 (a zero/negative start
+    could never widen: 0 * 4 == 0).  Shared with cache-existence probes
+    (corpus_wc.corpus_executable_persisted) so the key they compute is,
+    by construction, the key a real run compiles first."""
+    hard_cap = 1 << (shard_len // 2).bit_length()
+    return max(1, min(u_cap, hard_cap))
+
+
 def exactness_retry(run, shard_len: int, max_word_len: int, u_cap: int):
     """Shared overflow/retry discipline for the static-shape kernels.
 
@@ -321,12 +332,9 @@ def exactness_retry(run, shard_len: int, max_word_len: int, u_cap: int):
     64-byte word window if a word overflowed the packed window.  Returns the
     successful payload, or None when the input needs the host path
     (non-ASCII bytes, or words longer than 64)."""
-    hard_cap = 1 << (shard_len // 2).bit_length()
     ladder = (max_word_len, 64) if max_word_len < 64 else (max_word_len,)
     for mwl in ladder:
-        # Floor of 1: a zero/negative starting capacity could never widen
-        # (0 * 4 == 0) and would re-run the same kernel forever.
-        cap = max(1, min(u_cap, hard_cap))
+        cap = rung0_cap(shard_len, u_cap)
         while True:
             has_high, n_unique_max, max_len, payload = run(mwl, cap)
             if has_high:

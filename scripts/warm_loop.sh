@@ -25,7 +25,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   # quickly — while the outer timeout only backstops a post-init hang;
   # 3600 s covers any plausible cold compile, and TERM (not KILL) lets
   # the child's handler unwind the claim cleanly.
-  DSI_CHILD_INIT_TIMEOUT=240 timeout -k 30s 3600s python -u bench.py \
+  # WARM_ALL: the warm child's whole job is compiling BOTH transports
+  # into the persistent cache (a plain bench skips a non-cached pack6 to
+  # protect its budget — this is the one process that must not skip it).
+  DSI_BENCH_WARM_ALL=1 DSI_CHILD_INIT_TIMEOUT=240 timeout -k 30s 3600s \
+    python -u bench.py \
     --tpu-child "$REPO/.bench/warm-result.json" >> "$OUT/attempt.log" 2>&1
   if [ -f "$REPO/.bench/warm-result.json" ] && \
      ! grep -q '"error"' "$REPO/.bench/warm-result.json"; then

@@ -248,3 +248,40 @@ def test_aot_cache_hits_across_processes(tmp_path):
         outs.append(p.stdout.strip().splitlines()[-1])
     assert outs[0] == "loads=0 compiles=1"
     assert outs[1] == "loads=1 compiles=0"
+
+
+def test_executable_persisted_probe_mirrors_run_shapes(tmp_path):
+    """corpus_executable_persisted must hit the exact key a real run
+    persists — including exactness_retry's rung-0 capacity, which caps
+    u_cap by the buffer-length hard bound (a drifted mirror silently
+    reports False forever and the bench would skip a warmed pack6
+    transport / never trust its own cache).  Single-device subprocess:
+    persistence is disabled on the 8-device test mesh by design."""
+    import subprocess
+    import sys
+
+    child = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from dsi_tpu.ops.corpus_wc import (corpus_executable_persisted,\n"
+        "                                   corpus_wordcount)\n"
+        "raws = [b'the quick brown fox ' * 500,\n"
+        "        b'jumps over the lazy dog ' * 400]\n"
+        "assert not corpus_executable_persisted(raws)\n"
+        "assert not corpus_executable_persisted(raws, pack6=True)\n"
+        "corpus_wordcount(raws)\n"
+        "corpus_wordcount(raws, pack6=True)\n"
+        "assert corpus_executable_persisted(raws)\n"
+        "assert corpus_executable_persisted(raws, pack6=True)\n"
+        "assert not corpus_executable_persisted([b'word ' * 99999])\n"
+        "print('probe-ok')\n"
+    )
+    env = dict(os.environ)
+    env["DSI_AOT_CACHE_DIR"] = str(tmp_path / "aot")
+    env["DSI_AOT_QUIET"] = "1"
+    env.pop("XLA_FLAGS", None)  # single-device process, like the chip
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert p.stdout.strip().splitlines()[-1] == "probe-ok"
