@@ -128,23 +128,11 @@ rm -f "$OUT/wcstream-1g-wd"/mr-out-*
   > "$OUT/wcstream-1g.log" 2>&1
 log "wcstream-1g rc=$? $(tail -c 160 "$OUT/wcstream-1g.log" | tr '\n' ' ')"
 # Total-token invariant (full per-word parity is covered at test scale;
-# this one-pass host count catches gross miscounts at 1 GB for ~1 min):
-python - "$OUT" <<'PY' >> "$OUT/wcstream-1g.log" 2>&1
-import glob, re, sys
-out_dir = sys.argv[1]
-tot = 0
-for p in sorted(glob.glob(f"{out_dir}/corpus-1g/pg-*.txt")):
-    with open(p, "rb") as f:
-        tot += len(re.findall(rb"[A-Za-z]+", f.read()))
-got = 0
-for p in glob.glob(f"{out_dir}/wcstream-1g-wd/mr-out-*"):
-    with open(p) as f:
-        for line in f:
-            if line.strip():
-                got += int(line.rsplit(" ", 1)[1])
-print(f"token-count invariant: corpus={tot} mr-out={got} "
-      f"match={tot == got}", flush=True)
-PY
+# this one-pass host count catches gross miscounts at 1 GB for ~1 min;
+# shared helper so this and the warm_loop.sh ladder compute the SAME
+# invariant):
+python scripts/token_invariant.py "$OUT/corpus-1g" "$OUT/wcstream-1g-wd" \
+  >> "$OUT/wcstream-1g.log" 2>&1
 log "wcstream-1g invariant: $(tail -n 1 "$OUT/wcstream-1g.log")"
 
 log "evidence collection done"

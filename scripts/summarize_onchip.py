@@ -122,6 +122,11 @@ def main() -> None:
     print(_bench_line(f"{out}/benchA.json"))
     print("bench B (repeat):")
     print(_bench_line(f"{out}/benchB.json"))
+    if os.path.exists(f"{out}/benchC.json"):
+        print("bench C (full: transport probe + stream row):")
+        print(_bench_line(f"{out}/benchC.json"))
+    if os.path.isdir(f"{out}/done"):
+        print("ladder steps done:", " ".join(sorted(os.listdir(f"{out}/done"))))
     print("wire probe (probe_tunnel.py tail):")
     print(_tail(f"{out}/probe_tunnel.log"))
     for name in ("tpu_wc", "tpu_grep", "tpu_grep_literal", "tpu_indexer",

@@ -31,6 +31,15 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--file-size", type=int, default=300000,
                     help="harness split size (test_mr.sh ensure_corpus)")
+    ap.add_argument("--phase", choices=("harness", "stream", "all"),
+                    default="all",
+                    help="which program group to warm: 'harness' = the "
+                         "per-task worker kernels test_mr.sh runs touch; "
+                         "'stream' = the streaming step/pack programs; "
+                         "'all' = both.  Remote compiles cost tens of "
+                         "minutes EACH on the axon tunnel, so the ladder "
+                         "(warm_loop.sh) warms the group it is about to "
+                         "collect evidence with, not everything up front.")
     args = ap.parse_args()
 
     from dsi_tpu.utils.corpus import ensure_corpus
@@ -48,51 +57,57 @@ def main() -> int:
     print(f"devices={jax.devices()}", flush=True)
 
     from dsi_tpu.backends import aotcache
-    from dsi_tpu.ops.grepk import grep_host_result
-    from dsi_tpu.ops.wordcount import count_words_host_result
 
-    t0 = time.perf_counter()
-    res = count_words_host_result(raw)
-    assert res is not None and len(res) > 0
-    print(f"wc kernel ({len(raw)} B split): {time.perf_counter() - t0:.1f}s "
-          f"{len(res)} uniques", flush=True)
+    if args.phase in ("harness", "all"):
+        from dsi_tpu.ops.grepk import grep_host_result
+        from dsi_tpu.ops.wordcount import count_words_host_result
 
-    t0 = time.perf_counter()
-    lines = grep_host_result(raw, "the")
-    assert lines is not None
-    print(f"grep kernel: {time.perf_counter() - t0:.1f}s "
-          f"{len(lines)} matching lines", flush=True)
+        t0 = time.perf_counter()
+        res = count_words_host_result(raw)
+        assert res is not None and len(res) > 0
+        print(f"wc kernel ({len(raw)} B split): "
+              f"{time.perf_counter() - t0:.1f}s "
+              f"{len(res)} uniques", flush=True)
 
-    # Class-pattern grep kernel at the same shape — the tpu_grep harness
-    # default pattern ([Tt]he, ops/regexk.py).
-    from dsi_tpu.ops.regexk import classgrep_host_result
+        t0 = time.perf_counter()
+        lines = grep_host_result(raw, "the")
+        assert lines is not None
+        print(f"grep kernel: {time.perf_counter() - t0:.1f}s "
+              f"{len(lines)} matching lines", flush=True)
 
-    t0 = time.perf_counter()
-    clines = classgrep_host_result(raw, "[Tt]he")
-    assert clines is not None
-    print(f"classgrep kernel: {time.perf_counter() - t0:.1f}s "
-          f"{len(clines)} matching lines", flush=True)
+        # Class-pattern grep kernel at the same shape — the tpu_grep
+        # harness default pattern ([Tt]he, ops/regexk.py).
+        from dsi_tpu.ops.regexk import classgrep_host_result
 
-    # Stream-row programs: bench.py runs wordcount_streaming(aot=True,
-    # chunk_bytes=1<<20, u_cap=1<<14) on the single real device, and
-    # onchip_evidence.sh's wcstream step pins --u-cap 16384 to the same
-    # rungs — keep caps here in lockstep with BOTH.  Warm the start rung
-    # plus one x4 widening (per-chunk vocabulary can cross 16384).
-    from dsi_tpu.parallel.shuffle import default_mesh
-    from dsi_tpu.parallel.streaming import warm_stream_aot
+        t0 = time.perf_counter()
+        clines = classgrep_host_result(raw, "[Tt]he")
+        assert clines is not None
+        print(f"classgrep kernel: {time.perf_counter() - t0:.1f}s "
+              f"{len(clines)} matching lines", flush=True)
 
-    t0 = time.perf_counter()
-    mesh = default_mesh()
-    warm_stream_aot(mesh=mesh, chunk_bytes=1 << 20,
-                    caps=(1 << 14, 1 << 16))
-    # The GB-scale on-chip stream (onchip_evidence.sh step 9) uses 4 MiB
-    # chunks so per-step wire latency amortizes over 4x the bytes.  Warm
-    # one rung past the corpus's measured worst chunk (~64.3k uniques vs
-    # the 65,536 rung — 1.8% headroom, and file ordering can shift it):
-    # a widening retry on the chip must load, never cold-compile.
-    warm_stream_aot(mesh=mesh, chunk_bytes=1 << 22,
-                    caps=(1 << 14, 1 << 16, 1 << 18))
-    print(f"stream programs: {time.perf_counter() - t0:.1f}s", flush=True)
+    if args.phase in ("stream", "all"):
+        # Stream-row programs: bench.py runs wordcount_streaming(aot=True,
+        # chunk_bytes=1<<20, u_cap=1<<14) on the single real device, and
+        # onchip_evidence.sh's wcstream step pins --u-cap 16384 to the same
+        # rungs — keep caps here in lockstep with BOTH.  Warm the start
+        # rung plus one x4 widening (per-chunk vocabulary can cross 16384).
+        from dsi_tpu.parallel.shuffle import default_mesh
+        from dsi_tpu.parallel.streaming import warm_stream_aot
+
+        t0 = time.perf_counter()
+        mesh = default_mesh()
+        warm_stream_aot(mesh=mesh, chunk_bytes=1 << 20,
+                        caps=(1 << 14, 1 << 16))
+        # The GB-scale on-chip stream (onchip_evidence.sh step 9) uses
+        # 4 MiB chunks so per-step wire latency amortizes over 4x the
+        # bytes.  Warm one rung past the corpus's measured worst chunk
+        # (~64.3k uniques vs the 65,536 rung — 1.8% headroom, and file
+        # ordering can shift it): a widening retry on the chip must load,
+        # never cold-compile.
+        warm_stream_aot(mesh=mesh, chunk_bytes=1 << 22,
+                        caps=(1 << 14, 1 << 16, 1 << 18))
+        print(f"stream programs: {time.perf_counter() - t0:.1f}s",
+              flush=True)
 
     print(f"aot stats: {aotcache.stats}", flush=True)
     return 0

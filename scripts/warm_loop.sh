@@ -1,64 +1,189 @@
 #!/usr/bin/env bash
-# Retry the AOT-cache warmer until the device claim clears, then stop.
+# Value-ordered warm + evidence LADDER for the axon tunnel.
 #
-# Each attempt is bench.py's --tpu-child run to completion (never killed —
-# a SIGKILLed client mid-claim is itself a wedge hazard, BASELINE.md).  A
-# failed init exits cleanly with an error verdict; we sleep and retry.
-# Success = warm-result.json with no "error" key, meaning both corpus_wc
-# executables are compiled AND persisted in .aotcache for every later
-# process (driver bench runs included).
+# Remote compiles cost tens of minutes EACH (outage #3 died inside ONE
+# 28-minute compile), and recovery windows have lasted ~30 minutes — so
+# the earlier "warm all ~19 programs, then collect evidence" sequencing
+# could starve forever.  This ladder interleaves: each phase warms only
+# the programs its evidence needs, then captures that evidence
+# immediately.  Completed steps are marker-gated ($EV/done/<step>), so
+# any restart resumes at the first missing artifact; warm steps are
+# idempotent-cheap once their executables are in the AOT cache.
+#
+#   A1  warm the raw corpus program   (bench --tpu-child, TRANSPORT=raw)
+#   A2  bench A: fresh process, raw-only, no stream row — the headline
+#       number + the AOT-hit proof (compile_s≈0, aot_loads≥1)
+#   A3  bench B: repeatability sample
+#   A4  wire-ceiling probe (probe_tunnel.py)
+#   B1  warm the harness worker kernels (warm_kernels --phase harness)
+#   B2-B6  full-framework harness on-chip: tpu_wc, tpu_grep (class),
+#          tpu_grep (literal), tpu_indexer, tfidf
+#   C1  warm pack6 corpus program + stream programs
+#   C2  bench C: full run — transport probe + stream row
+#   C3  wcstream --check on the chip     C4  wcstream ~1 GB + invariant
+#
+# Evidence lands in $EV with onchip_evidence.sh-compatible filenames so
+# scripts/summarize_onchip.py reads it unchanged.  Single-tenant: steps
+# run strictly sequentially; nothing else may touch the chip.
+#
+# Usage: warm_loop.sh [OUT=/tmp/warm_loop] [BUDGET_S=14400] [EV=/tmp/onchip/ladder]
 set -u
 REPO=$(cd "$(dirname "$0")/.." && pwd)
 cd "$REPO"
 OUT=${1:-/tmp/warm_loop}
-mkdir -p "$OUT"
-DEADLINE=$(( $(date +%s) + ${2:-7200} ))
-n=0
-while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  n=$((n + 1))
-  echo "$(date -u +%H:%M:%S) attempt $n" >> "$OUT/log"
-  # Stale results must not masquerade as this attempt's verdict.
+DEADLINE=$(( $(date +%s) + ${2:-14400} ))
+EV=${3:-/tmp/onchip/ladder}
+# Resume-vs-isolation: an INCOMPLETE ladder must resume in place (the
+# markers are the whole point), but a COMPLETED one must not be silently
+# "re-run" as an instant exit-0, nor overwritten — archive it and start
+# fresh (fresh evidence against a warm cache is cheap and useful).
+if [ -f "$EV/done/C4" ]; then
+  mv "$EV" "$EV-$(date -u +%m%dT%H%M%S)"
+fi
+mkdir -p "$OUT" "$EV/done"
+
+log() { echo "$(date -u +%H:%M:%S) $*" >> "$OUT/log"; }
+left() { echo $(( DEADLINE - $(date +%s) )); }
+
+# A stale ambient platform pin would silently turn every step below into
+# a host run with green-looking logs; a leaked DSI_GREP_PATTERN would
+# demote the class-pattern grep run to the literal kernel.
+log "ladder start; ambient pins: JAX_PLATFORMS='${JAX_PLATFORMS:-}' DSI_JAX_PLATFORM='${DSI_JAX_PLATFORM:-}' DSI_GREP_PATTERN='${DSI_GREP_PATTERN:-}'"
+unset JAX_PLATFORMS DSI_JAX_PLATFORM DSI_GREP_PATTERN
+
+bench_ok() {  # $1 = json path: a SUCCESSFUL TPU verdict, not an error,
+              # fallback, or parity failure.  bench.py emits permanent
+              # errors and parity mismatches as metric=wc_tpu_throughput
+              # with value=0 and an "error" key but NO "tpu_error", so
+              # both keys must be absent (mirrors summarize_onchip.py's
+              # _valid_tpu_verdict).
+  grep -q '"metric": "wc_tpu_throughput"' "$1" 2>/dev/null && \
+  ! grep -q '"tpu_error"' "$1" && \
+  ! grep -q '"error"' "$1"
+}
+
+step_A1() {
   rm -f "$REPO/.bench/warm-result.json" "$REPO/.bench/warm-result.json.init"
-  # Bounded attempt, two layers: the child's own init watchdog
-  # (DSI_CHILD_INIT_TIMEOUT) converts a wedged-claim init hang into a
-  # clean error verdict in 4 min — so during an outage the loop cycles
-  # quickly — while the outer timeout only backstops a post-init hang;
-  # 3600 s covers any plausible cold compile, and TERM (not KILL) lets
-  # the child's handler unwind the claim cleanly.
-  # WARM_ALL: the warm child's whole job is compiling BOTH transports
-  # into the persistent cache (a plain bench skips a non-cached pack6 to
-  # protect its budget — this is the one process that must not skip it).
-  DSI_BENCH_WARM_ALL=1 DSI_CHILD_INIT_TIMEOUT=240 timeout -k 30s 3600s \
-    python -u bench.py \
+  # TERM (not KILL) on timeout lets a post-init child unwind its claim;
+  # the child's own init watchdog turns an outage into a clean error
+  # verdict in 4 min, so closed-port periods cycle fast.
+  DSI_BENCH_TRANSPORT=raw DSI_BENCH_STREAM_MB=0 DSI_CHILD_INIT_TIMEOUT=240 \
+    timeout -k 30s 3600s python -u bench.py \
     --tpu-child "$REPO/.bench/warm-result.json" >> "$OUT/attempt.log" 2>&1
-  if [ -f "$REPO/.bench/warm-result.json" ] && \
-     ! grep -q '"error"' "$REPO/.bench/warm-result.json"; then
-    echo "$(date -u +%H:%M:%S) corpus_wc warm after $n attempts" >> "$OUT/log"
-    # Also warm the per-task worker kernels the on-chip harness runs use
-    # (tpu_wc / tpu_grep map shapes; see scripts/warm_kernels.py).
-    # 7200 s: round 4 widened the warm set to ~17 programs (worker
-    # kernels + both grep tiers + stream shapes at 1 MiB and 4 MiB
-    # chunks); remote axon compiles can run minutes each.
-    if timeout -k 30s 7200s python scripts/warm_kernels.py \
-        >> "$OUT/kernels.log" 2>&1; then
-      echo "$(date -u +%H:%M:%S) worker kernels warm" >> "$OUT/log"
-      # Chain into the round's on-chip evidence collection (two bench
-      # runs + on-chip harness runs) ONLY with a fully warm cache: a
-      # cold-compile worker under the harness's 180 s timeout would be
-      # SIGKILLed mid-claim — the wedge hazard again.  Per-run stamped
-      # dir so a later round can't overwrite this round's evidence.
-      EV="/tmp/onchip/$(date -u +%m%dT%H%M%S)"
-      bash scripts/onchip_evidence.sh "$EV" >> "$OUT/log" 2>&1
-      echo "$(date -u +%H:%M:%S) onchip evidence done (see $EV)" >> "$OUT/log"
+  [ -f "$REPO/.bench/warm-result.json" ] && \
+    ! grep -q '"error"' "$REPO/.bench/warm-result.json"
+}
+
+step_A2() {
+  DSI_BENCH_STREAM_MB=0 DSI_CHILD_INIT_TIMEOUT=150 timeout -k 30s 2700s \
+    python bench.py > "$EV/benchA.json" 2> "$EV/benchA.err"
+  bench_ok "$EV/benchA.json"
+}
+
+step_A3() {
+  DSI_BENCH_STREAM_MB=0 DSI_CHILD_INIT_TIMEOUT=150 timeout -k 30s 2700s \
+    python bench.py > "$EV/benchB.json" 2> "$EV/benchB.err"
+  bench_ok "$EV/benchB.json"
+}
+
+step_A4() {
+  timeout -k 30s 900s python scripts/probe_tunnel.py --mb 8 \
+    > "$EV/probe_tunnel.log" 2>&1
+}
+
+step_B1() {
+  timeout -k 30s 7200s python scripts/warm_kernels.py --phase harness \
+    >> "$OUT/kernels.log" 2>&1
+}
+
+harness() {  # $1 = app, $2 = log name, [$3 = DSI_GREP_PATTERN]
+  if [ -n "${3:-}" ]; then
+    { time DSI_GREP_PATTERN="$3" bash scripts/test_mr.sh "$1" tpu ; } \
+      > "$EV/$2" 2>&1
+  else
+    { time bash scripts/test_mr.sh "$1" tpu ; } > "$EV/$2" 2>&1
+  fi
+  grep -q "PASS" "$EV/$2"
+}
+
+step_B2() { harness tpu_wc harness_tpu_wc.log; }
+step_B3() { harness tpu_grep harness_tpu_grep.log; }
+step_B4() { harness tpu_grep harness_tpu_grep_literal.log the; }
+step_B5() { harness tpu_indexer harness_tpu_indexer.log; }
+step_B6() { harness tfidf harness_tfidf.log; }
+
+step_C1() {
+  rm -f "$REPO/.bench/warm-result.json" "$REPO/.bench/warm-result.json.init"
+  # WARM_ALL compiles the pack6 program (raw loads from cache in ms).
+  DSI_BENCH_WARM_ALL=1 DSI_BENCH_STREAM_MB=0 DSI_CHILD_INIT_TIMEOUT=240 \
+    timeout -k 30s 3600s python -u bench.py \
+    --tpu-child "$REPO/.bench/warm-result.json" >> "$OUT/attempt.log" 2>&1
+  { [ -f "$REPO/.bench/warm-result.json" ] && \
+    ! grep -q '"error"' "$REPO/.bench/warm-result.json"; } || return 1
+  timeout -k 30s 7200s python scripts/warm_kernels.py --phase stream \
+    >> "$OUT/kernels.log" 2>&1
+}
+
+step_C2() {
+  DSI_CHILD_INIT_TIMEOUT=150 timeout -k 30s 2700s \
+    python bench.py > "$EV/benchC.json" 2> "$EV/benchC.err"
+  # This step exists for the FULL verdict: a skipped or parity-failed
+  # stream row must not be marked done (the headline alone is bench A/B).
+  bench_ok "$EV/benchC.json" && \
+  ! grep -q '"stream_skipped"' "$EV/benchC.json" && \
+  grep -q '"stream_parity": true' "$EV/benchC.json"
+}
+
+step_C3() {
+  python -c "from dsi_tpu.utils.corpus import ensure_corpus; \
+             print(ensure_corpus('$EV/corpus', n_files=4))" \
+    > "$EV/corpus.log" 2>&1 || return 1
+  mkdir -p "$EV/wcstream-wd"
+  # --u-cap 16384 + --aot in lockstep with warm_kernels' stream rungs.
+  timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
+    --aot --u-cap 16384 \
+    --workdir "$EV/wcstream-wd" "$EV"/corpus/pg-*.txt \
+    > "$EV/wcstream.log" 2>&1
+}
+
+step_C4() {
+  python -c "from dsi_tpu.utils.corpus import ensure_corpus; \
+             ensure_corpus('$EV/corpus-1g', n_files=1024, file_size=1048576)" \
+    > "$EV/corpus-1g.log" 2>&1 || return 1
+  mkdir -p "$EV/wcstream-1g-wd"
+  rm -f "$EV/wcstream-1g-wd"/mr-out-*
+  { time timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --devices 1 \
+      --aot --u-cap 16384 --chunk-bytes 4194304 \
+      --workdir "$EV/wcstream-1g-wd" "$EV"/corpus-1g/pg-*.txt ; } \
+    > "$EV/wcstream-1g.log" 2>&1 || return 1
+  # Total-token invariant: one-pass host count catches gross miscounts.
+  python scripts/token_invariant.py "$EV/corpus-1g" "$EV/wcstream-1g-wd" \
+    >> "$EV/wcstream-1g.log" 2>&1
+}
+
+STEPS="A1 A2 A3 A4 B1 B2 B3 B4 B5 B6 C1 C2 C3 C4"
+while [ "$(left)" -gt 120 ]; do
+  progressed=0
+  for s in $STEPS; do
+    [ -f "$EV/done/$s" ] && continue
+    log "step $s start (budget left $(left)s)"
+    if "step_$s"; then
+      touch "$EV/done/$s"
+      log "step $s DONE"
+      progressed=1
     else
-      echo "$(date -u +%H:%M:%S) warm_kernels FAILED (see kernels.log);" \
-           "skipping on-chip evidence chain" >> "$OUT/log"
+      log "step $s failed; backing off 120s"
+      sleep 120
+      break
     fi
+  done
+  if [ -f "$EV/done/C4" ]; then
+    log "ladder COMPLETE (evidence in $EV)"
     exit 0
   fi
-  tail -c 300 "$REPO/.bench/warm-result.json" >> "$OUT/log" 2>/dev/null
-  echo >> "$OUT/log"
-  sleep 120
+  # A full pass with zero progress and no failure cannot happen (the
+  # first missing step either succeeds or fails), but guard anyway.
+  [ "$progressed" = 0 ] && sleep 60
 done
-echo "$(date -u +%H:%M:%S) gave up (deadline)" >> "$OUT/log"
+log "deadline reached; done so far: $(ls "$EV/done" 2>/dev/null | tr '\n' ' ')"
 exit 1
