@@ -83,6 +83,13 @@ N_FILES = int(os.environ.get("DSI_BENCH_FILES", "8"))
 FILE_SIZE = int(os.environ.get("DSI_BENCH_FILE_SIZE",
                                str((2 << 20) - 64)))  # pads to 2^21 on device
 N_REDUCE = 10
+# Stream-row program shape — ONE definition shared by the cache-existence
+# gate and the wordcount_streaming call in run_stream_row, so the probed
+# key cannot drift from the key the run compiles (these must also stay in
+# lockstep with scripts/warm_kernels.py --phase stream and
+# onchip_evidence.sh's --u-cap).
+STREAM_CHUNK_BYTES = 1 << 20
+STREAM_U_CAP = 1 << 14
 # Overridable so tests (and ad-hoc small-corpus runs) don't overwrite the
 # canonical .bench corpus/oracle the warm loop's parity checks rely on.
 WORKDIR = (os.environ.get("DSI_BENCH_WORKDIR")
@@ -409,7 +416,29 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
                 "stream row runs only against a warm AOT cache"}
 
     from dsi_tpu.parallel.shuffle import default_mesh
-    from dsi_tpu.parallel.streaming import stream_files, wordcount_streaming
+    from dsi_tpu.parallel.streaming import (stream_files,
+                                            stream_programs_persisted,
+                                            wordcount_streaming)
+
+    # Same discipline as the pack6 transport: on the tunnel platform a
+    # cold stream-program compile costs tens of minutes — never gamble a
+    # bench window on it; compiling these is the warm ladder's phase-C
+    # job (scripts/warm_kernels.py --phase stream).  Exempt: CPU
+    # processes (the fallback path, tests — compiles in seconds) and
+    # multi-device meshes (the AOT cache is by-design unused there, so
+    # the probe could never pass and in-process compile is the only
+    # path — the pre-gate behavior).
+    import jax
+
+    if (jax.devices()[0].platform != "cpu"
+            and len(jax.devices()) == 1
+            and os.environ.get("DSI_BENCH_WARM_ALL") != "1"
+            and not stream_programs_persisted(
+                chunk_bytes=STREAM_CHUNK_BYTES, u_cap=STREAM_U_CAP,
+                n_reduce=N_REDUCE)):
+        return {"stream_skipped":
+                "stream programs not in the AOT cache (cold compile "
+                "risk); warm via scripts/warm_kernels.py --phase stream"}
     from dsi_tpu.utils.tracing import Span
 
     corpus_bytes = sum(os.path.getsize(p) for p in files)
@@ -424,8 +453,8 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
     mesh = default_mesh()
     with Span("bench.stream") as pt:
         acc = wordcount_streaming(blocks(), mesh=mesh, n_reduce=N_REDUCE,
-                                  chunk_bytes=1 << 20, u_cap=1 << 14,
-                                  aot=True)
+                                  chunk_bytes=STREAM_CHUNK_BYTES,
+                                  u_cap=STREAM_U_CAP, aot=True)
     dt = pt.elapsed_s
     if acc is None:
         return {"stream_skipped": "stream needed the host path "

@@ -129,17 +129,12 @@ def stream_files(paths: Sequence[str],
                 yield b
 
 
-def _aot_step_fn(example_chunks, *, n_dev: int, n_reduce: int,
-                 max_word_len: int, u_cap: int, mesh: Mesh,
-                 t_cap_frac: int):
-    """Compiled ``mapreduce_step`` via the persistent AOT executable cache
-    (``backends/aotcache.py``) — for single-device bench processes on the
-    axon platform, where a fresh-process ``jax.jit`` pays a remote compile
-    that JAX's own persistent cache never absorbs (VERDICT r2 weakness
-    #1a).  Multi-device meshes compile in-process (the cache auto-disables
-    disk persistence there).  ``example_chunks`` may be a
-    ``ShapeDtypeStruct`` (warming compiles without executing)."""
-    from dsi_tpu.backends import aotcache
+def _step_program(*, n_dev: int, n_reduce: int, max_word_len: int,
+                  u_cap: int, mesh: Mesh, t_cap_frac: int):
+    """The (name, fn, code-deps) triple for one compiled
+    ``mapreduce_step`` shape — single definition shared by the
+    cached-compile path, the warmer, and the cache-existence probe, so a
+    probe's key is by construction the key a run compiles."""
     import dsi_tpu.ops.wordcount as _wc
     import dsi_tpu.parallel.shuffle as _sh
 
@@ -151,6 +146,20 @@ def _aot_step_fn(example_chunks, *, n_dev: int, n_reduce: int,
     fn._aot_code_deps = (_wc, _sh)
     name = (f"stream_step_d{n_dev}_r{n_reduce}_w{max_word_len}"
             f"_u{u_cap}_f{t_cap_frac}")
+    return name, fn
+
+
+def _aot_step_fn(example_chunks, **kw):
+    """Compiled ``mapreduce_step`` via the persistent AOT executable cache
+    (``backends/aotcache.py``) — for single-device bench processes on the
+    axon platform, where a fresh-process ``jax.jit`` pays a remote compile
+    that JAX's own persistent cache never absorbs (VERDICT r2 weakness
+    #1a).  Multi-device meshes compile in-process (the cache auto-disables
+    disk persistence there).  ``example_chunks`` may be a
+    ``ShapeDtypeStruct`` (warming compiles without executing)."""
+    from dsi_tpu.backends import aotcache
+
+    name, fn = _step_program(**kw)
     return aotcache.cached_compile(name, fn, (example_chunks,))
 
 
@@ -158,17 +167,72 @@ def _aot_step(chunks, **kw):
     return _aot_step_fn(chunks, **kw)(chunks)
 
 
-def _aot_pack_fn(example_args, *, mp: int):
-    """Compiled ``shuffle._slice_pack`` via the AOT cache (same rationale
-    as :func:`_aot_step_fn`).  ``example_args`` may be shape structs."""
-    from dsi_tpu.backends import aotcache
+def _pack_program(*, mp: int):
+    """(name, fn) for one compiled ``shuffle._slice_pack`` shape — shared
+    like :func:`_step_program`."""
     import dsi_tpu.parallel.shuffle as _sh
 
     def fn(k, l, c, p):
         return _slice_pack(k, l, c, p, mp=mp)
 
     fn._aot_code_deps = (_sh,)
-    return aotcache.cached_compile(f"stream_pack_m{mp}", fn, example_args)
+    return f"stream_pack_m{mp}", fn
+
+
+def _aot_pack_fn(example_args, *, mp: int):
+    """Compiled ``shuffle._slice_pack`` via the AOT cache (same rationale
+    as :func:`_aot_step_fn`).  ``example_args`` may be shape structs."""
+    from dsi_tpu.backends import aotcache
+
+    name, fn = _pack_program(mp=mp)
+    return aotcache.cached_compile(name, fn, example_args)
+
+
+def _stream_examples(n_dev: int, chunk_bytes: int, u_cap: int,
+                     max_word_len: int):
+    """Shape structs for the step input and pack inputs at one rung."""
+    import jax
+
+    sds = jax.ShapeDtypeStruct
+    chunks = sds((n_dev, chunk_bytes), jnp.uint8)
+    rows = n_dev * u_cap
+    kk = max_word_len // 4
+    pack_args = (sds((n_dev, rows, kk), jnp.uint32),
+                 sds((n_dev, rows), jnp.int32),
+                 sds((n_dev, rows), jnp.int32),
+                 sds((n_dev, rows), jnp.uint32))
+    return chunks, rows, pack_args
+
+
+def stream_programs_persisted(mesh: Mesh | None = None,
+                              chunk_bytes: int = 1 << 20,
+                              n_reduce: int = 10, max_word_len: int = 16,
+                              u_cap: int = 1 << 12,
+                              fracs: Sequence[int] = (4, 2)) -> bool:
+    """True when every starting-rung program
+    ``wordcount_streaming(..., aot=True)`` would reach first (step at
+    each token-capacity frac, plus the pack program) is already in the
+    persistent AOT cache — i.e. running the stream is loads, not
+    multi-minute remote compiles.  Same role as
+    ``corpus_wc.corpus_executable_persisted``: lets a time-boxed bench
+    skip the stream row rather than gamble its budget on cold compiles
+    (capacity-widening retries beyond the start rung are not probed;
+    they are rare and the headline verdict is already durable by then)."""
+    from dsi_tpu.backends.aotcache import is_persisted
+
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    chunks, rows, pack_args = _stream_examples(n_dev, chunk_bytes, u_cap,
+                                               max_word_len)
+    for frac in fracs:
+        name, fn = _step_program(n_dev=n_dev, n_reduce=n_reduce,
+                                 max_word_len=max_word_len, u_cap=u_cap,
+                                 mesh=mesh, t_cap_frac=frac)
+        if not is_persisted(name, fn, (chunks,)):
+            return False
+    name, fn = _pack_program(mp=rows)
+    return is_persisted(name, fn, pack_args)
 
 
 def _aot_pack(keys, lens, cnts, parts, *, mp: int):
@@ -193,25 +257,18 @@ def warm_stream_aot(mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
     token-capacity ladder.  The 64-byte word-window rung is NOT warmed by
     default — it is reachable only by streams carrying >``max_word_len``
     -byte words; pass ``word_lens=(16, 64)`` if yours can."""
-    import jax
-
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
-    sds = jax.ShapeDtypeStruct
     for mwl in word_lens:
         for cap in caps:
-            chunks = sds((n_dev, chunk_bytes), jnp.uint8)
+            chunks, rows, pack_args = _stream_examples(n_dev, chunk_bytes,
+                                                       cap, mwl)
             for frac in fracs:
                 _aot_step_fn(chunks, n_dev=n_dev, n_reduce=n_reduce,
                              max_word_len=mwl, u_cap=cap, mesh=mesh,
                              t_cap_frac=frac)
-            rows = n_dev * cap
-            kk = mwl // 4
-            _aot_pack_fn((sds((n_dev, rows, kk), jnp.uint32),
-                          sds((n_dev, rows), jnp.int32),
-                          sds((n_dev, rows), jnp.int32),
-                          sds((n_dev, rows), jnp.uint32)), mp=rows)
+            _aot_pack_fn(pack_args, mp=rows)
 
 
 def wordcount_streaming(

@@ -171,3 +171,36 @@ def test_streaming_aot_path_matches_counter(tmp_path, monkeypatch):
     for w, (_, part) in res.items():
         assert part == ihash(w) % 10
     assert aotcache.stats["compiles"] == compiles_after_warm
+
+
+def test_stream_programs_persisted_probe_mirrors_warm(tmp_path):
+    """stream_programs_persisted must hit the exact keys warm_stream_aot
+    persists — a drifted mirror makes the bench silently skip its stream
+    row forever on fully-warmed machines.  Single-device subprocess:
+    persistence is disabled on the 8-device test mesh by design."""
+    import os
+    import subprocess
+    import sys
+
+    child = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from dsi_tpu.parallel.streaming import (\n"
+        "    stream_programs_persisted, warm_stream_aot)\n"
+        "kw = dict(chunk_bytes=1 << 14, u_cap=1 << 10)\n"
+        "assert not stream_programs_persisted(**kw)\n"
+        "warm_stream_aot(chunk_bytes=1 << 14, caps=(1 << 10,))\n"
+        "assert stream_programs_persisted(**kw)\n"
+        "assert not stream_programs_persisted(chunk_bytes=1 << 15,\n"
+        "                                     u_cap=1 << 10)\n"
+        "print('probe-ok')\n"
+    )
+    env = dict(os.environ)
+    env["DSI_AOT_CACHE_DIR"] = str(tmp_path / "aot")
+    env["DSI_AOT_QUIET"] = "1"
+    env.pop("XLA_FLAGS", None)  # single-device process, like the chip
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert p.stdout.strip().splitlines()[-1] == "probe-ok"
