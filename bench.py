@@ -365,6 +365,7 @@ def tpu_child(result_path: str) -> int:
     phases.update(best_phases)
     result = {"tpu_s": round(dt, 3), "tpu_mbps": round(total_mb / dt, 2),
               "median_mbps": round(total_mb / median_s, 2),
+              "total_mb": round(total_mb, 2),
               "parity": parity, "platform": platform, "phases": phases}
     # The headline verdict is complete and durable from here on: emit it
     # BEFORE the stream row so a parent timeout mid-stream still finds a
@@ -717,6 +718,9 @@ def main() -> None:
     # and the streaming-path row (or why it was skipped).
     if "median_mbps" in res:
         out["median_mbps"] = res["median_mbps"]
+    if "total_mb" in res:  # lets summarize_onchip compute the wire
+        out["total_mb"] = res["total_mb"]  # ceiling from the artifact
+
     for k in ("stream_mbps", "stream_mb", "stream_s", "stream_parity",
               "stream_skipped"):
         if k in res:

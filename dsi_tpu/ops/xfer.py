@@ -1,0 +1,71 @@
+"""H2D upload strategies for the axon tunnel (the bench's real wall).
+
+The tunnel's fastest upload geometry depends on link health, and the two
+states differ by >10x in opposite directions (scripts/probe_tunnel.py):
+
+* healthy wire (round 3): pieced-ASYNC transfers pipeline — 16 x 1 MiB
+  observed at 1.2 GB/s once, 29 MB/s under congestion, vs 20-150 MB/s
+  single-shot.  Dispatch-all-then-block is the fast path.
+* degraded wire (2026-07-31 03:16 UTC): concurrent streams thrash the
+  constrained link — 8 x 1 MiB async measured 0.6 MB/s vs 5.8 MB/s for
+  one single-shot put.  One-transfer-in-flight recovers the rate.
+
+Neither geometry is safe to hardcode, so the upload mode is a runtime
+switch (no program shapes change, no AOT entry is re-fingerprinted by
+choosing differently):
+
+* ``DSI_UPLOAD_MODE=async`` (default) — dispatch every piece before any
+  sync, then block until all have landed.
+* ``DSI_UPLOAD_MODE=sync`` — serialize: put + block one piece at a time.
+
+Integration is staged behind the in-flight evidence ladder (editing
+``corpus_wc.py`` re-fingerprints its AOT entries, so the call-site swap
+lands right before the ladder's C1 re-warm): ``corpus_wc`` will route
+its piece upload through :func:`put_views`, and ``bench.py`` will probe
+both modes on its first reps (like its raw-vs-pack6 transport probe),
+commit the rest to the winner, and report ``stats``' wall time as an
+``upload_s`` phase instead of letting it hide inside ``kernel_s``.
+Until then this module is exercised by its tests only.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, List, Sequence
+
+#: Last-upload telemetry (per process, overwritten each call).
+stats = {"upload_s": 0.0, "upload_mode": "async"}
+
+
+def upload_mode() -> str:
+    mode = os.environ.get("DSI_UPLOAD_MODE", "async")
+    return mode if mode in ("async", "sync") else "async"
+
+
+def put_views(views: Sequence[Any], device=None) -> List[Any]:
+    """Transfer ``views`` (host arrays) to ``device`` (default: JAX's
+    default device), honoring ``DSI_UPLOAD_MODE``, and record the wall
+    time in ``stats``.  Returns device arrays in input order.
+
+    Blocking before return costs nothing real in either mode — a
+    consuming program cannot start until all its arguments have landed —
+    and gives callers an honest upload phase boundary.
+    """
+    import jax
+
+    mode = upload_mode()
+    t0 = time.perf_counter()
+    if mode == "sync":
+        out = []
+        for v in views:
+            d = (jax.device_put(v, device) if device is not None
+                 else jax.device_put(v))
+            d.block_until_ready()
+            out.append(d)
+    else:
+        out = (jax.device_put(list(views), device) if device is not None
+               else jax.device_put(list(views)))
+        jax.block_until_ready(out)
+    stats["upload_s"] = round(time.perf_counter() - t0, 3)
+    stats["upload_mode"] = mode
+    return list(out)

@@ -67,6 +67,32 @@ def main() -> int:
     print(f"H2D {args.mb} x 1 MiB async: {t:.3f}s  {n / t / 1e6:8.1f} MB/s",
           flush=True)
 
+    # Sequential (sync) piecing: one transfer in flight at a time.  On a
+    # DEGRADED tunnel the async pipeline has measured 10x SLOWER than one
+    # single-shot put (2026-07-31: 0.6 vs 5.8 MB/s) — concurrent streams
+    # appear to thrash the constrained link; this row shows whether
+    # serializing the pieces recovers the single-shot rate, which decides
+    # if corpus_wc needs a probe-selected upload mode.
+    t0 = time.perf_counter()
+    for p in pieces:
+        jax.device_put(p, dev).block_until_ready()
+    t = time.perf_counter() - t0
+    print(f"H2D {args.mb} x 1 MiB sync : {t:.3f}s  {n / t / 1e6:8.1f} MB/s",
+          flush=True)
+
+    # 2 MiB async pieces — corpus_wc's actual upload geometry (pack_pieces
+    # caps piece_size at 1 << 21), so this row is the bench's real H2D rate.
+    if args.mb >= 2:
+        p2 = [host[i << 21:(i + 1) << 21] for i in range(args.mb // 2)]
+        n2 = len(p2) << 21  # bytes actually transferred (odd --mb drops one)
+        t0 = time.perf_counter()
+        ds = jax.device_put(p2, dev)
+        for d in ds:
+            d.block_until_ready()
+        t = time.perf_counter() - t0
+        print(f"H2D {len(p2)} x 2 MiB async: {t:.3f}s  "
+              f"{n2 / t / 1e6:8.1f} MB/s", flush=True)
+
     # D2H of a fresh kernel output (no _npy_value cache)
     src = jax.device_put(host[:n // 4].view(np.uint32), dev)
     src.block_until_ready()

@@ -1,0 +1,40 @@
+"""Upload-mode transfer helper (ops/xfer.py): both modes move the same
+bytes, stats record the wall, and bad env values fall back to async."""
+
+import numpy as np
+import pytest
+
+from dsi_tpu.ops import xfer
+
+
+@pytest.fixture()
+def views():
+    rng = np.random.default_rng(7)
+    return [rng.integers(0, 255, size=1 << 12, dtype=np.uint8)
+            for _ in range(3)]
+
+
+@pytest.mark.parametrize("mode", ["async", "sync"])
+def test_put_views_roundtrip(views, mode, monkeypatch):
+    monkeypatch.setenv("DSI_UPLOAD_MODE", mode)
+    out = xfer.put_views(views)
+    assert len(out) == len(views)
+    for host, dev in zip(views, out):
+        np.testing.assert_array_equal(host, np.asarray(dev))
+    assert xfer.stats["upload_mode"] == mode
+    assert xfer.stats["upload_s"] >= 0.0
+
+
+def test_bad_mode_falls_back_to_async(views, monkeypatch):
+    monkeypatch.setenv("DSI_UPLOAD_MODE", "banana")
+    xfer.put_views(views)
+    assert xfer.stats["upload_mode"] == "async"
+
+
+def test_explicit_device(views, monkeypatch):
+    import jax
+
+    monkeypatch.setenv("DSI_UPLOAD_MODE", "sync")
+    dev = jax.devices()[0]
+    out = xfer.put_views(views, device=dev)
+    assert all(list(d.devices()) == [dev] for d in out)
