@@ -2,11 +2,13 @@
 
 Same job and output as ``grep`` (the working realization of the reference's
 ``mrapps/dgrep.go`` intent — see apps/grep.py): Map emits ``{line, ""}`` per
-matching line, Reduce counts occurrences.  Two device tiers: a plain ASCII
-literal ``DSI_GREP_PATTERN`` runs as the shifted-compare kernel
+matching line, Reduce counts occurrences.  Three device tiers: a plain
+ASCII literal ``DSI_GREP_PATTERN`` runs as the shifted-compare kernel
 (``ops/grepk.py``); fixed-length class patterns (``[Tt]he``, ``w.rd``,
 ``^\\d\\d`` …) run as the range-compare kernel (``ops/regexk.py``);
-anything wider falls back to the host Map.
+top-level alternations of those (``the|and``, ``[Cc]at|[Dd]og``) run one
+kernel pass per branch with line flags OR-ed (``ops/altk.py``); anything
+wider falls back to the host Map.
 """
 
 from __future__ import annotations
@@ -19,6 +21,7 @@ from dsi_tpu.mr.types import KeyValue
 
 
 def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
+    from dsi_tpu.ops.altk import altgrep_host_result
     from dsi_tpu.ops.grepk import grep_host_result
     from dsi_tpu.ops.regexk import classgrep_host_result
 
@@ -26,6 +29,8 @@ def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
     lines = grep_host_result(raw, pattern)
     if lines is None:
         lines = classgrep_host_result(raw, pattern)
+    if lines is None:
+        lines = altgrep_host_result(raw, pattern)
     if lines is None:
         return None
     return [KeyValue(line, "") for line in lines]

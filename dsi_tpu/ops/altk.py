@@ -1,0 +1,123 @@
+"""TPU grep tier 3: top-level alternation of fixed-length branches.
+
+Widens the device scope one more step past ``ops/regexk.py`` (VERDICT r3
+weakness #6): a pattern that is a top-level ``|``-alternation whose every
+branch is itself device-eligible — a plain literal (``ops/grepk.py``) or a
+fixed-length class pattern (``ops/regexk.py``) — runs as one kernel pass
+PER BRANCH with the per-line flags OR-ed on device.  ``the|and``,
+``[Cc]at|[Dd]og``, ``^\\d\\d|total`` all land here; variable-length
+operators, groups, or an ineligible branch still fall back to the host app
+(``backends/tpu.py`` contract: correctness never depends on a kernel).
+
+Python ``re`` semantics hold exactly: alternation binds loosest, so
+``re.search(a|b, line)`` is ``search(a) or search(b)`` per line, i.e. the
+elementwise max of the branches' line-flag vectors; per-branch anchors
+(``^a|b$`` parses as ``(^a)|(b$)``) are handled by each branch's own
+parser.  No new kernels and no new AOT entries beyond the branch programs
+themselves — an alternation of already-warmed branch shapes reuses their
+cached executables as-is.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from dsi_tpu.ops.grepk import (
+    _grep_jit,
+    is_literal_pattern,
+    lines_from_flags,
+    retry_line_caps,
+)
+from dsi_tpu.ops.regexk import _classgrep_compiled, parse_class_pattern
+from dsi_tpu.ops.wordcount import _pad_pow2
+
+
+def split_alternation(pat: str) -> Optional[List[str]]:
+    """Split ``pat`` on top-level ``|`` into >= 2 non-empty branches, or
+    None when it isn't a plain alternation: no unescaped ``|`` outside a
+    ``[...]`` class (``|`` inside a class is a literal), an empty branch
+    (``a|`` — the empty regex matches every line; host handles it), or an
+    unterminated class."""
+    branches, cur, in_class, i = [], [], False, 0
+    while i < len(pat):
+        c = pat[i]
+        if c == "\\" and i + 1 < len(pat):
+            cur += [c, pat[i + 1]]
+            i += 2
+            continue
+        if c == "[" and not in_class:
+            in_class = True
+        elif c == "]" and in_class:
+            in_class = False
+        elif c == "|" and not in_class:
+            branches.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    branches.append("".join(cur))
+    if in_class or len(branches) < 2 or any(not b for b in branches):
+        return None
+    # Duplicate branches add kernel passes but never change the OR.
+    return list(dict.fromkeys(branches))
+
+
+def _branch_flags(chunk, n_data: int, n_host_lines: int, branch: str,
+                  l_cap: int):
+    """(line_match, n_lines, overflow) for one branch at one rung —
+    literal branches via the shifted-compare kernel, class branches via
+    the range-compare kernel.  A literal longer than the DATA (not the
+    padded chunk: padding is zeros, unmatchable by printable literals)
+    cannot match; its flags are zero without compiling a dead kernel."""
+    if is_literal_pattern(branch):
+        if len(branch) > n_data:
+            return (jnp.zeros(l_cap, jnp.int32), jnp.int32(n_host_lines),
+                    jnp.bool_(n_host_lines > l_cap))
+        pat = jnp.asarray(
+            np.frombuffer(branch.encode("ascii"), dtype=np.uint8))
+        return _grep_jit(chunk, pat, l_cap=l_cap)
+    ranges, anchor_start, anchor_end = parse_class_pattern(branch)
+    return _classgrep_compiled(int(chunk.shape[0]), ranges, anchor_start,
+                               anchor_end, l_cap)(chunk)
+
+
+def altgrep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
+    """Matching lines of ``data`` (split on '\\n', in order), or None when
+    the pattern or data needs the host regex path.  Same retry discipline
+    as the single-branch tiers (``retry_line_caps``), applied to all
+    branches per rung so the flag vectors share one ``l_cap``."""
+    branches = split_alternation(pattern)
+    if branches is None:
+        return None
+    any_class = False
+    for b in branches:
+        if is_literal_pattern(b):
+            continue
+        if parse_class_pattern(b) is None:
+            return None  # branch outside both device tiers
+        any_class = True
+    if any_class and b"\x00" in data:
+        return None  # NUL inside a line would disagree with host re
+    try:
+        text = data.decode("ascii")
+    except UnicodeDecodeError:
+        return None
+    n_host_lines = data.count(b"\n") + 1
+    chunk = jnp.asarray(_pad_pow2(data))
+    n = int(chunk.shape[0])
+
+    def run(l_cap: int):
+        total, n_lines, overflow = None, None, None
+        for b in branches:
+            lm, nl, of = _branch_flags(chunk, len(data), n_host_lines, b,
+                                       l_cap)
+            total = lm if total is None else jnp.maximum(total, lm)
+            n_lines, overflow = nl, of  # chunk-derived: same every branch
+        return total, n_lines, overflow
+
+    line_match, nl = retry_line_caps(n, run)
+    return lines_from_flags(text, line_match, nl)

@@ -162,6 +162,10 @@ def _machine_limit(out: str) -> None:
     if corpus_mb is None:
         corpus_mb = float(os.environ.get("DSI_BENCH_CORPUS_MB", "16.7"))
         mb_src = "DSI_BENCH_CORPUS_MB default"
+    if corpus_mb <= 0:
+        print("machine-limit analysis: corpus size unusable "
+              f"({corpus_mb} MB from {mb_src})")
+        return
     rates = _probe_rates(f"{out}/probe_tunnel.log")
     h2d = {k: v for k, v in rates.items() if k.startswith("H2D")}
     d2h = {k: v for k, v in rates.items() if k.startswith("D2H")}
