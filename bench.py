@@ -241,6 +241,15 @@ def tpu_child(result_path: str) -> int:
         t0 = time.perf_counter()
         res = corpus_wordcount(raws, pack6=pack6)
         phases["kernel_s"] = round(time.perf_counter() - t0, 3)
+        # Upload sub-phase (inside kernel_s) when corpus_wc routes its
+        # piece transfer through ops/xfer.put_views; 0.0 means it didn't
+        # (pre-integration artifact or host fallback) — omit the keys
+        # rather than report a phase that wasn't measured.
+        from dsi_tpu.ops import xfer
+        if xfer.stats["upload_s"] > 0:
+            phases["upload_s"] = xfer.stats["upload_s"]
+            phases["upload"] = xfer.stats["upload_mode"]
+            xfer.stats["upload_s"] = 0.0
         t0 = time.perf_counter()
         if res is not None:
             write_corpus_output(res, N_REDUCE, WORKDIR)
@@ -298,6 +307,27 @@ def tpu_child(result_path: str) -> int:
         f = min(times_by_mode[False], default=1e18)
         return t < f
 
+    # Upload-mode probe (corpus_wc routes uploads through ops/xfer): sync
+    # and async piecing differ >10x in OPPOSITE directions between
+    # healthy and degraded tunnel states (scripts/probe_tunnel.py,
+    # 2026-07-31: async 0.6 vs single-shot 5.8 MB/s degraded; async up to
+    # 1.2 GB/s healthy), so rep 0 runs async, rep 1 sync, and the rest
+    # commit to the winner — the same probe-once shape as the transport
+    # choice above.  Probed only when the transport dimension is NOT also
+    # being probed (raw-only run): two probes on the same early reps
+    # would conflate their signals.  DSI_UPLOAD_MODE pins the choice; CPU
+    # runs skip the probe (no tunnel to adapt to).
+    upload_pin = os.environ.get("DSI_UPLOAD_MODE")
+    times_by_upload: dict = {"async": [], "sync": []}
+    upload_probe = (upload_pin is None and platform != "cpu"
+                    and not pack6_eligible and transport != "pack6"
+                    and reps >= 2)
+
+    def upload_winner() -> str:
+        a = min(times_by_upload["async"], default=1e18)
+        s = min(times_by_upload["sync"], default=1e18)
+        return "sync" if s < a else "async"
+
     rep_times = []
     dt, best_phases = None, {}
     for rep in range(reps):
@@ -318,6 +348,9 @@ def tpu_child(result_path: str) -> int:
             pack6 = False
         else:
             pack6 = pack6_winning()
+        if upload_probe:
+            um = ("async", "sync")[rep] if rep < 2 else upload_winner()
+            os.environ["DSI_UPLOAD_MODE"] = um
         t_all = time.perf_counter()
         res, phases = run_once(pack6=pack6)
         rep_s = time.perf_counter() - t_all
@@ -325,10 +358,21 @@ def tpu_child(result_path: str) -> int:
         if res is None:
             emit({"error": "kernel fell back mid-run", "permanent": True})
             return 1
+        if upload_probe and "upload_s" not in phases:
+            # corpus_wc didn't route this rep through ops/xfer.put_views
+            # (host fallback or pre-integration build): the knob is inert
+            # — stop probing so phases['uploads'] can't claim modes that
+            # never ran.
+            upload_probe = False
+            os.environ.pop("DSI_UPLOAD_MODE", None)
+        if upload_probe:
+            times_by_upload[um].append(rep_s)
         times_by_mode[pack6].append(rep_s)
         rep_times.append(rep_s)
         if dt is None or rep_s < dt:
             dt, best_phases = rep_s, phases
+    if upload_probe:
+        os.environ.pop("DSI_UPLOAD_MODE", None)
 
     tpu_lines = []
     for r in range(N_REDUCE):
@@ -362,6 +406,11 @@ def tpu_child(result_path: str) -> int:
                   m for m, used in (("raw", times_by_mode[False]),
                                     ("pack6", times_by_mode[True])) if used),
               "median_s": round(median_s, 3)}
+    if upload_pin:
+        phases["uploads"] = f"pin:{upload_pin}"
+    elif any(times_by_upload.values()):
+        phases["uploads"] = "+".join(
+            m for m in ("async", "sync") if times_by_upload[m])
     phases.update(best_phases)
     result = {"tpu_s": round(dt, 3), "tpu_mbps": round(total_mb / dt, 2),
               "median_mbps": round(total_mb / median_s, 2),

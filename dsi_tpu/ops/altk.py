@@ -60,10 +60,14 @@ def split_alternation(pat: str) -> Optional[List[str]]:
         cur.append(c)
         i += 1
     branches.append("".join(cur))
+    # Duplicate branches add kernel passes but never change the OR; a
+    # pattern that collapses to one distinct branch ('a|a') is not a real
+    # alternation — tiers 1/2 or the host own it, keeping the >= 2
+    # contract exact for callers.
+    branches = list(dict.fromkeys(branches))
     if in_class or len(branches) < 2 or any(not b for b in branches):
         return None
-    # Duplicate branches add kernel passes but never change the OR.
-    return list(dict.fromkeys(branches))
+    return branches
 
 
 def _branch_flags(chunk, n_data: int, n_host_lines: int, branch: str,

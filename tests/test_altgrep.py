@@ -26,6 +26,7 @@ def test_split_alternation():
     assert split_alternation("the|and") == ["the", "and"]
     assert split_alternation("a|b|c") == ["a", "b", "c"]
     assert split_alternation("a|a|b") == ["a", "b"]  # dedup, order kept
+    assert split_alternation("a|a") is None          # collapses to 1 branch
     assert split_alternation(r"a\|b") is None        # escaped: literal |
     assert split_alternation(r"a\||b") == [r"a\|", "b"]
     assert split_alternation("[a|b]x") is None       # | inside a class
