@@ -247,7 +247,7 @@ def tpu_child(result_path: str) -> int:
         # rather than report a phase that wasn't measured.
         from dsi_tpu.ops import xfer
         if xfer.stats["upload_s"] > 0:
-            phases["upload_s"] = xfer.stats["upload_s"]
+            phases["upload_s"] = round(xfer.stats["upload_s"], 3)
             phases["upload"] = xfer.stats["upload_mode"]
             xfer.stats["upload_s"] = 0.0
         t0 = time.perf_counter()

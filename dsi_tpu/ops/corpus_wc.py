@@ -12,9 +12,14 @@ wire bytes and round trips, not FLOPs.
   reference's nMap independent map tasks + reduce merge
   (``mr/coordinator.go:152``, ``mr/worker.go:110-146``) with a single
   fused XLA program.
-* **Uploads are pieced and async** — the tunnel pipelines small transfers
-  (~60-80 ms latency, bandwidth that only pieced/async transfers reach),
-  so each piece is a separate ``device_put`` dispatched before any sync.
+* **Uploads are pieced, with a runtime async/sync switch** — the healthy
+  tunnel pipelines small transfers (~60-80 ms latency, bandwidth that only
+  pieced/async transfers reach: each piece a separate ``device_put``
+  dispatched before any sync), but the DEGRADED tunnel inverts this by
+  >10x (2026-07-31: async 0.6 vs single-shot 5.8 MB/s — concurrent
+  streams thrash the constrained link), so the piece transfer routes
+  through ``ops/xfer.put_views`` honoring ``DSI_UPLOAD_MODE`` (async
+  default; sync = one transfer in flight), which bench.py probes per run.
 * **Downloads are position-coded** — the host already holds the corpus
   bytes, so the device never ships word spellings back.  Each unique word
   returns as ``(first_occurrence_position << 7 | byte_length, count)`` —
@@ -319,7 +324,9 @@ def corpus_wordcount(raws: Sequence[bytes], *, piece_size: int | None = None,
         for frac in (4, 2):  # exact token bound is n//2+1
             fn = _get_compiled(n_pieces, piece_size, mwl, cap,
                                frac, use_aot, pack6)
-            dev_args = jax.device_put(views)     # async, pieced
+            from dsi_tpu.ops import xfer  # host-side; NOT a kernel dep
+
+            dev_args = xfer.put_views(views)  # DSI_UPLOAD_MODE async|sync
             out = np.asarray(fn(*dev_args))      # the ONE D2H round trip
             nu, max_len, has_high, tok_of = (int(x) for x in out[-4:])
             if not tok_of:

@@ -18,14 +18,11 @@ choosing differently):
   sync, then block until all have landed.
 * ``DSI_UPLOAD_MODE=sync`` — serialize: put + block one piece at a time.
 
-Integration is staged behind the in-flight evidence ladder (editing
-``corpus_wc.py`` re-fingerprints its AOT entries, so the call-site swap
-lands right before the ladder's C1 re-warm): ``corpus_wc`` will route
-its piece upload through :func:`put_views`, and ``bench.py`` will probe
-both modes on its first reps (like its raw-vs-pack6 transport probe),
-commit the rest to the winner, and report ``stats``' wall time as an
-``upload_s`` phase instead of letting it hide inside ``kernel_s``.
-Until then this module is exercised by its tests only.
+``corpus_wc`` routes its piece upload through :func:`put_views`, and
+``bench.py`` probes both modes on its first reps (like its raw-vs-pack6
+transport probe), commits the rest to the winner, and reports ``stats``'
+wall time as an ``upload_s`` phase instead of letting it hide inside
+``kernel_s``.
 """
 from __future__ import annotations
 
@@ -33,7 +30,10 @@ import os
 import time
 from typing import Any, List, Sequence
 
-#: Last-upload telemetry (per process, overwritten each call).
+#: Upload telemetry: ``upload_s`` ACCUMULATES across calls (a single
+#: logical operation may upload more than once, e.g. corpus_wc's
+#: token-bound retry rung re-uploads the corpus) until the reader —
+#: bench.py's per-rep phase capture — zeroes it.
 stats = {"upload_s": 0.0, "upload_mode": "async"}
 
 
@@ -66,6 +66,6 @@ def put_views(views: Sequence[Any], device=None) -> List[Any]:
         out = (jax.device_put(list(views), device) if device is not None
                else jax.device_put(list(views)))
         jax.block_until_ready(out)
-    stats["upload_s"] = round(time.perf_counter() - t0, 3)
+    stats["upload_s"] += time.perf_counter() - t0
     stats["upload_mode"] = mode
     return list(out)

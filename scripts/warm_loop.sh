@@ -10,17 +10,26 @@
 # any restart resumes at the first missing artifact; warm steps are
 # idempotent-cheap once their executables are in the AOT cache.
 #
+# SMALL COMPILES FIRST (reordered after outage #4): the monolithic
+# corpus-program compile has now died mid-RPC at 28 min (outage #3) and
+# 54 min (outage #4) — longer than every observed recovery window —
+# while the harness/stream programs are many SMALL compiles that
+# persist one by one, so progress accumulates across windows.  The
+# ladder therefore banks the incremental evidence (harness apps,
+# streaming, the 1 GB run) before gambling a window on the big compile.
+#
+#   P0  wire-state probe (probe_tunnel.py) — cheap, records the window
+#   B1  warm the harness worker kernels (warm_kernels --phase harness)
+#   B2-B6  full-framework harness on-chip: tpu_wc, tpu_grep (class),
+#          tpu_grep (literal), tpu_indexer, tfidf
+#   S1  warm the streaming step/pack programs (warm_kernels --phase stream)
+#   C3  wcstream --check on the chip     C4  wcstream ~1 GB + invariant
 #   A1  warm the raw corpus program   (bench --tpu-child, TRANSPORT=raw)
 #   A2  bench A: fresh process, raw-only, no stream row — the headline
 #       number + the AOT-hit proof (compile_s≈0, aot_loads≥1)
 #   A3  bench B: repeatability sample
-#   A4  wire-ceiling probe (probe_tunnel.py)
-#   B1  warm the harness worker kernels (warm_kernels --phase harness)
-#   B2-B6  full-framework harness on-chip: tpu_wc, tpu_grep (class),
-#          tpu_grep (literal), tpu_indexer, tfidf
-#   C1  warm pack6 corpus program + stream programs
+#   C1  warm pack6 corpus program (stream warm already banked by S1)
 #   C2  bench C: full run — transport probe + stream row
-#   C3  wcstream --check on the chip     C4  wcstream ~1 GB + invariant
 #
 # Evidence lands in $EV with onchip_evidence.sh-compatible filenames so
 # scripts/summarize_onchip.py reads it unchanged.  Single-tenant: steps
@@ -37,13 +46,22 @@ EV=${3:-/tmp/onchip/ladder}
 # markers are the whole point), but a COMPLETED one must not be silently
 # "re-run" as an instant exit-0, nor overwritten — archive it and start
 # fresh (fresh evidence against a warm cache is cheap and useful).
-if [ -f "$EV/done/C4" ]; then
+if [ -f "$EV/done/C2" ]; then
   mv "$EV" "$EV-$(date -u +%m%dT%H%M%S)"
 fi
 mkdir -p "$OUT" "$EV/done"
 
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$OUT/log"; }
 left() { echo $(( DEADLINE - $(date +%s) )); }
+
+# Fail a chip step in ~2 s while the tunnel is down instead of letting a
+# JAX client hang in PJRT init polling for the step's full timeout: a
+# client that entered the poll during an outage completes init the
+# moment the terminal returns — and then the step timeout SIGKILLs it
+# WITH a live device claim (the wedge that cost the 01:05 window).
+# Port 8083 is the stateless port jax.devices() uses; probing it is
+# side-effect-free.
+tunnel_up() { timeout 2 bash -c 'echo > /dev/tcp/127.0.0.1/8083' 2>/dev/null; }
 
 # A stale ambient platform pin would silently turn every step below into
 # a host run with green-looking logs; a leaked DSI_GREP_PATTERN would
@@ -86,13 +104,18 @@ step_A3() {
   bench_ok "$EV/benchB.json"
 }
 
-step_A4() {
+step_P0() {
   timeout -k 30s 900s python scripts/probe_tunnel.py --mb 8 \
     > "$EV/probe_tunnel.log" 2>&1
 }
 
 step_B1() {
   timeout -k 30s 7200s python scripts/warm_kernels.py --phase harness \
+    >> "$OUT/kernels.log" 2>&1
+}
+
+step_S1() {
+  timeout -k 30s 7200s python scripts/warm_kernels.py --phase stream \
     >> "$OUT/kernels.log" 2>&1
 }
 
@@ -118,10 +141,8 @@ step_C1() {
   DSI_BENCH_WARM_ALL=1 DSI_BENCH_STREAM_MB=0 DSI_CHILD_INIT_TIMEOUT=240 \
     timeout -k 30s 3600s python -u bench.py \
     --tpu-child "$REPO/.bench/warm-result.json" >> "$OUT/attempt.log" 2>&1
-  { [ -f "$REPO/.bench/warm-result.json" ] && \
-    ! grep -q '"error"' "$REPO/.bench/warm-result.json"; } || return 1
-  timeout -k 30s 7200s python scripts/warm_kernels.py --phase stream \
-    >> "$OUT/kernels.log" 2>&1
+  [ -f "$REPO/.bench/warm-result.json" ] && \
+    ! grep -q '"error"' "$REPO/.bench/warm-result.json"
 }
 
 step_C2() {
@@ -161,11 +182,16 @@ step_C4() {
     >> "$EV/wcstream-1g.log" 2>&1
 }
 
-STEPS="A1 A2 A3 A4 B1 B2 B3 B4 B5 B6 C1 C2 C3 C4"
+STEPS="P0 B1 B2 B3 B4 B5 B6 S1 C3 C4 A1 A2 A3 C1 C2"
 while [ "$(left)" -gt 120 ]; do
   progressed=0
   for s in $STEPS; do
     [ -f "$EV/done/$s" ] && continue
+    if ! tunnel_up; then
+      log "step $s skipped: tunnel down (8083 closed); backing off 120s"
+      sleep 120
+      break
+    fi
     log "step $s start (budget left $(left)s)"
     if "step_$s"; then
       touch "$EV/done/$s"
@@ -177,7 +203,7 @@ while [ "$(left)" -gt 120 ]; do
       break
     fi
   done
-  if [ -f "$EV/done/C4" ]; then
+  if [ -f "$EV/done/C2" ]; then
     log "ladder COMPLETE (evidence in $EV)"
     exit 0
   fi

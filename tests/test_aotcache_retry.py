@@ -1,0 +1,143 @@
+"""aotcache transient-compile retry: UNAVAILABLE RPC deaths retry in
+process (bounded), deterministic failures raise immediately."""
+
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from dsi_tpu.backends import aotcache
+
+
+class _FlakyJit:
+    """Stands in for jax.jit(fn): .lower(...).compile() fails with a
+    transient error ``fails`` times, then compiles for real."""
+
+    def __init__(self, real_jitted, fails: int, msg: str):
+        self.real = real_jitted
+        self.left = fails
+        self.msg = msg
+        self.attempts = 0
+
+    def lower(self, *a, **k):
+        outer = self
+
+        class _Lowered:
+            def compile(self):
+                outer.attempts += 1
+                if outer.left > 0:
+                    outer.left -= 1
+                    raise RuntimeError(outer.msg)
+                return outer.real.lower(*a, **k).compile()
+
+        return _Lowered()
+
+
+def _flaky_compile(monkeypatch, fails, msg, retries=None):
+    import jax
+
+    # No pause between attempts, and skip the tunnel-port probe (retry
+    # gating on a live tunnel is for the axon platform, not CI).
+    monkeypatch.setenv("DSI_COMPILE_RETRY_PAUSE_S", "0")
+    monkeypatch.setenv("DSI_TUNNEL_PROBE_PORT", "0")
+    if retries is not None:
+        monkeypatch.setenv("DSI_COMPILE_RETRIES", str(retries))
+    flaky = {}
+    real_jit = jax.jit
+
+    def fake_jit(fn, **kw):
+        flaky["jit"] = _FlakyJit(real_jit(fn, **kw), fails, msg)
+        return flaky["jit"]
+
+    monkeypatch.setattr(jax, "jit", fake_jit)
+    x = np.arange(8, dtype=np.int32)
+    compiled = aotcache.cached_compile(
+        f"retrytest_{fails}_{msg[:12]}_{retries}", lambda v: v + 1, (x,),
+        persist=False)
+    return flaky["jit"], compiled, x
+
+
+def test_transient_unavailable_retries(monkeypatch):
+    jit, compiled, x = _flaky_compile(
+        monkeypatch, fails=2,
+        msg="UNAVAILABLE: remote_compile: Network Error: Unexpected EOF")
+    assert jit.attempts == 3  # 2 failures + 1 success
+    np.testing.assert_array_equal(np.asarray(compiled(x)), x + 1)
+
+
+def test_transient_budget_exhausted_raises(monkeypatch):
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        _flaky_compile(
+            monkeypatch, fails=5,
+            msg="UNAVAILABLE: transport: Connection refused", retries=1)
+
+
+def test_deterministic_error_raises_immediately(monkeypatch):
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        jit, _, _ = _flaky_compile(
+            monkeypatch, fails=5, msg="RESOURCE_EXHAUSTED: out of memory")
+
+
+class _PoisonedExe:
+    """Deserializes fine, dies at execution — the 2026-07-31 landmine."""
+
+    calls = 0
+
+    def __call__(self, *a):
+        type(self).calls += 1
+        raise RuntimeError(
+            "NOT_FOUND: Buffer Definition Event: Function "
+            "concatenate.35_kernel not found (type id: 1)")
+
+
+def test_loaded_executable_exec_failure_heals(tmp_path):
+    """A loaded entry whose first execution fails is evicted, marked, and
+    recompiled in-process; the caller sees only the correct result.
+    (_verify_first_call is unit-tested directly: the test mesh has 8
+    virtual devices, which disables disk persistence in cached_compile.)"""
+    import jax
+
+    x = np.arange(16, dtype=np.int32)
+    path = str(tmp_path / "poisontest-abc.aot")
+    with open(path, "w") as f:
+        f.write("poisoned-bytes")
+    with jax.default_device(jax.devices()[0]):
+        jitted = jax.jit(lambda v: v * 2)
+        wrapped = aotcache._verify_first_call(
+            _PoisonedExe(), path, "poisontest", jitted, (x,), {})
+        out = wrapped(x)
+        np.testing.assert_array_equal(np.asarray(out), x * 2)
+        assert _PoisonedExe.calls == 1
+        assert not os.path.exists(path), "poisoned entry not evicted"
+        assert os.path.exists(path + ".execfail"), "no poison marker"
+        # Marked entries are neither loaded nor re-saved.
+        assert aotcache._try_load(path) is None
+        aotcache._try_save(path, None, "poisontest")
+        assert not os.path.exists(path)
+        # Second call goes straight through (verified).
+        out2 = wrapped(x)
+        np.testing.assert_array_equal(np.asarray(out2), x * 2)
+        assert _PoisonedExe.calls == 1
+
+
+def test_loaded_executable_unavailable_not_marked(tmp_path):
+    """UNAVAILABLE during the first call is a tunnel hiccup: re-raised,
+    no eviction, no poison marker."""
+    import jax
+
+    class _Hiccup:
+        def __call__(self, *a):
+            raise RuntimeError("UNAVAILABLE: transport: Unexpected EOF")
+
+    x = np.arange(16, dtype=np.int32)
+    path = str(tmp_path / "hiccuptest-abc.aot")
+    with open(path, "w") as f:
+        f.write("entry-bytes")
+    wrapped = aotcache._verify_first_call(
+        _Hiccup(), path, "hiccuptest", jax.jit(lambda v: v * 2), (x,), {})
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        wrapped(x)
+    assert os.path.exists(path), "entry must not be evicted on UNAVAILABLE"
+    assert not os.path.exists(path + ".execfail")
