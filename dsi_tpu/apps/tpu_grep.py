@@ -2,13 +2,16 @@
 
 Same job and output as ``grep`` (the working realization of the reference's
 ``mrapps/dgrep.go`` intent — see apps/grep.py): Map emits ``{line, ""}`` per
-matching line, Reduce counts occurrences.  Three device tiers: a plain
+matching line, Reduce counts occurrences.  Four device tiers: a plain
 ASCII literal ``DSI_GREP_PATTERN`` runs as the shifted-compare kernel
 (``ops/grepk.py``); fixed-length class patterns (``[Tt]he``, ``w.rd``,
 ``^\\d\\d`` …) run as the range-compare kernel (``ops/regexk.py``);
 top-level alternations of those (``the|and``, ``[Cc]at|[Dd]og``) run one
-kernel pass per branch with line flags OR-ed (``ops/altk.py``); anything
-wider falls back to the host Map.
+kernel pass per branch with line flags OR-ed (``ops/altk.py``);
+variable-length patterns (``* + ?``, mixed alternation: ``ab*c``,
+``[0-9]+``, ``colou?r|gr[ae]y$``) run as a log-depth NFA transition-
+matrix scan (``ops/nfak.py``); anything wider (groups, bounded reps,
+nullable patterns) falls back to the host Map.
 """
 
 from __future__ import annotations
@@ -23,6 +26,7 @@ from dsi_tpu.mr.types import KeyValue
 def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
     from dsi_tpu.ops.altk import altgrep_host_result
     from dsi_tpu.ops.grepk import grep_host_result
+    from dsi_tpu.ops.nfak import nfagrep_host_result
     from dsi_tpu.ops.regexk import classgrep_host_result
 
     pattern = os.environ.get("DSI_GREP_PATTERN", r"(?!x)x")
@@ -31,6 +35,8 @@ def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
         lines = classgrep_host_result(raw, pattern)
     if lines is None:
         lines = altgrep_host_result(raw, pattern)
+    if lines is None:
+        lines = nfagrep_host_result(raw, pattern)
     if lines is None:
         return None
     return [KeyValue(line, "") for line in lines]

@@ -35,12 +35,13 @@ from dsi_tpu.ops.regexk import _classgrep_compiled, parse_class_pattern
 from dsi_tpu.ops.wordcount import _pad_pow2
 
 
-def split_alternation(pat: str) -> Optional[List[str]]:
-    """Split ``pat`` on top-level ``|`` into >= 2 non-empty branches, or
-    None when it isn't a plain alternation: no unescaped ``|`` outside a
-    ``[...]`` class (``|`` inside a class is a literal), an empty branch
-    (``a|`` — the empty regex matches every line; host handles it), or an
-    unterminated class."""
+def split_top_level(pat: str) -> Optional[List[str]]:
+    """Split ``pat`` on top-level ``|`` (escape-aware; ``|`` inside a
+    ``[...]`` class is a literal) into branches, in order and without
+    dedup.  None on an unterminated class or any empty branch (``a|`` —
+    the empty regex matches every line; host handles it).  A pattern
+    with no top-level ``|`` returns a single-element list.  Shared with
+    the NFA tier (``ops/nfak.py``), which accepts single branches."""
     branches, cur, in_class, i = [], [], False, 0
     while i < len(pat):
         c = pat[i]
@@ -60,12 +61,23 @@ def split_alternation(pat: str) -> Optional[List[str]]:
         cur.append(c)
         i += 1
     branches.append("".join(cur))
-    # Duplicate branches add kernel passes but never change the OR; a
-    # pattern that collapses to one distinct branch ('a|a') is not a real
-    # alternation — tiers 1/2 or the host own it, keeping the >= 2
-    # contract exact for callers.
+    if in_class or any(not b for b in branches):
+        return None
+    return branches
+
+
+def split_alternation(pat: str) -> Optional[List[str]]:
+    """Split ``pat`` on top-level ``|`` into >= 2 non-empty branches, or
+    None when it isn't a plain alternation.  Duplicate branches add
+    kernel passes but never change the OR, so they are removed; a
+    pattern that collapses to one distinct branch ('a|a') is not a real
+    alternation — tiers 1/2 or the host own it, keeping the >= 2
+    contract exact for callers."""
+    branches = split_top_level(pat)
+    if branches is None:
+        return None
     branches = list(dict.fromkeys(branches))
-    if in_class or len(branches) < 2 or any(not b for b in branches):
+    if len(branches) < 2:
         return None
     return branches
 

@@ -1,0 +1,344 @@
+"""TPU grep tier 4: variable-length regex via log-depth NFA matrix scan.
+
+Tiers 1-3 (``grepk``/``regexk``/``altk``) cover fixed-length patterns;
+this tier runs the variable-length operators ``* + ?`` (and top-level
+alternations mixing them) on device: ``ab*c``, ``[0-9]+``, ``colou?r``,
+``^x.*y$``.  Groups, bounded reps ``{m,n}``, backrefs, and nullable
+patterns (which match every line) still fall back to the host app —
+correctness never depends on a kernel (``backends/tpu.py`` contract).
+
+TPU-first shape — no data-dependent control flow, log-depth, MXU-heavy:
+
+1. The pattern compiles (host-side, Glushkov construction) to an NFA of
+   S <= 48 states; every byte value becomes a boolean S x S transition
+   matrix, assembled into a ``[256, S, S]`` table.
+2. Matching a chunk is then an associative product of per-byte matrices
+   over the boolean semiring.  The kernel computes per-block transition
+   matrices with a K-step batched-matmul scan, an exclusive
+   ``lax.associative_scan`` product across blocks (log depth), and a
+   vmapped K-step vector re-walk that emits a per-position "matched"
+   latch bit — turned into per-line flags by the same newline-cumsum +
+   ``segment_max`` machinery as every other grep tier.
+3. The table and start vector are program ARGUMENTS, not constants: one
+   compiled executable (per chunk-size/state-bucket/l_cap) serves EVERY
+   pattern — warm it once on the chip and all variable-length patterns
+   accelerate, which matters on a platform where each remote compile
+   costs minutes (BASELINE.md).
+
+Line discipline: content classes exclude ``\\n``/``\\0``, so no match
+window spans lines or padding; the line-end bytes reset all NFA states
+to the line-start states, and the absorbing "matched" latch survives to
+the line's last position where ``segment_max`` picks it up.  Inputs
+containing NUL route to the host (NUL acts as a line-end here but not
+in ``re``), same as ``regexk``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import dsi_tpu.ops.grepk as _grepk_mod
+from dsi_tpu.ops.altk import split_top_level
+from dsi_tpu.ops.grepk import (
+    line_flags_from_match,
+    lines_from_flags,
+    retry_line_caps,
+)
+from dsi_tpu.ops.regexk import ATOM_REJECT, atom_members
+from dsi_tpu.ops.wordcount import _pad_pow2
+
+#: State-count buckets (compiled-program granularity): S = 4 fixed
+#: states + one per pattern atom, rounded up to the smallest bucket.
+_S_BUCKETS = (16, 32, 48)
+#: Fixed state indices: 0 = always-alive sentinel, 1 = line-start state,
+#: atoms at 2..., end-latch = bucket-2, latch = bucket-1 (_build_table).
+_S_ANY, _S_LINE = 0, 1
+#: Bytes that end a line for the automaton: newline and the chunk's
+#: zero padding.
+_LINE_END = (0, 10)
+
+
+class _Atom:
+    __slots__ = ("bitmap", "nullable", "repeat")
+
+    def __init__(self, bitmap: np.ndarray, mod: str):
+        self.bitmap = bitmap            # [256] bool, False at 0 and 10
+        self.nullable = mod in ("?", "*")   # NOT `mod in "?*"`: '' is a
+        self.repeat = mod in ("+", "*")     # substring of every string
+
+
+def _parse_branch(branch: str):
+    """One alternation branch -> (atoms, anchor_start, anchor_end) or
+    None.  Anchors bind per branch, exactly re's loosest-| semantics."""
+    if not branch or not all(0x01 <= ord(c) <= 0x7E for c in branch):
+        return None
+    a_start = branch.startswith("^")
+    if a_start:
+        branch = branch[1:]
+    a_end = branch.endswith("$") and not branch.endswith("\\$")
+    if a_end:
+        branch = branch[:-1]
+    if not branch:
+        return None
+    atoms: List[_Atom] = []
+    i = 0
+    while i < len(branch):
+        if branch[i] in ATOM_REJECT:
+            # Groups, bounded reps, stray anchors — and a modifier with
+            # no atom before it ('*a'), which re rejects as an error.
+            return None
+        parsed = atom_members(branch, i)
+        if parsed is None:
+            return None
+        members, i = parsed
+        mod = ""
+        if i < len(branch) and branch[i] in "*+?":
+            mod = branch[i]
+            i += 1
+            if i < len(branch) and branch[i] in "*+?":
+                return None  # stacked modifiers: host
+        members = members - {0, 10}
+        if not members and mod not in ("?", "*"):
+            return None  # required atom can only match padding/newline
+        bitmap = np.zeros(256, bool)
+        bitmap[list(members)] = True
+        atoms.append(_Atom(bitmap, mod))
+    if all(a.nullable for a in atoms):
+        return None  # nullable pattern matches EVERY line: host owns it
+    return atoms, a_start, a_end
+
+
+def parse_nfa_pattern(pat: str):
+    """Full pattern -> (branches, n_atoms) or None, where each branch is
+    (atoms, anchor_start, anchor_end)."""
+    raw = split_top_level(pat)
+    if raw is None:
+        return None
+    branches = []
+    total = 0
+    for b in raw:
+        parsed = _parse_branch(b)
+        if parsed is None:
+            return None
+        branches.append(parsed)
+        total += len(parsed[0])
+    if total + 4 > _S_BUCKETS[-1]:
+        return None  # pattern too wide for the largest state bucket
+    return branches, total
+
+
+def _bucket(n_atoms: int) -> int:
+    need = n_atoms + 4
+    for s in _S_BUCKETS:
+        if need <= s:
+            return s
+    raise AssertionError("parse_nfa_pattern admitted an oversized pattern")
+
+
+def _build_table(branches, n_atoms: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Glushkov NFA -> ([256, S, S] float32 transition table, [S] float32
+    start vector).  Row-vector convention: v' = v @ M[byte]."""
+    S = _bucket(n_atoms)
+    latch = S - 1       # persisting: set mid-line, dies at newline
+    end_latch = S - 2   # one-position: set BY a line-end byte for $
+    M = np.zeros((256, S, S), np.float32)
+    content = np.ones(256, bool)
+    content[list(_LINE_END)] = False
+
+    # Fixed machinery: the sentinel is always alive; the line-start state
+    # is entered (from the sentinel) by every line-end byte; the latch
+    # survives every byte except newline (padding keeps the final line's
+    # verdict alive for segment_max).
+    M[:, _S_ANY, _S_ANY] = 1.0
+    for b in _LINE_END:
+        M[b, _S_ANY, _S_LINE] = 1.0
+    M[content, latch, latch] = 1.0
+    M[0, latch, latch] = 1.0
+
+    pos = 2  # first atom state index
+    for atoms, a_start, a_end in branches:
+        idx = list(range(pos, pos + len(atoms)))
+        pos += len(atoms)
+
+        def successors(i: int) -> List[int]:
+            out = []
+            if atoms[i].repeat:
+                out.append(i)
+            j = i + 1
+            while j < len(atoms):
+                out.append(j)
+                if not atoms[j].nullable:
+                    break
+                j += 1
+            return out
+
+        firsts = []
+        for j, a in enumerate(atoms):
+            firsts.append(j)
+            if not a.nullable:
+                break
+        lasts = []
+        for j in range(len(atoms) - 1, -1, -1):
+            lasts.append(j)
+            if not atoms[j].nullable:
+                break
+        last_set = set(lasts)
+
+        # Start edges: anchored branches begin only at line starts;
+        # unanchored also from the always-alive sentinel (match can
+        # start anywhere).
+        srcs = [_S_LINE] if a_start else [_S_ANY, _S_LINE]
+        edges = [(s, j) for s in srcs for j in firsts]
+        edges += [(idx[i], j) for i in range(len(atoms))
+                  for j in successors(i)]
+        for src, j in edges:
+            bm = atoms[j].bitmap
+            M[bm, src, idx[j]] = 1.0
+            if j in last_set and not a_end:
+                # Entering an accepting position completes a match.
+                M[bm, src, latch] = 1.0
+        if a_end:
+            # $-anchored: the match completes only when a line-end byte
+            # arrives while an accepting position is active.  It must
+            # set the ONE-POSITION end-latch, not the persisting latch:
+            # a latch born at the newline would survive through (and
+            # falsely flag) the entire NEXT line, since the persisting
+            # latch only dies at newlines.
+            for j in last_set:
+                for b in _LINE_END:
+                    M[b, idx[j], end_latch] = 1.0
+
+    v0 = np.zeros(S, np.float32)
+    v0[_S_ANY] = 1.0
+    v0[_S_LINE] = 1.0
+    return M, v0
+
+
+def nfa_kernel(chunk: jax.Array, table: jax.Array, v0: jax.Array, *,
+               s_bucket: int, block: int, l_cap: int):
+    """Match lines of ``chunk`` against the NFA in ``table``.
+
+    Returns (line_match [l_cap] i32 in line order, n_lines i32,
+    overflow bool) — the shared tier contract.  ``table``/``v0`` are
+    runtime arguments: the compiled program is pattern-independent.
+    """
+    n = chunk.shape[0]
+    k = min(block, n)
+    nb = n // k
+    cols = chunk.reshape(nb, k).T.astype(jnp.int32)  # [k, nb]
+    latch_idx = s_bucket - 1
+
+    # 1: per-block transition matrices (K-step batched-matmul scan over
+    # the boolean semiring; f32 matmul + threshold keeps it exact — row
+    # sums are bounded by S, far under f32 integer precision).
+    eye = jnp.broadcast_to(jnp.eye(s_bucket, dtype=jnp.float32),
+                           (nb, s_bucket, s_bucket))
+
+    def bstep(B, col):
+        Mb = table[col]                       # [nb, S, S]
+        return (jnp.matmul(B, Mb) > 0).astype(jnp.float32), None
+
+    B, _ = jax.lax.scan(bstep, eye, cols)
+
+    # 2: exclusive prefix product across blocks (log depth).
+    P = jax.lax.associative_scan(
+        lambda a, b: (jnp.matmul(a, b) > 0).astype(jnp.float32), B, axis=0)
+    entry = jnp.concatenate([eye[:1], P[:-1]], axis=0)   # [nb, S, S]
+    u = (jnp.einsum("s,bst->bt", v0, entry) > 0).astype(jnp.float32)
+
+    # 3: vector re-walk per block, all blocks in parallel, emitting the
+    # per-position latch bit.
+    def vstep(v, col):
+        Mb = table[col]
+        v2 = (jnp.einsum("bs,bst->bt", v, Mb) > 0).astype(jnp.float32)
+        # Either latch flavor flags the position: persisting (S-1, set
+        # mid-line) or one-position end-latch (S-2, set at line ends).
+        return v2, jnp.maximum(v2[:, latch_idx], v2[:, latch_idx - 1])
+
+    _, latch = jax.lax.scan(vstep, u, cols)              # [k, nb]
+    mask = latch.T.reshape(n) > 0
+    return line_flags_from_match(chunk, mask, l_cap)
+
+
+# The traced program uses only grepk's line machinery; regexk/altk/
+# wordcount contribute HOST-side parsing and padding whose effects reach
+# the program through its runtime arguments and shape key, so hashing
+# them would only cause spurious multi-minute recompiles of the shared
+# pattern-independent executable.
+nfa_kernel._aot_code_deps = (_grepk_mod,)
+
+
+def _nfa_example_static(n: int, s_bucket: int, block: int, l_cap: int):
+    sds = jax.ShapeDtypeStruct
+    example = (sds((n,), jnp.uint8),
+               sds((256, s_bucket, s_bucket), jnp.float32),
+               sds((s_bucket,), jnp.float32))
+    return example, {"s_bucket": s_bucket, "block": block, "l_cap": l_cap}
+
+
+@functools.lru_cache(maxsize=64)
+def _nfa_compiled(n: int, s_bucket: int, block: int, l_cap: int):
+    from dsi_tpu.backends.aotcache import cached_compile
+
+    example, static = _nfa_example_static(n, s_bucket, block, l_cap)
+    return cached_compile(f"nfagrep_s{s_bucket}", nfa_kernel, example,
+                          static=static)
+
+
+def _device_ready(n: int, s_bucket: int, block: int, l_cap: int) -> bool:
+    """Whether running this tier now is a millisecond load or a
+    multi-minute remote compile.  On CPU backends compiles are cheap —
+    always ready.  On an accelerator, only serve the tier when the
+    first-rung executable is already persisted (warm_kernels compiles
+    it, exporting DSI_NFA_COLD_OK=1 to bypass this gate): a cold remote
+    compile inside a worker TASK would outlive the harness process
+    timeout and loop forever (the bench's corpus_executable_persisted
+    discipline, applied to grep)."""
+    if os.environ.get("DSI_NFA_COLD_OK") == "1":
+        return True
+    if jax.devices()[0].platform == "cpu":
+        return True
+    from dsi_tpu.backends.aotcache import is_persisted
+
+    example, static = _nfa_example_static(n, s_bucket, block, l_cap)
+    return is_persisted(f"nfagrep_s{s_bucket}", nfa_kernel, example,
+                        static=static)
+
+
+def nfagrep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
+    """Matching lines of ``data`` (split on '\\n', in order), or None
+    when the pattern or data needs the host regex path.  Same retry
+    discipline as the other tiers."""
+    parsed = parse_nfa_pattern(pattern)
+    if parsed is None:
+        return None
+    if b"\x00" in data:
+        return None  # NUL inside a line would disagree with host re
+    try:
+        text = data.decode("ascii")
+    except UnicodeDecodeError:
+        return None
+    branches, n_atoms = parsed
+    table_np, v0_np = _build_table(branches, n_atoms)
+    s_bucket = table_np.shape[1]
+    # _pad_pow2 guarantees >= 1 trailing zero — the line-end byte the
+    # $ latch and final-line handling depend on.
+    chunk = jnp.asarray(_pad_pow2(data))
+    n = int(chunk.shape[0])
+    if not _device_ready(n, s_bucket, min(256, n), max(n // 8, 1)):
+        return None  # cold remote compile in-task: host serves this job
+    table = jnp.asarray(table_np)
+    v0 = jnp.asarray(v0_np)
+
+    def run(l_cap: int):
+        return _nfa_compiled(n, s_bucket, min(256, n), l_cap)(
+            chunk, table, v0)
+
+    line_match, nl = retry_line_caps(n, run)
+    return lines_from_flags(text, line_match, nl)

@@ -86,6 +86,74 @@ def _compress(members: set) -> List[Tuple[int, int]]:
     return out
 
 
+#: Characters that cannot START an atom in any device tier: modifiers,
+#: bounded reps, groups, stray anchors.  (Tier 4 consumes ``* + ?`` as
+#: modifiers AFTER a valid atom and splits ``|`` before parsing, so one
+#: set serves every tier — see ops/nfak.py.)
+ATOM_REJECT = "*+?{}()|^$"
+
+
+def atom_members(pat: str, i: int):
+    """Parse one atom starting at ``pat[i]`` — ``.``, an escape, a
+    ``[...]`` class, or a literal character — into its byte-member set.
+
+    Returns ``(members, next_i)`` or None when the atom needs the host
+    regex engine.  The SINGLE definition of atom/class semantics shared
+    by the class tier (here) and the NFA tier (``ops/nfak.py``), so the
+    tiers can never disagree on what a class means.  Callers reject
+    ``ATOM_REJECT`` characters first.  Members are raw — callers
+    subtract ``{0, 10}`` per their padding/newline discipline."""
+    c = pat[i]
+    if c == ".":
+        return set(range(1, 256)) - {10}, i + 1
+    if c == "\\":
+        if i + 1 >= len(pat):
+            return None
+        e = pat[i + 1]
+        if e in _ESCAPE_CLASSES:
+            return ({b for lo, hi in _ESCAPE_CLASSES[e]
+                     for b in range(lo, hi + 1)}, i + 2)
+        if not e.isalnum():  # \. \[ \\ etc: escaped literal
+            return {ord(e)}, i + 2
+        return None  # \b \A \Z back-refs etc.: host
+    if c == "[":
+        j = _find_class_end(pat, i)
+        if j == -1:
+            return None
+        body = pat[i + 1:j]
+        negate = body.startswith("^")
+        if negate:
+            body = body[1:]
+        members: set = set()
+        k = 0
+        while k < len(body):
+            if body[k] == "\\" and k + 1 < len(body):
+                e = body[k + 1]
+                if e in _ESCAPE_CLASSES:
+                    members |= {b for lo, hi in _ESCAPE_CLASSES[e]
+                                for b in range(lo, hi + 1)}
+                elif not e.isalnum():
+                    members.add(ord(e))
+                else:
+                    return None
+                k += 2
+            elif k + 2 < len(body) and body[k + 1] == "-":
+                lo, hi = ord(body[k]), ord(body[k + 2])
+                if lo > hi:
+                    return None
+                members |= set(range(lo, hi + 1))
+                k += 3
+            else:
+                members.add(ord(body[k]))
+                k += 1
+        if not members:
+            return None
+        if negate:
+            members = set(range(1, 256)) - members
+        return members, j + 1
+    return {ord(c)}, i + 1
+
+
 def parse_class_pattern(pat: str):
     """Parse the supported regex subset.
 
@@ -108,62 +176,12 @@ def parse_class_pattern(pat: str):
     positions: List[Tuple[Tuple[int, int], ...]] = []
     i = 0
     while i < len(pat):
-        c = pat[i]
-        if c in "*+?{}()|^$":
+        if pat[i] in ATOM_REJECT:
             return None  # variable-length / group / stray anchor: host
-        if c == ".":
-            members = set(range(1, 256)) - {10}
-            i += 1
-        elif c == "\\":
-            if i + 1 >= len(pat):
-                return None
-            e = pat[i + 1]
-            if e in _ESCAPE_CLASSES:
-                members = {b for lo, hi in _ESCAPE_CLASSES[e]
-                           for b in range(lo, hi + 1)}
-            elif not e.isalnum():  # \. \[ \\ etc: escaped literal
-                members = {ord(e)}
-            else:
-                return None  # \b \A \Z back-refs etc.: host
-            i += 2
-        elif c == "[":
-            j = _find_class_end(pat, i)
-            if j == -1:
-                return None
-            body = pat[i + 1:j]
-            negate = body.startswith("^")
-            if negate:
-                body = body[1:]
-            members = set()
-            k = 0
-            while k < len(body):
-                if body[k] == "\\" and k + 1 < len(body):
-                    e = body[k + 1]
-                    if e in _ESCAPE_CLASSES:
-                        members |= {b for lo, hi in _ESCAPE_CLASSES[e]
-                                    for b in range(lo, hi + 1)}
-                    elif not e.isalnum():
-                        members.add(ord(e))
-                    else:
-                        return None
-                    k += 2
-                elif k + 2 < len(body) and body[k + 1] == "-":
-                    lo, hi = ord(body[k]), ord(body[k + 2])
-                    if lo > hi:
-                        return None
-                    members |= set(range(lo, hi + 1))
-                    k += 3
-                else:
-                    members.add(ord(body[k]))
-                    k += 1
-            if not members:
-                return None
-            if negate:
-                members = set(range(1, 256)) - members
-            i = j + 1
-        else:
-            members = {ord(c)}
-            i += 1
+        parsed = atom_members(pat, i)
+        if parsed is None:
+            return None
+        members, i = parsed
         members -= {0, 10}
         if not members:
             return None  # class can only match padding/newline: host

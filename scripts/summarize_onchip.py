@@ -212,8 +212,8 @@ def main() -> None:
     print("wire probe (probe_tunnel.py tail):")
     print(_tail(f"{out}/probe_tunnel.log", 8))
     _machine_limit(out)
-    for name in ("tpu_wc", "tpu_grep", "tpu_grep_literal", "tpu_indexer",
-                 "tfidf"):
+    for name in ("tpu_wc", "tpu_grep", "tpu_grep_literal", "tpu_grep_nfa",
+                 "tpu_indexer", "tfidf"):
         print(f"harness {name}:{_harness(f'{out}/harness_{name}.log')}")
     print("wcstream --check (single-device mesh):")
     print(_tail(f"{out}/wcstream.log", 3))

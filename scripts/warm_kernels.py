@@ -85,6 +85,24 @@ def main() -> int:
         print(f"classgrep kernel: {time.perf_counter() - t0:.1f}s "
               f"{len(clines)} matching lines", flush=True)
 
+        # NFA matrix-scan grep kernel (tier 4, ops/nfak.py): the
+        # compiled program is PATTERN-INDEPENDENT (the transition table
+        # ships as an argument), so warming the smallest state bucket at
+        # this shape serves every variable-length pattern of <= 12
+        # atoms.  DSI_NFA_COLD_OK bypasses the tier's own
+        # cold-compile gate — compiling here is this script's job.
+        from dsi_tpu.ops.nfak import nfagrep_host_result
+
+        os.environ["DSI_NFA_COLD_OK"] = "1"
+        try:
+            t0 = time.perf_counter()
+            nlines = nfagrep_host_result(raw, "th+e")
+            assert nlines is not None
+            print(f"nfagrep kernel: {time.perf_counter() - t0:.1f}s "
+                  f"{len(nlines)} matching lines", flush=True)
+        finally:
+            del os.environ["DSI_NFA_COLD_OK"]
+
     if args.phase in ("stream", "all"):
         # Stream-row programs: bench.py runs wordcount_streaming(aot=True,
         # chunk_bytes=1<<20, u_cap=1<<14) on the single real device, and

@@ -20,8 +20,9 @@
 #
 #   P0  wire-state probe (probe_tunnel.py) — cheap, records the window
 #   B1  warm the harness worker kernels (warm_kernels --phase harness)
-#   B2-B6  full-framework harness on-chip: tpu_wc, tpu_grep (class),
-#          tpu_grep (literal), tpu_indexer, tfidf
+#   B2-B7  full-framework harness on-chip: tpu_wc, tpu_grep (class),
+#          tpu_grep (literal), tpu_indexer, tfidf, tpu_grep (tier-4
+#          variable-length NFA pattern)
 #   S1  warm the streaming step/pack programs (warm_kernels --phase stream)
 #   C3  wcstream --check on the chip     C4  wcstream ~1 GB + invariant
 #   A1  warm the raw corpus program   (bench --tpu-child, TRANSPORT=raw)
@@ -134,6 +135,9 @@ step_B3() { harness tpu_grep harness_tpu_grep.log; }
 step_B4() { harness tpu_grep harness_tpu_grep_literal.log the; }
 step_B5() { harness tpu_indexer harness_tpu_indexer.log; }
 step_B6() { harness tfidf harness_tfidf.log; }
+# Tier-4 variable-length grep on-chip: B1 warmed the pattern-independent
+# NFA program, so any eligible pattern at the harness shape loads warm.
+step_B7() { harness tpu_grep harness_tpu_grep_nfa.log 'qu+ick|dogs?$'; }
 
 step_C1() {
   rm -f "$REPO/.bench/warm-result.json" "$REPO/.bench/warm-result.json.init"
@@ -182,7 +186,7 @@ step_C4() {
     >> "$EV/wcstream-1g.log" 2>&1
 }
 
-STEPS="P0 B1 B2 B3 B4 B5 B6 S1 C3 C4 A1 A2 A3 C1 C2"
+STEPS="P0 B1 B2 B3 B4 B5 B6 B7 S1 C3 C4 A1 A2 A3 C1 C2"
 while [ "$(left)" -gt 120 ]; do
   progressed=0
   for s in $STEPS; do

@@ -1,0 +1,186 @@
+"""NFA matrix-scan grep tier (ops/nfak.py): differential vs host re,
+routing contract, multi-block correctness, and grammar fuzz."""
+
+import os
+import random
+import re
+
+import pytest
+
+pytest.importorskip("jax")
+
+from dsi_tpu.apps import grep, tpu_grep
+from dsi_tpu.ops.nfak import nfagrep_host_result, parse_nfa_pattern
+
+TEXT = (b"the quick brown fox\njumps over the lazy dogs\n"
+        b"no match here\ncolour and color\nab ac abc abbbc\n"
+        b"42 is the answer\n\nfox")
+
+
+def oracle(data: bytes, pat: str):
+    return [ln for ln in data.decode().split("\n") if re.search(pat, ln)]
+
+
+@pytest.mark.parametrize("pat", [
+    "ab*c", "colou?r", "[0-9]+", "a.*z",          # variable-length core
+    "qu+ick", "o[ux]*r", "a?b?c", "x*y",          # modifier mix
+    "^the", "dogs$", "^a.*c$", "f.x$", "x+$",     # anchors
+    "ab*c|fox", "z*fox|dogs?$", "^x*y|[0-9]+",    # alternation
+    "fox", "the",                                 # plain (tier overlap)
+    r"\d+ is", r"\w+ \w+", r"[a-z]+\s[a-z]+",     # escape classes
+])
+def test_matches_re_oracle(pat):
+    got = nfagrep_host_result(TEXT, pat)
+    assert got is not None, f"{pat!r} unexpectedly routed to host"
+    assert got == oracle(TEXT, pat), pat
+
+
+@pytest.mark.parametrize("pat", [
+    "a*",          # nullable: matches every line incl. empty — host
+    "x*y*",        # nullable via both atoms
+    "^$",          # empty anchored
+    "(ab)*",       # group
+    "a{2,3}",      # bounded repetition
+    "a**",         # stacked modifiers
+    "a|",          # empty branch
+    r"\bword",     # word boundary
+    "h\xe9llo",    # non-ASCII
+    "a" * 60,      # wider than the largest state bucket
+])
+def test_ineligible_routes_to_host(pat):
+    assert nfagrep_host_result(TEXT, pat) is None
+
+
+def test_nul_data_routes_to_host():
+    assert nfagrep_host_result(b"a\x00b\nfox\n", "fox+") is None
+
+
+def test_stray_modifier_routes_to_host():
+    # re rejects '*a' as an error; the tier must not silently treat the
+    # modifier as a literal.
+    assert nfagrep_host_result(TEXT, "*a") is None
+    assert nfagrep_host_result(TEXT, "a|+b") is None
+
+
+def test_cold_compile_gate(monkeypatch):
+    """On an accelerator platform the tier only serves patterns whose
+    program is already persisted (or when the warm script says cold
+    compiles are its job); CPU platforms are always ready."""
+    import dsi_tpu.ops.nfak as nfak
+
+    assert nfak._device_ready(1024, 16, 256, 128)  # CPU: always
+
+    class _FakeDev:
+        platform = "tpu"
+
+    monkeypatch.setattr(nfak.jax, "devices", lambda: [_FakeDev()])
+    monkeypatch.setattr(
+        "dsi_tpu.backends.aotcache.is_persisted",
+        lambda *a, **k: False)
+    assert not nfak._device_ready(1024, 16, 256, 128)
+    monkeypatch.setenv("DSI_NFA_COLD_OK", "1")
+    assert nfak._device_ready(1024, 16, 256, 128)
+    monkeypatch.delenv("DSI_NFA_COLD_OK")
+    monkeypatch.setattr(
+        "dsi_tpu.backends.aotcache.is_persisted",
+        lambda *a, **k: True)
+    assert nfak._device_ready(1024, 16, 256, 128)
+
+
+def test_multi_block_spanning():
+    """Data far larger than one 256-byte scan block, with matches that
+    sit inside, start, and end at block boundaries."""
+    rng = random.Random(5)
+    lines = []
+    for i in range(200):
+        pad = "".join(rng.choices("qwert yuiop", k=rng.randint(0, 40)))
+        lines.append(pad + ("abbbc" if i % 7 == 0 else "")
+                     + ("xyz" if i % 11 == 0 else ""))
+    data = "\n".join(lines).encode()
+    for pat in ["ab+c", "xy?z$", "^q.*c"]:
+        assert nfagrep_host_result(data, pat) == oracle(data, pat), pat
+
+
+def test_empty_lines_and_no_trailing_newline():
+    data = b"\n\nab\n\nabb\n"
+    assert nfagrep_host_result(data, "ab+") == oracle(data, "ab+")
+    data2 = b"ab\n\nabb"  # final line without newline
+    assert nfagrep_host_result(data2, "ab+$") == oracle(data2, "ab+$")
+
+
+def test_line_overflow_retry():
+    data = b"\n" * 3000 + b"needle\n" + b"\n" * 3000 + b"needles\n"
+    assert nfagrep_host_result(data, "needles?$") == ["needle", "needles"]
+
+
+def test_tpu_map_dispatches_tier4():
+    os.environ["DSI_GREP_PATTERN"] = "qu+ick|dogs$"
+    try:
+        kva = tpu_grep.tpu_map("f", TEXT)
+    finally:
+        del os.environ["DSI_GREP_PATTERN"]
+    assert kva is not None
+    assert [kv.key for kv in kva] == oracle(TEXT, "qu+ick|dogs$")
+
+
+def test_pattern_independent_program():
+    """The compiled program is shared across patterns (table ships as an
+    argument): two different patterns at one chunk shape must not
+    trigger a second compile."""
+    from dsi_tpu.backends import aotcache
+
+    data = b"alpha beta\ngamma delta\n" * 8
+    nfagrep_host_result(data, "al.*a")
+    before = aotcache.stats["compiles"]
+    nfagrep_host_result(data, "de[kl]ta+")
+    assert aotcache.stats["compiles"] == before
+
+
+def test_fuzz_generated_patterns_vs_oracle():
+    """Patterns built from the supported grammar with random modifiers
+    and alternation; every accepted pattern must agree with the re
+    oracle, and None routes are only allowed for nullable collapses."""
+    rng = random.Random(37)
+    alphabet = "abcxyzAB01 .,;"
+
+    def gen_atom():
+        r = rng.random()
+        if r < 0.45:
+            return rng.choice("abcxyzAB")
+        if r < 0.6:
+            return "."
+        if r < 0.72:
+            return rng.choice([r"\d", r"\w", r"\s"])
+        neg = "^" if rng.random() < 0.25 else ""
+        items = "".join(rng.sample("abcxyz019", rng.randint(1, 3)))
+        return f"[{neg}{items}]"
+
+    def gen_branch():
+        atoms = []
+        for _ in range(rng.randint(1, 5)):
+            a = gen_atom()
+            if rng.random() < 0.4:
+                a += rng.choice("*+?")
+            atoms.append(a)
+        b = "".join(atoms)
+        if rng.random() < 0.15:
+            b = "^" + b
+        if rng.random() < 0.15:
+            b = b + "$"
+        return b
+
+    accepted = 0
+    for trial in range(60):
+        pattern = "|".join(gen_branch()
+                           for _ in range(rng.randint(1, 3)))
+        lines = ["".join(rng.choices(alphabet, k=rng.randint(0, 30)))
+                 for _ in range(rng.randint(1, 40))]
+        data = "\n".join(lines).encode()
+        got = nfagrep_host_result(data, pattern)
+        if got is None:
+            # Only legitimate host routes: a nullable pattern.
+            assert parse_nfa_pattern(pattern) is None, (trial, pattern)
+            continue
+        accepted += 1
+        assert got == oracle(data, pattern), (trial, pattern, lines)
+    assert accepted >= 30, "fuzz generated too few device-eligible patterns"

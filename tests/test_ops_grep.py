@@ -61,8 +61,18 @@ def test_regex_routing_tiers():
         assert kva is not None and all("he" in kv.key for kv in kva)
     finally:
         del os.environ["DSI_GREP_PATTERN"]
-    # ...while variable-length regex still routes to the host app.
+    # ...variable-length regex is now served by tier 4 (the NFA
+    # matrix-scan kernel, ops/nfak.py)...
     os.environ["DSI_GREP_PATTERN"] = "th+e"
+    try:
+        kva = tpu_grep.tpu_map("f", TEXT)
+        assert kva is not None
+        assert [kv.key for kv in kva] == [
+            "the quick brown fox", "jumps over the lazy dog"]
+    finally:
+        del os.environ["DSI_GREP_PATTERN"]
+    # ...while groups/backrefs still route to the host app.
+    os.environ["DSI_GREP_PATTERN"] = "(th)+e"
     try:
         assert tpu_grep.tpu_map("f", TEXT) is None  # router: host handles it
     finally:
@@ -112,6 +122,24 @@ def test_line_count_mismatch_falls_back(monkeypatch):
 
     monkeypatch.setattr(regexk, "_classgrep_compiled", skewed_c)
     assert regexk.classgrep_host_result(TEXT, "fox") is None
+
+    # A literal is ALSO a valid tier-4 NFA pattern; skew its line counts
+    # too so the router truly has no healthy device tier left.
+    import dsi_tpu.ops.nfak as nfak
+
+    real_n = nfak._nfa_compiled
+
+    def skewed_n(n, s_bucket, block, l_cap):
+        fn = real_n(n, s_bucket, block, l_cap)
+
+        def wrap(chunk, table, v0):
+            line_match, n_lines, overflow = fn(chunk, table, v0)
+            return line_match, n_lines + 1, overflow
+
+        return wrap
+
+    monkeypatch.setattr(nfak, "_nfa_compiled", skewed_n)
+    assert nfak.nfagrep_host_result(TEXT, "fox") is None
 
     # ...and the app-level router then serves the task via the host Map.
     monkeypatch.setenv("DSI_GREP_PATTERN", "fox")
