@@ -1,11 +1,13 @@
 """TPU grep tier 4: variable-length regex via log-depth NFA matrix scan.
 
 Tiers 1-3 (``grepk``/``regexk``/``altk``) cover fixed-length patterns;
-this tier runs the variable-length operators ``* + ?`` (and top-level
-alternations mixing them) on device: ``ab*c``, ``[0-9]+``, ``colou?r``,
-``^x.*y$``.  Groups, bounded reps ``{m,n}``, backrefs, and nullable
-patterns (which match every line) still fall back to the host app —
-correctness never depends on a kernel (``backends/tpu.py`` contract).
+this tier runs the variable-length operators on device: ``* + ?``,
+bounded reps ``{m}``/``{m,}``/``{m,n}`` (expanded into optional atoms),
+their non-greedy forms (existence per line is greediness-independent),
+and top-level alternations mixing them — ``ab*c``, ``[0-9]{2,4}``,
+``colou?r``, ``^x.*?y$``.  Groups, backrefs, and nullable patterns
+(which match every line) still fall back to the host app — correctness
+never depends on a kernel (``backends/tpu.py`` contract).
 
 TPU-first shape — no data-dependent control flow, log-depth, MXU-heavy:
 
@@ -89,29 +91,103 @@ def _parse_branch(branch: str):
     atoms: List[_Atom] = []
     i = 0
     while i < len(branch):
-        if branch[i] in ATOM_REJECT:
-            # Groups, bounded reps, stray anchors — and a modifier with
-            # no atom before it ('*a'), which re rejects as an error.
+        if branch[i] in ATOM_REJECT and branch[i] not in "{}":
+            # Groups, stray anchors — and a modifier with no atom before
+            # it ('*a'), which re rejects as an error.  Braces fall
+            # through: a lone '}' is a literal in re, and '{' is handled
+            # just below.
             return None
+        if branch[i] == "{":
+            peek, pi = _parse_bounded_rep(branch, i)
+            if peek is not None or pi < 0:
+                # A VALID rep shape with nothing to repeat: re errors
+                # ("nothing to repeat") — host owns it.  An invalid body
+                # ('{2,x}') is a literal brace in re; fall through and
+                # parse it as a literal atom.
+                return None
         parsed = atom_members(branch, i)
         if parsed is None:
             return None
         members, i = parsed
         mod = ""
+        reps: Optional[Tuple[int, int]] = None  # (min, max); max<0 = inf
         if i < len(branch) and branch[i] in "*+?":
             mod = branch[i]
             i += 1
-            if i < len(branch) and branch[i] in "*+?":
-                return None  # stacked modifiers: host
+        elif i < len(branch) and branch[i] == "{":
+            reps, i = _parse_bounded_rep(branch, i)
+            if reps is None and i < 0:
+                return None  # malformed in a way re also rejects
+            if reps is not None and max(reps) > _S_BUCKETS[-1]:
+                # Reject oversized counts BEFORE the expansion loop: the
+                # parse runs in every worker task on every platform, and
+                # 'a{2000000000}' must fail in microseconds, not expand.
+                return None
+        if (mod or reps is not None) and i < len(branch) \
+                and branch[i] == "?":
+            # Non-greedy (*? +? ?? {m,n}?): greediness affects WHICH
+            # match is found, never WHETHER one exists, and per-line
+            # flags only need existence — greedy-equivalent here.
+            i += 1
+        if (mod or reps is not None) and i < len(branch) \
+                and branch[i] in "*+?":
+            return None  # stacked modifiers: host
         members = members - {0, 10}
-        if not members and mod not in ("?", "*"):
+        if not members and mod not in ("?", "*") and (
+                reps is None or reps[0] > 0):
             return None  # required atom can only match padding/newline
         bitmap = np.zeros(256, bool)
         bitmap[list(members)] = True
-        atoms.append(_Atom(bitmap, mod))
+        if reps is None:
+            atoms.append(_Atom(bitmap, mod))
+        else:
+            # X{m,n} expands to m required copies + (n-m) optional ones;
+            # X{m,} to m copies with the last one repeating.  The atom
+            # budget (state bucket) naturally bounds the expansion.
+            lo, hi = reps
+            for _ in range(lo):
+                atoms.append(_Atom(bitmap, ""))
+            if hi < 0:
+                if lo == 0:
+                    atoms.append(_Atom(bitmap, "*"))
+                else:
+                    atoms[-1] = _Atom(bitmap, "+")
+            else:
+                for _ in range(hi - lo):
+                    atoms.append(_Atom(bitmap, "?"))
+        if len(atoms) > _S_BUCKETS[-1]:
+            return None  # expansion exceeds the largest state bucket
     if all(a.nullable for a in atoms):
         return None  # nullable pattern matches EVERY line: host owns it
     return atoms, a_start, a_end
+
+
+def _parse_bounded_rep(branch: str, i: int):
+    """Parse ``{m}``, ``{m,}``, or ``{m,n}`` at ``branch[i]``.
+
+    Returns ``((lo, hi), next_i)`` with ``hi == -1`` for unbounded, or
+    ``(None, i)`` when the brace is not a valid bounded rep (re then
+    treats it as a literal '{' — the caller re-parses it as an atom), or
+    ``(None, -1)`` for ``{m,n}`` with ``m > n`` (re raises: host)."""
+    j = branch.find("}", i)
+    if j == -1:
+        return None, i
+    body = branch[i + 1:j]
+    parts = body.split(",")
+    if not all(p.isdigit() or p == "" for p in parts) or len(parts) > 2:
+        return None, i
+    if len(parts) == 1:
+        if not parts[0]:
+            return None, i  # bare '{}' is a literal brace pair in re
+        lo = hi = int(parts[0])
+    else:
+        # Python >= 3.11 re treats '{,n}' as the quantifier {0,n} (and
+        # '{,}' as {0,}), so an empty lo is 0, not a literal brace.
+        lo = int(parts[0]) if parts[0] else 0
+        hi = -1 if parts[1] == "" else int(parts[1])
+    if hi >= 0 and lo > hi:
+        return None, -1
+    return (lo, hi), j + 1
 
 
 def parse_nfa_pattern(pat: str):

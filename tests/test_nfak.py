@@ -36,16 +36,32 @@ def test_matches_re_oracle(pat):
 
 
 @pytest.mark.parametrize("pat", [
+    "ab{2}c", "b{2,}", "a{1,3}b", "[0-9]{2}",   # bounded reps (expanded)
+    "ab*?c", "qu+?ick", "colou??r", "b{1,3}?",  # non-greedy == greedy
+    "a{2", "x}y", "e}?",                        # literal braces, re-style
+    "x{,2}s", "o{,1}x",                         # {,n} == {0,n} (re>=3.11)
+])
+def test_bounded_reps_and_nongreedy_match_oracle(pat):
+    got = nfagrep_host_result(TEXT, pat)
+    assert got is not None, f"{pat!r} unexpectedly routed to host"
+    assert got == oracle(TEXT, pat), pat
+
+
+@pytest.mark.parametrize("pat", [
     "a*",          # nullable: matches every line incl. empty — host
     "x*y*",        # nullable via both atoms
+    "a{0,3}",      # nullable via bounded rep
     "^$",          # empty anchored
     "(ab)*",       # group
-    "a{2,3}",      # bounded repetition
+    "a{3,2}",      # inverted bounds: re errors
+    "{2}",         # bare quantifier: re 'nothing to repeat'
+    "a{2}{3}",     # multiple repeat: re errors
     "a**",         # stacked modifiers
     "a|",          # empty branch
     r"\bword",     # word boundary
     "h\xe9llo",    # non-ASCII
     "a" * 60,      # wider than the largest state bucket
+    "a{1,60}",     # expansion exceeds the state bucket
 ])
 def test_ineligible_routes_to_host(pat):
     assert nfagrep_host_result(TEXT, pat) is None
@@ -159,8 +175,16 @@ def test_fuzz_generated_patterns_vs_oracle():
         atoms = []
         for _ in range(rng.randint(1, 5)):
             a = gen_atom()
-            if rng.random() < 0.4:
+            r = rng.random()
+            if r < 0.3:
                 a += rng.choice("*+?")
+                if rng.random() < 0.25:
+                    a += "?"  # non-greedy
+            elif r < 0.45:
+                lo = rng.randint(0, 2)
+                hi = rng.choice(["", lo + rng.randint(0, 2)])
+                a += ("{%d}" % lo if hi == lo and rng.random() < 0.5
+                      else "{%d,%s}" % (lo, hi))
             atoms.append(a)
         b = "".join(atoms)
         if rng.random() < 0.15:
