@@ -285,3 +285,18 @@ def test_executable_persisted_probe_mirrors_run_shapes(tmp_path):
                        capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, p.stderr[-2000:]
     assert p.stdout.strip().splitlines()[-1] == "probe-ok"
+
+
+@pytest.mark.parametrize("mode", ["async", "sync"])
+def test_upload_modes_identical(mode, monkeypatch):
+    """DSI_UPLOAD_MODE selects transfer geometry only — results must be
+    byte-identical either way, and xfer telemetry must record the run."""
+    from dsi_tpu.ops import xfer
+
+    monkeypatch.setenv("DSI_UPLOAD_MODE", mode)
+    xfer.stats["upload_s"] = 0.0
+    texts = ["upload mode parity check one two two three three three"]
+    res = corpus_wordcount([t.encode() for t in texts], piece_size=PIECE)
+    assert counts_of(res) == oracle(texts)
+    assert xfer.stats["upload_mode"] == mode
+    assert xfer.stats["upload_s"] > 0.0
