@@ -207,10 +207,21 @@ def _tunnel_answers() -> bool:
     one jax.devices() uses), so a compile retry can distinguish an RPC
     blip (retry is worth it) from a full outage (fail fast and let the
     caller's bounded-attempt machinery cycle).  ``DSI_TUNNEL_PROBE_PORT=0``
-    disables the probe (always 'answers') for non-tunnel platforms."""
+    disables the probe (always 'answers').
+
+    Default: probe 8083 ONLY when the backend is the axon tunnel; on any
+    other platform a closed local port says nothing about the compile
+    service, and failing the probe there would silently disable retries
+    everywhere except the one machine the port exists on (ADVICE r4)."""
     import socket
 
-    port = int(os.environ.get("DSI_TUNNEL_PROBE_PORT", "8083"))
+    env = os.environ.get("DSI_TUNNEL_PROBE_PORT")
+    if env is None:
+        if "axon" not in _platform_fingerprint():
+            return True
+        port = 8083
+    else:
+        port = int(env)
     if port == 0:
         return True
     s = socket.socket()

@@ -43,11 +43,19 @@ def line_flags_from_match(chunk: jax.Array, match: jax.Array, l_cap: int):
     return line_match, n_lines, overflow
 
 
+def line_cap_rungs(n: int):
+    """The shared l_cap rung schedule: average line >= 8 bytes first,
+    then the n+1 hard bound (every byte a '\\n').  One definition so
+    readiness probes (``ops/nfak._device_ready``) and the retry loop can
+    never drift onto different compiled shapes."""
+    return (max(n // 8, 1), n + 1)
+
+
 def retry_line_caps(n: int, run):
     """Shared l_cap rung schedule (exactness_retry discipline): average
     line >= 8 bytes first, then the n+1 hard bound (every byte a '\\n').
     ``run(l_cap)`` -> (line_match, n_lines, overflow)."""
-    for l_cap in (max(n // 8, 1), n + 1):
+    for l_cap in line_cap_rungs(n):
         line_match, n_lines, overflow = run(l_cap)
         if not bool(overflow):
             break

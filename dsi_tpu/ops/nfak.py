@@ -48,9 +48,9 @@ import numpy as np
 import dsi_tpu.ops.grepk as _grepk_mod
 from dsi_tpu.ops.altk import split_top_level
 from dsi_tpu.ops.grepk import (
+    line_cap_rungs,
     line_flags_from_match,
     lines_from_flags,
-    retry_line_caps,
 )
 from dsi_tpu.ops.regexk import ATOM_REJECT, atom_members
 from dsi_tpu.ops.wordcount import _pad_pow2
@@ -181,8 +181,10 @@ def _parse_bounded_rep(branch: str, i: int):
             return None, i  # bare '{}' is a literal brace pair in re
         lo = hi = int(parts[0])
     else:
-        # Python >= 3.11 re treats '{,n}' as the quantifier {0,n} (and
-        # '{,}' as {0,}), so an empty lo is 0, not a literal brace.
+        # re treats '{,n}' as the quantifier {0,n} (and '{,}' as {0,})
+        # on every supported interpreter — "omitting m specifies a lower
+        # bound of zero" has been documented re behavior since long
+        # before 3.10 (verified against re/_parser.py's brace parse).
         lo = int(parts[0]) if parts[0] else 0
         hi = -1 if parts[1] == "" else int(parts[1])
     if hi >= 0 and lo > hi:
@@ -405,16 +407,25 @@ def nfagrep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
     s_bucket = table_np.shape[1]
     # _pad_pow2 guarantees >= 1 trailing zero — the line-end byte the
     # $ latch and final-line handling depend on.
-    chunk = jnp.asarray(_pad_pow2(data))
-    n = int(chunk.shape[0])
-    if not _device_ready(n, s_bucket, min(256, n), max(n // 8, 1)):
+    n = len(_pad_pow2(data))
+    block = min(256, n)
+    # Per-RUNG readiness (ADVICE r4): the retry schedule escalates to the
+    # n+1 rung on line-count overflow (average line < 8 bytes), and that
+    # rung is a separately compiled shape — gating only the first rung
+    # would let the escalation trigger exactly the in-task multi-minute
+    # remote compile the gate exists to prevent.  The gate precedes the
+    # table/chunk uploads so a not-ready refusal stays device-free.
+    rungs = line_cap_rungs(n)
+    if not _device_ready(n, s_bucket, block, rungs[0]):
         return None  # cold remote compile in-task: host serves this job
+    chunk = jnp.asarray(_pad_pow2(data))
     table = jnp.asarray(table_np)
     v0 = jnp.asarray(v0_np)
-
-    def run(l_cap: int):
-        return _nfa_compiled(n, s_bucket, min(256, n), l_cap)(
-            chunk, table, v0)
-
-    line_match, nl = retry_line_caps(n, run)
-    return lines_from_flags(text, line_match, nl)
+    for l_cap in rungs:
+        if not _device_ready(n, s_bucket, block, l_cap):
+            return None  # escalation rung not persisted: host serves it
+        line_match, n_lines, overflow = _nfa_compiled(
+            n, s_bucket, block, l_cap)(chunk, table, v0)
+        if not bool(overflow):
+            break
+    return lines_from_flags(text, line_match, int(n_lines))

@@ -100,6 +100,29 @@ def main() -> int:
             assert nlines is not None
             print(f"nfagrep kernel: {time.perf_counter() - t0:.1f}s "
                   f"{len(nlines)} matching lines", flush=True)
+
+            # The run above warms only the first l_cap rung (the corpus's
+            # average line is > 8 bytes, so no overflow).  The tier's
+            # per-rung readiness gate (ADVICE r4) refuses device dispatch
+            # unless EVERY rung it might escalate to is persisted — warm
+            # the n+1 overflow rung too so short-line inputs stay on
+            # device instead of falling back to host.
+            from dsi_tpu.ops.grepk import line_cap_rungs
+            from dsi_tpu.ops.nfak import _bucket, _nfa_compiled, \
+                parse_nfa_pattern
+            from dsi_tpu.ops.wordcount import _pad_pow2
+
+            # Derive the state bucket from the warm pattern exactly the
+            # way the tier does, so the two can never drift onto
+            # different compiled shapes.
+            _, n_atoms = parse_nfa_pattern("th+e")
+            s_bucket = _bucket(n_atoms)
+            n = len(_pad_pow2(raw))
+            t0 = time.perf_counter()
+            for l_cap in line_cap_rungs(n):
+                _nfa_compiled(n, s_bucket, min(256, n), l_cap)
+            print(f"nfagrep overflow rung: {time.perf_counter() - t0:.1f}s",
+                  flush=True)
         finally:
             del os.environ["DSI_NFA_COLD_OK"]
 

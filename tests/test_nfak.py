@@ -103,6 +103,40 @@ def test_cold_compile_gate(monkeypatch):
     assert nfak._device_ready(1024, 16, 256, 128)
 
 
+def test_overflow_rung_gated(monkeypatch):
+    """ADVICE r4 (medium): the l_cap retry schedule escalates to the n+1
+    rung on line-count overflow, a separately compiled shape.  On an
+    accelerator with only the FIRST rung persisted, the tier must fall
+    back to host rather than cold-compile the escalation rung in-task."""
+    import numpy as np
+
+    import dsi_tpu.ops.nfak as nfak
+    from dsi_tpu.ops.grepk import line_cap_rungs
+
+    compiled_caps = []
+
+    def fake_ready(n, s, b, l_cap):
+        return l_cap == line_cap_rungs(n)[0]  # only rung 1 persisted
+
+    def fake_compiled(n, s, b, l_cap):
+        compiled_caps.append(l_cap)
+
+        def run(chunk, table, v0):
+            # Overflowing result: forces escalation to the next rung.
+            return (np.zeros(l_cap, np.int32), np.int32(l_cap + 5),
+                    np.bool_(True))
+
+        return run
+
+    monkeypatch.setattr(nfak, "_device_ready", fake_ready)
+    monkeypatch.setattr(nfak, "_nfa_compiled", fake_compiled)
+    data = b"ab\n" * 64  # average line 3 B < 8 B: rung 1 overflows
+    assert nfak.nfagrep_host_result(data, "ab+") is None
+    n = len(nfak._pad_pow2(data))
+    assert compiled_caps == [line_cap_rungs(n)[0]], \
+        "escalation rung must never be compiled when not persisted"
+
+
 def test_multi_block_spanning():
     """Data far larger than one 256-byte scan block, with matches that
     sit inside, start, and end at block boundaries."""
