@@ -530,6 +530,149 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
             "stream_s": round(dt, 2), "stream_parity": True}
 
 
+def framework_row_mb() -> float:
+    return env_float("DSI_BENCH_FRAMEWORK_MB", 48.0)
+
+
+def run_framework_row() -> dict:
+    """The reference's own headline measurement (VERDICT r4 task 2): the
+    REAL distributed framework — coordinator + N worker processes over the
+    pull-RPC control plane and shared-FS data plane — versus the
+    sequential oracle on the same corpus (``main/test-mr.sh:36-53`` vs
+    ``main/mrsequential.go:25-87``).  Chip-independent: host-backend
+    workers, so the row exists even during a tunnel outage.
+
+    N = max(3, available cores) — the reference runs 3 workers
+    (``test-mr.sh:43-45``); more cores, more workers.  ``framework_cores``
+    rides the row because the speedup physically cannot exceed the core
+    count: on a 1-core box the distributed run CANNOT beat the sequential
+    oracle (process parallelism has nothing to run on), and the row must
+    say so rather than look like a framework defect.
+
+    Timing starts when workers spawn (coordinator already listening) and
+    stops when the last worker exits (workers exit on TaskStatus=DONE,
+    ``mr/worker.go:51-53`` semantics) — excluding the coordinator's 1 Hz
+    done-poll + exit-grace, which are fixed constants, not job work.
+
+    Always returns either a measured row or ``framework_skipped``; parity
+    mismatch suppresses the throughput (same discipline as the stream
+    row).
+    """
+    mb = framework_row_mb()
+    if mb <= 0:
+        return {}
+    import shutil
+
+    from dsi_tpu.apps import wc
+    from dsi_tpu.mr.sequential import run_sequential
+    from dsi_tpu.utils.corpus import ensure_corpus
+    from dsi_tpu.utils.tracing import Span
+
+    budget = env_float("DSI_BENCH_FRAMEWORK_TIMEOUT", 300.0)
+    n_workers = max(3, len(os.sched_getaffinity(0)))
+    fw_dir = os.path.join(WORKDIR, "fw")
+    shutil.rmtree(fw_dir, ignore_errors=True)
+    os.makedirs(fw_dir)
+    n_files = max(n_workers, round(mb * 1e6 / FILE_SIZE))
+    files = ensure_corpus(os.path.join(WORKDIR, "fw-corpus"),
+                          n_files=n_files, file_size=FILE_SIZE)
+    total_mb = sum(os.path.getsize(p) for p in files) / 1e6
+
+    # Oracle at THIS scale: the parity ground truth and the same-corpus
+    # baseline the speedup is computed against.
+    oracle_out = os.path.join(fw_dir, "mr-correct.txt")
+    with Span("bench.fw_oracle") as pt:
+        run_sequential(wc.Map, wc.Reduce, files, oracle_out)
+    fw_oracle_mbps = total_mb / pt.elapsed_s
+
+    # The native kv codec builds lazily on first use (up to ~2 min of
+    # g++, once per machine); force it now so no worker pays it inside
+    # the timed window.
+    from dsi_tpu import native
+
+    native.available()
+
+    env = dict(os.environ)
+    env["DSI_MR_SOCKET"] = os.path.join(fw_dir, "mr.sock")
+    # cwd is the sandbox, so the repo must reach the children via
+    # PYTHONPATH (the bench process itself gets it from sys.path.insert).
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    # Host-backend workers never touch jax; without this the axon
+    # sitecustomize hook imports jax (+ PJRT registration) in EVERY child
+    # interpreter — ~2.3 s per process, serialized on a 1-core box, which
+    # would measure the site hook instead of the framework.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "dsi_tpu.cli.mrcoordinator", *files],
+        cwd=fw_dir, env=env, stdout=sys.stderr, stderr=sys.stderr)
+    workers: list = []
+
+    def reap(reason: str) -> dict:
+        for p in [coord, *workers]:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        log(f"framework row skipped: {reason}")
+        return {"framework_skipped": reason}
+
+    deadline = time.monotonic() + 15.0
+    while not os.path.exists(env["DSI_MR_SOCKET"]):
+        if coord.poll() is not None or time.monotonic() > deadline:
+            return reap("coordinator did not open its socket")
+        time.sleep(0.05)
+
+    t0 = time.perf_counter()
+    workers = [
+        subprocess.Popen([sys.executable, "-m", "dsi_tpu.cli.mrworker", "wc"],
+                         cwd=fw_dir, env=env, stdout=sys.stderr,
+                         stderr=sys.stderr)
+        for _ in range(n_workers)]
+    deadline = time.monotonic() + budget
+    for p in workers:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            return reap(f"worker still running after {budget:.0f}s")
+    dt = time.perf_counter() - t0
+    if any(p.returncode != 0 for p in workers):
+        return reap("worker exited nonzero")
+    try:
+        coord.wait(timeout=30.0)
+    except subprocess.TimeoutExpired:
+        return reap("coordinator did not exit after job completion")
+
+    fw_lines = []
+    for r in range(N_REDUCE):
+        try:
+            with open(os.path.join(fw_dir, f"mr-out-{r}"),
+                      encoding="utf-8") as f:
+                fw_lines.extend(l for l in f if l.strip())
+        except OSError:
+            return reap(f"missing output partition mr-out-{r}")
+    fw_lines.sort()
+    with open(oracle_out, encoding="utf-8") as f:
+        oracle_lines = sorted(l for l in f if l.strip())
+    parity = fw_lines == oracle_lines
+    fw_mbps = total_mb / dt
+    log(f"framework row: {total_mb:.1f} MB, {n_workers} workers on "
+        f"{len(os.sched_getaffinity(0))} core(s): {dt:.2f}s = "
+        f"{fw_mbps:.2f} MB/s vs oracle {fw_oracle_mbps:.2f} MB/s "
+        f"(parity={parity})")
+    if not parity:
+        return {"framework_skipped": "parity mismatch (throughput "
+                                     "suppressed)",
+                "framework_parity": False}
+    return {"framework_mbps": round(fw_mbps, 2),
+            "framework_s": round(dt, 2),
+            "framework_mb": round(total_mb, 1),
+            "framework_workers": n_workers,
+            "framework_cores": len(os.sched_getaffinity(0)),
+            "framework_oracle_mbps": round(fw_oracle_mbps, 2),
+            "framework_vs_oracle": round(fw_mbps / fw_oracle_mbps, 2),
+            "framework_parity": True}
+
+
 def global_budget_s() -> float:
     """The TPU half's wall budget (DSI_BENCH_DEADLINE_S)."""
     return env_float("DSI_BENCH_DEADLINE_S", 2100.0)
@@ -729,6 +872,17 @@ def main() -> None:
         # fallback child would add minutes past the caller's budget.
         if budget_s >= 60:
             res = run_cpu_fallback(deadline)
+    # The distributed N-worker row is chip-independent (host workers), so
+    # it rides EVERY verdict branch — it is the number that exists even
+    # when the tunnel is down.  The budget<60 escape hatch stays fast
+    # unless the row is explicitly requested.
+    fw = {}
+    if budget_s >= 60 or "DSI_BENCH_FRAMEWORK_MB" in os.environ:
+        try:
+            fw = run_framework_row()
+        except Exception as e:  # never trade the verdict for the row
+            fw = {"framework_skipped":
+                  f"framework row failed: {type(e).__name__}: {e}"}
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,
@@ -737,6 +891,7 @@ def main() -> None:
                "diagnosis": diagnose_tunnel()}
         if tpu_error:
             out["tpu_error"] = tpu_error
+        out.update(fw)
         print(json.dumps(out))
         sys.exit(1)
     log(f"tpu path: {res['tpu_s']:.3f}s = {res['tpu_mbps']:.2f} MB/s  "
@@ -751,6 +906,7 @@ def main() -> None:
         if tpu_error:  # the mismatching run was the CPU fallback
             out["tpu_error"] = tpu_error
             out["diagnosis"] = diagnose_tunnel()
+        out.update(fw)
         print(json.dumps(out))
         sys.exit(1)
 
@@ -774,6 +930,7 @@ def main() -> None:
               "stream_skipped"):
         if k in res:
             out[k] = res[k]
+    out.update(fw)
     if tpu_error:
         # The number above was measured on the CPU FALLBACK backend: the
         # TPU half failed (tunnel outage etc.) and this run proves the

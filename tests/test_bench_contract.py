@@ -33,6 +33,7 @@ def run_bench(tmp_path, extra_env, timeout=300):
         "DSI_BENCH_FILES": "2",
         "DSI_BENCH_FILE_SIZE": "200000",
         "DSI_BENCH_REPS": "1",
+        "DSI_BENCH_FRAMEWORK_MB": "2",  # default 48 MB would dominate
         # Isolated workdir + compile cache: must NOT touch the repo's
         # canonical .bench corpus/oracle (the warm loop's parity checks
         # read them) or write CPU-platform entries into the persistent
@@ -83,12 +84,22 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
     if "stream_mbps" in v:
         assert v["stream_parity"] is True
         assert v["stream_mb"] >= 2
+    # The distributed N-worker row (the reference's own headline shape,
+    # test-mr.sh:36-53) rides the same verdict: measured or skipped.
+    assert ("framework_skipped" in v) != ("framework_mbps" in v)
+    if "framework_mbps" in v:
+        assert v["framework_parity"] is True
+        assert v["framework_workers"] >= 3
+        assert v["framework_vs_oracle"] == pytest.approx(
+            v["framework_mbps"] / v["framework_oracle_mbps"], rel=0.02)
 
 
 @pytest.mark.slow
 def test_stream_row_disabled_leaves_no_stream_keys(tmp_path):
     rc, v = run_bench(tmp_path, {"DSI_BENCH_TPU_TIMEOUTS": "0",
                                  "DSI_BENCH_DEADLINE_S": "600",
-                                 "DSI_BENCH_STREAM_MB": "0"})
+                                 "DSI_BENCH_STREAM_MB": "0",
+                                 "DSI_BENCH_FRAMEWORK_MB": "0"})
     assert rc == 0
     assert not any(k.startswith("stream_") for k in v)
+    assert not any(k.startswith("framework_") for k in v)
