@@ -45,6 +45,7 @@ with a bigger kernel or falls back to the host implementation
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, Optional
 
 import jax
@@ -193,13 +194,123 @@ def group_sorted(skeys_cols: tuple, counts: jax.Array, out_cap: int):
     return keys, totals, upos, ovalid, n_unique
 
 
+def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
+                fnv_t: jax.Array, *, u_cap: int, max_word_len: int):
+    """Group identical tokens WITHOUT the big sort: scatter tokens into
+    fnv-addressed buckets and verify each bucket holds exactly one
+    distinct word (segment-min == segment-max over every packed key
+    lane).  Tokens from buckets that fail the check (distinct words
+    sharing low hash bits — a few hundred per MiB of real text) are
+    compacted into a small fixed buffer and grouped by the exact
+    lexicographic sort, so the result is exact regardless of hash
+    behavior; only if the dirty set overflows its buffer (pathological
+    input) does ``group_overflow`` make the caller re-run the whole
+    chunk through the sort grouper.
+
+    Motivation (measured, BASELINE.md round 5): at 1 MiB/4 tokens the
+    2xu64-key ``lax.sort`` costs ~99 ms on this CPU while the segment-op
+    group + t_cap/8 repair sort costs ~50 ms — the big sort is the
+    kernel's dominant cost and this halves it.  The sort grouper remains
+    the default for accelerator platforms (TPU scatter characteristics
+    differ; switch there only with on-chip evidence).
+
+    Returns (keys64_u tuple [u_cap] per lane, len_u, cnt_u, n_unique,
+    group_overflow).
+    """
+    t_cap = lengths.shape[0]
+    # ~1x t_cap buckets, power of two (the index is a low-bits mask):
+    # measured on this CPU, the halved segment arrays beat the doubled
+    # (still tiny) dirty fraction.  d_cap absorbs the worst realistic
+    # dirty set — a hot word ("the" ~6% of English tokens, i.e. about
+    # t_cap/4 x 0.24) landing in a dirty bucket — with the
+    # group_overflow escape for pathological inputs.
+    n_buckets = 1 << max(10, int(t_cap).bit_length() - 1)
+    d_cap = max(1 << 8, t_cap // 16)
+    keys64 = pack_key_lanes(packed_cols)
+    k64 = len(keys64)
+
+    # Level 1: bucket by the (reference-exact) fnv1a hash's low bits.
+    idx1 = jnp.where(valid, (fnv_t & jnp.uint32(n_buckets - 1))
+                     .astype(jnp.int32), n_buckets)
+    tot1 = jax.ops.segment_sum(
+        jnp.where(valid, 1, 0), idx1, num_segments=n_buckets + 1)[:n_buckets]
+    len1 = jax.ops.segment_max(
+        jnp.where(valid, lengths, 0), idx1,
+        num_segments=n_buckets + 1)[:n_buckets]
+    keys1 = []
+    with jax.enable_x64(True):
+        dirty = jnp.zeros(n_buckets, jnp.bool_)
+        for kcol in keys64:
+            mn = jax.ops.segment_min(
+                kcol, idx1, num_segments=n_buckets + 1)[:n_buckets]
+            mx = jax.ops.segment_max(
+                kcol, idx1, num_segments=n_buckets + 1)[:n_buckets]
+            dirty |= mn != mx
+            keys1.append(mx)
+    occ1 = tot1 > 0
+    dirty &= occ1
+
+    # Dirty repair: compact the (few) tokens of dirty buckets and group
+    # them with the exact sort — small static buffer, zero collision
+    # risk, no retry unless it overflows.
+    in_dirty = valid & dirty[jnp.clip(idx1, 0, n_buckets - 1)]
+    n_dirty_tokens = jnp.sum(in_dirty, dtype=jnp.int32)
+    group_overflow = n_dirty_tokens > d_cap
+    (dpos,) = jnp.nonzero(in_dirty, size=d_cap, fill_value=0)
+    dvalid = jnp.arange(d_cap, dtype=jnp.int32) < n_dirty_tokens
+    dlen = jnp.where(dvalid, lengths[dpos], 0)
+    with jax.enable_x64(True):
+        dkeys = tuple(jnp.where(dvalid, kcol[dpos], jnp.uint64(_PAD_KEY64))
+                      for kcol in keys64)
+        sorted_ops = lax.sort(dkeys + (dlen,), num_keys=k64)
+        dgk, dtot, dupos, dovalid, n_du = group_sorted(
+            sorted_ops[:k64], jnp.ones(d_cap, jnp.int32), u_cap)
+        dslens = sorted_ops[k64]
+
+    # Assemble: clean level-1 buckets first, dirty-repair uniques after.
+    clean1 = occ1 & ~dirty
+    n_clean1 = jnp.sum(clean1, dtype=jnp.int32)
+    n_unique = n_clean1 + n_du
+    (cpos1,) = jnp.nonzero(clean1, size=u_cap, fill_value=n_buckets - 1)
+    v1 = jnp.arange(u_cap, dtype=jnp.int32) < n_clean1
+    dst2 = jnp.where(dovalid, jnp.arange(u_cap, dtype=jnp.int32) + n_clean1,
+                     u_cap)
+
+    with jax.enable_x64(True):
+        out_keys = []
+        for j in range(k64):
+            # A clean bucket's segment-max IS its one word's lane value.
+            col = jnp.where(v1, keys1[j][cpos1], jnp.uint64(0))
+            col = col.at[dst2].set(
+                jnp.where(dovalid, dgk[dupos, j], jnp.uint64(0)),
+                mode="drop")
+            out_keys.append(col)
+    len_u = jnp.where(v1, len1[cpos1], 0)
+    len_u = len_u.at[dst2].set(
+        jnp.where(dovalid, dslens[dupos], 0).astype(len_u.dtype),
+        mode="drop")
+    cnt_u = jnp.where(v1, tot1[cpos1], 0)
+    cnt_u = cnt_u.at[dst2].set(jnp.where(dovalid, dtot, 0), mode="drop")
+    return tuple(out_keys), len_u, cnt_u, n_unique, group_overflow
+
+
 def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
-                        u_cap: int = 1 << 17, t_cap_frac: int = 4):
+                        u_cap: int = 1 << 17, t_cap_frac: int = 4,
+                        grouper: str = "sort"):
     """Exact unique-word counts over one uint8 chunk (zero-padded tail).
 
     Returns (packed_u [u_cap, K] uint32, len_u [u_cap] i32, cnt_u [u_cap]
     i32, fnv_u [u_cap] u32, n_unique i32, max_len i32, has_high bool,
     token_overflow bool).
+
+    ``grouper`` selects how identical tokens are grouped: ``"sort"`` (the
+    default — lexicographic multi-key ``lax.sort``, right for the TPU) or
+    ``"hash"`` (scatter/segment-op bucketing with exact collision
+    verification and sort fallback, ~2x faster on the CPU backend where
+    XLA's sort is the measured kernel floor — BASELINE.md round 5).  A
+    hash-grouper attempt that cannot prove exactness reports
+    ``token_overflow`` so the shared retry ladder re-runs it; the wrapper
+    then routes the chunk to the sort grouper.
 
     Not jitted itself so it can be inlined into larger programs (the
     ``shard_map`` SPMD step in ``dsi_tpu/parallel/shuffle.py`` traces it per
@@ -210,28 +321,49 @@ def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
     k = max_word_len // 4
     t_cap = n // t_cap_frac + 1
 
-    idx = jnp.arange(n, dtype=jnp.int32)
     letter = is_ascii_letter(chunk)
     prev_letter = jnp.concatenate([jnp.zeros((1,), jnp.bool_), letter[:-1]])
     starts = letter & ~prev_letter
+    next_letter = jnp.concatenate([letter[1:], jnp.zeros((1,), jnp.bool_)])
+    ends = letter & ~next_letter
     n_tokens = jnp.sum(starts, dtype=jnp.int32)
     token_overflow = n_tokens > t_cap
 
-    # Distance to the next non-letter: token length at every start position.
-    m = jnp.where(letter, n, idx)
-    next_nl = lax.associative_scan(jnp.minimum, m, reverse=True)
-    length_all = (next_nl - idx).astype(jnp.int32)
-
-    lanes = build_lanes(chunk, length_all, max_word_len)
-
-    # Compact to the token buffer: k+1 one-dimensional gathers.
+    # Compact to the token buffer.  Token lengths come from the paired
+    # start/end compactions (runs cannot nest, so the i-th start matches
+    # the i-th end) — cheaper than the former per-position reverse-min
+    # scan, whose log-depth passes over the whole chunk were ~10% of the
+    # kernel.  Key lanes gather straight from the single packed-bytes
+    # array at ``start + 4j`` and are masked AFTER compaction: the same
+    # k token-level gathers as before, but the byte-masking runs over
+    # t_cap rows instead of building k masked full-chunk lane arrays.
     (start_pos,) = jnp.nonzero(starts, size=t_cap, fill_value=n - 1)
+    (end_pos,) = jnp.nonzero(ends, size=t_cap, fill_value=n - 1)
     valid = jnp.arange(t_cap, dtype=jnp.int32) < n_tokens
-    lengths = jnp.where(valid, length_all[start_pos], 0)
+    lengths = jnp.where(valid, end_pos - start_pos + 1, 0).astype(jnp.int32)
     max_len = jnp.max(lengths, initial=0)
+    c = chunk.astype(jnp.uint32)
+    b32 = ((c << 24) | (_shift_left(c, 1) << 16)
+           | (_shift_left(c, 2) << 8) | _shift_left(c, 3))
     packed_cols = tuple(
-        jnp.where(valid, lane[start_pos], jnp.uint32(_PAD_KEY))
-        for lane in lanes)
+        jnp.where(valid,
+                  b32[start_pos + 4 * j]
+                  & _byte_mask(jnp.clip(lengths - 4 * j, 0, 4)),
+                  jnp.uint32(_PAD_KEY))
+        for j in range(k))
+
+    if grouper == "hash":
+        fnv_t = fnv1a32_packed(jnp.stack(packed_cols, axis=1), lengths,
+                               max_word_len)
+        keys64_u, len_u, cnt_u, n_unique, group_of = _hash_group(
+            packed_cols, lengths, valid, fnv_t, u_cap=u_cap,
+            max_word_len=max_word_len)
+        with jax.enable_x64(True):
+            packed_u = unpack_key_rows(jnp.stack(keys64_u, axis=1), k)
+        fnv_u = fnv1a32_packed(packed_u, len_u, max_word_len)
+        has_high = jnp.any(chunk >= 128)
+        return (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
+                token_overflow | group_of)
 
     # Group identical words: lexicographic sort over the key lanes packed
     # pairwise into uint64s (pack_key_lanes: same order, half the
@@ -257,30 +389,63 @@ def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
 
 count_words_kernel = jax.jit(
     tokenize_group_core,
-    static_argnames=("max_word_len", "u_cap", "t_cap_frac"))
+    static_argnames=("max_word_len", "u_cap", "t_cap_frac", "grouper"))
+
+
+def default_grouper() -> str:
+    """Platform-adaptive grouping strategy: ``hash`` on the CPU backend
+    (where the multi-key sort is the measured kernel floor — BASELINE.md
+    round 5), ``sort`` on accelerators until on-chip evidence says
+    otherwise.  ``DSI_WC_GROUPER`` pins the choice."""
+    env = os.environ.get("DSI_WC_GROUPER")
+    if env in ("sort", "hash"):
+        return env
+    return "hash" if jax.devices()[0].platform == "cpu" else "sort"
+
+
+def grouper_ladder() -> tuple:
+    """The retry rungs every kernel wrapper walks: the platform's
+    preferred grouper first, with the sort grouper as the always-exact
+    last rung (a hash-grouper collision overflow cannot clear at frac=2;
+    the sort can never overflow there).  One definition so the three
+    wrappers (here, parallel/shuffle.py, parallel/streaming.py) cannot
+    drift."""
+    g0 = default_grouper()
+    return (g0, "sort") if g0 != "sort" else ("sort",)
 
 
 @functools.lru_cache(maxsize=256)
-def _cached_kernel(n: int, max_word_len: int, u_cap: int, t_cap_frac: int):
+def _cached_kernel(n: int, max_word_len: int, u_cap: int, t_cap_frac: int,
+                   grouper: str = "sort"):
     """The single-chunk kernel via the persistent AOT executable cache
     (backends/aotcache.py): a fresh worker process loads the serialized
     executable in milliseconds instead of re-paying the XLA compile —
     essential on platforms where jit compiles run to minutes and every
     mrworker is its own process (main/test-mr.sh:43-45 spawns three).
-    lru_cached so repeat dispatches skip the cache-key fingerprinting."""
+    lru_cached so repeat dispatches skip the cache-key fingerprinting.
+
+    The ``grouper`` static enters the key/name only for the hash variant
+    — purely so sort-grouper cache filenames keep their historical,
+    readable names.  (It is NOT a warm-cache-survival guarantee: the key
+    also fingerprints this module's source, so any kernel edit misses
+    and recompiles regardless.)"""
     from dsi_tpu.backends.aotcache import cached_compile
 
     example = (jax.ShapeDtypeStruct((n,), np.uint8),)
-    return cached_compile(
-        "wc_kernel", tokenize_group_core, example,
-        static={"max_word_len": max_word_len, "u_cap": u_cap,
-                "t_cap_frac": t_cap_frac})
+    static = {"max_word_len": max_word_len, "u_cap": u_cap,
+              "t_cap_frac": t_cap_frac}
+    name = "wc_kernel"
+    if grouper != "sort":
+        static["grouper"] = grouper
+        name = f"wc_kernel_{grouper}"
+    return cached_compile(name, tokenize_group_core, example, static=static)
 
 
 def run_count_kernel(chunk: jax.Array, *, max_word_len: int, u_cap: int,
-                     t_cap_frac: int):
+                     t_cap_frac: int, grouper: str = "sort"):
     """Dispatch one chunk through the AOT-cached executable."""
-    fn = _cached_kernel(int(chunk.shape[0]), max_word_len, u_cap, t_cap_frac)
+    fn = _cached_kernel(int(chunk.shape[0]), max_word_len, u_cap, t_cap_frac,
+                        grouper)
     return fn(chunk)
 
 
@@ -360,12 +525,17 @@ def count_words_host_result(
     letter-free input legitimately returns an empty dict."""
     chunk = _pad_pow2(data)
     dev_chunk = jnp.asarray(chunk)
+    groupers = grouper_ladder()
 
     def run(mwl: int, cap: int):
-        for frac in (4, 2):  # exact token bound is n//2+1; try compact first
-            (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
-             tok_of) = run_count_kernel(dev_chunk, max_word_len=mwl,
-                                        u_cap=cap, t_cap_frac=frac)
+        for g in groupers:
+            for frac in (4, 2):  # exact token bound is n//2+1
+                (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
+                 tok_of) = run_count_kernel(dev_chunk, max_word_len=mwl,
+                                            u_cap=cap, t_cap_frac=frac,
+                                            grouper=g)
+                if not bool(tok_of):
+                    break
             if not bool(tok_of):
                 break
         nu = int(n_unique)
@@ -394,15 +564,15 @@ def count_words_many(datas, *, max_word_len: int = 16,
     as ``count_words_host_result``.
     """
     launches = []
+    g0 = default_grouper()
     for data in datas:
         chunk = _pad_pow2(data)
-        # Same floor as exactness_retry: a zero/negative capacity would
-        # build a degenerate (or shape-invalid) kernel.
-        cap = max(1, min(u_cap, 1 << (len(chunk) // 2).bit_length()))
+        cap = rung0_cap(len(chunk), u_cap)
         launches.append((data, cap,
                          run_count_kernel(jnp.asarray(chunk),
                                           max_word_len=max_word_len,
-                                          u_cap=cap, t_cap_frac=4)))
+                                          u_cap=cap, t_cap_frac=4,
+                                          grouper=g0)))
     results = []
     for data, cap, out in launches:
         (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,

@@ -93,7 +93,8 @@ def shuffle_rows(rows: jax.Array, dest: jax.Array, *, n_dev: int,
 
 
 def map_prologue(chunk: jax.Array, *, n_dev: int, n_reduce: int,
-                 max_word_len: int, u_cap: int, t_cap_frac: int):
+                 max_word_len: int, u_cap: int, t_cap_frac: int,
+                 grouper: str = "sort"):
     """Shared per-device map phase: tokenize + combine + partition.
 
     The one place the reference-parity partition rule lives on device:
@@ -108,7 +109,8 @@ def map_prologue(chunk: jax.Array, *, n_dev: int, n_reduce: int,
     """
     (packed_u, len_u, cnt_u, fnv_u, n_unique, max_len, has_high,
      token_overflow) = tokenize_group_core(
-        chunk, max_word_len=max_word_len, u_cap=u_cap, t_cap_frac=t_cap_frac)
+        chunk, max_word_len=max_word_len, u_cap=u_cap, t_cap_frac=t_cap_frac,
+        grouper=grouper)
     uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
     part = (fnv_u & jnp.uint32(0x7FFFFFFF)) % jnp.uint32(n_reduce)
     dest = jnp.where(uvalid, (part % n_dev).astype(jnp.int32), n_dev)
@@ -117,7 +119,8 @@ def map_prologue(chunk: jax.Array, *, n_dev: int, n_reduce: int,
 
 
 def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
-                 max_word_len: int, u_cap: int, t_cap_frac: int):
+                 max_word_len: int, u_cap: int, t_cap_frac: int,
+                 grouper: str = "sort"):
     """Per-device body (runs under shard_map): map, all_to_all, reduce."""
     k = max_word_len // 4
     chunk = chunk.reshape(-1)  # [1, L] block -> [L]
@@ -126,7 +129,7 @@ def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
     packed_u, len_u, cnt_u, part, dest, (
         n_unique, max_len, has_high, token_overflow) = map_prologue(
         chunk, n_dev=n_dev, n_reduce=n_reduce, max_word_len=max_word_len,
-        u_cap=u_cap, t_cap_frac=t_cap_frac)
+        u_cap=u_cap, t_cap_frac=t_cap_frac, grouper=grouper)
 
     # ── shuffle: the mr-X-Y files become one ICI collective ──
     rows = jnp.concatenate(
@@ -166,10 +169,11 @@ def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_dev", "n_reduce", "max_word_len",
-                                    "u_cap", "t_cap_frac", "mesh"))
+                                    "u_cap", "t_cap_frac", "mesh",
+                                    "grouper"))
 def mapreduce_step(chunks: jax.Array, *, n_dev: int, n_reduce: int,
                    max_word_len: int, u_cap: int, mesh: Mesh,
-                   t_cap_frac: int = 4):
+                   t_cap_frac: int = 4, grouper: str = "sort"):
     """The full SPMD job step, jitted over the mesh.
 
     ``chunks``: [n_dev, L] uint8, one zero-padded text shard per device.
@@ -177,10 +181,15 @@ def mapreduce_step(chunks: jax.Array, *, n_dev: int, n_reduce: int,
     [D, D*u_cap, K], byte lengths, summed counts, reduce-partition ids, and a
     [D, 5] scalar block (m_unique, n_unique, max_len, has_high,
     token_overflow).
+
+    ``grouper`` (ops/wordcount.py default_grouper): with ``"hash"`` the
+    per-device map groups by scattered hash buckets instead of the big
+    sort; an unresolvable collision rides the token_overflow scalar and
+    the host wrapper re-runs the step with ``"sort"``.
     """
     body = functools.partial(_device_step, n_dev=n_dev, n_reduce=n_reduce,
                              max_word_len=max_word_len, u_cap=u_cap,
-                             t_cap_frac=t_cap_frac)
+                             t_cap_frac=t_cap_frac, grouper=grouper)
     return jax.shard_map(
         body, mesh=mesh,
         in_specs=P(AXIS, None),
@@ -260,13 +269,19 @@ def wordcount_sharded(
     n_dev = mesh.devices.size
     chunks_np, shard_len = shard_text(data, n_dev)
     chunks = jnp.asarray(chunks_np)
+    from dsi_tpu.ops.wordcount import grouper_ladder
+
+    groupers = grouper_ladder()
 
     def run(mwl: int, cap: int):
-        for frac in (4, 2):  # exact token bound is n//2+1; try compact first
-            keys, lens, cnts, parts, scal = mapreduce_step(
-                chunks, n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
-                u_cap=cap, mesh=mesh, t_cap_frac=frac)
-            scal = np.asarray(scal)
+        for g in groupers:
+            for frac in (4, 2):  # exact token bound is n//2+1
+                keys, lens, cnts, parts, scal = mapreduce_step(
+                    chunks, n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
+                    u_cap=cap, mesh=mesh, t_cap_frac=frac, grouper=g)
+                scal = np.asarray(scal)
+                if not scal[:, 4].any():
+                    break
             if not scal[:, 4].any():
                 break
 

@@ -52,7 +52,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from dsi_tpu.ops.wordcount import exactness_retry
+from dsi_tpu.ops.wordcount import (
+    default_grouper,
+    exactness_retry,
+    grouper_ladder,
+)
 from dsi_tpu.parallel.merge import PackedCounts
 from dsi_tpu.parallel.shuffle import (
     _is_letter_byte,
@@ -130,22 +134,29 @@ def stream_files(paths: Sequence[str],
 
 
 def _step_program(*, n_dev: int, n_reduce: int, max_word_len: int,
-                  u_cap: int, mesh: Mesh, t_cap_frac: int):
+                  u_cap: int, mesh: Mesh, t_cap_frac: int,
+                  grouper: str = "sort"):
     """The (name, fn, code-deps) triple for one compiled
     ``mapreduce_step`` shape — single definition shared by the
     cached-compile path, the warmer, and the cache-existence probe, so a
-    probe's key is by construction the key a run compiles."""
+    probe's key is by construction the key a run compiles.  The sort
+    grouper keeps its historical, readable name; the hash grouper gets a
+    distinct suffix.  (Naming only — cache invalidation is governed by
+    the source fingerprint, so kernel edits recompile either way.)"""
     import dsi_tpu.ops.wordcount as _wc
     import dsi_tpu.parallel.shuffle as _sh
 
     def fn(c):
         return mapreduce_step(c, n_dev=n_dev, n_reduce=n_reduce,
                               max_word_len=max_word_len, u_cap=u_cap,
-                              mesh=mesh, t_cap_frac=t_cap_frac)
+                              mesh=mesh, t_cap_frac=t_cap_frac,
+                              grouper=grouper)
 
     fn._aot_code_deps = (_wc, _sh)
     name = (f"stream_step_d{n_dev}_r{n_reduce}_w{max_word_len}"
             f"_u{u_cap}_f{t_cap_frac}")
+    if grouper != "sort":
+        name += f"_g{grouper}"
     return name, fn
 
 
@@ -225,12 +236,17 @@ def stream_programs_persisted(mesh: Mesh | None = None,
     n_dev = mesh.devices.size
     chunks, rows, pack_args = _stream_examples(n_dev, chunk_bytes, u_cap,
                                                max_word_len)
-    for frac in fracs:
-        name, fn = _step_program(n_dev=n_dev, n_reduce=n_reduce,
-                                 max_word_len=max_word_len, u_cap=u_cap,
-                                 mesh=mesh, t_cap_frac=frac)
-        if not is_persisted(name, fn, (chunks,)):
-            return False
+    # Probe every grouper rung the run's ladder can reach (the platform
+    # default first, sort as the exact fallback) — probing sort alone
+    # would answer "warm" while the first program a DSI_WC_GROUPER-pinned
+    # run compiles is cold.
+    for g in sorted(set(grouper_ladder())):
+        for frac in fracs:
+            name, fn = _step_program(n_dev=n_dev, n_reduce=n_reduce,
+                                     max_word_len=max_word_len, u_cap=u_cap,
+                                     mesh=mesh, t_cap_frac=frac, grouper=g)
+            if not is_persisted(name, fn, (chunks,)):
+                return False
     name, fn = _pack_program(mp=rows)
     return is_persisted(name, fn, pack_args)
 
@@ -260,14 +276,20 @@ def warm_stream_aot(mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
+    # Warm the platform's preferred grouper alongside the always-available
+    # sort rung (ops/wordcount.default_grouper): on the chip that is sort
+    # only (names unchanged — the warmed executables stay valid); on CPU
+    # the hash grouper is the first rung a run reaches.
+    groupers = {"sort", default_grouper()}
     for mwl in word_lens:
         for cap in caps:
             chunks, rows, pack_args = _stream_examples(n_dev, chunk_bytes,
                                                        cap, mwl)
             for frac in fracs:
-                _aot_step_fn(chunks, n_dev=n_dev, n_reduce=n_reduce,
-                             max_word_len=mwl, u_cap=cap, mesh=mesh,
-                             t_cap_frac=frac)
+                for g in sorted(groupers):
+                    _aot_step_fn(chunks, n_dev=n_dev, n_reduce=n_reduce,
+                                 max_word_len=mwl, u_cap=cap, mesh=mesh,
+                                 t_cap_frac=frac, grouper=g)
             _aot_pack_fn(pack_args, mp=rows)
 
 
@@ -303,6 +325,7 @@ def wordcount_streaming(
     acc = PackedCounts()
     state = {"cap": u_cap}
     step_fn = _aot_step if aot else mapreduce_step
+    groupers = grouper_ladder()
 
     def run_step(chunks_np: np.ndarray):
         chunks = jnp.asarray(chunks_np)
@@ -311,11 +334,15 @@ def wordcount_streaming(
             state["cap"] = cap  # last attempt = the one that succeeded
             if on_attempt is not None:
                 on_attempt(mwl, cap)
-            for frac in (4, 2):
-                keys, lens, cnts, parts, scal = step_fn(
-                    chunks, n_dev=n_dev, n_reduce=n_reduce,
-                    max_word_len=mwl, u_cap=cap, mesh=mesh, t_cap_frac=frac)
-                scal_np = np.asarray(scal)
+            for g in groupers:
+                for frac in (4, 2):
+                    keys, lens, cnts, parts, scal = step_fn(
+                        chunks, n_dev=n_dev, n_reduce=n_reduce,
+                        max_word_len=mwl, u_cap=cap, mesh=mesh,
+                        t_cap_frac=frac, grouper=g)
+                    scal_np = np.asarray(scal)
+                    if not scal_np[:, 4].any():
+                        break
                 if not scal_np[:, 4].any():
                     break
 

@@ -142,3 +142,62 @@ def test_pack_key_lanes_order_and_roundtrip():
                               cols_np.T[order_lanes])
         # PAD rows sort last under the packed order
         assert set(order_packed[-16:]) == set(pad_rows)
+
+
+# ── hash grouper (round 5): exactness under forced collisions ──────────
+
+
+def _fnv1a(w: str) -> int:
+    h = 0x811C9DC5
+    for ch in w.encode():
+        h = ((h ^ ch) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def _colliding_words(mask: int, count: int = 2):
+    """Distinct lowercase words sharing fnv1a low bits (the hash
+    grouper's level-1 bucket index at small chunk shapes)."""
+    seen: dict = {}
+    import itertools
+
+    for tup in itertools.product(string.ascii_lowercase, repeat=3):
+        w = "".join(tup)
+        b = _fnv1a(w) & mask
+        seen.setdefault(b, []).append(w)
+        if len(seen[b]) >= count:
+            return seen[b][:count]
+    raise AssertionError("no collision found")
+
+
+def test_hash_grouper_dirty_bucket_exact(monkeypatch):
+    """Two distinct words sharing a level-1 bucket must be separated by
+    the dirty-repair sort, not merged (exactness does not depend on hash
+    luck)."""
+    monkeypatch.setenv("DSI_WC_GROUPER", "hash")
+    # 4 KB pad -> t_cap = 1025 -> n_buckets = 1 << max(10, 10-1) = 1024.
+    w1, w2 = _colliding_words(1023)
+    text = (f"{w1} {w2} " * 150 + f"{w1} filler words here").ljust(3000)
+    check(text)
+
+
+def test_hash_grouper_dirty_overflow_falls_back(monkeypatch):
+    """More colliding tokens than the dirty buffer holds: group_overflow
+    must route the chunk to the sort grouper and stay exact."""
+    monkeypatch.setenv("DSI_WC_GROUPER", "hash")
+    w1, w2 = _colliding_words(1023)
+    # d_cap = max(256, t_cap//16) = 256 at this shape; 600 dirty tokens
+    # overflow it.
+    text = f"{w1} {w2} " * 300
+    check(text)
+
+
+def test_hash_grouper_matches_sort_on_random_text(monkeypatch):
+    rng = random.Random(11)
+    words = ["".join(rng.choices(string.ascii_lowercase, k=rng.randint(1, 12)))
+             for _ in range(400)]
+    text = " ".join(rng.choice(words) for _ in range(5000))
+    monkeypatch.setenv("DSI_WC_GROUPER", "hash")
+    rh = count_words_host_result(text.encode())
+    monkeypatch.setenv("DSI_WC_GROUPER", "sort")
+    rs = count_words_host_result(text.encode())
+    assert rh == rs and rh is not None
