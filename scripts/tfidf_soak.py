@@ -45,9 +45,11 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
     from dsi_tpu.mr.worker import ihash
     from dsi_tpu.parallel.shuffle import default_mesh
-    from dsi_tpu.parallel.tfidf import tfidf_sharded
+    from dsi_tpu.parallel.tfidf import FileDocs, tfidf_sharded
     from dsi_tpu.utils.corpus import ensure_corpus
 
     n_docs = max(1, (args.mb << 10) // args.doc_kb)
@@ -56,30 +58,31 @@ def main() -> int:
         os.path.abspath(__file__))), ".bench", f"tfidf-soak-{args.mb}")
     t0 = time.perf_counter()
     paths = ensure_corpus(cdir, n_files=n_docs, file_size=doc_bytes)
-    docs = []
-    for p in paths:
-        with open(p, "rb") as f:
-            docs.append(f.read())
+    # Lazy docs + packed result (round 5): the corpus never sits resident
+    # and the postings stay numpy — the r4 soak's 5.1 GB peak was mostly
+    # the resident docs plus the pythonized result dict.
+    docs = FileDocs(paths)
     gen_s = time.perf_counter() - t0
-    total_mb = sum(len(d) for d in docs) / 1e6
+    total_mb = sum(docs.lengths) / 1e6
     print(f"corpus: {len(docs)} docs, {total_mb:.0f} MB "
-          f"(gen+read {gen_s:.1f}s)", file=sys.stderr, flush=True)
+          f"(gen {gen_s:.1f}s)", file=sys.stderr, flush=True)
 
     mesh = default_mesh(args.devices)
     partitions = set(range(args.slice)) if args.slice else None
     t0 = time.perf_counter()
     res = tfidf_sharded(docs, mesh=mesh, n_reduce=args.n_reduce,
-                        u_cap=1 << 15, partitions=partitions)
+                        u_cap=1 << 15, partitions=partitions, packed=True)
     wall = time.perf_counter() - t0
     assert res is not None, "tfidf fell back to host"
 
-    # Structural invariants over the whole result.
-    postings = 0
-    for w, (part, pairs) in res.items():
-        assert 1 <= len(pairs) <= len(docs)
-        if partitions is not None:
-            assert part in partitions, (w, part)
-        postings += len(pairs)
+    # Structural invariants over the whole result (vectorized on the
+    # packed tables).
+    ppw = res.postings_per_word()
+    assert len(ppw) == 0 or (1 <= ppw.min() and ppw.max() <= len(docs))
+    if partitions is not None:
+        assert np.isin(res.parts,
+                       np.fromiter(partitions, np.uint32)).all()
+    postings = res.n_postings
 
     # Exact parity for the first --verify-docs documents: every sampled
     # doc's (word -> tf) with an in-slice partition must appear verbatim.
@@ -88,11 +91,12 @@ def main() -> int:
         counts: dict = {}
         for w in re.findall(r"[A-Za-z]+", docs[di].decode()):
             counts[w] = counts.get(w, 0) + 1
+        hits = res.lookup_many(counts.keys())
         for w, tf in counts.items():
             if partitions is not None and ihash(w) % args.n_reduce \
                     not in partitions:
                 continue
-            ent = res.get(w)  # a missing word is a mismatch, not a crash
+            ent = hits.get(w)  # a missing word is a mismatch, not a crash
             got = dict(ent[1]).get(di) if ent else None
             if got != tf:
                 print(f"sample mismatch: doc {di} word {w!r}: {got} != {tf}",

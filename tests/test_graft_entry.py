@@ -23,9 +23,17 @@ def test_dryrun_multichip_prints_evidence(capsys):
 
 def test_entry_returns_jittable(capsys):
     import jax
+    import numpy as np
 
     import __graft_entry__ as g
 
     fn, args = g.entry()
-    out = jax.jit(fn)(*args)
-    assert out is not None
+    # The contract: the driver's compile check exercises the bench's own
+    # corpus-scale program shape (8 x 2 MiB pieces), not a toy.
+    assert len(args) == 8 and all(a.shape == (1 << 21,) for a in args)
+    assert all(isinstance(a, np.ndarray) for a in args)  # no device puts
+    out = np.asarray(jax.jit(fn)(*args))
+    # corpus_kernel contract: flattened [u_cap, 2] rows + 4 scalars, and
+    # the example text must actually produce counts with no escapes.
+    nu, max_len, has_high, tok_of = (int(x) for x in out[-4:])
+    assert nu > 0 and not has_high and not tok_of and max_len <= 16

@@ -4,6 +4,7 @@ multi-chip path on the virtual 8-device mesh (BASELINE.json's last config).
 
 import math
 import os
+import random
 
 import numpy as np
 import pytest
@@ -169,3 +170,40 @@ def test_spmd_falls_back_on_non_ascii(tmp_path):
     res = tfidf_sharded(docs, mesh=default_mesh(8), n_reduce=5,
                         u_cap=1 << 8)
     assert res is None  # caller must route the job to the host path
+
+
+def test_packed_and_lazy_docs_match_dict(tmp_path):
+    """FileDocs + packed=True must agree with resident docs + dict result
+    (the GB-soak memory path, VERDICT r4 weakness #4)."""
+    import numpy as np
+
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.tfidf import FileDocs, tfidf_sharded
+
+    rng = random.Random(7)
+    paths = []
+    for i in range(5):
+        # Letter-only words (digits split tokens: maximal letter runs).
+        words = ["w" + "abcdefghij"[rng.randint(0, 9)]
+                 + "xyzpq"[rng.randint(0, 4)] + "end"[rng.randint(0, 2):]
+                 for _ in range(400)]
+        p = tmp_path / f"doc-{i}.txt"
+        p.write_bytes((" ".join(words)).encode())
+        paths.append(str(p))
+    docs = [open(p, "rb").read() for p in paths]
+    mesh = default_mesh(4)
+    want = tfidf_sharded(docs, mesh=mesh, n_reduce=10)
+    lazy = FileDocs(paths)
+    assert lazy.lengths == [len(d) for d in docs]
+    got = tfidf_sharded(lazy, mesh=mesh, n_reduce=10, packed=True)
+    assert got is not None and want is not None
+    assert got.to_dict() == want
+    # Point lookups agree and omit absent words.
+    some = list(want)[:20] + ["notaword"]
+    hits = got.lookup_many(some)
+    assert "notaword" not in hits
+    for w in some[:20]:
+        assert hits[w] == want[w]
+    # Vectorized invariant surface used by the soak.
+    assert got.n_postings == sum(len(ps) for _, ps in want.values())
+    assert (got.postings_per_word() >= 1).all()
