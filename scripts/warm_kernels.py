@@ -123,6 +123,16 @@ def main() -> int:
                 _nfa_compiled(n, s_bucket, min(256, n), l_cap)
             print(f"nfagrep overflow rung: {time.perf_counter() - t0:.1f}s",
                   flush=True)
+
+            # Calibrate the tier-4 dispatch cost model on THIS platform
+            # (kernel vs host re): device dispatch is opt-in until a
+            # measurement here proves it (ops/nfak.py tier4_preferred).
+            from dsi_tpu.ops.nfak import calibrate_tier4
+
+            t0 = time.perf_counter()
+            entry = calibrate_tier4(s_bucket)
+            print(f"nfagrep cost model s{s_bucket}: {entry} "
+                  f"({time.perf_counter() - t0:.1f}s)", flush=True)
         finally:
             del os.environ["DSI_NFA_COLD_OK"]
 

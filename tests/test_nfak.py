@@ -17,6 +17,14 @@ TEXT = (b"the quick brown fox\njumps over the lazy dogs\n"
         b"42 is the answer\n\nfox")
 
 
+@pytest.fixture(autouse=True)
+def _force_device_dispatch(monkeypatch):
+    """These tests exercise the kernel itself; pin past the cost-model
+    gate (which routes to host wherever the kernel measures slower —
+    its own tests below override the pin)."""
+    monkeypatch.setenv("DSI_NFA_DISPATCH", "device")
+
+
 def oracle(data: bytes, pat: str):
     return [ln for ln in data.decode().split("\n") if re.search(pat, ln)]
 
@@ -242,3 +250,50 @@ def test_fuzz_generated_patterns_vs_oracle():
         accepted += 1
         assert got == oracle(data, pattern), (trial, pattern, lines)
     assert accepted >= 30, "fuzz generated too few device-eligible patterns"
+
+
+# ── tier-4 dispatch cost model (round 5) ───────────────────────────────
+
+
+def test_cost_model_pins(monkeypatch):
+    import dsi_tpu.ops.nfak as nfak
+
+    monkeypatch.setenv("DSI_NFA_DISPATCH", "host")
+    assert nfak.tier4_preferred(16) is False
+    assert nfak.nfagrep_host_result(TEXT, "qu+ick") is None  # host serves
+    monkeypatch.setenv("DSI_NFA_DISPATCH", "device")
+    assert nfak.tier4_preferred(16) is True
+
+
+def test_cost_model_routes_to_winner(monkeypatch):
+    import dsi_tpu.ops.nfak as nfak
+
+    monkeypatch.delenv("DSI_NFA_DISPATCH", raising=False)
+    key = nfak._cost_key(16)
+    monkeypatch.setitem(nfak._cost_cache, key,
+                        {"host_mbps": 20.0, "kernel_mbps": 2.0})
+    nfak._cost_loaded = True
+    assert nfak.tier4_preferred(16) is False
+    assert nfak.nfagrep_host_result(TEXT, "qu+ick") is None
+    monkeypatch.setitem(nfak._cost_cache, key,
+                        {"host_mbps": 2.0, "kernel_mbps": 20.0})
+    assert nfak.tier4_preferred(16) is True
+    got = nfak.nfagrep_host_result(TEXT, "qu+ick")
+    assert got == oracle(TEXT, "qu+ick")
+
+
+def test_cost_model_cpu_calibrates_and_persists(monkeypatch, tmp_path):
+    import dsi_tpu.ops.nfak as nfak
+
+    monkeypatch.delenv("DSI_NFA_DISPATCH", raising=False)
+    monkeypatch.setenv("DSI_AOT_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(nfak, "_cost_cache", {})
+    monkeypatch.setattr(nfak, "_cost_loaded", False)
+    pref = nfak.tier4_preferred(16)
+    assert pref in (True, False)  # measured, not None
+    entry = nfak._load_costs()[nfak._cost_key(16)]
+    assert entry["host_mbps"] > 0 and entry["kernel_mbps"] > 0
+    import json
+
+    on_disk = json.load(open(tmp_path / "nfa_cost.json"))
+    assert on_disk[nfak._cost_key(16)] == entry
