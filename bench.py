@@ -645,9 +645,20 @@ def run_framework_row(bench_oracle_mbps: float,
             return reap("coordinator did not open its socket")
         time.sleep(0.05)
 
+    # Workers run the combiner app on the native (C++ task-body) backend
+    # by default — the host data plane at compiled speed, the moral
+    # equivalent of the reference's compiled-Go workers; output is
+    # byte-identical to wc's (parity gate below).  Chip-independent
+    # either way.
+    fw_backend = os.environ.get("DSI_BENCH_FRAMEWORK_BACKEND", "native")
+    # The accelerated backends need the combiner app (it declares the
+    # native/tpu task bodies); plain host runs the reference-semantics
+    # wc.  Either way the final output is byte-identical (parity gate).
+    fw_app = "wc" if fw_backend == "host" else "tpu_wc"
     t0 = time.perf_counter()
     workers = [
-        subprocess.Popen([sys.executable, "-m", "dsi_tpu.cli.mrworker", "wc"],
+        subprocess.Popen([sys.executable, "-m", "dsi_tpu.cli.mrworker",
+                          "--backend", fw_backend, fw_app],
                          cwd=fw_dir, env=env, stdout=sys.stderr,
                          stderr=sys.stderr)
         for _ in range(n_workers)]
@@ -691,6 +702,7 @@ def run_framework_row(bench_oracle_mbps: float,
             "framework_mb": round(total_mb, 1),
             "framework_workers": n_workers,
             "framework_cores": len(os.sched_getaffinity(0)),
+            "framework_backend": fw_backend,
             "framework_oracle_mbps": round(fw_oracle_mbps, 2),
             "framework_vs_oracle": round(fw_mbps / fw_oracle_mbps, 2),
             "framework_parity": True}

@@ -23,6 +23,11 @@ from typing import List, Optional
 from dsi_tpu.apps.wc import tokenize
 from dsi_tpu.mr.types import KeyValue
 
+#: The C++ job kernels (native/wcjob.cpp via backends/native.py) implement
+#: exactly this app's combiner semantics — Map emits per-unique counts,
+#: Reduce sums them.
+native_kind = "wc_combine"
+
 
 def Map(filename: str, contents: str) -> List[KeyValue]:
     counts = Counter(tokenize(contents))

@@ -1,0 +1,77 @@
+"""Native task backend: runs task bodies through the C++ job kernels.
+
+The reference's per-task compute is compiled Go (``mrapps/wc.go:21-44``,
+``mr/worker.go:110-146``); the framework's default host path re-creates
+those semantics in Python and pays interpreter costs per token/record.
+This runner (``mrworker --backend native``) executes the whole task body
+in one C++ call for apps that declare a supported ``native_kind``
+(currently ``"wc_combine"`` — the word-count combiner family,
+``apps/tpu_wc.py``), falling back to the exact host path whenever the
+native side declines (non-ASCII input, JSON escapes, missing library) —
+the same correctness-never-depends-on-the-kernel contract as the TPU
+backend (``backends/tpu.py``).
+"""
+
+from __future__ import annotations
+
+import os
+
+from dsi_tpu.mr import worker as w
+from dsi_tpu.mr.plugin import load_plugin_module
+from dsi_tpu.utils.atomicio import atomic_write
+
+
+class NativeTaskRunner:
+    """Backend object for ``worker_loop(task_runner=...)``."""
+
+    def __init__(self, app_module):
+        self.app = app_module
+        self.kind = getattr(app_module, "native_kind", None)
+        if self.kind != "wc_combine":
+            import sys
+
+            print(
+                f"mrworker: app {getattr(app_module, '__name__', app_module)}"
+                " declares no supported native_kind; --backend=native will "
+                "run every task on the host path (the tpu_wc app declares "
+                "wc_combine)", file=sys.stderr)
+            self.kind = None
+
+    @classmethod
+    def for_app(cls, name_or_path: str) -> "NativeTaskRunner":
+        return cls(load_plugin_module(name_or_path))
+
+    def run_map(self, mapf, filename: str, map_task: int, n_reduce: int,
+                workdir: str = ".") -> None:
+        from dsi_tpu import native
+
+        blobs = (native.wc_map_file(filename, n_reduce)
+                 if self.kind == "wc_combine" else None)
+        if blobs is None:  # host fallback (worker.go:55-92 semantics)
+            w.run_map_task(mapf, filename, map_task, n_reduce, workdir)
+            return
+        for r, blob in enumerate(blobs):
+            with atomic_write(w.intermediate_name(map_task, r, workdir),
+                              mode="wb") as f:
+                f.write(blob)
+
+    def run_reduce(self, reducef, reduce_task: int, n_map: int,
+                   workdir: str = ".") -> None:
+        from dsi_tpu import native
+
+        blob = (native.wc_reduce(workdir, reduce_task, n_map)
+                if self.kind == "wc_combine" else None)
+        if blob is None:
+            w.run_reduce_task(reducef, reduce_task, n_map, workdir)
+            return
+        # Same commit + GC discipline as the host reduce (first-writer-
+        # wins against re-queued duplicates; errors-ignored intermediate
+        # GC — worker.go:148,151-154 with the duplicate-race fix).
+        with atomic_write(w.output_name(reduce_task, workdir),
+                          first_wins=True, mode="wb") as out:
+            out.write(blob)
+        for i in range(n_map):
+            try:
+                os.remove(w.intermediate_name(i, reduce_task, workdir))
+            except OSError:
+                pass

@@ -18,7 +18,8 @@ from dsi_tpu.mr.worker import worker_loop
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--backend", choices=("host", "tpu"), default="host")
+    p.add_argument("--backend", choices=("host", "tpu", "native"),
+                   default="host")
     p.add_argument("app")
     args = p.parse_args(argv)
     mapf, reducef = load_plugin(args.app)
@@ -36,6 +37,10 @@ def main(argv=None) -> int:
         from dsi_tpu.backends.tpu import TpuTaskRunner
 
         runner = TpuTaskRunner.for_app(args.app)
+    elif args.backend == "native":
+        from dsi_tpu.backends.native import NativeTaskRunner
+
+        runner = NativeTaskRunner.for_app(args.app)
     worker_loop(mapf, reducef, cfg, task_runner=runner)
     return 0
 

@@ -38,11 +38,13 @@ def _load():
         if os.environ.get("DSI_NO_NATIVE") == "1":
             _lib = False
             return None
-        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "kvcodec.cpp")
+        here = os.path.dirname(os.path.abspath(__file__))
+        srcs = [os.path.join(here, "kvcodec.cpp"),
+                os.path.join(here, "wcjob.cpp")]
         stale = (not os.path.exists(_SO_PATH)
-                 or (os.path.exists(src)
-                     and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)))
+                 or any(os.path.exists(s)
+                        and os.path.getmtime(s) > os.path.getmtime(_SO_PATH)
+                        for s in srcs))
         if stale:
             script = os.path.join(_REPO, "scripts", "build_native.sh")
             try:
@@ -67,6 +69,13 @@ def _load():
             lib.kv_encode_partitions.argtypes = [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
                 ctypes.c_uint32, ctypes.POINTER(ctypes.c_size_t)]
+            lib.wc_map_file.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.wc_map_file.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                        ctypes.POINTER(ctypes.c_size_t)]
+            lib.wc_reduce.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.wc_reduce.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                      ctypes.c_uint32,
+                                      ctypes.POINTER(ctypes.c_size_t)]
             _lib = lib
         except (OSError, AttributeError) as e:
             # AttributeError: a stale .so predating a symbol and a failed
@@ -158,14 +167,59 @@ def encode_partitions(kva, n_reduce: int) -> Optional[List[bytes]]:
         arena = ctypes.string_at(ptr, out_len.value)
     finally:
         lib.kv_arena_free(ptr)
-    (n_parts,) = struct.unpack_from("<I", arena, 0)
-    if n_parts != n_reduce:
+    return _unpack_blobs(arena, n_reduce)
+
+
+def _unpack_blobs(arena: bytes, want: int) -> Optional[List[bytes]]:
+    (n,) = struct.unpack_from("<I", arena, 0)
+    if n != want:
         return None
-    blobs: List[bytes] = []
+    out: List[bytes] = []
     off = 4
-    for _ in range(n_reduce):
+    for _ in range(n):
         (bl,) = struct.unpack_from("<I", arena, off)
         off += 4
-        blobs.append(arena[off:off + bl])
+        out.append(arena[off:off + bl])
         off += bl
-    return blobs
+    return out
+
+
+def wc_map_file(path: str, n_reduce: int) -> Optional[List[bytes]]:
+    """Whole word-count COMBINER map task natively (dsi_tpu/native/
+    wcjob.cpp): tokenize + count-per-unique + reference partition hash +
+    JSON-lines render in one C++ pass.  Returns the n_reduce partition
+    blobs, or None when the split needs the host path (non-ASCII bytes,
+    IO failure, or no library)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t()
+    ptr = lib.wc_map_file(path.encode(), n_reduce, ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    return _unpack_blobs(arena, n_reduce)
+
+
+def wc_reduce(workdir: str, reduce_task: int, n_map: int) -> Optional[bytes]:
+    """Whole word-count SUM reduce task natively: parse + per-key sum +
+    bytewise sort + "key sum\\n" render.  Returns the mr-out-<r> blob, or
+    None when the Python reduce (the app's own Reduce) must own the task
+    (escapes/non-ASCII/malformed records, or no library)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t()
+    ptr = lib.wc_reduce(workdir.encode(), reduce_task, n_map,
+                        ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    blobs = _unpack_blobs(arena, 1)
+    return None if blobs is None else blobs[0]

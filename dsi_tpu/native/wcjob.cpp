@@ -1,0 +1,236 @@
+// Native word-count job bodies for the host data plane.
+//
+// The reference's whole per-task compute is compiled Go
+// (mrapps/wc.go:21-44 map, mr/worker.go:110-146 reduce); the Python host
+// path re-creates the semantics but pays interpreter costs per token and
+// per record — on a 1-core box that caps the distributed N-worker run
+// below the sequential oracle.  This file implements the word-count
+// COMBINER app's task bodies natively (apps/tpu_wc.py semantics: Map
+// emits one {word, count} record per unique word per split; Reduce sums
+// counts), with the same exactness escapes as every native piece here:
+// anything the C++ cannot prove it handled byte-identically returns NULL
+// and the Python path serves the task (dsi_tpu/native/__init__.py
+// contract — native and pure runs can never diverge).
+//
+// wc_map_file:  read a split, tokenize maximal [A-Za-z] runs (== Go
+//   strings.FieldsFunc(!unicode.IsLetter) on ASCII; ANY byte >= 0x80
+//   declines the split), count per unique word, partition by the
+//   reference hash (fnv1a32(word) & 0x7fffffff % n_reduce,
+//   mr/worker.go:33-37,76), and render each partition's JSON-lines blob
+//   ({"Key": "w", "Value": "<count>"} — the exact record format the
+//   Python writer and both decoders use).
+//   Arena: u32 n_blobs, then per blob u32 len + bytes.
+//
+// wc_reduce: parse the n_map intermediate files of one reduce partition
+//   (missing files tolerated, worker.go:106-108), sum integer Values per
+//   Key, sort keys bytewise (== Python str sort for the ASCII keys this
+//   parser accepts), render "key sum\n" lines (worker.go:144 "%v %v\n").
+//   Declines (NULL) on: any JSON escape, any non-ASCII byte, any
+//   non-integer value, or any malformed record — the Python reduce
+//   (which applies the app's own Reduce) then owns the task.
+//
+// Build: scripts/build_native.sh links this into libkvcodec.so alongside
+// the codec.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+inline bool is_letter(unsigned char c) {
+  return (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z');
+}
+
+inline uint32_t fnv1a32(const char* s, size_t n) {
+  uint32_t h = 0x811C9DC5u;
+  for (size_t i = 0; i < n; i++) {
+    h ^= (unsigned char)s[i];
+    h *= 0x01000193u;
+  }
+  return h;
+}
+
+// Read a whole file; false on open failure (caller's tolerated case).
+bool read_file(const char* path, std::string& out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (n < 0) { fclose(f); return false; }
+  out.resize((size_t)n);
+  size_t got = n ? fread(&out[0], 1, (size_t)n, f) : 0;
+  fclose(f);
+  if (got != (size_t)n) return false;
+  return true;
+}
+
+uint8_t* pack_blobs(const std::vector<std::string>& blobs, size_t* out_len) {
+  size_t total = 4;
+  for (const auto& b : blobs) {
+    if (b.size() > UINT32_MAX) return nullptr;  // u32 framing would wrap
+    total += 4 + b.size();
+  }
+  uint8_t* arena = (uint8_t*)malloc(total);
+  if (!arena) return nullptr;
+  uint32_t n = (uint32_t)blobs.size();
+  memcpy(arena, &n, 4);
+  size_t off = 4;
+  for (const auto& b : blobs) {
+    uint32_t len = (uint32_t)b.size();
+    memcpy(arena + off, &len, 4);
+    off += 4;
+    memcpy(arena + off, b.data(), b.size());
+    off += b.size();
+  }
+  *out_len = total;
+  return arena;
+}
+
+}  // namespace
+
+extern "C" {
+
+// NULL when the split needs the host path (non-ASCII byte) or on IO/OOM.
+uint8_t* wc_map_file(const char* path, uint32_t n_reduce, size_t* out_len) {
+  std::string data;
+  if (!read_file(path, data) || n_reduce == 0) return nullptr;
+  for (unsigned char c : data)
+    if (c >= 0x80) return nullptr;  // Unicode: host tokenizer owns it
+
+  // Count per unique word (string_view keys into the split buffer).
+  struct SV {
+    const char* p;
+    uint32_t n;
+  };
+  struct SVHash {
+    size_t operator()(const SV& s) const {
+      // FNV-1a 64 for the table only (the partition hash is computed
+      // separately with the reference's exact 32-bit variant).
+      uint64_t h = 1469598103934665603ull;
+      for (uint32_t i = 0; i < s.n; i++) {
+        h ^= (unsigned char)s.p[i];
+        h *= 1099511628211ull;
+      }
+      return (size_t)h;
+    }
+  };
+  struct SVEq {
+    bool operator()(const SV& a, const SV& b) const {
+      return a.n == b.n && memcmp(a.p, b.p, a.n) == 0;
+    }
+  };
+  std::unordered_map<SV, uint64_t, SVHash, SVEq> counts;
+  counts.reserve(1 << 15);
+  const char* p = data.data();
+  const char* end = p + data.size();
+  while (p < end) {
+    while (p < end && !is_letter((unsigned char)*p)) p++;
+    const char* s = p;
+    while (p < end && is_letter((unsigned char)*p)) p++;
+    if (p > s) counts[SV{s, (uint32_t)(p - s)}]++;
+  }
+
+  std::vector<std::string> blobs(n_reduce);
+  char line[96];
+  for (const auto& it : counts) {
+    uint32_t part = (fnv1a32(it.first.p, it.first.n) & 0x7FFFFFFFu) % n_reduce;
+    std::string& b = blobs[part];
+    // {"Key": "word", "Value": "count"}\n — ASCII letters need no JSON
+    // escaping; format matches the Python json.dumps writer.
+    b += "{\"Key\": \"";
+    b.append(it.first.p, it.first.n);
+    int m = snprintf(line, sizeof line, "\", \"Value\": \"%llu\"}\n",
+                     (unsigned long long)it.second);
+    b.append(line, (size_t)m);
+  }
+  return pack_blobs(blobs, out_len);
+}
+
+// NULL => the Python reduce owns the task.  Arena: one blob (the rendered
+// mr-out-<r> contents) in pack_blobs framing with n_blobs == 1.
+uint8_t* wc_reduce(const char* workdir, uint32_t reduce_task, uint32_t n_map,
+                   size_t* out_len) {
+  std::unordered_map<std::string, uint64_t> sums;
+  sums.reserve(1 << 15);
+  std::string data;
+  char path[4096];
+  for (uint32_t i = 0; i < n_map; i++) {
+    snprintf(path, sizeof path, "%s/mr-%u-%u", workdir, i, reduce_task);
+    data.clear();
+    if (!read_file(path, data)) continue;  // tolerated: worker.go:106-108
+    const char* p = data.data();
+    const char* end = p + data.size();
+    while (p < end) {
+      // One record per line: {"Key": "...", "Value": "..."}
+      while (p < end && (*p == '\n' || *p == '\r' || *p == ' ')) p++;
+      if (p >= end) break;
+      auto expect = [&](const char* s) {
+        size_t n = strlen(s);
+        if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
+        p += n;
+        return true;
+      };
+      auto str_span = [&](const char** sp, uint32_t* sn) {
+        if (p >= end || *p != '"') return false;
+        p++;
+        const char* s = p;
+        while (p < end && *p != '"') {
+          unsigned char c = (unsigned char)*p;
+          if (c == '\\' || c >= 0x80 || c < 0x20) return false;
+          p++;
+        }
+        if (p >= end) return false;
+        *sp = s;
+        *sn = (uint32_t)(p - s);
+        p++;  // closing quote
+        return true;
+      };
+      const char *ks, *vs;
+      uint32_t kn, vn;
+      if (!expect("{\"Key\": ") || !str_span(&ks, &kn) ||
+          !expect(", \"Value\": ") || !str_span(&vs, &vn) || !expect("}"))
+        return nullptr;  // unexpected shape/escape: Python decides
+      // Strictly one record per line (the Python decoder json.loads's
+      // each LINE and breaks on trailing garbage — kvcodec.cpp enforces
+      // the same invariant): anything but whitespace-then-newline/EOF
+      // after the record defers to Python.
+      while (p < end && (*p == ' ' || *p == '\r')) p++;
+      if (p < end && *p != '\n') return nullptr;
+      if (p < end) p++;
+      if (vn == 0 || vn > 18) return nullptr;
+      uint64_t v = 0;
+      for (uint32_t j = 0; j < vn; j++) {
+        if (vs[j] < '0' || vs[j] > '9') return nullptr;
+        v = v * 10 + (uint64_t)(vs[j] - '0');
+      }
+      uint64_t& slot = sums[std::string(ks, kn)];
+      if (slot > UINT64_MAX - v) return nullptr;  // Python sums exactly
+      slot += v;
+    }
+  }
+  std::vector<const std::pair<const std::string, uint64_t>*> rows;
+  rows.reserve(sums.size());
+  for (const auto& kv : sums) rows.push_back(&kv);
+  std::sort(rows.begin(), rows.end(),
+            [](const auto* a, const auto* b) { return a->first < b->first; });
+  std::string out;
+  out.reserve(rows.size() * 16);
+  char tail[32];
+  for (const auto* kv : rows) {
+    out += kv->first;
+    int m = snprintf(tail, sizeof tail, " %llu\n",
+                     (unsigned long long)kv->second);
+    out.append(tail, (size_t)m);
+  }
+  std::vector<std::string> blobs{out};
+  return pack_blobs(blobs, out_len);
+}
+
+}  // extern "C"

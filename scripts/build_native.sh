@@ -10,6 +10,7 @@ mkdir -p "$REPO/build"
 # half-written .so.
 TMP="$REPO/build/.libkvcodec.$$.tmp"
 g++ -O2 -Wall -shared -fPIC -std=c++17 \
-    -o "$TMP" "$REPO/dsi_tpu/native/kvcodec.cpp"
+    -o "$TMP" "$REPO/dsi_tpu/native/kvcodec.cpp" \
+    "$REPO/dsi_tpu/native/wcjob.cpp"
 mv -f "$TMP" "$REPO/build/libkvcodec.so"
 echo "built $REPO/build/libkvcodec.so"

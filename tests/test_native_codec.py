@@ -203,3 +203,125 @@ def test_write_intermediates_native_off_equivalence(tmp_path, monkeypatch):
     monkeypatch.setattr(native, "_lib", None)
     assert _decoded_partitions(str(on), 2, 5) == \
         _decoded_partitions(str(off), 2, 5)
+
+
+# ── native wc job bodies (round 5: wcjob.cpp) ──────────────────────────
+
+
+def test_native_wc_map_matches_combiner(tmp_path):
+    import json
+
+    from dsi_tpu import native
+    from dsi_tpu.apps.tpu_wc import Map
+    from dsi_tpu.mr.worker import ihash
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    raw = (b"the quick the lazy dog12dog cat-cat foo_bar " * 500
+           + b"tail without newline")
+    p = tmp_path / "split.txt"
+    p.write_bytes(raw)
+    blobs = native.wc_map_file(str(p), 10)
+    assert blobs is not None
+    got = {}
+    for r, blob in enumerate(blobs):
+        for line in blob.decode().splitlines():
+            o = json.loads(line)
+            assert ihash(o["Key"]) % 10 == r
+            got[o["Key"]] = got.get(o["Key"], 0) + int(o["Value"])
+    want = {kv.key: int(kv.value) for kv in Map("f", raw.decode())}
+    assert got == want
+
+
+def test_native_wc_map_declines_non_ascii(tmp_path):
+    from dsi_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    p = tmp_path / "u.txt"
+    p.write_bytes("the café".encode())
+    assert native.wc_map_file(str(p), 10) is None
+
+
+def test_native_wc_reduce_matches_python(tmp_path):
+    """Native reduce over a MIX of native- and Python-written
+    intermediates must equal the host group/sort/reduce output."""
+    import io
+    import json
+
+    from dsi_tpu import native
+    from dsi_tpu.apps.tpu_wc import Reduce
+    from dsi_tpu.mr.worker import group_and_reduce, read_intermediates
+    from dsi_tpu.mr.types import KeyValue
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    wd = str(tmp_path)
+    # map 0: native-format blob; map 1: Python json.dumps writer; map 2
+    # missing (tolerated).
+    (tmp_path / "mr-0-3").write_bytes(
+        b'{"Key": "apple", "Value": "2"}\n{"Key": "zebra", "Value": "5"}\n')
+    with open(tmp_path / "mr-1-3", "w") as f:
+        for k, v in (("apple", "3"), ("mango", "1")):
+            f.write(json.dumps({"Key": k, "Value": v}) + "\n")
+    blob = native.wc_reduce(wd, 3, 3)
+    assert blob is not None
+    buf = io.StringIO()
+    group_and_reduce(read_intermediates(3, 3, wd), Reduce, buf)
+    assert blob.decode() == buf.getvalue()
+    assert blob.decode() == "apple 5\nmango 1\nzebra 5\n"
+
+
+def test_native_wc_reduce_declines_escapes_and_bad_values(tmp_path):
+    from dsi_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    (tmp_path / "mr-0-1").write_bytes(
+        b'{"Key": "caf\\u00e9", "Value": "1"}\n')
+    assert native.wc_reduce(str(tmp_path), 1, 1) is None
+    (tmp_path / "mr-0-2").write_bytes(b'{"Key": "a", "Value": "x1"}\n')
+    assert native.wc_reduce(str(tmp_path), 2, 1) is None
+
+
+def test_native_runner_unicode_split_falls_back_exact(tmp_path):
+    """End-to-end through the runner seam: a unicode split routes its map
+    to the host combiner (escaped JSON), and the reduce then declines to
+    Python — final output still exact."""
+    import io
+
+    from dsi_tpu.apps import tpu_wc
+    from dsi_tpu.backends.native import NativeTaskRunner
+    from dsi_tpu.mr.worker import group_and_reduce, read_intermediates
+
+    r = NativeTaskRunner(tpu_wc)
+    split = tmp_path / "s.txt"
+    split.write_text("the café the naïve dog café")
+    r.run_map(tpu_wc.Map, str(split), 0, 4, str(tmp_path))
+    for part in range(4):
+        r.run_reduce(tpu_wc.Reduce, part, 1, str(tmp_path))
+    out = "".join(open(tmp_path / f"mr-out-{p}").read() for p in range(4))
+    rows = dict(line.rsplit(" ", 1) for line in out.splitlines())
+    assert rows == {"the": "2", "café": "2", "naïve": "1", "dog": "1"}
+
+
+def test_native_wc_reduce_declines_concatenated_records(tmp_path):
+    """Two records on one line: the Python decoder breaks there
+    (reference semantics) — native must defer, not parse both."""
+    from dsi_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    (tmp_path / "mr-0-4").write_bytes(
+        b'{"Key": "a", "Value": "1"}{"Key": "b", "Value": "2"}\n')
+    assert native.wc_reduce(str(tmp_path), 4, 1) is None
+
+
+def test_native_wc_reduce_declines_u64_overflow(tmp_path):
+    from dsi_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    big = '{"Key": "a", "Value": "999999999999999999"}\n' * 21
+    (tmp_path / "mr-0-5").write_bytes(big.encode())
+    assert native.wc_reduce(str(tmp_path), 5, 1) is None

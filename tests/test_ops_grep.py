@@ -62,8 +62,10 @@ def test_regex_routing_tiers():
     finally:
         del os.environ["DSI_GREP_PATTERN"]
     # ...variable-length regex is now served by tier 4 (the NFA
-    # matrix-scan kernel, ops/nfak.py)...
+    # matrix-scan kernel, ops/nfak.py; pinned past the dispatch cost
+    # model, which routes to host wherever the kernel measures slower)...
     os.environ["DSI_GREP_PATTERN"] = "th+e"
+    os.environ["DSI_NFA_DISPATCH"] = "device"
     try:
         kva = tpu_grep.tpu_map("f", TEXT)
         assert kva is not None
@@ -71,6 +73,7 @@ def test_regex_routing_tiers():
             "the quick brown fox", "jumps over the lazy dog"]
     finally:
         del os.environ["DSI_GREP_PATTERN"]
+        del os.environ["DSI_NFA_DISPATCH"]
     # ...while groups/backrefs still route to the host app.
     os.environ["DSI_GREP_PATTERN"] = "(th)+e"
     try:
