@@ -70,22 +70,25 @@ _FNV_PRIME = np.uint32(0x01000193)
 
 
 def corpus_kernel(*pieces, max_word_len: int = 16, u_cap: int = 1 << 18,
-                  t_cap_frac: int = 4):
+                  t_cap_frac: int = 4, grouper: str = "sort"):
     """Count every word of the concatenated pieces; emit position-coded rows.
 
     Returns ONE 1-D uint32 array of length ``2*u_cap + 4``:
-    ``rows[u_cap, 2]`` flattened (``pos << 7 | len``, ``count``; rows are in
-    lexicographic word order, pad rows zero) followed by the scalars
-    ``[n_unique, max_len, has_high, token_overflow]``.
+    ``rows[u_cap, 2]`` flattened (``pos << 7 | len``, ``count``; with the
+    sort grouper rows are in lexicographic word order, with the hash
+    grouper in bucket order — the output writer sorts host-side either
+    way; pad rows zero) followed by the scalars ``[n_unique, max_len,
+    has_high, token_overflow]``.
     """
     import jax.numpy as jnp
 
     chunk = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
-    return _corpus_core(chunk, max_word_len, u_cap, t_cap_frac)
+    return _corpus_core(chunk, max_word_len, u_cap, t_cap_frac, grouper)
 
 
 def corpus_kernel_packed(*pieces_and_table, max_word_len: int = 16,
-                         u_cap: int = 1 << 18, t_cap_frac: int = 4):
+                         u_cap: int = 1 << 18, t_cap_frac: int = 4,
+                         grouper: str = "sort"):
     """``corpus_kernel`` over a 6-bit transport encoding of the corpus.
 
     The host packs 4 corpus bytes into 3 wire bytes when the corpus uses
@@ -110,10 +113,11 @@ def corpus_kernel_packed(*pieces_and_table, max_word_len: int = 16,
     chunk = jnp.zeros_like(codes, dtype=jnp.uint8)
     for k in range(64):
         chunk = jnp.where(codes == k, table[k], chunk)
-    return _corpus_core(chunk, max_word_len, u_cap, t_cap_frac)
+    return _corpus_core(chunk, max_word_len, u_cap, t_cap_frac, grouper)
 
 
-def _corpus_core(chunk, max_word_len: int, u_cap: int, t_cap_frac: int):
+def _corpus_core(chunk, max_word_len: int, u_cap: int, t_cap_frac: int,
+                 grouper: str = "sort"):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -146,28 +150,44 @@ def _corpus_core(chunk, max_word_len: int, u_cap: int, t_cap_frac: int):
     packed_cols = tuple(
         jnp.where(valid, lane[start_pos], jnp.uint32(_PAD_KEY))
         for lane in lanes)
-    # Position and length ride the sort as ONE pre-packed payload column
-    # (pos << 7 | len — already the wire encoding): one fewer 4M-row sort
-    # operand than carrying them separately.
+    # Position and length ride grouping as ONE pre-packed payload column
+    # (pos << 7 | len — already the wire encoding).
     poslen_tok = jnp.where(
         valid,
         (start_pos.astype(jnp.uint32) << 7)
         | lengths.astype(jnp.uint32), 0)
 
-    # Stable sort over the key lanes packed pairwise into uint64s (same
-    # lexicographic order, half the comparator keys — wordcount.py
-    # pack_key_lanes; the sort is this kernel's dominant cost): within a
-    # group of equal words the original token order (ascending position)
-    # survives, so each group's FIRST row carries the word's first
-    # occurrence position (its length is group-invariant).
-    with jax.enable_x64(True):  # u64 operands need the scoped flag
-        keys64 = pack_key_lanes(packed_cols)
-        k64 = len(keys64)
-        sorted_ops = lax.sort(keys64 + (poslen_tok,),
-                              num_keys=k64, is_stable=True)
-        _, totals, upos, ovalid, n_unique = group_sorted(
-            sorted_ops[:k64], jnp.ones(t_cap, jnp.int32), u_cap)
-    poslen = jnp.where(ovalid, sorted_ops[k64][upos], 0)
+    if grouper == "hash":
+        # Scatter/segment grouping (ops/wordcount.py _hash_group): exact
+        # via per-bucket lane verification + dirty-repair sort; the
+        # first-occurrence poslen is the per-group MIN of the combined
+        # column (length is group-invariant, so min == min position).
+        from dsi_tpu.ops.wordcount import _hash_group, fnv1a32_packed
+
+        fnv_t = fnv1a32_packed(jnp.stack(packed_cols, axis=1), lengths,
+                               max_word_len)
+        _, _, cnt_u, poslen_u, n_unique, group_of = _hash_group(
+            packed_cols, lengths, valid, fnv_t, u_cap=u_cap,
+            max_word_len=max_word_len, extra=poslen_tok)
+        uvalid = jnp.arange(u_cap, dtype=jnp.int32) < n_unique
+        poslen = jnp.where(uvalid, poslen_u, 0)
+        totals = jnp.where(uvalid, cnt_u, 0)
+        token_overflow = token_overflow | group_of
+    else:
+        # Stable sort over the key lanes packed pairwise into uint64s
+        # (same lexicographic order, half the comparator keys —
+        # wordcount.py pack_key_lanes): within a group of equal words the
+        # original token order (ascending position) survives, so each
+        # group's FIRST row carries the word's first occurrence position
+        # (its length is group-invariant).
+        with jax.enable_x64(True):  # u64 operands need the scoped flag
+            keys64 = pack_key_lanes(packed_cols)
+            k64 = len(keys64)
+            sorted_ops = lax.sort(keys64 + (poslen_tok,),
+                                  num_keys=k64, is_stable=True)
+            _, totals, upos, ovalid, n_unique = group_sorted(
+                sorted_ops[:k64], jnp.ones(t_cap, jnp.int32), u_cap)
+        poslen = jnp.where(ovalid, sorted_ops[k64][upos], 0)
     rows = jnp.stack([poslen, totals.astype(jnp.uint32)], axis=1)
     has_high = jnp.any(chunk >= 128)
     scalars = jnp.stack([
@@ -280,15 +300,19 @@ class CorpusResult:
 
 def corpus_wordcount(raws: Sequence[bytes], *, piece_size: int | None = None,
                      max_word_len: int = 16, u_cap: int = 1 << 18,
-                     use_aot: bool = True,
-                     pack6: bool = False) -> Optional[CorpusResult]:
+                     use_aot: bool = True, pack6: bool = False,
+                     grouper: str | None = None) -> Optional[CorpusResult]:
     """Exact whole-corpus counts, or None when the host path is needed
     (non-ASCII bytes or a word longer than 64 — same escape contract as
     ``count_words_host_result``).  Retries wider static shapes on overflow.
 
     ``pack6=True`` ships the corpus 6 bits per byte (25% fewer upload
     bytes — the upload is this platform's measured wall) when its alphabet
-    fits in 64 symbols, transparently reverting to raw bytes when not."""
+    fits in 64 symbols, transparently reverting to raw bytes when not.
+
+    ``grouper`` (default: the platform-adaptive ``default_grouper``)
+    picks the grouping stage; an unresolvable hash-grouper collision
+    retries through the sort grouper, the always-exact last rung."""
     import jax
 
     buf, n_pieces, piece_size = _resolve_pieces(raws, piece_size)
@@ -317,18 +341,28 @@ def corpus_wordcount(raws: Sequence[bytes], *, piece_size: int | None = None,
     if table is not None:
         views.append(table)
 
+    from dsi_tpu.ops.wordcount import grouper_ladder
+
+    if grouper is None:
+        groupers = grouper_ladder()
+    else:
+        groupers = (grouper, "sort") if grouper != "sort" else ("sort",)
+
     def run(mwl: int, cap: int):
         # The shared overflow/retry discipline (exactness_retry) drives mwl
-        # and cap; the token-buffer frac retry is local, as in the other
-        # callers (wordcount, shuffle, tfidf).
-        for frac in (4, 2):  # exact token bound is n//2+1
-            fn = _get_compiled(n_pieces, piece_size, mwl, cap,
-                               frac, use_aot, pack6)
-            from dsi_tpu.ops import xfer  # host-side; NOT a kernel dep
+        # and cap; the token-buffer frac and grouper retries are local, as
+        # in the other callers (wordcount, shuffle, tfidf).
+        for g in groupers:
+            for frac in (4, 2):  # exact token bound is n//2+1
+                fn = _get_compiled(n_pieces, piece_size, mwl, cap,
+                                   frac, use_aot, pack6, g)
+                from dsi_tpu.ops import xfer  # host-side; NOT a kernel dep
 
-            dev_args = xfer.put_views(views)  # DSI_UPLOAD_MODE async|sync
-            out = np.asarray(fn(*dev_args))      # the ONE D2H round trip
-            nu, max_len, has_high, tok_of = (int(x) for x in out[-4:])
+                dev_args = xfer.put_views(views)  # DSI_UPLOAD_MODE knob
+                out = np.asarray(fn(*dev_args))   # the ONE D2H round trip
+                nu, max_len, has_high, tok_of = (int(x) for x in out[-4:])
+                if not tok_of:
+                    break
             if not tok_of:
                 break
 
@@ -374,9 +408,13 @@ def _example_and_fn(n_pieces: int, piece_size: int, pack6: bool):
 
 @functools.lru_cache(maxsize=64)
 def _get_compiled(n_pieces: int, piece_size: int, mwl: int, cap: int,
-                  frac: int, use_aot: bool, pack6: bool = False):
+                  frac: int, use_aot: bool, pack6: bool = False,
+                  grouper: str = "sort"):
     static = {"max_word_len": mwl, "u_cap": cap, "t_cap_frac": frac}
     example, fn, name = _example_and_fn(n_pieces, piece_size, pack6)
+    if grouper != "sort":  # sort keeps its historical, readable name
+        static["grouper"] = grouper
+        name += f"_g{grouper}"
     from dsi_tpu.backends.aotcache import cached_compile
 
     # use_aot=False still memoizes in-process and accounts compile time in
@@ -388,7 +426,8 @@ def _get_compiled(n_pieces: int, piece_size: int, mwl: int, cap: int,
 def corpus_executable_persisted(raws: Sequence[bytes], *,
                                 piece_size: int | None = None,
                                 max_word_len: int = 16, u_cap: int = 1 << 18,
-                                pack6: bool = False) -> bool:
+                                pack6: bool = False,
+                                grouper: str | None = None) -> bool:
     """True when the rung-0 program ``corpus_wordcount(raws, pack6=...)``
     would run first is already in the persistent AOT cache — i.e. touching
     this transport is a millisecond load, not a multi-minute remote
@@ -403,12 +442,19 @@ def corpus_executable_persisted(raws: Sequence[bytes], *,
     if pack6 and pack6_encode(buf) is None:
         return False
     example, fn, name = _example_and_fn(n_pieces, piece_size, pack6)
+    static = {"max_word_len": max_word_len,
+              "u_cap": rung0_cap(len(buf), u_cap),
+              "t_cap_frac": 4}
+    if grouper is None:
+        from dsi_tpu.ops.wordcount import grouper_ladder
+
+        grouper = grouper_ladder()[0]  # the program a run reaches first
+    if grouper != "sort":
+        static["grouper"] = grouper
+        name += f"_g{grouper}"
     from dsi_tpu.backends.aotcache import is_persisted
 
-    return is_persisted(name, fn, example,
-                        static={"max_word_len": max_word_len,
-                                "u_cap": rung0_cap(len(buf), u_cap),
-                                "t_cap_frac": 4})
+    return is_persisted(name, fn, example, static=static)
 
 
 def render_lines(mat: np.ndarray, lens: np.ndarray,
@@ -459,11 +505,13 @@ def write_corpus_output(res: CorpusResult, n_reduce: int,
                         workdir: str = ".") -> List[str]:
     """Materialise mr-out-<r> files straight from the position-coded table.
 
-    Device rows arrive in lexicographic word order (the kernel's sort), and
-    ASCII byte order == Python ``sorted`` order on str, so a stable sort by
-    partition leaves each partition's lines in the reference's within-file
-    order (``mr/worker.go:124-146``).  Everything is vectorized numpy —
-    this sits inside the bench's timed window (~0.3 s of Python loop before,
+    Rows are first put in lexicographic word order host-side (ASCII byte
+    order == Python ``sorted`` order on str; a no-op permutation for the
+    sort grouper's already-ordered rows, required for the hash grouper's
+    bucket-ordered rows), then a stable sort by partition leaves each
+    partition's lines in the reference's within-file order
+    (``mr/worker.go:124-146``).  Everything is vectorized numpy — this
+    sits inside the bench's timed window (~0.3 s of Python loop before,
     ~30 ms now at 137k unique words).
     """
     from dsi_tpu.utils.atomicio import atomic_write
@@ -471,6 +519,12 @@ def write_corpus_output(res: CorpusResult, n_reduce: int,
     width = int(res.lens.max(initial=1))
     mat = res.byte_matrix(width)  # built once: hashes + spellings below
     part = res.ihashes(mat) % np.uint32(n_reduce)
+
+    worder = np.lexsort(tuple(mat[:, j] for j in range(width - 1, -1, -1)))
+    mat = mat[worder]
+    part = part[worder]
+    res = CorpusResult(res.buf, res.pos[worder], res.lens[worder],
+                       res.cnt[worder])
 
     order = np.argsort(part, kind="stable")
     buf, ends = render_lines(mat[order], res.lens[order], res.cnt[order])

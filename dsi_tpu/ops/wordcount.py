@@ -195,7 +195,8 @@ def group_sorted(skeys_cols: tuple, counts: jax.Array, out_cap: int):
 
 
 def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
-                fnv_t: jax.Array, *, u_cap: int, max_word_len: int):
+                fnv_t: jax.Array, *, u_cap: int, max_word_len: int,
+                extra=None):
     """Group identical tokens WITHOUT the big sort: scatter tokens into
     fnv-addressed buckets and verify each bucket holds exactly one
     distinct word (segment-min == segment-max over every packed key
@@ -214,8 +215,12 @@ def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
     the default for accelerator platforms (TPU scatter characteristics
     differ; switch there only with on-chip evidence).
 
-    Returns (keys64_u tuple [u_cap] per lane, len_u, cnt_u, n_unique,
-    group_overflow).
+    ``extra``, when given, is a per-token uint32 payload reduced by MIN
+    within each group (the corpus kernel's first-occurrence position
+    coding) and returned as a fifth table.
+
+    Returns (keys64_u tuple [u_cap] per lane, len_u, cnt_u, extra_u or
+    None, n_unique, group_overflow).
     """
     t_cap = lengths.shape[0]
     # ~1x t_cap buckets, power of two (the index is a low-bits mask):
@@ -237,6 +242,11 @@ def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
     len1 = jax.ops.segment_max(
         jnp.where(valid, lengths, 0), idx1,
         num_segments=n_buckets + 1)[:n_buckets]
+    ex1 = None
+    if extra is not None:
+        ex1 = jax.ops.segment_min(
+            jnp.where(valid, extra, jnp.uint32(0xFFFFFFFF)), idx1,
+            num_segments=n_buckets + 1)[:n_buckets]
     keys1 = []
     with jax.enable_x64(True):
         dirty = jnp.zeros(n_buckets, jnp.bool_)
@@ -262,10 +272,19 @@ def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
     with jax.enable_x64(True):
         dkeys = tuple(jnp.where(dvalid, kcol[dpos], jnp.uint64(_PAD_KEY64))
                       for kcol in keys64)
-        sorted_ops = lax.sort(dkeys + (dlen,), num_keys=k64)
+        if extra is None:
+            sorted_ops = lax.sort(dkeys + (dlen,), num_keys=k64)
+            dsex = None
+        else:
+            dex = jnp.where(dvalid, extra[dpos], jnp.uint32(0xFFFFFFFF))
+            # extra rides as an additional SORT KEY (not a group key):
+            # within a word's run rows order ascending by it, so the
+            # run's first row carries the group minimum.
+            sorted_ops = lax.sort(dkeys + (dex, dlen), num_keys=k64 + 1)
+            dsex = sorted_ops[k64]
         dgk, dtot, dupos, dovalid, n_du = group_sorted(
             sorted_ops[:k64], jnp.ones(d_cap, jnp.int32), u_cap)
-        dslens = sorted_ops[k64]
+        dslens = sorted_ops[-1]
 
     # Assemble: clean level-1 buckets first, dirty-repair uniques after.
     clean1 = occ1 & ~dirty
@@ -291,7 +310,12 @@ def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
         mode="drop")
     cnt_u = jnp.where(v1, tot1[cpos1], 0)
     cnt_u = cnt_u.at[dst2].set(jnp.where(dovalid, dtot, 0), mode="drop")
-    return tuple(out_keys), len_u, cnt_u, n_unique, group_overflow
+    ex_u = None
+    if extra is not None:
+        ex_u = jnp.where(v1, ex1[cpos1], jnp.uint32(0))
+        ex_u = ex_u.at[dst2].set(
+            jnp.where(dovalid, dsex[dupos], jnp.uint32(0)), mode="drop")
+    return tuple(out_keys), len_u, cnt_u, ex_u, n_unique, group_overflow
 
 
 def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
@@ -355,7 +379,7 @@ def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
     if grouper == "hash":
         fnv_t = fnv1a32_packed(jnp.stack(packed_cols, axis=1), lengths,
                                max_word_len)
-        keys64_u, len_u, cnt_u, n_unique, group_of = _hash_group(
+        keys64_u, len_u, cnt_u, _, n_unique, group_of = _hash_group(
             packed_cols, lengths, valid, fnv_t, u_cap=u_cap,
             max_word_len=max_word_len)
         with jax.enable_x64(True):
