@@ -15,6 +15,11 @@ from typing import List, Optional
 from dsi_tpu.apps.indexer import Map, Reduce  # noqa: F401  (host fallback)
 from dsi_tpu.mr.types import KeyValue
 
+#: C++ task bodies (native/wcjob.cpp via backends/native.py) implement
+#: exactly this app's semantics: Map = distinct words x document, Reduce
+#: = "<count> <sorted,docs>".
+native_kind = "indexer"
+
 
 def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
     from dsi_tpu.ops.wordcount import count_words_host_result

@@ -21,20 +21,53 @@ from dsi_tpu.mr.plugin import load_plugin_module
 from dsi_tpu.utils.atomicio import atomic_write
 
 
+def _wc_map(filename, n_reduce):
+    from dsi_tpu import native
+
+    return native.wc_map_file(filename, n_reduce)
+
+
+def _wc_reduce(workdir, reduce_task, n_map):
+    from dsi_tpu import native
+
+    return native.wc_reduce(workdir, reduce_task, n_map)
+
+
+def _idx_map(filename, n_reduce):
+    from dsi_tpu import native
+
+    # The host Map's document value is the filename argument verbatim
+    # (apps/indexer.py Map).
+    return native.idx_map_file(filename, filename, n_reduce)
+
+
+def _idx_reduce(workdir, reduce_task, n_map):
+    from dsi_tpu import native
+
+    return native.idx_reduce(workdir, reduce_task, n_map)
+
+
+#: native_kind -> (map body, reduce body); each returns None to decline.
+_KINDS = {
+    "wc_combine": (_wc_map, _wc_reduce),
+    "indexer": (_idx_map, _idx_reduce),
+}
+
+
 class NativeTaskRunner:
     """Backend object for ``worker_loop(task_runner=...)``."""
 
     def __init__(self, app_module):
         self.app = app_module
         self.kind = getattr(app_module, "native_kind", None)
-        if self.kind != "wc_combine":
+        if self.kind not in _KINDS:
             import sys
 
             print(
                 f"mrworker: app {getattr(app_module, '__name__', app_module)}"
                 " declares no supported native_kind; --backend=native will "
-                "run every task on the host path (the tpu_wc app declares "
-                "wc_combine)", file=sys.stderr)
+                f"run every task on the host path (supported:"
+                f" {sorted(_KINDS)})", file=sys.stderr)
             self.kind = None
 
     @classmethod
@@ -43,10 +76,8 @@ class NativeTaskRunner:
 
     def run_map(self, mapf, filename: str, map_task: int, n_reduce: int,
                 workdir: str = ".") -> None:
-        from dsi_tpu import native
-
-        blobs = (native.wc_map_file(filename, n_reduce)
-                 if self.kind == "wc_combine" else None)
+        blobs = (_KINDS[self.kind][0](filename, n_reduce)
+                 if self.kind else None)
         if blobs is None:  # host fallback (worker.go:55-92 semantics)
             w.run_map_task(mapf, filename, map_task, n_reduce, workdir)
             return
@@ -57,10 +88,8 @@ class NativeTaskRunner:
 
     def run_reduce(self, reducef, reduce_task: int, n_map: int,
                    workdir: str = ".") -> None:
-        from dsi_tpu import native
-
-        blob = (native.wc_reduce(workdir, reduce_task, n_map)
-                if self.kind == "wc_combine" else None)
+        blob = (_KINDS[self.kind][1](workdir, reduce_task, n_map)
+                if self.kind else None)
         if blob is None:
             w.run_reduce_task(reducef, reduce_task, n_map, workdir)
             return

@@ -76,6 +76,14 @@ def _load():
             lib.wc_reduce.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                       ctypes.c_uint32,
                                       ctypes.POINTER(ctypes.c_size_t)]
+            lib.idx_map_file.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.idx_map_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.c_uint32,
+                                         ctypes.POINTER(ctypes.c_size_t)]
+            lib.idx_reduce.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.idx_reduce.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                       ctypes.c_uint32,
+                                       ctypes.POINTER(ctypes.c_size_t)]
             _lib = lib
         except (OSError, AttributeError) as e:
             # AttributeError: a stale .so predating a symbol and a failed
@@ -202,6 +210,48 @@ def wc_map_file(path: str, n_reduce: int) -> Optional[List[bytes]]:
     finally:
         lib.kv_arena_free(ptr)
     return _unpack_blobs(arena, n_reduce)
+
+
+def idx_map_file(path: str, docname: str,
+                 n_reduce: int) -> Optional[List[bytes]]:
+    """Whole inverted-index map task natively (distinct words +
+    partition + render); None -> host path (non-ASCII split, docname
+    needing JSON escapes, or no library)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t()
+    try:
+        args = (path.encode(), docname.encode("ascii"), n_reduce)
+    except UnicodeEncodeError:
+        return None
+    ptr = lib.idx_map_file(*args, ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    return _unpack_blobs(arena, n_reduce)
+
+
+def idx_reduce(workdir: str, reduce_task: int, n_map: int) -> Optional[bytes]:
+    """Whole inverted-index reduce task natively ("<count> <docs,...>"
+    over sorted deduplicated documents); None -> Python reduce."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t()
+    ptr = lib.idx_reduce(workdir.encode(), reduce_task, n_map,
+                         ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    blobs = _unpack_blobs(arena, 1)
+    return None if blobs is None else blobs[0]
 
 
 def wc_reduce(workdir: str, reduce_task: int, n_map: int) -> Optional[bytes]:

@@ -37,8 +37,10 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <set>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -228,6 +230,124 @@ uint8_t* wc_reduce(const char* workdir, uint32_t reduce_task, uint32_t n_map,
     int m = snprintf(tail, sizeof tail, " %llu\n",
                      (unsigned long long)kv->second);
     out.append(tail, (size_t)m);
+  }
+  std::vector<std::string> blobs{out};
+  return pack_blobs(blobs, out_len);
+}
+
+// Inverted-index app bodies (apps/indexer.py semantics, native_kind
+// "indexer"): Map emits one {word, document} record per DISTINCT word
+// per split; Reduce renders "<count> <doc1>,<doc2>,..." over the sorted
+// deduplicated documents.  Same decline discipline as the wc bodies.
+
+// NULL when the split/docname needs the host path.
+uint8_t* idx_map_file(const char* path, const char* docname,
+                      uint32_t n_reduce, size_t* out_len) {
+  if (n_reduce == 0) return nullptr;
+  for (const char* c = docname; *c; c++) {
+    unsigned char u = (unsigned char)*c;
+    if (u < 0x20 || u >= 0x7F || u == '"' || u == '\\')
+      return nullptr;  // would need JSON escaping: Python writer owns it
+  }
+  std::string data;
+  if (!read_file(path, data)) return nullptr;
+  for (unsigned char c : data)
+    if (c >= 0x80) return nullptr;  // Unicode: host tokenizer owns it
+
+  std::unordered_set<std::string> words;
+  words.reserve(1 << 14);
+  const char* p = data.data();
+  const char* end = p + data.size();
+  while (p < end) {
+    while (p < end && !is_letter((unsigned char)*p)) p++;
+    const char* s = p;
+    while (p < end && is_letter((unsigned char)*p)) p++;
+    if (p > s) words.emplace(s, (size_t)(p - s));
+  }
+
+  std::vector<std::string> blobs(n_reduce);
+  for (const auto& w : words) {
+    uint32_t part = (fnv1a32(w.data(), w.size()) & 0x7FFFFFFFu) % n_reduce;
+    std::string& b = blobs[part];
+    b += "{\"Key\": \"";
+    b += w;
+    b += "\", \"Value\": \"";
+    b += docname;
+    b += "\"}\n";
+  }
+  return pack_blobs(blobs, out_len);
+}
+
+// NULL => the Python reduce (the app's own Reduce) owns the task.
+uint8_t* idx_reduce(const char* workdir, uint32_t reduce_task,
+                    uint32_t n_map, size_t* out_len) {
+  // std::set gives bytewise order == Python str sort for the ASCII
+  // strings this parser accepts.
+  std::unordered_map<std::string, std::set<std::string>> docs;
+  std::string data;
+  char path[4096];
+  for (uint32_t i = 0; i < n_map; i++) {
+    snprintf(path, sizeof path, "%s/mr-%u-%u", workdir, i, reduce_task);
+    data.clear();
+    if (!read_file(path, data)) continue;  // tolerated: worker.go:106-108
+    const char* p = data.data();
+    const char* end = p + data.size();
+    while (p < end) {
+      while (p < end && (*p == '\n' || *p == '\r' || *p == ' ')) p++;
+      if (p >= end) break;
+      auto expect = [&](const char* s) {
+        size_t n = strlen(s);
+        if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
+        p += n;
+        return true;
+      };
+      auto str_span = [&](const char** sp, uint32_t* sn) {
+        if (p >= end || *p != '"') return false;
+        p++;
+        const char* s = p;
+        while (p < end && *p != '"') {
+          unsigned char c = (unsigned char)*p;
+          if (c == '\\' || c >= 0x80 || c < 0x20) return false;
+          p++;
+        }
+        if (p >= end) return false;
+        *sp = s;
+        *sn = (uint32_t)(p - s);
+        p++;
+        return true;
+      };
+      const char *ks, *vs;
+      uint32_t kn, vn;
+      if (!expect("{\"Key\": ") || !str_span(&ks, &kn) ||
+          !expect(", \"Value\": ") || !str_span(&vs, &vn) || !expect("}"))
+        return nullptr;
+      // One record per line, like wc_reduce (the Python decoder breaks
+      // on trailing garbage).
+      while (p < end && (*p == ' ' || *p == '\r')) p++;
+      if (p < end && *p != '\n') return nullptr;
+      if (p < end) p++;
+      docs[std::string(ks, kn)].emplace(vs, vn);
+    }
+  }
+  std::vector<const std::pair<const std::string,
+                              std::set<std::string>>*> rows;
+  rows.reserve(docs.size());
+  for (const auto& kv : docs) rows.push_back(&kv);
+  std::sort(rows.begin(), rows.end(),
+            [](const auto* a, const auto* b) { return a->first < b->first; });
+  std::string out;
+  char num[16];
+  for (const auto* kv : rows) {
+    out += kv->first;
+    int m = snprintf(num, sizeof num, " %zu ", kv->second.size());
+    out.append(num, (size_t)m);
+    bool first = true;
+    for (const auto& d : kv->second) {
+      if (!first) out += ',';
+      first = false;
+      out += d;
+    }
+    out += '\n';
   }
   std::vector<std::string> blobs{out};
   return pack_blobs(blobs, out_len);

@@ -325,3 +325,49 @@ def test_native_wc_reduce_declines_u64_overflow(tmp_path):
     big = '{"Key": "a", "Value": "999999999999999999"}\n' * 21
     (tmp_path / "mr-0-5").write_bytes(big.encode())
     assert native.wc_reduce(str(tmp_path), 5, 1) is None
+
+
+def test_native_indexer_bodies_match_host(tmp_path):
+    """Native indexer map+reduce vs the host app path, mixed encoders."""
+    import io
+
+    from dsi_tpu import native
+    from dsi_tpu.apps.indexer import Map, Reduce
+    from dsi_tpu.mr.worker import (group_and_reduce, ihash,
+                                   read_intermediates, write_intermediates)
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    d0 = tmp_path / "docA.txt"
+    d0.write_bytes(b"red fish blue fish and red dog12dog")
+    d1 = tmp_path / "docB.txt"
+    d1.write_bytes(b"blue whale and the dog")
+    # map 0 native, map 1 via the host writer.
+    blobs = native.idx_map_file(str(d0), str(d0), 6)
+    assert blobs is not None
+    for r, blob in enumerate(blobs):
+        (tmp_path / f"mr-0-{r}").write_bytes(blob)
+    write_intermediates(Map(str(d1), d1.read_bytes().decode()), 1, 6,
+                        str(tmp_path))
+    for r in range(6):
+        blob = native.idx_reduce(str(tmp_path), r, 2)
+        assert blob is not None
+        buf = io.StringIO()
+        group_and_reduce(read_intermediates(r, 2, str(tmp_path)), Reduce,
+                         buf)
+        assert blob.decode() == buf.getvalue(), r
+    # Spot-check content: 'blue' appears in both docs.
+    r = ihash("blue") % 6
+    blob = native.idx_reduce(str(tmp_path), r, 2).decode()
+    assert f"blue 2 {d0},{d1}\n" in blob
+
+
+def test_native_indexer_declines_unescapable_docname(tmp_path):
+    from dsi_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    p = tmp_path / "doc.txt"
+    p.write_bytes(b"plain words")
+    assert native.idx_map_file(str(p), 'doc"quote', 4) is None
+    assert native.idx_map_file(str(p), "café", 4) is None
