@@ -23,6 +23,10 @@ from typing import List
 
 from dsi_tpu.mr.types import KeyValue
 
+#: C++ task bodies (native/wcjob.cpp via backends/native.py, literal
+#: patterns only — regex patterns decline to this module's re path).
+native_kind = "grep_count"
+
 
 def _pattern() -> "re.Pattern[str]":
     return re.compile(os.environ.get("DSI_GREP_PATTERN", r"(?!x)x"))

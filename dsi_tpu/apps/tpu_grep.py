@@ -22,6 +22,10 @@ from typing import List, Optional
 from dsi_tpu.apps.grep import Map, Reduce  # noqa: F401  (host fallback)
 from dsi_tpu.mr.types import KeyValue
 
+#: C++ task bodies (native/wcjob.cpp via backends/native.py, literal
+#: patterns only — regex declines to the host re path).
+native_kind = "grep_count"
+
 
 def tpu_map(filename: str, raw: bytes) -> Optional[List[KeyValue]]:
     from dsi_tpu.ops.altk import altgrep_host_result

@@ -47,10 +47,27 @@ def _idx_reduce(workdir, reduce_task, n_map):
     return native.idx_reduce(workdir, reduce_task, n_map)
 
 
+def _grep_map(filename, n_reduce):
+    from dsi_tpu import native
+
+    # Same out-of-band pattern source as the app (apps/grep.py).
+    pattern = os.environ.get("DSI_GREP_PATTERN", "")
+    if not pattern:
+        return None
+    return native.grep_map_file(filename, pattern, n_reduce)
+
+
+def _grep_reduce(workdir, reduce_task, n_map):
+    from dsi_tpu import native
+
+    return native.grep_reduce(workdir, reduce_task, n_map)
+
+
 #: native_kind -> (map body, reduce body); each returns None to decline.
 _KINDS = {
     "wc_combine": (_wc_map, _wc_reduce),
     "indexer": (_idx_map, _idx_reduce),
+    "grep_count": (_grep_map, _grep_reduce),
 }
 
 

@@ -84,6 +84,14 @@ def _load():
             lib.idx_reduce.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                        ctypes.c_uint32,
                                        ctypes.POINTER(ctypes.c_size_t)]
+            lib.grep_map_file.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.grep_map_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                          ctypes.c_uint32,
+                                          ctypes.POINTER(ctypes.c_size_t)]
+            lib.grep_reduce.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.grep_reduce.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                        ctypes.c_uint32,
+                                        ctypes.POINTER(ctypes.c_size_t)]
             _lib = lib
         except (OSError, AttributeError) as e:
             # AttributeError: a stale .so predating a symbol and a failed
@@ -244,6 +252,49 @@ def idx_reduce(workdir: str, reduce_task: int, n_map: int) -> Optional[bytes]:
     out_len = ctypes.c_size_t()
     ptr = lib.idx_reduce(workdir.encode(), reduce_task, n_map,
                          ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    blobs = _unpack_blobs(arena, 1)
+    return None if blobs is None else blobs[0]
+
+
+def grep_map_file(path: str, pattern: str,
+                  n_reduce: int) -> Optional[List[bytes]]:
+    """Whole literal-grep map task natively (byte-level substring search
+    per line + partition + render); None -> host re path (regex
+    metacharacters, non-ASCII split/pattern, rare control bytes)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t()
+    try:
+        args = (path.encode(), pattern.encode("ascii"), n_reduce)
+    except UnicodeEncodeError:
+        return None
+    ptr = lib.grep_map_file(*args, ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    return _unpack_blobs(arena, n_reduce)
+
+
+def grep_reduce(workdir: str, reduce_task: int,
+                n_map: int) -> Optional[bytes]:
+    """Whole occurrence-count grep reduce task natively; None -> Python
+    reduce (escapes beyond the map's minimal set, non-ASCII keys)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t()
+    ptr = lib.grep_reduce(workdir.encode(), reduce_task, n_map,
+                          ctypes.byref(out_len))
     if not ptr:
         return None
     try:

@@ -235,6 +235,164 @@ uint8_t* wc_reduce(const char* workdir, uint32_t reduce_task, uint32_t n_map,
   return pack_blobs(blobs, out_len);
 }
 
+// Distributed-grep app bodies (apps/grep.py semantics, native_kind
+// "grep_count"): Map emits one {line, ""} record per line containing
+// the LITERAL pattern (regex patterns decline to the host's re path);
+// Reduce counts occurrences.  ASCII-only (a split or pattern with any
+// byte >= 0x80 declines — the host path owns Unicode), with the minimal
+// JSON escape set lines need (\" \\ \t \r; other control bytes
+// decline).  For pure-ASCII literal patterns, byte-level substring
+// search over 0x0A-split lines is exactly re.search over the
+// utf-8-decoded text's lines.
+
+static bool grep_escape_line(const char* s, size_t n, std::string& out) {
+  for (size_t i = 0; i < n; i++) {
+    unsigned char c = (unsigned char)s[i];
+    if (c >= 0x80) return false;
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (c < 0x20) return false;  // rare ctrl chars: Python owns them
+        out.push_back((char)c);
+    }
+  }
+  return true;
+}
+
+extern "C" uint8_t* grep_map_file(const char* path, const char* pattern,
+                                  uint32_t n_reduce, size_t* out_len) {
+  if (n_reduce == 0) return nullptr;
+  size_t plen = strlen(pattern);
+  if (plen == 0) return nullptr;
+  for (const char* c = pattern; *c; c++) {
+    unsigned char u = (unsigned char)*c;
+    if (u >= 0x80 || u < 0x20) return nullptr;
+    // Only LITERAL patterns: any regex metacharacter defers to re.
+    if (strchr("\\^$.|?*+()[]{}", *c)) return nullptr;
+  }
+  std::string data;
+  if (!read_file(path, data)) return nullptr;
+  for (unsigned char c : data)
+    if (c >= 0x80) return nullptr;
+
+  std::vector<std::string> blobs(n_reduce);
+  const char* p = data.data();
+  const char* end = p + data.size();
+  std::string esc;
+  while (p <= end) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+    const char* e = nl ? nl : end;
+    if ((size_t)(e - p) >= plen &&
+        memmem(p, (size_t)(e - p), pattern, plen) != nullptr) {
+      esc.clear();
+      if (!grep_escape_line(p, (size_t)(e - p), esc)) return nullptr;
+      uint32_t part =
+          (fnv1a32(p, (size_t)(e - p)) & 0x7FFFFFFFu) % n_reduce;
+      std::string& b = blobs[part];
+      b += "{\"Key\": \"";
+      b += esc;
+      b += "\", \"Value\": \"\"}\n";
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return pack_blobs(blobs, out_len);
+}
+
+extern "C" uint8_t* grep_reduce(const char* workdir, uint32_t reduce_task,
+                                uint32_t n_map, size_t* out_len) {
+  // Count records per key; keys unescape to raw bytes before grouping
+  // and sorting (bytewise == Python str sort for the ASCII lines this
+  // parser accepts; \uXXXX or unknown escapes decline).
+  std::unordered_map<std::string, uint64_t> counts;
+  std::string data, key;
+  char path[4096];
+  for (uint32_t i = 0; i < n_map; i++) {
+    snprintf(path, sizeof path, "%s/mr-%u-%u", workdir, i, reduce_task);
+    data.clear();
+    if (!read_file(path, data)) continue;  // tolerated: worker.go:106-108
+    const char* p = data.data();
+    const char* end = p + data.size();
+    while (p < end) {
+      while (p < end && (*p == '\n' || *p == '\r' || *p == ' ')) p++;
+      if (p >= end) break;
+      auto expect = [&](const char* s) {
+        size_t n = strlen(s);
+        if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
+        p += n;
+        return true;
+      };
+      // Key string WITH the limited escape set, unescaped into `key`.
+      auto key_span = [&]() {
+        if (p >= end || *p != '"') return false;
+        p++;
+        key.clear();
+        while (p < end && *p != '"') {
+          unsigned char c = (unsigned char)*p;
+          if (c >= 0x80 || c < 0x20) return false;
+          if (c == '\\') {
+            if (p + 1 >= end) return false;
+            char n = p[1];
+            if (n == '"') key.push_back('"');
+            else if (n == '\\') key.push_back('\\');
+            else if (n == 't') key.push_back('\t');
+            else if (n == 'r') key.push_back('\r');
+            else if (n == '/') key.push_back('/');
+            else return false;  // \uXXXX etc: Python owns it
+            p += 2;
+          } else {
+            key.push_back((char)c);
+            p++;
+          }
+        }
+        if (p >= end) return false;
+        p++;
+        return true;
+      };
+      // Value must be a plain string; its content is ignored (the app's
+      // Reduce counts records), but escapes/non-ASCII still decline so
+      // acceptance implies the Python decoder agrees on record count.
+      auto skip_value = [&]() {
+        if (p >= end || *p != '"') return false;
+        p++;
+        while (p < end && *p != '"') {
+          unsigned char c = (unsigned char)*p;
+          if (c == '\\' || c >= 0x80 || c < 0x20) return false;
+          p++;
+        }
+        if (p >= end) return false;
+        p++;
+        return true;
+      };
+      if (!expect("{\"Key\": ") || !key_span() ||
+          !expect(", \"Value\": ") || !skip_value() || !expect("}"))
+        return nullptr;
+      while (p < end && (*p == ' ' || *p == '\r')) p++;
+      if (p < end && *p != '\n') return nullptr;
+      if (p < end) p++;
+      counts[key]++;
+    }
+  }
+  std::vector<const std::pair<const std::string, uint64_t>*> rows;
+  rows.reserve(counts.size());
+  for (const auto& kv : counts) rows.push_back(&kv);
+  std::sort(rows.begin(), rows.end(),
+            [](const auto* a, const auto* b) { return a->first < b->first; });
+  std::string out;
+  char tail[32];
+  for (const auto* kv : rows) {
+    out += kv->first;
+    int m = snprintf(tail, sizeof tail, " %llu\n",
+                     (unsigned long long)kv->second);
+    out.append(tail, (size_t)m);
+  }
+  std::vector<std::string> blobs{out};
+  return pack_blobs(blobs, out_len);
+}
+
 // Inverted-index app bodies (apps/indexer.py semantics, native_kind
 // "indexer"): Map emits one {word, document} record per DISTINCT word
 // per split; Reduce renders "<count> <doc1>,<doc2>,..." over the sorted

@@ -371,3 +371,54 @@ def test_native_indexer_declines_unescapable_docname(tmp_path):
     p.write_bytes(b"plain words")
     assert native.idx_map_file(str(p), 'doc"quote', 4) is None
     assert native.idx_map_file(str(p), "café", 4) is None
+
+
+def test_native_grep_bodies_match_host(tmp_path, monkeypatch):
+    """Native literal-grep map+reduce vs the host re path end-to-end,
+    including lines needing the minimal escape set."""
+    import io
+
+    from dsi_tpu import native
+    from dsi_tpu.apps.grep import Map, Reduce
+    from dsi_tpu.mr.worker import (group_and_reduce, read_intermediates,
+                                   write_intermediates)
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    monkeypatch.setenv("DSI_GREP_PATTERN", "dog")
+    raw = (b'the "dog" barked\tloudly\n'
+           b"no match here\n"
+           b"dog and dog again\n"
+           b"back\\slash dog line\n"
+           b"the dog\n"
+           b"the dog\n"
+           b"tail dog without newline")
+    p = tmp_path / "s.txt"
+    p.write_bytes(raw)
+    blobs = native.grep_map_file(str(p), "dog", 4)
+    assert blobs is not None
+    for r, blob in enumerate(blobs):
+        (tmp_path / f"mr-0-{r}").write_bytes(blob)
+    # A second map task via the Python writer (mixed encoders).
+    write_intermediates(Map(str(p), raw.decode()), 1, 4, str(tmp_path))
+    for r in range(4):
+        blob = native.grep_reduce(str(tmp_path), r, 2)
+        assert blob is not None, r
+        buf = io.StringIO()
+        group_and_reduce(read_intermediates(r, 2, str(tmp_path)), Reduce,
+                         buf)
+        assert blob.decode() == buf.getvalue(), r
+
+
+def test_native_grep_declines_regex_and_unicode(tmp_path):
+    from dsi_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    p = tmp_path / "s.txt"
+    p.write_bytes(b"plain dog line\n")
+    assert native.grep_map_file(str(p), "do+g", 4) is None  # regex: host re
+    assert native.grep_map_file(str(p), "café", 4) is None
+    p2 = tmp_path / "u.txt"
+    p2.write_bytes("the café dog\n".encode())
+    assert native.grep_map_file(str(p2), "dog", 4) is None  # unicode split
