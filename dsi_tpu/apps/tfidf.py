@@ -33,6 +33,10 @@ from typing import List, Optional, Sequence, Tuple
 from dsi_tpu.apps.wc import tokenize
 from dsi_tpu.mr.types import KeyValue
 
+#: C++ map body (native/wcjob.cpp via backends/native.py); the reduce
+#: (float scoring) always runs the Python format_value path.
+native_kind = "tfidf"
+
 
 def n_docs_from_env() -> int:
     raw = os.environ.get("DSI_TFIDF_NDOCS")

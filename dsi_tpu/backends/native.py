@@ -63,11 +63,21 @@ def _grep_reduce(workdir, reduce_task, n_map):
     return native.grep_reduce(workdir, reduce_task, n_map)
 
 
+def _tfidf_map(filename, n_reduce):
+    from dsi_tpu import native
+
+    return native.tfidf_map_file(filename, filename, n_reduce)
+
+
 #: native_kind -> (map body, reduce body); each returns None to decline.
+#: A None reduce body means that phase always runs the Python path (the
+#: tfidf reduce does float scoring whose formatting parity belongs to
+#: the shared Python format_value).
 _KINDS = {
     "wc_combine": (_wc_map, _wc_reduce),
     "indexer": (_idx_map, _idx_reduce),
     "grep_count": (_grep_map, _grep_reduce),
+    "tfidf": (_tfidf_map, None),
 }
 
 
@@ -105,8 +115,8 @@ class NativeTaskRunner:
 
     def run_reduce(self, reducef, reduce_task: int, n_map: int,
                    workdir: str = ".") -> None:
-        blob = (_KINDS[self.kind][1](workdir, reduce_task, n_map)
-                if self.kind else None)
+        body = _KINDS[self.kind][1] if self.kind else None
+        blob = body(workdir, reduce_task, n_map) if body else None
         if blob is None:
             w.run_reduce_task(reducef, reduce_task, n_map, workdir)
             return

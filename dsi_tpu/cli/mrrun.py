@@ -9,7 +9,7 @@ and exits when the coordinator does.
 
 Usage:
     python -m dsi_tpu.cli.mrrun [--workers 3] [--nreduce 10]
-        [--backend host|tpu] [--workdir DIR] [--task-timeout S]
+        [--backend host|tpu|native] [--workdir DIR] [--task-timeout S]
         [--check] <app> inputfiles...
 
 ``--check`` additionally runs the sequential oracle and byte-compares the
@@ -32,7 +32,8 @@ def main(argv=None) -> int:
     p.add_argument("files", nargs="+")
     p.add_argument("--workers", type=int, default=3)
     p.add_argument("--nreduce", type=int, default=10)
-    p.add_argument("--backend", choices=("host", "tpu"), default="host")
+    p.add_argument("--backend", choices=("host", "tpu", "native"),
+                   default="host")
     p.add_argument("--workdir", default=".")
     p.add_argument("--task-timeout", type=float, default=10.0)
     p.add_argument("--journal", default="",

@@ -92,6 +92,10 @@ def _load():
             lib.grep_reduce.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                         ctypes.c_uint32,
                                         ctypes.POINTER(ctypes.c_size_t)]
+            lib.tfidf_map_file.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.tfidf_map_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_size_t)]
             _lib = lib
         except (OSError, AttributeError) as e:
             # AttributeError: a stale .so predating a symbol and a failed
@@ -276,6 +280,29 @@ def grep_map_file(path: str, pattern: str,
     except UnicodeEncodeError:
         return None
     ptr = lib.grep_map_file(*args, ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        arena = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.kv_arena_free(ptr)
+    return _unpack_blobs(arena, n_reduce)
+
+
+def tfidf_map_file(path: str, docname: str,
+                   n_reduce: int) -> Optional[List[bytes]]:
+    """Whole TF-IDF map task natively (distinct words x in-doc counts,
+    value "<doc>\\t<tf>"); None -> host path.  The reduce (float
+    scoring) always runs on the Python path."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t()
+    try:
+        args = (path.encode(), docname.encode("ascii"), n_reduce)
+    except UnicodeEncodeError:
+        return None
+    ptr = lib.tfidf_map_file(*args, ctypes.byref(out_len))
     if not ptr:
         return None
     try:

@@ -235,6 +235,70 @@ uint8_t* wc_reduce(const char* workdir, uint32_t reduce_task, uint32_t n_map,
   return pack_blobs(blobs, out_len);
 }
 
+// TF-IDF map body (apps/tfidf.py semantics, native_kind "tfidf"): Map
+// emits one {word, "<doc>\t<tf>"} record per DISTINCT word per
+// document (tf = in-document count); the reduce (df/idf float scoring)
+// stays on the Python path, whose decoder reads the \t escape this
+// renders.  Same decline discipline as the other bodies.
+extern "C" uint8_t* tfidf_map_file(const char* path, const char* docname,
+                                   uint32_t n_reduce, size_t* out_len) {
+  if (n_reduce == 0) return nullptr;
+  for (const char* c = docname; *c; c++) {
+    unsigned char u = (unsigned char)*c;
+    if (u < 0x20 || u >= 0x7F || u == '"' || u == '\\')
+      return nullptr;  // would need wider escaping: Python writer owns it
+  }
+  std::string data;
+  if (!read_file(path, data)) return nullptr;
+  for (unsigned char c : data)
+    if (c >= 0x80) return nullptr;
+
+  struct SV {
+    const char* p;
+    uint32_t n;
+  };
+  struct SVHash {
+    size_t operator()(const SV& s) const {
+      uint64_t h = 1469598103934665603ull;
+      for (uint32_t i = 0; i < s.n; i++) {
+        h ^= (unsigned char)s.p[i];
+        h *= 1099511628211ull;
+      }
+      return (size_t)h;
+    }
+  };
+  struct SVEq {
+    bool operator()(const SV& a, const SV& b) const {
+      return a.n == b.n && memcmp(a.p, b.p, a.n) == 0;
+    }
+  };
+  std::unordered_map<SV, uint64_t, SVHash, SVEq> counts;
+  counts.reserve(1 << 14);
+  const char* p = data.data();
+  const char* end = p + data.size();
+  while (p < end) {
+    while (p < end && !is_letter((unsigned char)*p)) p++;
+    const char* s = p;
+    while (p < end && is_letter((unsigned char)*p)) p++;
+    if (p > s) counts[SV{s, (uint32_t)(p - s)}]++;
+  }
+
+  std::vector<std::string> blobs(n_reduce);
+  char tail[96];
+  for (const auto& it : counts) {
+    uint32_t part = (fnv1a32(it.first.p, it.first.n) & 0x7FFFFFFFu) % n_reduce;
+    std::string& b = blobs[part];
+    b += "{\"Key\": \"";
+    b.append(it.first.p, it.first.n);
+    b += "\", \"Value\": \"";
+    b += docname;
+    int m = snprintf(tail, sizeof tail, "\\t%llu\"}\n",
+                     (unsigned long long)it.second);
+    b.append(tail, (size_t)m);
+  }
+  return pack_blobs(blobs, out_len);
+}
+
 // Distributed-grep app bodies (apps/grep.py semantics, native_kind
 // "grep_count"): Map emits one {line, ""} record per line containing
 // the LITERAL pattern (regex patterns decline to the host's re path);

@@ -422,3 +422,27 @@ def test_native_grep_declines_regex_and_unicode(tmp_path):
     p2 = tmp_path / "u.txt"
     p2.write_bytes("the café dog\n".encode())
     assert native.grep_map_file(str(p2), "dog", 4) is None  # unicode split
+
+
+def test_native_tfidf_map_matches_host(tmp_path):
+    import json
+
+    from dsi_tpu import native
+    from dsi_tpu.apps.tfidf import Map
+    from dsi_tpu.mr.worker import ihash
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    raw = b"red fish blue fish red red dog12dog"
+    p = tmp_path / "docA.txt"
+    p.write_bytes(raw)
+    blobs = native.tfidf_map_file(str(p), str(p), 6)
+    assert blobs is not None
+    got = {}
+    for r, blob in enumerate(blobs):
+        for line in blob.decode().splitlines():
+            o = json.loads(line)
+            assert ihash(o["Key"]) % 6 == r
+            got[o["Key"]] = o["Value"]
+    want = {kv.key: kv.value for kv in Map(str(p), raw.decode())}
+    assert got == want
