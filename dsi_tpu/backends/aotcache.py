@@ -209,15 +209,29 @@ def _tunnel_answers() -> bool:
     caller's bounded-attempt machinery cycle).  ``DSI_TUNNEL_PROBE_PORT=0``
     disables the probe (always 'answers').
 
-    Default: probe 8083 ONLY when the backend is the axon tunnel; on any
-    other platform a closed local port says nothing about the compile
-    service, and failing the probe there would silently disable retries
+    Default: probe 8083 ONLY when this process targets the axon tunnel
+    (decided from the platform-pin environment, NOT from
+    ``get_backend()`` — a backend-initializing call here could itself
+    hang on the outage this probe exists to sidestep); on any other
+    platform a closed local port says nothing about the compile service,
+    and failing the probe there would silently disable retries
     everywhere except the one machine the port exists on (ADVICE r4)."""
     import socket
 
     env = os.environ.get("DSI_TUNNEL_PROBE_PORT")
     if env is None:
-        if "axon" not in _platform_fingerprint():
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends:
+            # Backends already initialized (every current caller's case):
+            # asking the live backend is free and authoritative.
+            axon = "axon" in _platform_fingerprint()
+        else:
+            # Pre-init: never trigger initialization from here — decide
+            # from the platform-pin environment instead.
+            axon = "axon" in (os.environ.get("JAX_PLATFORMS", "")
+                              + os.environ.get("DSI_JAX_PLATFORM", ""))
+        if not axon:
             return True
         port = 8083
     else:

@@ -204,24 +204,41 @@ def _unpack_blobs(arena: bytes, want: int) -> Optional[List[bytes]]:
     return out
 
 
-def wc_map_file(path: str, n_reduce: int) -> Optional[List[bytes]]:
-    """Whole word-count COMBINER map task natively (dsi_tpu/native/
-    wcjob.cpp): tokenize + count-per-unique + reference partition hash +
-    JSON-lines render in one C++ pass.  Returns the n_reduce partition
-    blobs, or None when the split needs the host path (non-ASCII bytes,
-    IO failure, or no library)."""
+def _call_arena(symbol: str, args: tuple, want: int) -> Optional[List[bytes]]:
+    """Shared call shape for every wcjob.cpp entry point: load, call,
+    copy the arena out, ALWAYS free it, unpack the blob framing.  One
+    place owns the arena-free-on-any-path invariant."""
     lib = _load()
     if lib is None:
         return None
     out_len = ctypes.c_size_t()
-    ptr = lib.wc_map_file(path.encode(), n_reduce, ctypes.byref(out_len))
+    ptr = getattr(lib, symbol)(*args, ctypes.byref(out_len))
     if not ptr:
         return None
     try:
         arena = ctypes.string_at(ptr, out_len.value)
     finally:
         lib.kv_arena_free(ptr)
-    return _unpack_blobs(arena, n_reduce)
+    return _unpack_blobs(arena, want)
+
+
+def wc_map_file(path: str, n_reduce: int) -> Optional[List[bytes]]:
+    """Whole word-count COMBINER map task natively (dsi_tpu/native/
+    wcjob.cpp): tokenize + count-per-unique + reference partition hash +
+    JSON-lines render in one C++ pass.  Returns the n_reduce partition
+    blobs, or None when the split needs the host path (non-ASCII bytes,
+    IO failure, or no library)."""
+    return _call_arena("wc_map_file", (path.encode(), n_reduce), n_reduce)
+
+
+def wc_reduce(workdir: str, reduce_task: int, n_map: int) -> Optional[bytes]:
+    """Whole word-count SUM reduce task natively: parse + per-key sum +
+    bytewise sort + "key sum\\n" render.  Returns the mr-out-<r> blob, or
+    None when the Python reduce (the app's own Reduce) must own the task
+    (escapes/non-ASCII/malformed records, overflow, or no library)."""
+    blobs = _call_arena("wc_reduce", (workdir.encode(), reduce_task, n_map),
+                        1)
+    return None if blobs is None else blobs[0]
 
 
 def idx_map_file(path: str, docname: str,
@@ -229,40 +246,18 @@ def idx_map_file(path: str, docname: str,
     """Whole inverted-index map task natively (distinct words +
     partition + render); None -> host path (non-ASCII split, docname
     needing JSON escapes, or no library)."""
-    lib = _load()
-    if lib is None:
-        return None
-    out_len = ctypes.c_size_t()
     try:
         args = (path.encode(), docname.encode("ascii"), n_reduce)
     except UnicodeEncodeError:
         return None
-    ptr = lib.idx_map_file(*args, ctypes.byref(out_len))
-    if not ptr:
-        return None
-    try:
-        arena = ctypes.string_at(ptr, out_len.value)
-    finally:
-        lib.kv_arena_free(ptr)
-    return _unpack_blobs(arena, n_reduce)
+    return _call_arena("idx_map_file", args, n_reduce)
 
 
 def idx_reduce(workdir: str, reduce_task: int, n_map: int) -> Optional[bytes]:
     """Whole inverted-index reduce task natively ("<count> <docs,...>"
     over sorted deduplicated documents); None -> Python reduce."""
-    lib = _load()
-    if lib is None:
-        return None
-    out_len = ctypes.c_size_t()
-    ptr = lib.idx_reduce(workdir.encode(), reduce_task, n_map,
-                         ctypes.byref(out_len))
-    if not ptr:
-        return None
-    try:
-        arena = ctypes.string_at(ptr, out_len.value)
-    finally:
-        lib.kv_arena_free(ptr)
-    blobs = _unpack_blobs(arena, 1)
+    blobs = _call_arena("idx_reduce", (workdir.encode(), reduce_task, n_map),
+                        1)
     return None if blobs is None else blobs[0]
 
 
@@ -271,22 +266,20 @@ def grep_map_file(path: str, pattern: str,
     """Whole literal-grep map task natively (byte-level substring search
     per line + partition + render); None -> host re path (regex
     metacharacters, non-ASCII split/pattern, rare control bytes)."""
-    lib = _load()
-    if lib is None:
-        return None
-    out_len = ctypes.c_size_t()
     try:
         args = (path.encode(), pattern.encode("ascii"), n_reduce)
     except UnicodeEncodeError:
         return None
-    ptr = lib.grep_map_file(*args, ctypes.byref(out_len))
-    if not ptr:
-        return None
-    try:
-        arena = ctypes.string_at(ptr, out_len.value)
-    finally:
-        lib.kv_arena_free(ptr)
-    return _unpack_blobs(arena, n_reduce)
+    return _call_arena("grep_map_file", args, n_reduce)
+
+
+def grep_reduce(workdir: str, reduce_task: int,
+                n_map: int) -> Optional[bytes]:
+    """Whole occurrence-count grep reduce task natively; None -> Python
+    reduce (escapes beyond the map's minimal set, non-ASCII keys)."""
+    blobs = _call_arena("grep_reduce", (workdir.encode(), reduce_task,
+                                        n_map), 1)
+    return None if blobs is None else blobs[0]
 
 
 def tfidf_map_file(path: str, docname: str,
@@ -294,60 +287,8 @@ def tfidf_map_file(path: str, docname: str,
     """Whole TF-IDF map task natively (distinct words x in-doc counts,
     value "<doc>\\t<tf>"); None -> host path.  The reduce (float
     scoring) always runs on the Python path."""
-    lib = _load()
-    if lib is None:
-        return None
-    out_len = ctypes.c_size_t()
     try:
         args = (path.encode(), docname.encode("ascii"), n_reduce)
     except UnicodeEncodeError:
         return None
-    ptr = lib.tfidf_map_file(*args, ctypes.byref(out_len))
-    if not ptr:
-        return None
-    try:
-        arena = ctypes.string_at(ptr, out_len.value)
-    finally:
-        lib.kv_arena_free(ptr)
-    return _unpack_blobs(arena, n_reduce)
-
-
-def grep_reduce(workdir: str, reduce_task: int,
-                n_map: int) -> Optional[bytes]:
-    """Whole occurrence-count grep reduce task natively; None -> Python
-    reduce (escapes beyond the map's minimal set, non-ASCII keys)."""
-    lib = _load()
-    if lib is None:
-        return None
-    out_len = ctypes.c_size_t()
-    ptr = lib.grep_reduce(workdir.encode(), reduce_task, n_map,
-                          ctypes.byref(out_len))
-    if not ptr:
-        return None
-    try:
-        arena = ctypes.string_at(ptr, out_len.value)
-    finally:
-        lib.kv_arena_free(ptr)
-    blobs = _unpack_blobs(arena, 1)
-    return None if blobs is None else blobs[0]
-
-
-def wc_reduce(workdir: str, reduce_task: int, n_map: int) -> Optional[bytes]:
-    """Whole word-count SUM reduce task natively: parse + per-key sum +
-    bytewise sort + "key sum\\n" render.  Returns the mr-out-<r> blob, or
-    None when the Python reduce (the app's own Reduce) must own the task
-    (escapes/non-ASCII/malformed records, or no library)."""
-    lib = _load()
-    if lib is None:
-        return None
-    out_len = ctypes.c_size_t()
-    ptr = lib.wc_reduce(workdir.encode(), reduce_task, n_map,
-                        ctypes.byref(out_len))
-    if not ptr:
-        return None
-    try:
-        arena = ctypes.string_at(ptr, out_len.value)
-    finally:
-        lib.kv_arena_free(ptr)
-    blobs = _unpack_blobs(arena, 1)
-    return None if blobs is None else blobs[0]
+    return _call_arena("tfidf_map_file", args, n_reduce)

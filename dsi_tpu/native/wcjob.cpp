@@ -95,6 +95,115 @@ uint8_t* pack_blobs(const std::vector<std::string>& blobs, size_t* out_len) {
   return arena;
 }
 
+// Byte-span key for per-unique-word hash tables over a split buffer.
+// The table hash is FNV-1a 64 (table use only — the partition hash is
+// always the reference's exact 32-bit variant, fnv1a32 above).
+struct SV {
+  const char* p;
+  uint32_t n;
+};
+struct SVHash {
+  size_t operator()(const SV& s) const {
+    uint64_t h = 1469598103934665603ull;
+    for (uint32_t i = 0; i < s.n; i++) {
+      h ^= (unsigned char)s.p[i];
+      h *= 1099511628211ull;
+    }
+    return (size_t)h;
+  }
+};
+struct SVEq {
+  bool operator()(const SV& a, const SV& b) const {
+    return a.n == b.n && memcmp(a.p, b.p, a.n) == 0;
+  }
+};
+
+// Tokenize maximal [A-Za-z] runs into a per-unique-word count table.
+void count_tokens(const std::string& data,
+                  std::unordered_map<SV, uint64_t, SVHash, SVEq>& counts) {
+  const char* p = data.data();
+  const char* end = p + data.size();
+  while (p < end) {
+    while (p < end && !is_letter((unsigned char)*p)) p++;
+    const char* s = p;
+    while (p < end && is_letter((unsigned char)*p)) p++;
+    if (p > s) counts[SV{s, (uint32_t)(p - s)}]++;
+  }
+}
+
+// Shared strict record parser for every native reduce body: one
+// {"Key": "...", "Value": "..."} record per line, matching the exact
+// shape both writers (this file and Python json.dumps) emit.  Returns
+// 1 on a parsed record, 0 at clean end-of-data, -1 when the file must
+// defer to the Python decoder (escapes — unless `unescape_key` handles
+// the minimal set —, non-ASCII/control bytes, concatenated records,
+// malformed shapes).  Acceptance here implies the Python decoder agrees
+// on the record sequence, which is what lets the native reduce's output
+// be byte-identical by construction.
+int parse_record(const char*& p, const char* end, SV* key, SV* val,
+                 std::string* unescape_key) {
+  while (p < end && (*p == '\n' || *p == '\r' || *p == ' ')) p++;
+  if (p >= end) return 0;
+  auto expect = [&](const char* s) {
+    size_t n = strlen(s);
+    if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  };
+  auto plain_span = [&](SV* out) {
+    if (p >= end || *p != '"') return false;
+    p++;
+    const char* s = p;
+    while (p < end && *p != '"') {
+      unsigned char c = (unsigned char)*p;
+      if (c == '\\' || c >= 0x80 || c < 0x20) return false;
+      p++;
+    }
+    if (p >= end) return false;
+    out->p = s;
+    out->n = (uint32_t)(p - s);
+    p++;
+    return true;
+  };
+  auto escaped_span = [&](std::string* out) {
+    if (p >= end || *p != '"') return false;
+    p++;
+    out->clear();
+    while (p < end && *p != '"') {
+      unsigned char c = (unsigned char)*p;
+      if (c >= 0x80 || c < 0x20) return false;
+      if (c == '\\') {
+        if (p + 1 >= end) return false;
+        char n = p[1];
+        if (n == '"') out->push_back('"');
+        else if (n == '\\') out->push_back('\\');
+        else if (n == 't') out->push_back('\t');
+        else if (n == 'r') out->push_back('\r');
+        else if (n == '/') out->push_back('/');
+        else return false;  // \uXXXX etc: Python owns it
+        p += 2;
+      } else {
+        out->push_back((char)c);
+        p++;
+      }
+    }
+    if (p >= end) return false;
+    p++;
+    return true;
+  };
+  if (!expect("{\"Key\": ")) return -1;
+  if (unescape_key ? !escaped_span(unescape_key) : !plain_span(key))
+    return -1;
+  if (!expect(", \"Value\": ") || !plain_span(val) || !expect("}"))
+    return -1;
+  // Strictly one record per line (the Python decoder json.loads's each
+  // LINE and breaks on trailing garbage; kvcodec.cpp enforces the same).
+  while (p < end && (*p == ' ' || *p == '\r')) p++;
+  if (p < end && *p != '\n') return -1;
+  if (p < end) p++;
+  return 1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -106,38 +215,9 @@ uint8_t* wc_map_file(const char* path, uint32_t n_reduce, size_t* out_len) {
   for (unsigned char c : data)
     if (c >= 0x80) return nullptr;  // Unicode: host tokenizer owns it
 
-  // Count per unique word (string_view keys into the split buffer).
-  struct SV {
-    const char* p;
-    uint32_t n;
-  };
-  struct SVHash {
-    size_t operator()(const SV& s) const {
-      // FNV-1a 64 for the table only (the partition hash is computed
-      // separately with the reference's exact 32-bit variant).
-      uint64_t h = 1469598103934665603ull;
-      for (uint32_t i = 0; i < s.n; i++) {
-        h ^= (unsigned char)s.p[i];
-        h *= 1099511628211ull;
-      }
-      return (size_t)h;
-    }
-  };
-  struct SVEq {
-    bool operator()(const SV& a, const SV& b) const {
-      return a.n == b.n && memcmp(a.p, b.p, a.n) == 0;
-    }
-  };
   std::unordered_map<SV, uint64_t, SVHash, SVEq> counts;
   counts.reserve(1 << 15);
-  const char* p = data.data();
-  const char* end = p + data.size();
-  while (p < end) {
-    while (p < end && !is_letter((unsigned char)*p)) p++;
-    const char* s = p;
-    while (p < end && is_letter((unsigned char)*p)) p++;
-    if (p > s) counts[SV{s, (uint32_t)(p - s)}]++;
-  }
+  count_tokens(data, counts);
 
   std::vector<std::string> blobs(n_reduce);
   char line[96];
@@ -169,53 +249,20 @@ uint8_t* wc_reduce(const char* workdir, uint32_t reduce_task, uint32_t n_map,
     if (!read_file(path, data)) continue;  // tolerated: worker.go:106-108
     const char* p = data.data();
     const char* end = p + data.size();
-    while (p < end) {
-      // One record per line: {"Key": "...", "Value": "..."}
-      while (p < end && (*p == '\n' || *p == '\r' || *p == ' ')) p++;
-      if (p >= end) break;
-      auto expect = [&](const char* s) {
-        size_t n = strlen(s);
-        if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
-        p += n;
-        return true;
-      };
-      auto str_span = [&](const char** sp, uint32_t* sn) {
-        if (p >= end || *p != '"') return false;
-        p++;
-        const char* s = p;
-        while (p < end && *p != '"') {
-          unsigned char c = (unsigned char)*p;
-          if (c == '\\' || c >= 0x80 || c < 0x20) return false;
-          p++;
-        }
-        if (p >= end) return false;
-        *sp = s;
-        *sn = (uint32_t)(p - s);
-        p++;  // closing quote
-        return true;
-      };
-      const char *ks, *vs;
-      uint32_t kn, vn;
-      if (!expect("{\"Key\": ") || !str_span(&ks, &kn) ||
-          !expect(", \"Value\": ") || !str_span(&vs, &vn) || !expect("}"))
-        return nullptr;  // unexpected shape/escape: Python decides
-      // Strictly one record per line (the Python decoder json.loads's
-      // each LINE and breaks on trailing garbage — kvcodec.cpp enforces
-      // the same invariant): anything but whitespace-then-newline/EOF
-      // after the record defers to Python.
-      while (p < end && (*p == ' ' || *p == '\r')) p++;
-      if (p < end && *p != '\n') return nullptr;
-      if (p < end) p++;
-      if (vn == 0 || vn > 18) return nullptr;
+    SV key, val;
+    int rc;
+    while ((rc = parse_record(p, end, &key, &val, nullptr)) == 1) {
+      if (val.n == 0 || val.n > 18) return nullptr;
       uint64_t v = 0;
-      for (uint32_t j = 0; j < vn; j++) {
-        if (vs[j] < '0' || vs[j] > '9') return nullptr;
-        v = v * 10 + (uint64_t)(vs[j] - '0');
+      for (uint32_t j = 0; j < val.n; j++) {
+        if (val.p[j] < '0' || val.p[j] > '9') return nullptr;
+        v = v * 10 + (uint64_t)(val.p[j] - '0');
       }
-      uint64_t& slot = sums[std::string(ks, kn)];
+      uint64_t& slot = sums[std::string(key.p, key.n)];
       if (slot > UINT64_MAX - v) return nullptr;  // Python sums exactly
       slot += v;
     }
+    if (rc < 0) return nullptr;  // unexpected shape/escape: Python decides
   }
   std::vector<const std::pair<const std::string, uint64_t>*> rows;
   rows.reserve(sums.size());
@@ -253,35 +300,9 @@ extern "C" uint8_t* tfidf_map_file(const char* path, const char* docname,
   for (unsigned char c : data)
     if (c >= 0x80) return nullptr;
 
-  struct SV {
-    const char* p;
-    uint32_t n;
-  };
-  struct SVHash {
-    size_t operator()(const SV& s) const {
-      uint64_t h = 1469598103934665603ull;
-      for (uint32_t i = 0; i < s.n; i++) {
-        h ^= (unsigned char)s.p[i];
-        h *= 1099511628211ull;
-      }
-      return (size_t)h;
-    }
-  };
-  struct SVEq {
-    bool operator()(const SV& a, const SV& b) const {
-      return a.n == b.n && memcmp(a.p, b.p, a.n) == 0;
-    }
-  };
   std::unordered_map<SV, uint64_t, SVHash, SVEq> counts;
   counts.reserve(1 << 14);
-  const char* p = data.data();
-  const char* end = p + data.size();
-  while (p < end) {
-    while (p < end && !is_letter((unsigned char)*p)) p++;
-    const char* s = p;
-    while (p < end && is_letter((unsigned char)*p)) p++;
-    if (p > s) counts[SV{s, (uint32_t)(p - s)}]++;
-  }
+  count_tokens(data, counts);
 
   std::vector<std::string> blobs(n_reduce);
   char tail[96];
@@ -380,65 +401,15 @@ extern "C" uint8_t* grep_reduce(const char* workdir, uint32_t reduce_task,
     if (!read_file(path, data)) continue;  // tolerated: worker.go:106-108
     const char* p = data.data();
     const char* end = p + data.size();
-    while (p < end) {
-      while (p < end && (*p == '\n' || *p == '\r' || *p == ' ')) p++;
-      if (p >= end) break;
-      auto expect = [&](const char* s) {
-        size_t n = strlen(s);
-        if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
-        p += n;
-        return true;
-      };
-      // Key string WITH the limited escape set, unescaped into `key`.
-      auto key_span = [&]() {
-        if (p >= end || *p != '"') return false;
-        p++;
-        key.clear();
-        while (p < end && *p != '"') {
-          unsigned char c = (unsigned char)*p;
-          if (c >= 0x80 || c < 0x20) return false;
-          if (c == '\\') {
-            if (p + 1 >= end) return false;
-            char n = p[1];
-            if (n == '"') key.push_back('"');
-            else if (n == '\\') key.push_back('\\');
-            else if (n == 't') key.push_back('\t');
-            else if (n == 'r') key.push_back('\r');
-            else if (n == '/') key.push_back('/');
-            else return false;  // \uXXXX etc: Python owns it
-            p += 2;
-          } else {
-            key.push_back((char)c);
-            p++;
-          }
-        }
-        if (p >= end) return false;
-        p++;
-        return true;
-      };
-      // Value must be a plain string; its content is ignored (the app's
-      // Reduce counts records), but escapes/non-ASCII still decline so
-      // acceptance implies the Python decoder agrees on record count.
-      auto skip_value = [&]() {
-        if (p >= end || *p != '"') return false;
-        p++;
-        while (p < end && *p != '"') {
-          unsigned char c = (unsigned char)*p;
-          if (c == '\\' || c >= 0x80 || c < 0x20) return false;
-          p++;
-        }
-        if (p >= end) return false;
-        p++;
-        return true;
-      };
-      if (!expect("{\"Key\": ") || !key_span() ||
-          !expect(", \"Value\": ") || !skip_value() || !expect("}"))
-        return nullptr;
-      while (p < end && (*p == ' ' || *p == '\r')) p++;
-      if (p < end && *p != '\n') return nullptr;
-      if (p < end) p++;
+    SV val;
+    int rc;
+    // Key with the minimal escape set unescaped; the value's content is
+    // ignored (the app's Reduce counts records) but still parses
+    // strictly so acceptance implies the Python decoder agrees on the
+    // record sequence.
+    while ((rc = parse_record(p, end, nullptr, &val, &key)) == 1)
       counts[key]++;
-    }
+    if (rc < 0) return nullptr;
   }
   std::vector<const std::pair<const std::string, uint64_t>*> rows;
   rows.reserve(counts.size());
@@ -514,42 +485,11 @@ uint8_t* idx_reduce(const char* workdir, uint32_t reduce_task,
     if (!read_file(path, data)) continue;  // tolerated: worker.go:106-108
     const char* p = data.data();
     const char* end = p + data.size();
-    while (p < end) {
-      while (p < end && (*p == '\n' || *p == '\r' || *p == ' ')) p++;
-      if (p >= end) break;
-      auto expect = [&](const char* s) {
-        size_t n = strlen(s);
-        if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
-        p += n;
-        return true;
-      };
-      auto str_span = [&](const char** sp, uint32_t* sn) {
-        if (p >= end || *p != '"') return false;
-        p++;
-        const char* s = p;
-        while (p < end && *p != '"') {
-          unsigned char c = (unsigned char)*p;
-          if (c == '\\' || c >= 0x80 || c < 0x20) return false;
-          p++;
-        }
-        if (p >= end) return false;
-        *sp = s;
-        *sn = (uint32_t)(p - s);
-        p++;
-        return true;
-      };
-      const char *ks, *vs;
-      uint32_t kn, vn;
-      if (!expect("{\"Key\": ") || !str_span(&ks, &kn) ||
-          !expect(", \"Value\": ") || !str_span(&vs, &vn) || !expect("}"))
-        return nullptr;
-      // One record per line, like wc_reduce (the Python decoder breaks
-      // on trailing garbage).
-      while (p < end && (*p == ' ' || *p == '\r')) p++;
-      if (p < end && *p != '\n') return nullptr;
-      if (p < end) p++;
-      docs[std::string(ks, kn)].emplace(vs, vn);
-    }
+    SV key, val;
+    int rc;
+    while ((rc = parse_record(p, end, &key, &val, nullptr)) == 1)
+      docs[std::string(key.p, key.n)].emplace(val.p, val.n);
+    if (rc < 0) return nullptr;
   }
   std::vector<const std::pair<const std::string,
                               std::set<std::string>>*> rows;

@@ -135,5 +135,25 @@ def altgrep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
             n_lines, overflow = nl, of  # chunk-derived: same every branch
         return total, n_lines, overflow
 
-    line_match, nl = retry_line_caps(n, run)
+    def ready(l_cap: int) -> bool:
+        # Every branch's compiled shape must be a warm load at this rung
+        # (grepk.device_ready discipline).
+        from dsi_tpu.ops.grepk import grep_rung_ready
+        from dsi_tpu.ops.regexk import classgrep_rung_ready
+
+        for b in branches:
+            if is_literal_pattern(b):
+                if len(b) > len(data):
+                    continue  # dead branch: no kernel is compiled for it
+                if not grep_rung_ready(n, len(b), l_cap):
+                    return False
+            else:
+                ranges, a_s, a_e = parse_class_pattern(b)
+                if not classgrep_rung_ready(n, ranges, a_s, a_e, l_cap):
+                    return False
+        return True
+
+    line_match, nl = retry_line_caps(n, run, ready=ready)
+    if line_match is None:
+        return None  # cold remote compile in-task: host serves this job
     return lines_from_flags(text, line_match, nl)

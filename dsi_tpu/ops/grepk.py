@@ -15,6 +15,7 @@ depends on the kernel (``backends/tpu.py`` contract).
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional
 
 import jax
@@ -23,6 +24,25 @@ import numpy as np
 
 import dsi_tpu.ops.wordcount as _wordcount_mod
 from dsi_tpu.ops.wordcount import _pad_pow2, _shift_left
+
+
+def device_ready(name: str, fn, example, static) -> bool:
+    """Whether dispatching this compiled shape NOW is a millisecond load
+    or a multi-minute remote compile — the bench's
+    ``corpus_executable_persisted`` discipline, shared by every grep
+    tier's rung gate (ADVICE r4: the l_cap escalation rung is a
+    separately compiled shape, and an ungated escalation cold-compiles
+    inside a worker task).  CPU backends are always ready (compiles are
+    seconds); ``DSI_GREP_COLD_OK=1`` / ``DSI_NFA_COLD_OK=1`` bypass the
+    gate for scripts/warm_kernels.py, whose job the compiles are."""
+    if os.environ.get("DSI_GREP_COLD_OK") == "1" \
+            or os.environ.get("DSI_NFA_COLD_OK") == "1":
+        return True
+    if jax.devices()[0].platform == "cpu":
+        return True
+    from dsi_tpu.backends.aotcache import is_persisted
+
+    return is_persisted(name, fn, example, static=static)
 
 
 def line_flags_from_match(chunk: jax.Array, match: jax.Array, l_cap: int):
@@ -51,11 +71,18 @@ def line_cap_rungs(n: int):
     return (max(n // 8, 1), n + 1)
 
 
-def retry_line_caps(n: int, run):
+def retry_line_caps(n: int, run, ready=None):
     """Shared l_cap rung schedule (exactness_retry discipline): average
     line >= 8 bytes first, then the n+1 hard bound (every byte a '\\n').
-    ``run(l_cap)`` -> (line_match, n_lines, overflow)."""
+    ``run(l_cap)`` -> (line_match, n_lines, overflow).
+
+    ``ready(l_cap)``, when given, gates EVERY rung (including the
+    overflow escalation, a separately compiled shape): a not-ready rung
+    returns ``(None, -1)`` and the caller serves the job on the host
+    path instead of cold-compiling inside a worker task."""
     for l_cap in line_cap_rungs(n):
+        if ready is not None and not ready(l_cap):
+            return None, -1
         line_match, n_lines, overflow = run(l_cap)
         if not bool(overflow):
             break
@@ -93,14 +120,24 @@ def grep_kernel(chunk: jax.Array, pattern: jax.Array, *, l_cap: int):
 grep_kernel._aot_code_deps = (_wordcount_mod,)
 
 
+def _grep_example(n: int, m: int):
+    return (jax.ShapeDtypeStruct((n,), np.uint8),
+            jax.ShapeDtypeStruct((m,), np.uint8))
+
+
 @functools.lru_cache(maxsize=64)
 def _grep_compiled(n: int, m: int, l_cap: int):
     from dsi_tpu.backends.aotcache import cached_compile
 
-    example = (jax.ShapeDtypeStruct((n,), np.uint8),
-               jax.ShapeDtypeStruct((m,), np.uint8))
-    return cached_compile("grep_kernel", grep_kernel, example,
+    return cached_compile("grep_kernel", grep_kernel, _grep_example(n, m),
                           static={"l_cap": l_cap})
+
+
+def grep_rung_ready(n: int, m: int, l_cap: int) -> bool:
+    """Readiness probe for exactly the shape ``_grep_compiled`` builds —
+    shared with the alternation tier (``ops/altk.py``)."""
+    return device_ready("grep_kernel", grep_kernel, _grep_example(n, m),
+                        {"l_cap": l_cap})
 
 
 def _grep_jit(chunk, pattern, *, l_cap: int):
@@ -139,6 +176,10 @@ def grep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
     chunk = jnp.asarray(_pad_pow2(data))
     pat = jnp.asarray(np.frombuffer(pattern.encode("ascii"), dtype=np.uint8))
     n = int(chunk.shape[0])
+    m = len(pattern)
     line_match, nl = retry_line_caps(
-        n, lambda l_cap: _grep_jit(chunk, pat, l_cap=l_cap))
+        n, lambda l_cap: _grep_jit(chunk, pat, l_cap=l_cap),
+        ready=lambda l_cap: grep_rung_ready(n, m, l_cap))
+    if line_match is None:
+        return None  # cold remote compile in-task: host serves this job
     return lines_from_flags(text, line_match, nl)

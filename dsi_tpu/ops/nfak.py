@@ -48,9 +48,11 @@ import numpy as np
 import dsi_tpu.ops.grepk as _grepk_mod
 from dsi_tpu.ops.altk import split_top_level
 from dsi_tpu.ops.grepk import (
+    device_ready,
     line_cap_rungs,
     line_flags_from_match,
     lines_from_flags,
+    retry_line_caps,
 )
 from dsi_tpu.ops.regexk import ATOM_REJECT, atom_members
 from dsi_tpu.ops.wordcount import _pad_pow2
@@ -370,23 +372,11 @@ def _nfa_compiled(n: int, s_bucket: int, block: int, l_cap: int):
 
 
 def _device_ready(n: int, s_bucket: int, block: int, l_cap: int) -> bool:
-    """Whether running this tier now is a millisecond load or a
-    multi-minute remote compile.  On CPU backends compiles are cheap —
-    always ready.  On an accelerator, only serve the tier when the
-    first-rung executable is already persisted (warm_kernels compiles
-    it, exporting DSI_NFA_COLD_OK=1 to bypass this gate): a cold remote
-    compile inside a worker TASK would outlive the harness process
-    timeout and loop forever (the bench's corpus_executable_persisted
-    discipline, applied to grep)."""
-    if os.environ.get("DSI_NFA_COLD_OK") == "1":
-        return True
-    if jax.devices()[0].platform == "cpu":
-        return True
-    from dsi_tpu.backends.aotcache import is_persisted
-
+    """Readiness probe for exactly the shape ``_nfa_compiled`` builds
+    (shared rung-gate discipline: ``grepk.device_ready``)."""
     example, static = _nfa_example_static(n, s_bucket, block, l_cap)
-    return is_persisted(f"nfagrep_s{s_bucket}", nfa_kernel, example,
-                        static=static)
+    return device_ready(f"nfagrep_s{s_bucket}", nfa_kernel, example,
+                        static)
 
 
 #: In-process view of the persisted calibration table (loaded once; a
@@ -541,25 +531,26 @@ def nfagrep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
     s_bucket = table_np.shape[1]
     # _pad_pow2 guarantees >= 1 trailing zero — the line-end byte the
     # $ latch and final-line handling depend on.
-    n = len(_pad_pow2(data))
+    chunk_np = _pad_pow2(data)
+    n = len(chunk_np)
     block = min(256, n)
-    # Per-RUNG readiness (ADVICE r4): the retry schedule escalates to the
-    # n+1 rung on line-count overflow (average line < 8 bytes), and that
-    # rung is a separately compiled shape — gating only the first rung
-    # would let the escalation trigger exactly the in-task multi-minute
-    # remote compile the gate exists to prevent.  The gate precedes the
-    # table/chunk uploads so a not-ready refusal stays device-free.
-    rungs = line_cap_rungs(n)
-    if not _device_ready(n, s_bucket, block, rungs[0]):
+    # Per-RUNG readiness (ADVICE r4) via the shared gated retry
+    # (grepk.retry_line_caps): the escalation rung is a separately
+    # compiled shape, and an ungated escalation would cold-compile
+    # inside a worker task.  Device uploads happen lazily on the first
+    # rung that actually runs, so a not-ready refusal stays device-free.
+    dev = {}
+
+    def run(l_cap: int):
+        if not dev:
+            dev["chunk"] = jnp.asarray(chunk_np)
+            dev["table"] = jnp.asarray(table_np)
+            dev["v0"] = jnp.asarray(v0_np)
+        return _nfa_compiled(n, s_bucket, block, l_cap)(
+            dev["chunk"], dev["table"], dev["v0"])
+
+    line_match, nl = retry_line_caps(
+        n, run, ready=lambda l_cap: _device_ready(n, s_bucket, block, l_cap))
+    if line_match is None:
         return None  # cold remote compile in-task: host serves this job
-    chunk = jnp.asarray(_pad_pow2(data))
-    table = jnp.asarray(table_np)
-    v0 = jnp.asarray(v0_np)
-    for l_cap in rungs:
-        if not _device_ready(n, s_bucket, block, l_cap):
-            return None  # escalation rung not persisted: host serves it
-        line_match, n_lines, overflow = _nfa_compiled(
-            n, s_bucket, block, l_cap)(chunk, table, v0)
-        if not bool(overflow):
-            break
-    return lines_from_flags(text, line_match, int(n_lines))
+    return lines_from_flags(text, line_match, nl)

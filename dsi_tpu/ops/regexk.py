@@ -229,16 +229,34 @@ def classgrep_kernel(chunk: jax.Array, *, ranges, anchor_start: bool,
 classgrep_kernel._aot_code_deps = (_wordcount_mod, _grepk_mod)
 
 
+def _classgrep_example_static(n: int, ranges, anchor_start: bool,
+                              anchor_end: bool, l_cap: int):
+    example = (jax.ShapeDtypeStruct((n,), np.uint8),)
+    return example, {"ranges": ranges, "anchor_start": anchor_start,
+                     "anchor_end": anchor_end, "l_cap": l_cap}
+
+
 @functools.lru_cache(maxsize=64)
 def _classgrep_compiled(n: int, ranges, anchor_start: bool,
                         anchor_end: bool, l_cap: int):
     from dsi_tpu.backends.aotcache import cached_compile
 
-    example = (jax.ShapeDtypeStruct((n,), np.uint8),)
-    return cached_compile(
-        "classgrep_kernel", classgrep_kernel, example,
-        static={"ranges": ranges, "anchor_start": anchor_start,
-                "anchor_end": anchor_end, "l_cap": l_cap})
+    example, static = _classgrep_example_static(n, ranges, anchor_start,
+                                                anchor_end, l_cap)
+    return cached_compile("classgrep_kernel", classgrep_kernel, example,
+                          static=static)
+
+
+def classgrep_rung_ready(n: int, ranges, anchor_start: bool,
+                         anchor_end: bool, l_cap: int) -> bool:
+    """Readiness probe for exactly the shape ``_classgrep_compiled``
+    builds — shared with the alternation tier (``ops/altk.py``)."""
+    from dsi_tpu.ops.grepk import device_ready
+
+    example, static = _classgrep_example_static(n, ranges, anchor_start,
+                                                anchor_end, l_cap)
+    return device_ready("classgrep_kernel", classgrep_kernel, example,
+                        static)
 
 
 def classgrep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
@@ -259,5 +277,9 @@ def classgrep_host_result(data: bytes, pattern: str) -> Optional[List[str]]:
     n = int(chunk.shape[0])
     line_match, nl = retry_line_caps(
         n, lambda l_cap: _classgrep_compiled(
-            n, ranges, anchor_start, anchor_end, l_cap)(chunk))
+            n, ranges, anchor_start, anchor_end, l_cap)(chunk),
+        ready=lambda l_cap: classgrep_rung_ready(
+            n, ranges, anchor_start, anchor_end, l_cap))
+    if line_match is None:
+        return None  # cold remote compile in-task: host serves this job
     return lines_from_flags(text, line_match, nl)

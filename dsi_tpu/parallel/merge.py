@@ -186,10 +186,11 @@ class PackedPostings:
     word i's."""
 
     __slots__ = ("kk", "skeys", "lens", "parts", "starts", "ends",
-                 "tfs", "docs")
+                 "tfs", "docs", "_be")
 
     def __init__(self, kk: int):
         self.kk = kk
+        self._be = None  # lazy big-endian key view (lookup_many)
         self.skeys = np.zeros((0, max(kk, 1)), np.uint32)
         self.lens = np.zeros(0, np.uint32)
         self.parts = np.zeros(0, np.uint32)
@@ -218,11 +219,17 @@ class PackedPostings:
         n = len(self.skeys)
         if n == 0:
             return {}
-        be = np.ascontiguousarray(self.skeys.astype(">u4"))
+        if self._be is None:  # immutable after finalize_packed: cache it
+            self._be = np.ascontiguousarray(self.skeys.astype(">u4"))
+        be = self._be
         width = 4 * self.kk
         out: Dict[str, Tuple[int, List[Tuple[int, int]]]] = {}
         for w in words:
-            raw = w.encode("ascii", "ignore")
+            try:
+                raw = w.encode("ascii")
+            except UnicodeEncodeError:
+                continue  # non-ASCII cannot be in the table: omit, never
+                # alias to an ASCII-stripped spelling
             if not raw or len(raw) > width:
                 continue
             q = raw.ljust(width, b"\x00")
