@@ -158,6 +158,11 @@ def main() -> int:
 
         t0 = time.perf_counter()
         mesh = default_mesh()
+        # bench.py's stream row shape (STREAM_CHUNK_BYTES/STREAM_U_CAP):
+        # 2 MiB chunks, 2^15 start capacity + one x4 widening.
+        warm_stream_aot(mesh=mesh, chunk_bytes=1 << 21,
+                        caps=(1 << 15, 1 << 17))
+        # wcstream --check's shape (onchip_evidence.sh pins --u-cap 16384).
         warm_stream_aot(mesh=mesh, chunk_bytes=1 << 20,
                         caps=(1 << 14, 1 << 16))
         # The GB-scale on-chip stream (onchip_evidence.sh step 9) uses
