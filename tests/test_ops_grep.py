@@ -157,3 +157,26 @@ def test_control_byte_pattern_rejected():
     assert not is_literal_pattern("\x00")
     assert not is_literal_pattern("a\x01b")
     assert grep_host_result(b"abc\x00x\ndef", "\x00") is None
+
+
+def test_rung_gate_covers_all_tiers(monkeypatch):
+    """Round-5 review: every grep tier must refuse a rung whose compiled
+    shape is not persisted on an accelerator (host fallback), including
+    the n+1 overflow escalation."""
+    import dsi_tpu.ops.altk as altk
+    import dsi_tpu.ops.grepk as grepk
+    import dsi_tpu.ops.regexk as regexk
+
+    class _FakeDev:
+        platform = "tpu"
+
+    monkeypatch.setattr(grepk.jax, "devices", lambda: [_FakeDev()])
+    monkeypatch.setattr("dsi_tpu.backends.aotcache.is_persisted",
+                        lambda *a, **k: False)
+    data = b"the quick fox\nplain line\n" * 8
+    assert grepk.grep_host_result(data, "fox") is None
+    assert regexk.classgrep_host_result(data, "[Tt]he") is None
+    assert altk.altgrep_host_result(data, "fox|[Tt]he") is None
+    # Warm-script bypass keeps compiles possible where they are the job.
+    monkeypatch.setenv("DSI_GREP_COLD_OK", "1")
+    assert grepk.grep_host_result(data, "fox") is not None
