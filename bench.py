@@ -60,7 +60,10 @@ Environment knobs:
   DSI_BENCH_STREAM_MB     size of the streaming-path row (default 64;
                           0 disables it).  The row only runs against a
                           warm AOT cache and never pre-empts the headline
-                          verdict (which is emitted first).
+                          verdict (which is emitted first).  The row runs
+                          at the streaming engine's pipeline depth
+                          (DSI_STREAM_PIPELINE_DEPTH, default 2) and
+                          reports per-phase seconds as ``stream_phases``.
   DSI_BENCH_FRAMEWORK_MB  corpus size for the distributed N-worker row
                           (default 48; 0 disables it; auto-shrunk so its
                           oracle pass costs ~100 s on a slow box, skipped
@@ -513,10 +516,12 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
             yield from stream_files(files)
 
     mesh = default_mesh()
+    pstats: dict = {}
     with Span("bench.stream") as pt:
         acc = wordcount_streaming(blocks(), mesh=mesh, n_reduce=N_REDUCE,
                                   chunk_bytes=STREAM_CHUNK_BYTES,
-                                  u_cap=STREAM_U_CAP, aot=True)
+                                  u_cap=STREAM_U_CAP, aot=True,
+                                  pipeline_stats=pstats)
     dt = pt.elapsed_s
     if acc is None:
         return {"stream_skipped": "stream needed the host path "
@@ -532,14 +537,22 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
               and all(acc.get(w, (0, 0))[0] == c * cycles
                       for w, c in oracle.items()))
     mb = corpus_bytes * cycles / 1e6
+    # Per-phase attribution (mirrors the TPU path's ``phases`` dict):
+    # lets BENCH_r06+ say WHERE stream throughput went — kernel-bound,
+    # or batch/upload/pull/merge overhead the pipeline failed to hide.
+    phases = {k: pstats[k] for k in ("batch_s", "batch_wait_s", "upload_s",
+                                     "kernel_s", "pull_s", "merge_s",
+                                     "replay_s", "depth", "replays")
+              if k in pstats}
     log(f"stream row: {mb:.1f} MB in {dt:.2f}s = {mb / dt:.2f} MB/s "
-        f"(cycles={cycles}, parity={parity})")
+        f"(cycles={cycles}, parity={parity}, phases={phases})")
     if not parity:
         return {"stream_skipped": f"parity mismatch over {mb:.1f} MB "
                                   f"(throughput suppressed)",
                 "stream_parity": False}
     return {"stream_mbps": round(mb / dt, 2), "stream_mb": round(mb, 1),
-            "stream_s": round(dt, 2), "stream_parity": True}
+            "stream_s": round(dt, 2), "stream_parity": True,
+            "stream_phases": phases}
 
 
 def framework_row_mb() -> float:
@@ -974,7 +987,7 @@ def main() -> None:
         out["total_mb"] = res["total_mb"]  # ceiling from the artifact
 
     for k in ("stream_mbps", "stream_mb", "stream_s", "stream_parity",
-              "stream_skipped"):
+              "stream_phases", "stream_skipped"):
         if k in res:
             out[k] = res[k]
     out.update(fw)

@@ -94,7 +94,8 @@ def _code_fingerprint(fn: Callable) -> str:
 
 
 def _key(name: str, fn: Callable, example_args: Tuple[Any, ...],
-         static: Dict[str, Any]) -> str:
+         static: Dict[str, Any],
+         donate_argnums: Tuple[int, ...] = ()) -> str:
     import jax
 
     parts = [_CACHE_VERSION, _platform_fingerprint(), name,
@@ -103,6 +104,10 @@ def _key(name: str, fn: Callable, example_args: Tuple[Any, ...],
         parts.append(f"{jax.numpy.shape(a)}:{jax.numpy.result_type(a)}")
     for k in sorted(static):
         parts.append(f"{k}={static[k]!r}")
+    if donate_argnums:
+        # Donation changes the executable's aliasing config, not its math;
+        # keyed only when requested so pre-existing entries keep their keys.
+        parts.append(f"donate={tuple(donate_argnums)!r}")
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:32]
 
 
@@ -112,7 +117,8 @@ def _log(msg: str) -> None:
 
 
 def is_persisted(name: str, fn: Callable, example_args: Tuple[Any, ...],
-                 static: Dict[str, Any] | None = None) -> bool:
+                 static: Dict[str, Any] | None = None,
+                 donate_argnums: Tuple[int, ...] = ()) -> bool:
     """True when a compiled executable for exactly this (platform, source,
     shapes, static) key is already on disk.  Pure existence probe — no
     compile, no load, no device work beyond the platform fingerprint
@@ -134,13 +140,15 @@ def is_persisted(name: str, fn: Callable, example_args: Tuple[Any, ...],
         return False
     if len(jax.devices()) != 1:
         return False
-    key = _key(name, fn, example_args, static or {})
+    key = _key(name, fn, example_args, static or {}, donate_argnums)
     return os.path.exists(os.path.join(cache_dir(), f"{name}-{key}.aot"))
 
 
 def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
                    static: Dict[str, Any] | None = None,
-                   persist: bool | None = None) -> Callable:
+                   persist: bool | None = None,
+                   donate_argnums: Tuple[int, ...] = (),
+                   x64: bool = False) -> Callable:
     """Return a compiled callable for ``fn`` at ``example_args``' avals.
 
     ``static`` are keyword arguments baked into the program (and the cache
@@ -148,20 +156,27 @@ def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
     shapes/dtypes.  Thread-safe; per-process memoized.  ``persist=False``
     keeps the in-process memo + compile-time accounting but never touches
     disk; the default honors the ``DSI_AOT_CACHE=0`` kill switch.
+    ``donate_argnums`` marks input buffers the caller hands to the program
+    (jax.jit semantics; the streaming pipeline donates its per-step chunk
+    uploads so an in-flight window never doubles HBM residency) — callers
+    must not reuse a donated argument after the call.  ``x64=True`` runs
+    trace/lower/compile under the scoped x64 flag — required for programs
+    whose bodies touch uint64 (utils/jaxcompat.x64_scoped rationale).
     """
     import jax
 
     if persist is None:
         persist = os.environ.get("DSI_AOT_CACHE", "1") != "0"
     static = static or {}
-    key = _key(name, fn, example_args, static)
+    key = _key(name, fn, example_args, static, donate_argnums)
     with _memo_lock:
         hit = _memo.get(key)
     if hit is not None:
         return hit
 
     path = os.path.join(cache_dir(), f"{name}-{key}.aot")
-    jitted = jax.jit(fn, static_argnames=tuple(static or ()))
+    jitted = jax.jit(fn, static_argnames=tuple(static or ()),
+                     donate_argnums=donate_argnums)
 
     # Disk persistence is for the real chip (one device per process).  In a
     # multi-device process (the 8-virtual-CPU test mesh) a deserialized
@@ -172,7 +187,8 @@ def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
 
     loaded = _try_load(path) if persist else None
     if loaded is None:
-        compiled = _compile_with_retry(jitted, example_args, static, name)
+        compiled = _compile_with_retry(jitted, example_args, static, name,
+                                       x64=x64)
         if persist:
             _try_save(path, compiled, name)
         loaded = compiled
@@ -180,7 +196,8 @@ def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
         stats["loads"] += 1
         _log(f"{name}: loaded from {os.path.basename(path)}")
         loaded = _verify_first_call(loaded, path, name, jitted,
-                                    example_args, static)
+                                    example_args, static, x64=x64,
+                                    donate_argnums=donate_argnums)
 
     with _memo_lock:
         _memo[key] = loaded
@@ -249,7 +266,8 @@ def _tunnel_answers() -> bool:
         s.close()
 
 
-def _compile_with_retry(jitted, example_args, static, name: str):
+def _compile_with_retry(jitted, example_args, static, name: str,
+                        x64: bool = False):
     """lower+compile pinned to one device, with bounded transient retry.
 
     Pinning: under a multi-device process (e.g. the 8-virtual-CPU test
@@ -267,13 +285,17 @@ def _compile_with_retry(jitted, example_args, static, name: str):
     retry in milliseconds, so raising immediately hands control back to
     the caller's outage machinery instead of burning the budget.
     Non-transient errors (OOM, lowering bugs) raise immediately."""
+    import contextlib
     import time
 
     import jax
 
+    from dsi_tpu.utils.jaxcompat import enable_x64
+
     retries = int(os.environ.get("DSI_COMPILE_RETRIES", "2"))
     t0 = time.perf_counter()
-    with jax.default_device(jax.devices()[0]):
+    x64_scope = enable_x64(True) if x64 else contextlib.nullcontext()
+    with jax.default_device(jax.devices()[0]), x64_scope:
         for attempt in range(retries + 1):
             try:
                 compiled = jitted.lower(*example_args, **static).compile()
@@ -296,7 +318,8 @@ def _compile_with_retry(jitted, example_args, static, name: str):
 
 
 def _verify_first_call(exe, path: str, name: str, jitted,
-                       example_args, static) -> Callable:
+                       example_args, static, x64: bool = False,
+                       donate_argnums: Tuple[int, ...] = ()) -> Callable:
     """Trust-but-verify wrapper for DESERIALIZED executables: a loaded
     entry can pass deserialization yet fail at EXECUTION (observed on
     this host 2026-07-31: XLA:CPU AOT loader warns of a machine-feature
@@ -314,6 +337,17 @@ def _verify_first_call(exe, path: str, name: str, jitted,
     def call(*args):
         if state["verified"]:
             return state["exe"](*args)
+        backups = None
+        if donate_argnums:
+            # The first invocation DONATES (consumes) these inputs; the
+            # evict-recompile-reinvoke recovery below re-runs with the
+            # same args, which would hit 'Array has been deleted' instead
+            # of recovering.  Keep device copies until the call verifies
+            # — a one-time cost per loaded program, dropped on success.
+            import jax.numpy as jnp
+
+            backups = {i: jnp.array(args[i], copy=True)
+                       for i in donate_argnums if i < len(args)}
         try:
             out = state["exe"](*args)
             jax.block_until_ready(out)
@@ -344,12 +378,16 @@ def _verify_first_call(exe, path: str, name: str, jitted,
                 except OSError:
                     pass
             compiled = _compile_with_retry(jitted, example_args, static,
-                                           name)
+                                           name, x64=x64)
             # Outside the poison class the entry bytes may simply have
             # been stale/corrupt — re-persist the fresh executable
             # (_try_save itself skips marked entries).
             _try_save(path, compiled, name)
             state["exe"] = compiled
+            if backups:
+                args = list(args)
+                for i, b in backups.items():
+                    args[i] = b
             out = state["exe"](*args)
         state["verified"] = True
         return out

@@ -53,6 +53,9 @@ def main(argv=None) -> int:
     p.add_argument("--u-cap", type=_positive_int, default=1 << 12,
                    help="starting per-device unique capacity (sticky; "
                         "widens on overflow)")
+    p.add_argument("--pipeline-depth", type=_positive_int, default=None,
+                   help="in-flight stream steps (default: "
+                        "DSI_STREAM_PIPELINE_DEPTH or 2; 1 = synchronous)")
     args = p.parse_args(argv)
 
     from dsi_tpu.utils.platformpin import pin_platform_from_env
@@ -66,7 +69,8 @@ def main(argv=None) -> int:
     acc = wordcount_streaming(stream_files(args.files), mesh=mesh,
                               n_reduce=args.nreduce,
                               chunk_bytes=args.chunk_bytes,
-                              u_cap=args.u_cap, aot=args.aot)
+                              u_cap=args.u_cap, aot=args.aot,
+                              depth=args.pipeline_depth)
     if acc is None:
         # Host fallback: the sequential oracle semantics, partitioned output.
         print("wcstream: stream needs the host path; running host word count",

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 import dsi_tpu.ops.wordcount as _wordcount
+from dsi_tpu.utils.jaxcompat import enable_x64
 from dsi_tpu.ops.wordcount import (
     _PAD_KEY,
     build_lanes,
@@ -180,7 +181,7 @@ def _corpus_core(chunk, max_word_len: int, u_cap: int, t_cap_frac: int,
         # original token order (ascending position) survives, so each
         # group's FIRST row carries the word's first occurrence position
         # (its length is group-invariant).
-        with jax.enable_x64(True):  # u64 operands need the scoped flag
+        with enable_x64(True):  # u64 operands need the scoped flag
             keys64 = pack_key_lanes(packed_cols)
             k64 = len(keys64)
             sorted_ops = lax.sort(keys64 + (poslen_tok,),
@@ -420,7 +421,7 @@ def _get_compiled(n_pieces: int, piece_size: int, mwl: int, cap: int,
     # use_aot=False still memoizes in-process and accounts compile time in
     # aotcache.stats; it only stops disk reads/writes.
     return cached_compile(name, fn, example, static=static,
-                          persist=None if use_aot else False)
+                          persist=None if use_aot else False, x64=True)
 
 
 def corpus_executable_persisted(raws: Sequence[bytes], *,

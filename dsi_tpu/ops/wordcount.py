@@ -53,6 +53,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dsi_tpu.utils.jaxcompat import enable_x64, x64_scoped
+
 _FNV_OFFSET = 0x811C9DC5
 _FNV_PRIME = 0x01000193
 _PAD_KEY = 0xFFFFFFFF  # sorts after every real word (ASCII first byte < 0x80)
@@ -124,7 +126,7 @@ def pack_key_lanes(cols: tuple) -> tuple:
     context makes these ops real 64-bit without flipping the global
     default (which would change dtype inference package-wide)."""
     out = []
-    with jax.enable_x64(True):
+    with enable_x64(True):
         for j in range(0, len(cols), 2):
             hi = cols[j].astype(jnp.uint64) << 32
             lo = (cols[j + 1] if j + 1 < len(cols)
@@ -141,7 +143,7 @@ def unpack_key_lanes(cols64, k: int) -> tuple:
     """Inverse of :func:`pack_key_lanes`: k uint32 lanes back out of the
     packed uint64 columns."""
     out = []
-    with jax.enable_x64(True):
+    with enable_x64(True):
         for j in range(k):
             w = cols64[j // 2]
             out.append(((w >> 32) if j % 2 == 0 else w).astype(jnp.uint32))
@@ -173,7 +175,7 @@ def group_sorted(skeys_cols: tuple, counts: jax.Array, out_cap: int):
     t = skeys_cols[0].shape[0]
     k = len(skeys_cols)
     dtype = skeys_cols[0].dtype
-    with jax.enable_x64(True):  # 64-bit constants need the scoped flag
+    with enable_x64(True):  # 64-bit constants need the scoped flag
         pad = jnp.array(jnp.iinfo(dtype).max, dtype)  # _PAD_KEY for u32
         keys = jnp.stack(skeys_cols, axis=1)
         valid = skeys_cols[0] != pad
@@ -248,7 +250,7 @@ def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
             jnp.where(valid, extra, jnp.uint32(0xFFFFFFFF)), idx1,
             num_segments=n_buckets + 1)[:n_buckets]
     keys1 = []
-    with jax.enable_x64(True):
+    with enable_x64(True):
         dirty = jnp.zeros(n_buckets, jnp.bool_)
         for kcol in keys64:
             mn = jax.ops.segment_min(
@@ -269,7 +271,7 @@ def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
     (dpos,) = jnp.nonzero(in_dirty, size=d_cap, fill_value=0)
     dvalid = jnp.arange(d_cap, dtype=jnp.int32) < n_dirty_tokens
     dlen = jnp.where(dvalid, lengths[dpos], 0)
-    with jax.enable_x64(True):
+    with enable_x64(True):
         dkeys = tuple(jnp.where(dvalid, kcol[dpos], jnp.uint64(_PAD_KEY64))
                       for kcol in keys64)
         if extra is None:
@@ -295,7 +297,7 @@ def _hash_group(packed_cols: tuple, lengths: jax.Array, valid: jax.Array,
     dst2 = jnp.where(dovalid, jnp.arange(u_cap, dtype=jnp.int32) + n_clean1,
                      u_cap)
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         out_keys = []
         for j in range(k64):
             # A clean bucket's segment-max IS its one word's lane value.
@@ -382,7 +384,7 @@ def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
         keys64_u, len_u, cnt_u, _, n_unique, group_of = _hash_group(
             packed_cols, lengths, valid, fnv_t, u_cap=u_cap,
             max_word_len=max_word_len)
-        with jax.enable_x64(True):
+        with enable_x64(True):
             packed_u = unpack_key_rows(jnp.stack(keys64_u, axis=1), k)
         fnv_u = fnv1a32_packed(packed_u, len_u, max_word_len)
         has_high = jnp.any(chunk >= 128)
@@ -393,7 +395,7 @@ def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
     # pairwise into uint64s (pack_key_lanes: same order, half the
     # comparator keys — the sort is ~3/4 of this kernel's wall on CPU),
     # then run boundaries; lanes unpack only after compaction to u_cap.
-    with jax.enable_x64(True):  # every op touching u64 operands needs it
+    with enable_x64(True):  # every op touching u64 operands needs it
         keys64 = pack_key_lanes(packed_cols)
         k64 = len(keys64)
         sorted_ops = lax.sort(keys64 + (lengths,), num_keys=k64)
@@ -411,9 +413,9 @@ def tokenize_group_core(chunk: jax.Array, *, max_word_len: int = 16,
             token_overflow)
 
 
-count_words_kernel = jax.jit(
+count_words_kernel = x64_scoped(jax.jit(
     tokenize_group_core,
-    static_argnames=("max_word_len", "u_cap", "t_cap_frac", "grouper"))
+    static_argnames=("max_word_len", "u_cap", "t_cap_frac", "grouper")))
 
 
 def default_grouper() -> str:
@@ -462,7 +464,8 @@ def _cached_kernel(n: int, max_word_len: int, u_cap: int, t_cap_frac: int,
     if grouper != "sort":
         static["grouper"] = grouper
         name = f"wc_kernel_{grouper}"
-    return cached_compile(name, tokenize_group_core, example, static=static)
+    return cached_compile(name, tokenize_group_core, example,
+                          static=static, x64=True)
 
 
 def run_count_kernel(chunk: jax.Array, *, max_word_len: int, u_cap: int,

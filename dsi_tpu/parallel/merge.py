@@ -96,6 +96,19 @@ class PackedCounts:
         if self._pending >= self._compact_rows:
             self._compact()
 
+    def add_packed_step(self, packed: np.ndarray, n_uniques,
+                        kk: int) -> None:
+        """Ingest one pulled step tensor ``[n_dev, mp, kk+3]`` (the
+        ``shuffle._slice_pack`` layout: kk key lanes + len/count/partition
+        columns), taking the first ``n_uniques[d]`` rows of each device's
+        table.  One call per stream step — the merge phase the pipelined
+        engine (parallel/streaming.py) runs on the host while later
+        steps' kernels are still in flight on device."""
+        for d in range(packed.shape[0]):
+            nu = int(n_uniques[d])
+            r = packed[d, :nu]
+            self.add(r[:, :kk], r[:, kk], r[:, kk + 1], r[:, kk + 2])
+
     def _compact(self) -> None:
         if len(self._bufs) <= 1:
             return

@@ -49,6 +49,9 @@ from dsi_tpu.ops.wordcount import (
     unpack_key_rows,
 )
 
+from dsi_tpu.utils.jaxcompat import (enable_x64, x64_scoped,
+                                     shard_map as _shard_map)
+
 AXIS = "workers"
 
 
@@ -142,7 +145,7 @@ def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
     #    packed pairwise into uint64s — same order, half the comparator
     #    keys, see pack_key_lanes) ──
     out_cap = n_dev * u_cap
-    with jax.enable_x64(True):  # every op touching u64 operands needs it
+    with enable_x64(True):  # every op touching u64 operands needs it
         rkeys64 = pack_key_lanes(tuple(recv[:, j] for j in range(k)))
         k64 = len(rkeys64)
         rlen = recv[:, k]
@@ -167,34 +170,50 @@ def _device_step(chunk: jax.Array, *, n_dev: int, n_reduce: int,
             scalars[None])
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("n_dev", "n_reduce", "max_word_len",
-                                    "u_cap", "t_cap_frac", "mesh",
-                                    "grouper"))
-def mapreduce_step(chunks: jax.Array, *, n_dev: int, n_reduce: int,
-                   max_word_len: int, u_cap: int, mesh: Mesh,
-                   t_cap_frac: int = 4, grouper: str = "sort"):
-    """The full SPMD job step, jitted over the mesh.
-
-    ``chunks``: [n_dev, L] uint8, one zero-padded text shard per device.
-    Returns per-device arrays stacked on axis 0: packed word keys
-    [D, D*u_cap, K], byte lengths, summed counts, reduce-partition ids, and a
-    [D, 5] scalar block (m_unique, n_unique, max_len, has_high,
-    token_overflow).
-
-    ``grouper`` (ops/wordcount.py default_grouper): with ``"hash"`` the
-    per-device map groups by scattered hash buckets instead of the big
-    sort; an unresolvable collision rides the token_overflow scalar and
-    the host wrapper re-runs the step with ``"sort"``.
-    """
+def _mapreduce_step_impl(chunks: jax.Array, *, n_dev: int, n_reduce: int,
+                         max_word_len: int, u_cap: int, mesh: Mesh,
+                         t_cap_frac: int = 4, grouper: str = "sort"):
+    """The full SPMD job step body — jitted twice below (with and without
+    input-buffer donation) so the streaming engine's per-step uploads can
+    be consumed by the kernel while ``wordcount_sharded`` keeps reusing
+    one uploaded corpus across its retry attempts."""
     body = functools.partial(_device_step, n_dev=n_dev, n_reduce=n_reduce,
                              max_word_len=max_word_len, u_cap=u_cap,
                              t_cap_frac=t_cap_frac, grouper=grouper)
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=P(AXIS, None),
         out_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
                    P(AXIS, None), P(AXIS, None)))(chunks)
+
+
+_STEP_STATICS = ("n_dev", "n_reduce", "max_word_len", "u_cap", "t_cap_frac",
+                 "mesh", "grouper")
+
+#: The full SPMD job step, jitted over the mesh.
+#:
+#: ``chunks``: [n_dev, L] uint8, one zero-padded text shard per device.
+#: Returns per-device arrays stacked on axis 0: packed word keys
+#: [D, D*u_cap, K], byte lengths, summed counts, reduce-partition ids, and a
+#: [D, 5] scalar block (m_unique, n_unique, max_len, has_high,
+#: token_overflow).
+#:
+#: ``grouper`` (ops/wordcount.py default_grouper): with ``"hash"`` the
+#: per-device map groups by scattered hash buckets instead of the big
+#: sort; an unresolvable collision rides the token_overflow scalar and
+#: the host wrapper re-runs the step with ``"sort"``.
+mapreduce_step = x64_scoped(
+    jax.jit(_mapreduce_step_impl, static_argnames=_STEP_STATICS))
+
+#: Same program with the chunk buffer DONATED: the caller hands its upload
+#: to the kernel, so an in-flight pipeline window holds at most one chunk
+#: buffer per step in HBM (parallel/streaming.py).  A donated array cannot
+#: be reused — streaming re-uploads per attempt; ``wordcount_sharded``
+#: stays on the non-donated entry because it reuses one upload across its
+#: whole retry ladder.
+mapreduce_step_donate = x64_scoped(
+    jax.jit(_mapreduce_step_impl, static_argnames=_STEP_STATICS,
+            donate_argnums=(0,)))
 
 
 def occupied_prefix(m: int, cap_rows: int) -> int:

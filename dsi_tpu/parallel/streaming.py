@@ -33,81 +33,207 @@ Three scale levers this module owns (VERDICT r3 weakness #2):
   is the difference between milliseconds and seconds per step,
 * **vectorized merge** — no per-word Python in the steady state.
 
-Memory bound, explicitly: device HBM holds one ``n_dev x chunk_bytes``
-batch plus the kernel's fixed-size buffers; the host holds the carry
-(< ``n_dev x chunk_bytes + block``) and the accumulator (O(uniques) merged
-table plus a bounded compaction window).  Nothing scales with total
-corpus bytes.
+And the lever that makes the stream a *pipeline* rather than a lockstep
+loop (BENCH_r05: the serialized batch → upload → kernel → pull → merge
+cycle made streaming the slowest row): ``wordcount_streaming`` keeps a
+window of ``depth`` steps in flight (default 2, ``DSI_STREAM_PIPELINE_
+DEPTH``).  A background batcher thread slices blocks into a bounded
+queue; the main thread uploads and dispatches step k+1 without
+synchronizing while step k's kernel runs; the overflow-flag check
+(``scal[:, 4]`` and friends) is **deferred** until a step leaves the
+window, and the host-side merge of a confirmed step overlaps the device
+work of the steps behind it.  Deferral is safe because the accumulator
+only ever merges a step already proven exact — a late-detected overflow
+replays just that step through the shared exactness ladder at the wider
+capacity, disturbing nothing merged before it.  ``depth=1`` is the
+synchronous path: same function, same ladder, same results dict.
+
+Memory bound, explicitly: device HBM holds at most ``depth`` chunk
+buffers (each step's upload is DONATED to its kernel —
+`backends/aotcache.cached_compile(donate_argnums=...)` /
+``shuffle.mapreduce_step_donate`` — so a window never doubles chunk
+residency) plus ``depth`` per-step result sets awaiting their deferred
+pull — one packed ``[n_dev, n_dev*u_cap, K+3]`` tensor per in-flight
+step under ``aot`` (the four result tables free as soon as the eager
+pack consumes them), the four equivalent-size tables per step on the
+jit path — plus one kernel's working buffers.  All of it is
+capacity-bounded (scales with ``depth x n_dev^2 x u_cap``, never with
+corpus bytes); size ``depth``/``u_cap`` together when HBM is tight.
+The host holds a small rotating pool of batch buffers (O(depth)), the
+carry (< ``n_dev x chunk_bytes + block``) and the accumulator
+(O(uniques) merged table plus a bounded compaction window).
 
 The reference has no analogue (its scaling lever is nMap = #input files on
 a shared filesystem, ``mr/coordinator.go:152``); this is that lever
-re-designed for a device mesh: nMap becomes "number of stream steps".
+re-designed for a device mesh: nMap becomes "number of stream steps", and
+the pipeline is the reference's map/shuffle/reduce-of-different-tasks
+concurrency re-created inside one process.
 """
 
 from __future__ import annotations
 
+import collections
+import contextlib
+import os
+import queue
+import threading
+import time
+import warnings
 from typing import Dict, Iterable, Iterator, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from dsi_tpu.ops.wordcount import (
     default_grouper,
     exactness_retry,
     grouper_ladder,
+    rung0_cap,
 )
 from dsi_tpu.parallel.merge import PackedCounts
 from dsi_tpu.parallel.shuffle import (
+    AXIS,
     _is_letter_byte,
+    _mapreduce_step_impl,
     _slice_pack,
     default_mesh,
-    mapreduce_step,
+    mapreduce_step_donate,
     occupied_prefix,
 )
+
+
+@contextlib.contextmanager
+def _quiet_unusable_donation():
+    """The stream step donates its chunk upload (HBM residency stays ≤
+    depth chunk buffers); on backends where no output shape matches the
+    input XLA cannot alias the donation and jax warns once per compiled
+    rung.  Expected here — the buffer is freed at execution end instead
+    of reused in place — so the warning is suppressed around OUR OWN
+    dispatches only: a process-global filter would hide the same warning
+    from the user's unrelated jax programs, where a silently-unusable
+    donation is real signal."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
 
 # A cut never needs to back off further than the longest word the kernels
 # can represent (64 bytes, ops/wordcount.py exactness_retry ladder) — if it
 # does, the input has a word the device path must hand to the host anyway.
 _MAX_BACKOFF = 96
 
+#: jax.jit donate_argnums for the stream step program: the chunk upload is
+#: consumed by the kernel.  Shared by the AOT compile, the warmer, and the
+#: cache-existence probe so all three agree on the executable's key.
+_STEP_DONATE = (0,)
+
 
 class _TokenTooLong(Exception):
     """A letter run longer than the device word limit spans a cut point."""
+
+
+class _NeedsHostPath(Exception):
+    """A step proved the stream needs the host path (non-ASCII, >64-byte
+    word): unwind the pipeline and return None to the caller."""
 
 
 def _cut_at_boundary(buf, size: int) -> int:
     """Largest c <= size with no letter run crossing buf[c-1]/buf[c]."""
     if len(buf) <= size:
         return len(buf)
-    c = size
-    while c > 0 and _is_letter_byte(buf[c - 1]) and _is_letter_byte(buf[c]):
-        c -= 1
-        if size - c > _MAX_BACKOFF:
-            raise _TokenTooLong
-    return c
+    if not (_is_letter_byte(buf[size - 1]) and _is_letter_byte(buf[size])):
+        return size  # common case: the natural cut already sits on a gap
+    # Back off vectorized: one numpy scan over the candidate window
+    # instead of the former per-byte Python loop (~100 interpreter
+    # iterations per long-word cut on the hot batching path).
+    lo = max(0, size - _MAX_BACKOFF - 1)
+    win = np.frombuffer(memoryview(buf)[lo:size + 1], dtype=np.uint8)
+    letter = ((win >= 65) & (win <= 90)) | ((win >= 97) & (win <= 122))
+    ok = ~(letter[:-1] & letter[1:])  # ok[p] ⇔ cut c = lo+p+1 splits no run
+    hits = np.flatnonzero(ok)
+    if hits.size:
+        return lo + 1 + int(hits[-1])
+    if size <= _MAX_BACKOFF:
+        return 0  # the whole prefix is one (representable) letter run
+    raise _TokenTooLong
 
 
-def batch_stream(blocks: Iterable[bytes], n_dev: int,
-                 chunk_bytes: int) -> Iterator[np.ndarray]:
+class _BufferPool:
+    """Small rotating pool of reusable ``[n_dev, chunk_bytes]`` host batch
+    buffers.  ``take`` hands out a free buffer, allocating only when the
+    pool is dry (startup, or the consumer still holds every buffer in its
+    in-flight window); ``give`` returns one for reuse.  Never blocks —
+    the pipeline's bounded queue provides the backpressure; the pool only
+    removes the per-batch ``np.zeros`` allocation + page-fault churn from
+    the steady state.  ``allocs`` counts real allocations, so a caller
+    can assert reuse (a stream of any length allocates O(depth) buffers).
+    """
+
+    def __init__(self, n_dev: int, chunk_bytes: int, retain: int):
+        self._shape = (n_dev, chunk_bytes)
+        self._free: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._retain = retain
+        self.allocs = 0
+
+    def take(self) -> np.ndarray:
+        with self._lock:
+            if self._free:
+                return self._free.popleft()
+            self.allocs += 1
+        return np.zeros(self._shape, dtype=np.uint8)
+
+    def give(self, buf: Optional[np.ndarray]) -> None:
+        if buf is None or buf.shape != self._shape:
+            return
+        with self._lock:
+            if len(self._free) < self._retain:
+                self._free.append(buf)
+
+
+def batch_stream(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
+                 pool: Optional[_BufferPool] = None) -> Iterator[np.ndarray]:
     """Slice a byte-block stream into zero-padded [n_dev, chunk_bytes]
-    batches, cutting rows only at non-letter boundaries."""
+    batches, cutting rows only at non-letter boundaries.
+
+    With ``pool`` (the streaming engine's buffer pool) batches come from a
+    small rotating buffer set instead of a fresh ``np.zeros`` per batch;
+    the consumer must hand each yielded batch back via ``pool.give`` once
+    it no longer reads it (the pipeline returns a buffer when its step is
+    confirmed exact).  Rows are always written in full — data then zero
+    tail — so a recycled buffer never leaks stale bytes."""
     carry = bytearray()
-    batch = np.zeros((n_dev, chunk_bytes), dtype=np.uint8)
+
+    def new_batch() -> np.ndarray:
+        if pool is not None:
+            return pool.take()
+        return np.zeros((n_dev, chunk_bytes), dtype=np.uint8)
+
+    batch = new_batch()
     row = 0
 
     def fill_rows(final: bool):
         nonlocal row, carry, batch
         while carry and (len(carry) >= chunk_bytes + 1 or final):
             cut = _cut_at_boundary(carry, chunk_bytes)
-            piece = carry[:cut]
-            del carry[:cut]
-            batch[row, :len(piece)] = np.frombuffer(bytes(piece),
-                                                    dtype=np.uint8)
+            if cut == 0:
+                # A letter run as wide as the whole row: no cut can make
+                # progress at this chunk size, so the word needs the host
+                # path.  (The pre-pool code spun forever here, emitting
+                # empty rows without ever consuming the carry.)
+                raise _TokenTooLong
+            view = np.frombuffer(carry, dtype=np.uint8, count=cut)
+            batch[row, :cut] = view
+            del view           # release the bytearray export before the
+            del carry[:cut]    # resize (a live view blocks it)
+            batch[row, cut:] = 0
             row += 1
             if row == n_dev:
                 yield batch
-                batch = np.zeros((n_dev, chunk_bytes), dtype=np.uint8)
+                batch = new_batch()
                 row = 0
 
     for block in blocks:
@@ -115,7 +241,10 @@ def batch_stream(blocks: Iterable[bytes], n_dev: int,
         yield from fill_rows(final=False)
     yield from fill_rows(final=True)
     if row:
-        yield batch  # tail batch; remaining rows are empty (all-zero) chunks
+        batch[row:] = 0  # recycled buffer: stale tail rows must not count
+        yield batch      # tail batch; remaining rows are empty chunks
+    elif pool is not None:
+        pool.give(batch)  # taken but never filled: straight back
 
 
 def stream_files(paths: Sequence[str],
@@ -133,6 +262,18 @@ def stream_files(paths: Sequence[str],
                 yield b
 
 
+def pipeline_depth(depth: Optional[int] = None) -> int:
+    """Resolve the stream's in-flight window: an explicit ``depth`` wins,
+    else ``DSI_STREAM_PIPELINE_DEPTH`` (default 2), floored at 1 (the
+    synchronous path)."""
+    if depth is None:
+        try:
+            depth = int(os.environ.get("DSI_STREAM_PIPELINE_DEPTH", "2"))
+        except ValueError:
+            depth = 2
+    return max(1, depth)
+
+
 def _step_program(*, n_dev: int, n_reduce: int, max_word_len: int,
                   u_cap: int, mesh: Mesh, t_cap_frac: int,
                   grouper: str = "sort"):
@@ -147,10 +288,10 @@ def _step_program(*, n_dev: int, n_reduce: int, max_word_len: int,
     import dsi_tpu.parallel.shuffle as _sh
 
     def fn(c):
-        return mapreduce_step(c, n_dev=n_dev, n_reduce=n_reduce,
-                              max_word_len=max_word_len, u_cap=u_cap,
-                              mesh=mesh, t_cap_frac=t_cap_frac,
-                              grouper=grouper)
+        return _mapreduce_step_impl(c, n_dev=n_dev, n_reduce=n_reduce,
+                                    max_word_len=max_word_len, u_cap=u_cap,
+                                    mesh=mesh, t_cap_frac=t_cap_frac,
+                                    grouper=grouper)
 
     fn._aot_code_deps = (_wc, _sh)
     name = (f"stream_step_d{n_dev}_r{n_reduce}_w{max_word_len}"
@@ -167,11 +308,15 @@ def _aot_step_fn(example_chunks, **kw):
     that JAX's own persistent cache never absorbs (VERDICT r2 weakness
     #1a).  Multi-device meshes compile in-process (the cache auto-disables
     disk persistence there).  ``example_chunks`` may be a
-    ``ShapeDtypeStruct`` (warming compiles without executing)."""
+    ``ShapeDtypeStruct`` (warming compiles without executing).  The chunk
+    argument is donated (the pipeline re-uploads per attempt)."""
     from dsi_tpu.backends import aotcache
 
     name, fn = _step_program(**kw)
-    return aotcache.cached_compile(name, fn, (example_chunks,))
+    with _quiet_unusable_donation():  # a cold entry compiles right here
+        return aotcache.cached_compile(name, fn, (example_chunks,),
+                                       donate_argnums=_STEP_DONATE,
+                                       x64=True)
 
 
 def _aot_step(chunks, **kw):
@@ -245,7 +390,8 @@ def stream_programs_persisted(mesh: Mesh | None = None,
             name, fn = _step_program(n_dev=n_dev, n_reduce=n_reduce,
                                      max_word_len=max_word_len, u_cap=u_cap,
                                      mesh=mesh, t_cap_frac=frac, grouper=g)
-            if not is_persisted(name, fn, (chunks,)):
+            if not is_persisted(name, fn, (chunks,),
+                                donate_argnums=_STEP_DONATE):
                 return False
     name, fn = _pack_program(mp=rows)
     return is_persisted(name, fn, pack_args)
@@ -297,16 +443,37 @@ def wordcount_streaming(
         blocks: Iterable[bytes], mesh: Mesh | None = None,
         n_reduce: int = 10, chunk_bytes: int = 1 << 20,
         max_word_len: int = 16, u_cap: int = 1 << 12,
-        aot: bool = False,
-        on_attempt=None) -> Optional[Dict[str, Tuple[int, int]]]:
-    """Exact whole-stream word counts with bounded memory.
+        aot: bool = False, on_attempt=None,
+        depth: Optional[int] = None,
+        pipeline_stats: Optional[dict] = None,
+) -> Optional[Dict[str, Tuple[int, int]]]:
+    """Exact whole-stream word counts with bounded memory, pipelined.
 
     Returns ``{word: (count, reduce_partition)}``, or None when the stream
     needs the host path (non-ASCII bytes, or a word longer than the device
     limit).  Every step reuses one compiled program per capacity rung; a
     step whose uniques overflow retries itself at a wider capacity without
-    disturbing the accumulator (rows are merged only after a step
-    succeeds), and the widened capacity sticks for later steps.
+    disturbing the accumulator (rows are merged only after a step is
+    confirmed exact), and the widened capacity — like a widened word
+    window — sticks for every later step.
+
+    ``depth`` (default ``DSI_STREAM_PIPELINE_DEPTH``, 2) is the in-flight
+    step window.  At ``depth > 1`` a background batcher thread slices
+    blocks into a bounded queue while the main thread uploads and
+    dispatches ahead without synchronizing; each step's exactness flags
+    are checked only when it leaves the window (``depth - 1`` steps
+    late), and a failed check replays exactly that step through the
+    shared ladder — results are bit-identical to ``depth=1`` because the
+    accumulator's inputs (the confirmed per-step tables) are identical.
+    ``depth=1`` is fully synchronous: no thread, dispatch then check.
+
+    ``pipeline_stats``, if given, is a dict populated with per-phase wall
+    seconds (``batch_s`` build time in the batcher, ``batch_wait_s`` main-
+    thread starvation, ``upload_s``, ``kernel_s`` time blocked on step
+    flags, ``pull_s``, ``merge_s``, ``replay_s``) plus ``depth``,
+    ``steps``, ``replays``, ``max_inflight_chunks`` (peak device chunk
+    buffers — bounded by ``depth``) and ``batch_allocs`` (host batch
+    buffers ever allocated — O(depth), not O(steps), thanks to the pool).
 
     ``on_attempt(max_word_len, u_cap)``, if given, is called before every
     kernel attempt — observability for the retry ladder (the driver's
@@ -322,68 +489,250 @@ def wordcount_streaming(
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
+    depth = pipeline_depth(depth)
     acc = PackedCounts()
-    state = {"cap": u_cap}
-    step_fn = _aot_step if aot else mapreduce_step
     groupers = grouper_ladder()
+    # Sticky dispatch rung: starts where the sync ladder would, and only
+    # ever moves toward more headroom (run_step_sync records the rung
+    # that cleared) — cap and word window widen, and grouper/frac follow
+    # the last cleared combination so a stream that consistently
+    # token-overflows the optimistic frac (dense 1-letter words) or needs
+    # the sort fallback doesn't replay every step forever.
+    state = {"cap": rung0_cap(chunk_bytes, u_cap), "mwl": max_word_len,
+             "grouper": groupers[0], "frac": 4}
+    sharding = NamedSharding(mesh, PartitionSpec(AXIS, None))
+    stats = {"depth": depth, "steps": 0, "replays": 0,
+             "max_inflight_chunks": 0, "donate_chunks": True,
+             "batch_s": 0.0, "batch_wait_s": 0.0, "upload_s": 0.0,
+             "kernel_s": 0.0, "pull_s": 0.0, "merge_s": 0.0,
+             "replay_s": 0.0}
+    # Live host buffers = out queue (≤ depth+1) + in-flight window
+    # (≤ depth) + one being filled + one being finished.
+    pool = _BufferPool(n_dev, chunk_bytes, retain=2 * depth + 3)
 
-    def run_step(chunks_np: np.ndarray):
-        chunks = jnp.asarray(chunks_np)
+    def step_call(chunks_dev, mwl, cap, frac, g):
+        kw = dict(n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
+                  u_cap=cap, mesh=mesh, t_cap_frac=frac, grouper=g)
+        with _quiet_unusable_donation():  # first call per rung compiles
+            if aot:
+                return _aot_step(chunks_dev, **kw)
+            return mapreduce_step_donate(chunks_dev, **kw)
+
+    def pull_packed(keys, lens, cnts, parts, scal_np):
+        """One packed host tensor per step (the single-pull D2H shape,
+        shuffle._slice_pack) + per-device occupied counts + key width.
+        Under aot the prefix is the full capacity instead of the
+        data-dependent pow2 prefix — deterministic shapes beat pull
+        volume there (see the aot note in the docstring)."""
+        m = int(scal_np[:, 0].max())
+        if m == 0:
+            return None, None, 0
+        kk = keys.shape[2]
+        if aot:
+            packed = np.asarray(_aot_pack(keys, lens, cnts, parts,
+                                          mp=keys.shape[1]))
+        else:
+            mp = occupied_prefix(m, keys.shape[1])
+            packed = np.asarray(_slice_pack(keys, lens, cnts, parts, mp=mp))
+        return packed, scal_np[:, 0], kk
+
+    def run_step_sync(chunks_np):
+        """The full exactness ladder for ONE batch — the replay path of a
+        deferred-check failure, and the semantics ``depth=1`` reduces to.
+        Each attempt re-uploads (the step program donates its input, so a
+        device buffer never survives an attempt)."""
 
         def run(mwl: int, cap: int):
-            state["cap"] = cap  # last attempt = the one that succeeded
+            state["cap"] = cap    # last attempt = the one that succeeded
+            state["mwl"] = mwl    # (sticky for later optimistic dispatches)
             if on_attempt is not None:
                 on_attempt(mwl, cap)
             for g in groupers:
                 for frac in (4, 2):
-                    keys, lens, cnts, parts, scal = step_fn(
-                        chunks, n_dev=n_dev, n_reduce=n_reduce,
-                        max_word_len=mwl, u_cap=cap, mesh=mesh,
-                        t_cap_frac=frac, grouper=g)
+                    chunks = jax.device_put(chunks_np, sharding)
+                    keys, lens, cnts, parts, scal = step_call(
+                        chunks, mwl, cap, frac, g)
                     scal_np = np.asarray(scal)
                     if not scal_np[:, 4].any():
                         break
                 if not scal_np[:, 4].any():
                     break
+            state["grouper"], state["frac"] = g, frac  # cleared rung sticks
 
             def payload():
-                # Pull only the occupied prefix of each result table (the
-                # max per-device merged uniques, pow2-rounded so the slice
-                # programs stay bounded at log2(cap) distinct shapes): the
-                # D2H bill tracks vocabulary, not capacity.  Under aot the
-                # prefix is the full capacity instead — deterministic
-                # shapes beat pull volume there (see docstring).
-                m = int(scal_np[:, 0].max())
-                out = []
-                if m == 0:
-                    return out
-                kk = keys.shape[2]
-                if aot:
-                    packed = np.asarray(_aot_pack(
-                        keys, lens, cnts, parts, mp=keys.shape[1]))
-                else:
-                    mp = occupied_prefix(m, keys.shape[1])
-                    packed = np.asarray(_slice_pack(keys, lens, cnts,
-                                                    parts, mp=mp))
-                for d in range(n_dev):
-                    nu = int(scal_np[d, 0])
-                    r = packed[d, :nu]
-                    out.append((r[:, :kk], r[:, kk], r[:, kk + 1],
-                                r[:, kk + 2]))
-                return out
+                return pull_packed(keys, lens, cnts, parts, scal_np)
 
             return (bool(scal_np[:, 3].any()), int(scal_np[:, 1].max()),
                     int(scal_np[:, 2].max()), payload)
 
-        return exactness_retry(run, chunk_bytes, max_word_len, state["cap"])
+        return exactness_retry(run, chunk_bytes, state["mwl"], state["cap"])
 
-    try:
-        for batch in batch_stream(blocks, n_dev, chunk_bytes):
-            payload = run_step(batch)
+    pending: collections.deque = collections.deque()
+
+    def dispatch(buf: np.ndarray) -> None:
+        """Optimistically launch one step at the sticky rung — upload +
+        async kernel dispatch, no synchronization.  Under aot the pack
+        program is dispatched HERE too (its full-capacity shape is
+        deterministic, no flags needed): on an in-order device stream a
+        pack dispatched at finish time would queue behind the NEXT step's
+        kernel, serializing exactly what the window exists to overlap —
+        and misattributing that kernel's wall to pull_s."""
+        mwl, cap = state["mwl"], state["cap"]
+        if on_attempt is not None:
+            on_attempt(mwl, cap)
+        t0 = time.perf_counter()
+        chunks = jax.device_put(buf, sharding)
+        stats["upload_s"] += time.perf_counter() - t0
+        keys, lens, cnts, parts, scal = step_call(
+            chunks, mwl, cap, state["frac"], state["grouper"])
+        if aot:
+            # Only scal + the packed tensor stay referenced: the four
+            # result tables free as soon as the pack consumes them, so an
+            # in-flight step holds one packed copy, not five tables.
+            packed_dev = _aot_pack(keys, lens, cnts, parts,
+                                   mp=keys.shape[1])
+            handles = (scal, packed_dev, keys.shape[2], None)
+        else:
+            handles = (scal, None, keys.shape[2],
+                       (keys, lens, cnts, parts))
+        pending.append((buf, mwl, cap, handles))
+        stats["steps"] += 1
+        if len(pending) > stats["max_inflight_chunks"]:
+            stats["max_inflight_chunks"] = len(pending)
+
+    def finish_one() -> None:
+        """Retire the oldest in-flight step: deferred exactness check,
+        then merge (clean) or replay-at-wider-shape (overflow)."""
+        buf, mwl, cap, (scal, packed_dev, kk, tables) = pending.popleft()
+        t0 = time.perf_counter()
+        scal_np = np.asarray(scal)   # blocks until this step's kernel lands
+        stats["kernel_s"] += time.perf_counter() - t0
+        if scal_np[:, 3].any():      # non-ASCII: the whole stream is host's
+            pool.give(buf)
+            raise _NeedsHostPath
+        exact = (not scal_np[:, 4].any()
+                 and int(scal_np[:, 1].max()) <= cap
+                 and int(scal_np[:, 2].max()) <= mwl)
+        if exact:
+            t0 = time.perf_counter()
+            if int(scal_np[:, 0].max()) == 0:
+                packed, nus = None, None
+            elif packed_dev is not None:  # aot: pack already executed
+                packed, nus = np.asarray(packed_dev), scal_np[:, 0]
+            else:
+                packed, nus, kk = pull_packed(*tables, scal_np)
+            stats["pull_s"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            if packed is not None:
+                acc.add_packed_step(packed, nus, kk)
+            stats["merge_s"] += time.perf_counter() - t0
+        else:
+            # Late-detected overflow: replay just this step through the
+            # ladder.  Exactly-once by construction — the optimistic
+            # attempt's tables are dropped unmerged, and the replay's
+            # payload merges here and nowhere else.
+            stats["replays"] += 1
+            t0 = time.perf_counter()
+            payload = run_step_sync(buf)
             if payload is None:
-                return None  # caller routes the job to the host path
-            for krows, lrows, crows, prows in payload():
-                acc.add(krows, lrows, crows, prows)
-    except _TokenTooLong:
-        return None
-    return acc.finalize()
+                pool.give(buf)
+                stats["replay_s"] += time.perf_counter() - t0
+                raise _NeedsHostPath
+            packed, nus, kk = payload()
+            if packed is not None:
+                acc.add_packed_step(packed, nus, kk)
+            stats["replay_s"] += time.perf_counter() - t0
+        pool.give(buf)
+
+    # ── batch feed: inline at depth=1, background thread otherwise ──
+    stop = threading.Event()
+    out_q: queue.Queue = queue.Queue(maxsize=depth + 1)
+    batcher_thread: Optional[threading.Thread] = None
+
+    def batcher() -> None:
+        gen = batch_stream(blocks, n_dev, chunk_bytes, pool=pool)
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    b = next(gen)
+                except StopIteration:
+                    break
+                stats["batch_s"] += time.perf_counter() - t0
+                while not stop.is_set():
+                    try:
+                        out_q.put(("batch", b), timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            out_q.put(("done", None))
+        except BaseException as e:  # surfaced to the main thread
+            # Stop-aware retry, like the batch put above: a fixed timeout
+            # could drop the error while the main thread sits in a long
+            # replay (minutes on a tunneled compile), leaving it blocked
+            # forever on a queue that will never produce the sentinel.
+            while not stop.is_set():
+                try:
+                    out_q.put(("err", e), timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
+    def feed() -> Iterator[np.ndarray]:
+        nonlocal batcher_thread
+        if depth == 1:
+            gen = batch_stream(blocks, n_dev, chunk_bytes, pool=pool)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    b = next(gen)
+                except StopIteration:
+                    return
+                stats["batch_s"] += time.perf_counter() - t0
+                yield b
+            return
+        batcher_thread = threading.Thread(target=batcher, daemon=True,
+                                          name="dsi-stream-batcher")
+        batcher_thread.start()
+        while True:
+            t0 = time.perf_counter()
+            kind, item = out_q.get()
+            stats["batch_wait_s"] += time.perf_counter() - t0
+            if kind == "done":
+                return
+            if kind == "err":
+                raise item
+            yield item
+
+    result: Optional[Dict[str, Tuple[int, int]]]
+    try:
+        for buf in feed():
+            dispatch(buf)
+            if len(pending) >= depth:
+                finish_one()
+        while pending:
+            finish_one()
+        result = acc.finalize()
+    except (_TokenTooLong, _NeedsHostPath):
+        result = None  # caller routes the job to the host path
+    finally:
+        if batcher_thread is not None:
+            stop.set()
+            # Unblock a batcher stuck on a full queue; bounded — a
+            # batcher mid-build exits at its next stop check.
+            deadline = time.monotonic() + 5.0
+            while (batcher_thread.is_alive()
+                   and time.monotonic() < deadline):
+                try:
+                    out_q.get_nowait()
+                except queue.Empty:
+                    batcher_thread.join(0.05)
+        if pipeline_stats is not None:
+            stats["batch_allocs"] = pool.allocs
+            for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",
+                      "pull_s", "merge_s", "replay_s"):
+                stats[k] = round(stats[k], 4)
+            pipeline_stats.update(stats)
+    return result

@@ -51,6 +51,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dsi_tpu.utils.jaxcompat import (enable_x64, x64_scoped,
+                                     shard_map as _shard_map)
+
 from dsi_tpu.ops.wordcount import (
     _PAD_KEY64,
     exactness_retry,
@@ -100,7 +103,7 @@ def _tfidf_device_step(chunk: jax.Array, doc_id: jax.Array, *, n_dev: int,
     # is all-ones in every lane, i.e. uint64-max after packing (a real
     # first lane can be 0xFFFFFFFF only for non-ASCII bytes, which
     # has_high rejects).
-    with jax.enable_x64(True):  # every op touching u64 operands needs it
+    with enable_x64(True):  # every op touching u64 operands needs it
         keys64 = pack_key_lanes(tuple(recv[:, j] for j in range(k)))
         pay64 = pack_key_lanes(tuple(recv[:, k + j] for j in range(4)))
         k64 = len(keys64)
@@ -118,13 +121,10 @@ def _tfidf_device_step(chunk: jax.Array, doc_id: jax.Array, *, n_dev: int,
     return srecv[None], scalars[None]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("n_dev", "n_reduce", "max_word_len",
-                                    "u_cap", "t_cap_frac", "mesh",
-                                    "grouper"))
-def tfidf_wave_step(chunks: jax.Array, doc_ids: jax.Array, *, n_dev: int,
-                    n_reduce: int, max_word_len: int, u_cap: int, mesh: Mesh,
-                    t_cap_frac: int = 4, grouper: str = "sort"):
+def _tfidf_wave_step_impl(chunks: jax.Array, doc_ids: jax.Array, *,
+                          n_dev: int, n_reduce: int, max_word_len: int,
+                          u_cap: int, mesh: Mesh, t_cap_frac: int = 4,
+                          grouper: str = "sort"):
     """One SPMD wave: ``chunks`` [n_dev, L] uint8 (one zero-padded document
     per device), ``doc_ids`` [n_dev] int32.  Returns per-device sorted
     (word, len, tf, doc, part) rows [D, D*u_cap, K+4] and [D, 5] scalars
@@ -133,10 +133,16 @@ def tfidf_wave_step(chunks: jax.Array, doc_ids: jax.Array, *, n_dev: int,
                              n_reduce=n_reduce, max_word_len=max_word_len,
                              u_cap=u_cap, t_cap_frac=t_cap_frac,
                              grouper=grouper)
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS)),
         out_specs=(P(AXIS, None, None), P(AXIS, None)))(chunks, doc_ids)
+
+
+tfidf_wave_step = x64_scoped(jax.jit(
+    _tfidf_wave_step_impl,
+    static_argnames=("n_dev", "n_reduce", "max_word_len", "u_cap",
+                     "t_cap_frac", "mesh", "grouper")))
 
 
 def plan_waves(doc_lens: Sequence[int],

@@ -1,0 +1,43 @@
+"""Version-tolerant aliases for jax APIs that moved between releases.
+
+The kernels target the modern spellings (``jax.shard_map``,
+``jax.enable_x64``); on installs that predate their graduation from
+``jax.experimental`` the experimental originals are re-exported instead.
+One module so every kernel resolves the same implementation — a per-file
+try/except drift here would let two modules disagree mid-upgrade.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+try:
+    enable_x64 = jax.enable_x64
+except AttributeError:  # pre-graduation jax (e.g. 0.4.x)
+    from jax.experimental import enable_x64  # noqa: F401
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pre-graduation jax (e.g. 0.4.x)
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def x64_scoped(fn):
+    """Run every invocation of ``fn`` under ``enable_x64(True)``.
+
+    The kernels write their uint64 blocks inside scoped ``enable_x64``
+    contexts; on jax versions where lowering reads the flag at the
+    jit-call boundary rather than at trace time, the scoped block alone
+    fails stablehlo verification ("shift_left op requires compatible
+    types") — the *call* must sit inside the scope so trace, lower, and
+    compile all see x64.  Wrapping only the u64-bearing entry points
+    keeps the flag out of the global config (which would change dtype
+    inference package-wide)."""
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        with enable_x64(True):
+            return fn(*args, **kwargs)
+
+    return call

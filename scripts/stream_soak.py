@@ -23,6 +23,9 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mb", type=int, default=512)
     ap.add_argument("--chunk-bytes", type=int, default=1 << 20)
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    help="in-flight stream steps (default: "
+                         "DSI_STREAM_PIPELINE_DEPTH or 2; 1 = synchronous)")
     args = ap.parse_args()
 
     import jax
@@ -52,9 +55,12 @@ def main() -> int:
             yield bytes(buf)
 
     mesh = default_mesh(8)
+    pstats: dict = {}
     t0 = time.perf_counter()
     acc = wordcount_streaming(blocks(), mesh=mesh, n_reduce=10,
-                              chunk_bytes=args.chunk_bytes)
+                              chunk_bytes=args.chunk_bytes,
+                              depth=args.pipeline_depth,
+                              pipeline_stats=pstats)
     dt = time.perf_counter() - t0
     assert acc is not None
     ok = all(acc.get(w, (0, 0))[0] == n_lines for w in words)
@@ -66,6 +72,7 @@ def main() -> int:
         "counts_exact": ok,
         "uniques": len(acc),
         "peak_rss_mb": round(peak_mb, 1),
+        "pipeline": pstats,
     }))
     return 0 if ok else 1
 

@@ -27,13 +27,20 @@ def test_entry_returns_jittable(capsys):
 
     import __graft_entry__ as g
 
-    fn, args = g.entry()
-    # The contract: the driver's compile check exercises the bench's own
-    # corpus-scale program shape (8 x 2 MiB pieces), not a toy.
-    assert len(args) == 8 and all(a.shape == (1 << 21,) for a in args)
-    assert all(isinstance(a, np.ndarray) for a in args)  # no device puts
-    out = np.asarray(jax.jit(fn)(*args))
-    # corpus_kernel contract: flattened [u_cap, 2] rows + 4 scalars, and
-    # the example text must actually produce counts with no escapes.
-    nu, max_len, has_high, tok_of = (int(x) for x in out[-4:])
-    assert nu > 0 and not has_high and not tok_of and max_len <= 16
+    x64_before = jax.config.jax_enable_x64
+    try:
+        fn, args = g.entry()
+        # The contract: the driver's compile check exercises the bench's
+        # own corpus-scale program shape (8 x 2 MiB pieces), not a toy.
+        assert len(args) == 8 and all(a.shape == (1 << 21,) for a in args)
+        assert all(isinstance(a, np.ndarray) for a in args)  # no device puts
+        out = np.asarray(jax.jit(fn)(*args))
+        # corpus_kernel contract: flattened [u_cap, 2] rows + 4 scalars,
+        # and the example text must produce counts with no escapes.
+        nu, max_len, has_high, tok_of = (int(x) for x in out[-4:])
+        assert nu > 0 and not has_high and not tok_of and max_len <= 16
+    finally:
+        # entry() flips the process-global x64 flag for the driver's
+        # caller-owned jit; restore it so later tests in this process see
+        # the suite's default config.
+        jax.config.update("jax_enable_x64", x64_before)

@@ -117,6 +117,7 @@ def test_pack_key_lanes_order_and_roundtrip():
 
     from dsi_tpu.ops.wordcount import (_PAD_KEY, pack_key_lanes,
                                        unpack_key_rows)
+    from dsi_tpu.utils.jaxcompat import enable_x64
 
     rng = np.random.default_rng(3)
     for k in (1, 2, 3, 4, 16):
@@ -128,14 +129,18 @@ def test_pack_key_lanes_order_and_roundtrip():
             cols_np[j, pad_rows] = _PAD_KEY
         cols = tuple(jnp.asarray(cols_np[j]) for j in range(k))
 
-        packed = pack_key_lanes(cols)
-        assert len(packed) == (k + 1) // 2
-        # roundtrip
-        rows64 = jnp.stack(packed, axis=1)
-        back = np.asarray(unpack_key_rows(rows64, k))
+        # Eager u64 ops need the scope held across every op touching the
+        # packed values (jaxcompat.x64_scoped rationale): outside it the
+        # stack/asarray would silently truncate the high lanes to u32.
+        with enable_x64(True):
+            packed = pack_key_lanes(cols)
+            assert len(packed) == (k + 1) // 2
+            # roundtrip
+            rows64 = jnp.stack(packed, axis=1)
+            back = np.asarray(unpack_key_rows(rows64, k))
+            packed_np = [np.asarray(p) for p in packed]
         assert np.array_equal(back, cols_np.T)
         # order: argsort by packed columns == lexsort by original lanes
-        packed_np = [np.asarray(p) for p in packed]
         order_packed = np.lexsort(tuple(reversed(packed_np)))
         order_lanes = np.lexsort(tuple(reversed(cols_np)))
         assert np.array_equal(cols_np.T[order_packed],

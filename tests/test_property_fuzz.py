@@ -14,6 +14,8 @@ import os
 import re
 
 import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 jax = pytest.importorskip("jax")

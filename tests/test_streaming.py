@@ -16,6 +16,9 @@ import numpy as np
 from dsi_tpu.mr.worker import ihash
 from dsi_tpu.parallel.shuffle import default_mesh, wordcount_sharded
 from dsi_tpu.parallel.streaming import (
+    _MAX_BACKOFF,
+    _TokenTooLong,
+    _cut_at_boundary,
     batch_stream,
     stream_files,
     wordcount_streaming,
@@ -64,6 +67,148 @@ def test_streaming_matches_one_shot_sharded():
     oneshot = wordcount_sharded(text, mesh=mesh, n_reduce=10, u_cap=1 << 10)
     assert stream is not None and oneshot is not None
     assert stream == oneshot
+
+
+def _cut_reference(buf, size):
+    """The pre-vectorization per-byte backoff loop, kept as the oracle."""
+    def letter(b):
+        return (65 <= b <= 90) or (97 <= b <= 122)
+
+    if len(buf) <= size:
+        return len(buf)
+    c = size
+    while c > 0 and letter(buf[c - 1]) and letter(buf[c]):
+        c -= 1
+        if size - c > _MAX_BACKOFF:
+            raise _TokenTooLong
+    return c
+
+
+def test_cut_at_boundary_matches_scalar_reference():
+    """The vectorized cut must agree with the per-byte reference loop on
+    random byte soup, long letter runs at every offset around the cut,
+    and the too-long-token escape."""
+    rng = np.random.default_rng(11)
+    for size in (8, 64, 97, 256):
+        for _ in range(40):
+            n = size + int(rng.integers(1, 2 * _MAX_BACKOFF + 8))
+            buf = bytearray(rng.integers(0, 256, size=n, dtype=np.uint8)
+                            .tobytes())
+            # bias toward letters so long runs actually occur
+            if rng.random() < 0.5:
+                run = int(rng.integers(1, 2 * _MAX_BACKOFF))
+                at = int(rng.integers(0, max(1, n - run)))
+                buf[at:at + run] = b"q" * run
+            try:
+                want = _cut_reference(buf, size)
+            except _TokenTooLong:
+                with pytest.raises(_TokenTooLong):
+                    _cut_at_boundary(buf, size)
+                continue
+            assert _cut_at_boundary(buf, size) == want
+    # short-buffer fast path
+    assert _cut_at_boundary(bytearray(b"abc"), 8) == 3
+
+
+def test_pipeline_depth_parity_and_deferred_replay():
+    """depth=1, depth=3, and a host Counter must agree bit-for-bit on a
+    stream that forces a mid-stream capacity overflow — the deferred
+    check replays the overflowing step exactly once (counts would be
+    doubled by a merge-then-replay bug, halved by a dropped step)."""
+    rng = np.random.default_rng(23)
+    small = ["aa", "bb", "cc", "dd"]
+    big = ["w%03d" % i for i in range(700)]  # > u_cap uniques per chunk
+    blocks = []
+    for i in range(12):
+        vocab = small if i < 6 else big  # overflow arrives mid-stream
+        picks = rng.integers(0, len(vocab), 400)
+        blocks.append((" ".join(vocab[j] for j in picks) + "\n").encode())
+    text = b"".join(blocks)
+    want = dict(collections.Counter(WORDS.findall(text.decode())))
+    mesh = _mesh()
+    results, stats = {}, {}
+    for d in (1, 3):
+        st: dict = {}
+        res = wordcount_streaming(list(blocks), mesh=mesh, n_reduce=10,
+                                  chunk_bytes=1 << 11, u_cap=64, depth=d,
+                                  pipeline_stats=st)
+        assert res is not None
+        results[d], stats[d] = res, st
+    assert {w: c for w, (c, _) in results[3].items()} == want
+    assert results[1] == results[3]  # bit-identical dicts, partitions too
+    assert stats[3]["replays"] >= 1  # the deferred check actually fired
+    assert stats[3]["steps"] == stats[1]["steps"]
+
+
+def test_pipeline_keeps_tail_batch_and_step_count():
+    """depth>1 must retire every step including the partial tail batch —
+    a window-drain bug would drop the newest steps, a reorder would still
+    show up as wrong counts for the tail-only marker word."""
+    filler = ("lorem ipsum dolor sit amet " * 40).encode()
+    blocks = [filler] * 7 + [b"zzzmarker zzzmarker zzzmarker"]
+    text = b"".join(blocks)
+    want = dict(collections.Counter(WORDS.findall(text.decode())))
+    st: dict = {}
+    res = wordcount_streaming(list(blocks), mesh=_mesh(), n_reduce=10,
+                              chunk_bytes=1 << 10, u_cap=1 << 8, depth=3,
+                              pipeline_stats=st)
+    assert res is not None
+    assert {w: c for w, (c, _) in res.items()} == want
+    assert res["zzzmarker"][0] == 3  # the tail-only word survived
+    n_rows = sum(len(b) for b in blocks) // (1 << 10) + 1
+    assert st["steps"] >= max(1, n_rows // 8)  # tail batch was a step
+
+
+def test_pipeline_buffer_accounting_stays_bounded():
+    """Host batch buffers are recycled (O(depth) allocations however long
+    the stream) and the device in-flight window never exceeds depth —
+    the HBM-residency bound the design promises."""
+    line = ("alpha beta gamma delta " * 30).encode()
+    blocks = [line] * 200
+    for d in (1, 2, 3):
+        st: dict = {}
+        res = wordcount_streaming(list(blocks), mesh=_mesh(), n_reduce=10,
+                                  chunk_bytes=1 << 10, u_cap=1 << 8,
+                                  depth=d, pipeline_stats=st)
+        assert res is not None
+        assert st["steps"] > 2 * d  # long enough to prove recycling
+        assert st["max_inflight_chunks"] <= d
+        assert st["batch_allocs"] <= 2 * d + 3
+        assert st["replays"] == 0
+
+
+def test_pipeline_sticky_rung_bounds_replays():
+    """A stream that token-overflows the optimistic frac on EVERY chunk
+    (dense single-letter words: tokens ≈ n/2 > t_cap at frac 4) must
+    replay at most the in-flight window, not every step: the cleared
+    (grouper, frac) rung sticks for later dispatches just like a widened
+    capacity."""
+    text = b"a b c d e f g h " * 6000
+    want = dict(collections.Counter(WORDS.findall(text.decode())))
+    st: dict = {}
+    res = wordcount_streaming([text], mesh=_mesh(), n_reduce=10,
+                              chunk_bytes=1 << 11, u_cap=1 << 8, depth=3,
+                              pipeline_stats=st)
+    assert res is not None
+    assert {w: c for w, (c, _) in res.items()} == want
+    assert st["steps"] > 3  # long enough that stickiness matters
+    assert 1 <= st["replays"] <= 3  # bounded by the window, not the stream
+
+
+def test_pipeline_depth_env_default(monkeypatch):
+    """DSI_STREAM_PIPELINE_DEPTH is the default window for callers that
+    pass no depth; an explicit depth always wins."""
+    monkeypatch.setenv("DSI_STREAM_PIPELINE_DEPTH", "3")
+    st: dict = {}
+    res = wordcount_streaming([b"one two three " * 200], mesh=_mesh(),
+                              chunk_bytes=1 << 10, u_cap=1 << 8,
+                              pipeline_stats=st)
+    assert res is not None and st["depth"] == 3
+    st = {}
+    res = wordcount_streaming([b"one two three " * 200], mesh=_mesh(),
+                              chunk_bytes=1 << 10, u_cap=1 << 8, depth=1,
+                              pipeline_stats=st)
+    assert res is not None and st["depth"] == 1
 
 
 def test_streaming_non_ascii_falls_back():
