@@ -495,12 +495,19 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
     # path — the pre-gate behavior).
     import jax
 
+    # DSI_BENCH_STREAM_DEVICE_ACC=1 runs the row with the device-resident
+    # accumulator (device/table.py): folds on device, host pulls every
+    # DSI_STREAM_SYNC_EVERY steps — BENCH_r06+ compares stream_phases
+    # with and without it (the gate below then also demands the fold
+    # programs be warm: a cold fold compile is the same remote-compile
+    # hazard as a cold step compile).
+    device_acc = os.environ.get("DSI_BENCH_STREAM_DEVICE_ACC") == "1"
     if (jax.devices()[0].platform != "cpu"
             and len(jax.devices()) == 1
             and os.environ.get("DSI_BENCH_WARM_ALL") != "1"
             and not stream_programs_persisted(
                 chunk_bytes=STREAM_CHUNK_BYTES, u_cap=STREAM_U_CAP,
-                n_reduce=N_REDUCE)):
+                n_reduce=N_REDUCE, device_accumulate=device_acc)):
         return {"stream_skipped":
                 "stream programs not in the AOT cache (cold compile "
                 "risk); warm via scripts/warm_kernels.py --phase stream"}
@@ -521,6 +528,7 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
         acc = wordcount_streaming(blocks(), mesh=mesh, n_reduce=N_REDUCE,
                                   chunk_bytes=STREAM_CHUNK_BYTES,
                                   u_cap=STREAM_U_CAP, aot=True,
+                                  device_accumulate=device_acc,
                                   pipeline_stats=pstats)
     dt = pt.elapsed_s
     if acc is None:
@@ -539,10 +547,17 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
     mb = corpus_bytes * cycles / 1e6
     # Per-phase attribution (mirrors the TPU path's ``phases`` dict):
     # lets BENCH_r06+ say WHERE stream throughput went — kernel-bound,
-    # or batch/upload/pull/merge overhead the pipeline failed to hide.
+    # or batch/upload/pull/merge overhead the pipeline failed to hide —
+    # and, with device accumulation, show the pull amortization
+    # (step_pulls vs sync_pulls: per-step D2H vs ceil(steps/K)+widens).
     phases = {k: pstats[k] for k in ("batch_s", "batch_wait_s", "upload_s",
                                      "kernel_s", "pull_s", "merge_s",
-                                     "replay_s", "depth", "replays")
+                                     "replay_s", "depth", "replays",
+                                     "device_accumulate", "sync_every",
+                                     "step_pulls", "folds", "fold_s",
+                                     "fold_overflows", "sync_pulls",
+                                     "sync_s", "widens", "widen_s",
+                                     "table_cap")
               if k in pstats}
     log(f"stream row: {mb:.1f} MB in {dt:.2f}s = {mb / dt:.2f} MB/s "
         f"(cycles={cycles}, parity={parity}, phases={phases})")
@@ -662,6 +677,30 @@ def run_framework_row(bench_oracle_mbps: float) -> dict:
         log(f"framework row skipped: {reason}")
         return {"framework_skipped": reason}
 
+    # Every exit path below must reap: the explicit skip paths do it via
+    # reap(), but an UNEXPECTED exception (worker spawn OSError, oracle
+    # read failure) used to leave orphan coordinator/worker processes
+    # contending for the core through the rest of the bench (ADVICE r5
+    # item 1).  The finally is a no-op on the normal path — every child
+    # has already been wait()ed.
+    try:
+        return _run_framework_body(coord, workers, reap, env, fw_dir,
+                                   oracle_out, total_mb, n_workers,
+                                   native_ok, budget, fw_oracle_mbps)
+    finally:
+        for p in [coord, *workers]:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def _run_framework_body(coord, workers, reap, env, fw_dir, oracle_out,
+                        total_mb, n_workers, native_ok, budget,
+                        fw_oracle_mbps) -> dict:
+    """The measured portion of :func:`run_framework_row`, factored out so
+    the caller's try/finally reaps children on ANY exit.  ``workers`` is
+    the caller's (initially empty) list and is mutated in place — the
+    finally must see the same list object the spawns land in."""
     deadline = time.monotonic() + 15.0
     while not os.path.exists(env["DSI_MR_SOCKET"]):
         if coord.poll() is not None or time.monotonic() > deadline:
@@ -681,7 +720,7 @@ def run_framework_row(bench_oracle_mbps: float) -> dict:
     # wc.  Either way the final output is byte-identical (parity gate).
     fw_app = "wc" if fw_backend == "host" else "tpu_wc"
     t0 = time.perf_counter()
-    workers = [
+    workers[:] = [
         subprocess.Popen([sys.executable, "-m", "dsi_tpu.cli.mrworker",
                           "--backend", fw_backend, fw_app],
                          cwd=fw_dir, env=env, stdout=sys.stderr,

@@ -243,13 +243,22 @@ def _tunnel_answers() -> bool:
             # Backends already initialized (every current caller's case):
             # asking the live backend is free and authoritative.
             axon = "axon" in _platform_fingerprint()
+            if not axon:
+                return True
         else:
             # Pre-init: never trigger initialization from here — decide
-            # from the platform-pin environment instead.
-            axon = "axon" in (os.environ.get("JAX_PLATFORMS", "")
-                              + os.environ.get("DSI_JAX_PLATFORM", ""))
-        if not axon:
-            return True
+            # from the platform-pin environment when it says anything.
+            # With NO pin at all the environment is inconclusive:
+            # fall through to the probe rather than assume non-axon —
+            # that assumption answered "tunnel fine" during real outages
+            # and disabled the fast-fail exactly where it matters
+            # (ADVICE r5 item 4).  A pinned non-axon process (tests,
+            # soaks set JAX_PLATFORMS=cpu) still skips the probe, so a
+            # closed local 8083 cannot disable retries there.
+            pins = (os.environ.get("JAX_PLATFORMS", "")
+                    + os.environ.get("DSI_JAX_PLATFORM", ""))
+            if pins and "axon" not in pins:
+                return True
         port = 8083
     else:
         port = int(env)
@@ -344,10 +353,20 @@ def _verify_first_call(exe, path: str, name: str, jitted,
             # same args, which would hit 'Array has been deleted' instead
             # of recovering.  Keep device copies until the call verifies
             # — a one-time cost per loaded program, dropped on success.
+            # Copy under the x64 scope when the program needs it: outside
+            # it jnp.array canonicalizes a uint64 operand down to uint32,
+            # and the recovery re-invoke would hand the recompiled
+            # executable a wrong-dtype (truncated) argument.
+            import contextlib
+
             import jax.numpy as jnp
 
-            backups = {i: jnp.array(args[i], copy=True)
-                       for i in donate_argnums if i < len(args)}
+            from dsi_tpu.utils.jaxcompat import enable_x64
+
+            scope = enable_x64(True) if x64 else contextlib.nullcontext()
+            with scope:
+                backups = {i: jnp.array(args[i], copy=True)
+                           for i in donate_argnums if i < len(args)}
         try:
             out = state["exe"](*args)
             jax.block_until_ready(out)

@@ -13,7 +13,8 @@ never depends on the device kernel.
 Usage:
     python -m dsi_tpu.cli.wcstream [--nreduce N] [--chunk-bytes B]
         [--devices D] [--workdir DIR] [--check] [--aot] [--u-cap U]
-        inputfiles...
+        [--pipeline-depth D] [--device-accumulate] [--sync-every K]
+        [--stats] inputfiles...
 """
 
 from __future__ import annotations
@@ -56,6 +57,18 @@ def main(argv=None) -> int:
     p.add_argument("--pipeline-depth", type=_positive_int, default=None,
                    help="in-flight stream steps (default: "
                         "DSI_STREAM_PIPELINE_DEPTH or 2; 1 = synchronous)")
+    p.add_argument("--device-accumulate", action="store_true",
+                   help="fold confirmed steps into the device-resident "
+                        "merge table (dsi_tpu/device/) and pull to the "
+                        "host only every --sync-every steps — amortizes "
+                        "the per-step D2H pull; results are bit-identical")
+    p.add_argument("--sync-every", type=_positive_int, default=None,
+                   help="folds between host pulls with "
+                        "--device-accumulate (default: "
+                        "DSI_STREAM_SYNC_EVERY or 8)")
+    p.add_argument("--stats", action="store_true",
+                   help="print the pipeline_stats dict (phase walls + "
+                        "fold/sync/widen counters) to stderr")
     args = p.parse_args(argv)
 
     from dsi_tpu.utils.platformpin import pin_platform_from_env
@@ -66,11 +79,17 @@ def main(argv=None) -> int:
     from dsi_tpu.parallel.streaming import stream_files, wordcount_streaming
 
     mesh = default_mesh(args.devices)
+    pstats: dict = {}
     acc = wordcount_streaming(stream_files(args.files), mesh=mesh,
                               n_reduce=args.nreduce,
                               chunk_bytes=args.chunk_bytes,
                               u_cap=args.u_cap, aot=args.aot,
-                              depth=args.pipeline_depth)
+                              depth=args.pipeline_depth,
+                              device_accumulate=args.device_accumulate,
+                              sync_every=args.sync_every,
+                              pipeline_stats=pstats)
+    if args.stats:
+        print(f"wcstream: pipeline_stats={pstats}", file=sys.stderr)
     if acc is None:
         # Host fallback: the sequential oracle semantics, partitioned output.
         print("wcstream: stream needs the host path; running host word count",

@@ -1,0 +1,33 @@
+"""Device-resident accumulator service.
+
+The layer between the SPMD kernels and the host accumulators: persistent
+on-device state that absorbs per-step results with compiled fold/append
+programs and meets the host only at sync points — the cross-step
+amortization ROADMAP's top open item calls for, and the same shape a
+training-stack optimizer/metrics loop needs (device state + periodic
+host visibility).
+
+* :mod:`~dsi_tpu.device.table` — :class:`DeviceTable`, the merged
+  word/count table the streaming word count folds into.
+* :mod:`~dsi_tpu.device.postings` — :class:`DevicePostings`, the
+  append-only postings buffer the TF-IDF wave walk batches pulls with.
+* :mod:`~dsi_tpu.device.policy` — :class:`SyncPolicy`, the one owner of
+  the every-K-folds pull cadence.
+"""
+
+from dsi_tpu.device.policy import SyncPolicy, sync_every_default
+from dsi_tpu.device.table import (
+    DeviceTable,
+    device_fold_persisted,
+    warm_device_fold,
+)
+from dsi_tpu.device.postings import DevicePostings
+
+__all__ = [
+    "DevicePostings",
+    "DeviceTable",
+    "SyncPolicy",
+    "device_fold_persisted",
+    "sync_every_default",
+    "warm_device_fold",
+]

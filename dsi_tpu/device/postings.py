@@ -1,0 +1,156 @@
+"""Append-only device-resident postings buffer for the TF-IDF wave walk.
+
+TF-IDF's per-wave output is postings — (word, len, tf, doc, part) rows
+that accumulate rather than merge — so the word-count ``DeviceTable``'s
+sort+segment-sum fold is the wrong program.  What the wave walk shares
+with the stream is the COST SHAPE: one D2H pull per wave, each charged
+the tunnel's fixed per-transfer latency regardless of size
+(ROADMAP item 2).  This buffer batches those pulls: waves append their
+valid rows into a persistent on-device buffer with a compiled scatter
+(same dump-row idiom as ``shuffle.shuffle_rows``), and the host pulls
+once per K waves (``device/policy.py`` cadence) or when the buffer
+fills.
+
+Unlike the merge table there is no capacity *ladder*: a drain empties
+the buffer, and the capacity is chosen >= one wave's worst-case row
+count (``n_dev * u_cap``), so an append that overflows simply drains
+and retries — overflow is an early sync, never a loss.  The commit is
+still all-or-nothing across devices (``pmax`` on the overflow bit) so a
+drained-and-retried wave cannot double-append its already-committed
+shards.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsi_tpu.parallel.shuffle import AXIS, occupied_prefix
+from dsi_tpu.utils.jaxcompat import shard_map
+
+
+def _append_device(buf, n, rows, scal, *, cap: int, width: int):
+    """Per-device body: scatter this wave's valid rows at the write
+    offset.  Rows beyond the wave's valid count and rows past the
+    capacity land on the dump row / out of bounds (dropped — identical
+    either way because an overflowing append keeps the OLD buffer)."""
+    buf = buf.reshape(cap, width)
+    n0 = n.reshape(())
+    r = rows.shape[-2]
+    rows = rows.reshape(r, width)
+    nr = scal.reshape(-1)[0]
+
+    valid = jnp.arange(r, dtype=jnp.int32) < nr
+    idx = jnp.where(valid, n0 + jnp.arange(r, dtype=jnp.int32), cap)
+    target = jnp.concatenate([buf, jnp.zeros((1, width), jnp.uint32)], axis=0)
+    new_buf = target.at[idx].set(rows)[:cap]
+    new_n = n0 + nr
+    ov = lax.pmax((new_n > cap).astype(jnp.int32), AXIS)
+    keep_old = ov > 0
+    out_buf = jnp.where(keep_old, buf, new_buf)
+    out_n = jnp.where(keep_old, n0, new_n)
+    flags = jnp.stack([ov, out_n])
+    return out_buf[None], out_n[None], flags[None]
+
+
+def _append_impl(buf, n, rows, scal, *, mesh: Mesh):
+    cap, width = buf.shape[1], buf.shape[2]
+    body = functools.partial(_append_device, cap=cap, width=width)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(AXIS), P(AXIS, None, None),
+                  P(AXIS, None)),
+        out_specs=(P(AXIS, None, None), P(AXIS), P(AXIS, None)),
+    )(buf, n, rows, scal)
+
+
+_append_step = jax.jit(_append_impl, static_argnames=("mesh",),
+                       donate_argnums=(0, 1))
+
+
+@functools.partial(jax.jit, static_argnames=("mp",))
+def _buf_prefix(buf, *, mp: int):
+    return buf[:, :mp]
+
+
+class DevicePostings:
+    """Persistent ``[n_dev, cap, width]`` uint32 append buffer over the
+    mesh.  ``append`` scatters one wave's rows (synchronously checked —
+    the wave walk already blocks on its scalars each wave, so the tiny
+    flags pull costs nothing extra); ``drain`` pulls the occupied prefix
+    and hands each device's rows to the caller, then resets.
+
+    ``stats``, if given, receives ``appends``, ``append_overflows``,
+    ``sync_pulls``, ``append_s``, ``drain_s``.
+    """
+
+    def __init__(self, mesh: Mesh, *, width: int, cap: int,
+                 stats: Optional[dict] = None):
+        self.mesh = mesh
+        self.n_dev = int(mesh.devices.size)
+        self.width = int(width)
+        self.cap = 1 << max(0, int(cap) - 1).bit_length()
+        self.stats = stats if stats is not None else {}
+        for key in ("appends", "append_overflows", "sync_pulls"):
+            self.stats.setdefault(key, 0)
+        for key in ("append_s", "drain_s"):
+            self.stats.setdefault(key, 0.0)
+        sh3 = NamedSharding(mesh, P(AXIS, None, None))
+        sh1 = NamedSharding(mesh, P(AXIS))
+        self._buf = jax.device_put(
+            np.zeros((self.n_dev, self.cap, self.width), np.uint32), sh3)
+        self._n = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
+        self._nrows = np.zeros(self.n_dev, dtype=np.int64)
+
+    def append(self, rows_dev, scal_dev) -> bool:
+        """Append one wave's valid rows.  Returns False when the buffer
+        was full (a global no-op): the caller drains and retries — which
+        always succeeds, because ``cap`` >= one wave's row count."""
+        t0 = time.perf_counter()
+        self._buf, self._n, flags = _append_step(
+            self._buf, self._n, rows_dev, scal_dev, mesh=self.mesh)
+        flags_np = np.asarray(flags)
+        self._nrows = flags_np[:, 1].astype(np.int64)
+        overflowed = bool(flags_np[:, 0].any())
+        if overflowed:
+            self.stats["append_overflows"] += 1
+        else:
+            self.stats["appends"] += 1
+        self.stats["append_s"] += time.perf_counter() - t0
+        return not overflowed
+
+    @property
+    def pending_rows(self) -> int:
+        return int(self._nrows.sum())
+
+    def drain(self) -> List[np.ndarray]:
+        """Pull every device's occupied rows (ONE sliced transfer for
+        the whole buffer) and reset the buffer.  Returns one
+        ``[n_d, width]`` uint32 array per device — the caller applies
+        its own filters (padding docs, partition slices) before
+        accumulating, exactly as it did on the per-wave pull path."""
+        t0 = time.perf_counter()
+        out: List[np.ndarray] = []
+        m = int(self._nrows.max())
+        if m == 0:
+            self.stats["drain_s"] += time.perf_counter() - t0
+            return [np.zeros((0, self.width), np.uint32)] * self.n_dev
+        mp = occupied_prefix(m, self.cap)
+        pulled = np.asarray(_buf_prefix(self._buf, mp=mp))
+        for d in range(self.n_dev):
+            out.append(pulled[d, :int(self._nrows[d])])
+        self.stats["sync_pulls"] += 1
+        # Reset is host-side bookkeeping only: rows beyond the write
+        # offset are never read, so the buffer bytes can stay stale.
+        sh1 = NamedSharding(self.mesh, P(AXIS))
+        self._n = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
+        self._nrows[:] = 0
+        self.stats["drain_s"] += time.perf_counter() - t0
+        return out

@@ -1,0 +1,568 @@
+"""Device-resident accumulator service: the cross-step merge table.
+
+The streaming engine (``parallel/streaming.py``) historically mirrored
+the reference MapReduce's host-centric shape: every step's reduce output
+crossed D2H and was merged into the host accumulator before the next
+step could retire — one pull per step, exactly the per-intermediate
+round-trip the reference pays in JSON files on a shared filesystem
+(``mr/worker.go:81-121``).  On the axon tunnel that pull is ~0.1 s of
+latency plus ~25 MB/s of wire per step; the depth-2 pipeline can hide
+the *merge* but not the wire.
+
+This module keeps the merged table ON DEVICE instead:
+
+* :class:`DeviceTable` owns a persistent packed key/count table at a
+  fixed per-device capacity rung — keys as big-endian uint32 lanes
+  (``ops/wordcount.py`` layout, so the host decode path is unchanged),
+  counts as uint64 (cross-step sums can exceed uint32 long before a
+  sync), occupancy per device.  Every device holds only words of the
+  reduce partitions it owns (``parallel/shuffle.py`` routing), so
+  per-device tables are disjoint and a host drain is a concatenation.
+* ``fold``: ONE compiled program (cached via ``backends/aotcache`` under
+  ``aot``) merges a step's packed reduce output into the table in place:
+  concat + packed-u64 lexicographic sort + run detection + segment-sum —
+  the same grouping idiom as the kernels' reduce, at table+step size.
+  The table arrays are DONATED to the fold, so XLA updates the table in
+  place and table residency never doubles; the step tensor is NOT
+  donated — it is the recovery payload if the fold reports overflow.
+* overflow never drops keys silently: a fold whose merged uniques exceed
+  the capacity rung is a GLOBAL no-op (an on-device ``pmax`` makes every
+  device keep its old shard — a mixed commit would double-count the
+  folded devices when the step is recovered) and surfaces a widen signal
+  in the fold's tiny ``[n_dev, 2]`` flags output.
+* ``widen``: drain the table to the host accumulator (``PackedCounts``),
+  reallocate at the next capacity rung (x4, the repo's rung discipline),
+  and re-fold the orphaned steps — their packed tensors were kept alive
+  exactly for this.  The same protocol re-keys the table when the word
+  window widens mid-stream (kk changes, e.g. a >16-byte word forcing the
+  64-byte rung).
+* flag checks are LAGGED: blocking on a fold's flags the moment it is
+  dispatched would wait out every kernel queued behind it on the
+  in-order device stream — the serialization the pipeline exists to
+  avoid.  Folds are confirmed ``lag`` folds late (the streaming engine
+  passes its pipeline depth); folds are commutative count-sums and a
+  failed fold is a no-op, so late detection loses nothing.
+
+Sync cadence (pull every K folds) is owned by ``device/policy.py``; the
+caller drives ``sync()``/``close()``.  Host pulls therefore number
+``ceil(folds / K) + widens`` instead of one per step — the amortization
+``pipeline_stats`` reports as ``sync_pulls``/``widens``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import functools
+import time
+import warnings
+from typing import Deque, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsi_tpu.ops.wordcount import (
+    _PAD_KEY,
+    _PAD_KEY64,
+    group_sorted,
+    pack_key_lanes,
+    unpack_key_rows,
+)
+from dsi_tpu.parallel.shuffle import AXIS, occupied_prefix
+from dsi_tpu.utils.jaxcompat import enable_x64, x64_scoped, shard_map
+
+#: jax.jit donate_argnums for the fold/clear programs: the five table
+#: arrays are consumed and rewritten in place.  Shared by the jit path,
+#: the AOT compile, the warmer, and the cache-existence probe.
+_TABLE_DONATE = (0, 1, 2, 3, 4)
+
+
+@contextlib.contextmanager
+def _quiet_unusable_donation():
+    """On backends where XLA declines to alias a donated buffer (XLA:CPU
+    does even for shape-matched donations) jax warns once per compiled
+    program — expected for OUR dispatches, so the warning is suppressed
+    around them only: a process-global filter would hide the same
+    warning from the user's unrelated jax programs, where a silently
+    unusable donation is real signal.  The single definition for every
+    donating dispatch site (the streaming engine imports it from
+    here)."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def _fold_device(tkeys, tlens, tcnts, tparts, tn, packed, scal, *,
+                 cap: int, kk: int):
+    """Per-device fold body (runs under shard_map).
+
+    Table shard + this device's slice of the step's packed reduce output
+    -> merged table shard + ``[overflow, occupancy]`` flags.  Pad rows
+    carry ``_PAD_KEY`` in every lane (u64-max after pairwise packing) so
+    they sort last and ``group_sorted``'s max-value pad detection holds —
+    the invariant every fold output re-establishes.
+    """
+    tkeys = tkeys.reshape(cap, kk)
+    tlens = tlens.reshape(cap)
+    tcnts = tcnts.reshape(cap)
+    tparts = tparts.reshape(cap)
+    tn0 = tn.reshape(())
+    rows = packed.shape[-2]
+    packed = packed.reshape(rows, kk + 3)
+    scal = scal.reshape(-1)
+
+    # Step rows beyond this device's merged-unique count are garbage
+    # (zero keys, not pad): mask them to pad rows before the sort.
+    sn = scal[0]
+    svalid = jnp.arange(rows, dtype=jnp.int32) < sn
+    skeys = jnp.where(svalid[:, None], packed[:, :kk], jnp.uint32(_PAD_KEY))
+    slens = jnp.where(svalid, packed[:, kk].astype(jnp.int32), 0)
+    sparts = jnp.where(svalid, packed[:, kk + 2].astype(jnp.int32), 0)
+
+    with enable_x64(True):  # every op touching u64 operands needs it
+        scnts = jnp.where(svalid, packed[:, kk + 1].astype(jnp.uint64),
+                          jnp.uint64(0))
+        allkeys = jnp.concatenate([tkeys, skeys], axis=0)
+        alllens = jnp.concatenate([tlens, slens])
+        allcnts = jnp.concatenate([tcnts, scnts])
+        allparts = jnp.concatenate([tparts, sparts])
+        keys64 = pack_key_lanes(tuple(allkeys[:, j] for j in range(kk)))
+        k64 = len(keys64)
+        sorted_ops = lax.sort(keys64 + (alllens, allcnts, allparts),
+                              num_keys=k64)
+        mkeys64, tot, upos, ovalid, m_unique = group_sorted(
+            sorted_ops[:k64], sorted_ops[k64 + 1], cap)
+        new_keys64 = jnp.where(ovalid[:, None], mkeys64[upos],
+                               jnp.uint64(_PAD_KEY64))
+        new_keys = unpack_key_rows(new_keys64, kk)
+        new_cnts = jnp.where(ovalid, tot, jnp.uint64(0))
+    new_lens = jnp.where(ovalid, sorted_ops[k64][upos], 0)
+    new_parts = jnp.where(ovalid, sorted_ops[k64 + 2][upos], 0)
+
+    # Commit is all-or-nothing ACROSS devices: if any shard overflowed,
+    # every shard keeps its old table (the step is recovered whole by the
+    # widen path; a partial commit would double-count the folded shards).
+    ov = lax.pmax((m_unique > cap).astype(jnp.int32), AXIS)
+    keep_old = ov > 0
+    out_keys = jnp.where(keep_old, tkeys, new_keys)
+    out_lens = jnp.where(keep_old, tlens, new_lens)
+    out_cnts = jnp.where(keep_old, tcnts, new_cnts)
+    out_parts = jnp.where(keep_old, tparts, new_parts)
+    out_n = jnp.where(keep_old, tn0, jnp.minimum(m_unique, cap))
+    flags = jnp.stack([ov, out_n])
+    return (out_keys[None], out_lens[None], out_cnts[None], out_parts[None],
+            out_n[None], flags[None])
+
+
+def _fold_impl(tkeys, tlens, tcnts, tparts, tn, packed, scal, *, mesh: Mesh):
+    cap, kk = tkeys.shape[1], tkeys.shape[2]
+    body = functools.partial(_fold_device, cap=cap, kk=kk)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                  P(AXIS, None), P(AXIS), P(AXIS, None, None), P(AXIS, None)),
+        out_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                   P(AXIS, None), P(AXIS), P(AXIS, None)),
+    )(tkeys, tlens, tcnts, tparts, tn, packed, scal)
+
+
+#: In-process fold program for multi-device meshes / non-aot callers.
+fold_step = x64_scoped(jax.jit(_fold_impl, static_argnames=("mesh",),
+                               donate_argnums=_TABLE_DONATE))
+
+
+def _clear_device(tkeys, tlens, tcnts, tparts, tn):
+    return (jnp.full_like(tkeys, jnp.uint32(_PAD_KEY)),
+            jnp.zeros_like(tlens), jnp.zeros_like(tcnts),
+            jnp.zeros_like(tparts), jnp.zeros_like(tn))
+
+
+def _clear_impl(tkeys, tlens, tcnts, tparts, tn, *, mesh: Mesh):
+    """Reset the table to empty ON DEVICE (donated, in place): a sync
+    must not re-upload a capacity-sized block of pads over the tunnel
+    just to start the next window."""
+    return shard_map(
+        _clear_device, mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                  P(AXIS, None), P(AXIS)),
+        out_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                   P(AXIS, None), P(AXIS)),
+    )(tkeys, tlens, tcnts, tparts, tn)
+
+
+clear_table = x64_scoped(jax.jit(_clear_impl, static_argnames=("mesh",),
+                                 donate_argnums=_TABLE_DONATE))
+
+
+@functools.partial(jax.jit, static_argnames=("mp",))
+def _pack_prefix_impl(tkeys, tlens, tparts, tcnts, *, mp: int):
+    """Device-side prefix slice + pack for a table drain: one uint32
+    tensor [D, mp, kk+2] (keys + len + part) plus the uint64 count
+    prefix — two D2H transfers per SYNC, versus (historically) one pull
+    per STEP.  ``mp`` is the pow2-rounded occupied prefix under jit,
+    the full capacity under aot (deterministic shapes, same trade as the
+    stream's pulls)."""
+    packed = jnp.concatenate(
+        [tkeys[:, :mp],
+         tlens[:, :mp, None].astype(jnp.uint32),
+         tparts[:, :mp, None].astype(jnp.uint32)], axis=2)
+    return packed, tcnts[:, :mp]
+
+
+_pack_prefix = x64_scoped(_pack_prefix_impl)
+
+
+def _fold_program(*, mesh: Mesh, n_dev: int, cap: int, kk: int, rows: int):
+    """(name, fn) for one compiled fold shape — single definition shared
+    by the cached-compile path, the warmer, and the cache-existence
+    probe (same discipline as ``streaming._step_program``)."""
+    import dsi_tpu.ops.wordcount as _wc
+
+    def fn(tkeys, tlens, tcnts, tparts, tn, packed, scal):
+        return _fold_impl(tkeys, tlens, tcnts, tparts, tn, packed, scal,
+                          mesh=mesh)
+
+    fn._aot_code_deps = (_wc,)
+    return f"dacc_fold_d{n_dev}_c{cap}_k{kk}_r{rows}", fn
+
+
+def _clear_program(*, mesh: Mesh, n_dev: int, cap: int, kk: int):
+    def fn(tkeys, tlens, tcnts, tparts, tn):
+        return _clear_impl(tkeys, tlens, tcnts, tparts, tn, mesh=mesh)
+
+    return f"dacc_clear_d{n_dev}_c{cap}_k{kk}", fn
+
+
+def _pack_program(*, n_dev: int, cap: int, kk: int, mp: int):
+    def fn(tkeys, tlens, tparts, tcnts):
+        return _pack_prefix_impl(tkeys, tlens, tparts, tcnts, mp=mp)
+
+    return f"dacc_pack_d{n_dev}_c{cap}_k{kk}_m{mp}", fn
+
+
+def _table_structs(n_dev: int, cap: int, kk: int):
+    sds = jax.ShapeDtypeStruct
+    return (sds((n_dev, cap, kk), jnp.uint32),
+            sds((n_dev, cap), jnp.int32),
+            sds((n_dev, cap), jnp.uint64),
+            sds((n_dev, cap), jnp.int32),
+            sds((n_dev,), jnp.int32))
+
+
+def _step_structs(n_dev: int, rows: int, kk: int):
+    sds = jax.ShapeDtypeStruct
+    return (sds((n_dev, rows, kk + 3), jnp.uint32),
+            sds((n_dev, 5), jnp.int32))
+
+
+def warm_device_fold(mesh: Mesh, *, u_cap: int, kk: int = 4,
+                     table_rungs: int = 2) -> None:
+    """Compile + persist the fold/clear/pack shapes a device-accumulated
+    stream reaches at this step capacity: the rung-0 table (cap = step
+    rows) plus ``table_rungs - 1`` x4 widenings, from shape structs alone
+    (no data, nothing executed) — so a fresh axon process only ever
+    loads.  Callers warm per step-cap rung, mirroring
+    ``streaming.warm_stream_aot``'s caps ladder."""
+    from dsi_tpu.backends import aotcache
+
+    n_dev = mesh.devices.size
+    rows = n_dev * u_cap
+    # Same rounding DeviceTable applies to its rung-0 capacity — warmed
+    # keys must be, by construction, the keys a run compiles first.
+    cap = _pow2(rows)
+    for _ in range(max(1, table_rungs)):
+        table = _table_structs(n_dev, cap, kk)
+        step = _step_structs(n_dev, rows, kk)
+        name, fn = _fold_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk,
+                                 rows=rows)
+        with _quiet_unusable_donation():
+            aotcache.cached_compile(name, fn, table + step,
+                                    donate_argnums=_TABLE_DONATE, x64=True)
+        name, fn = _clear_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk)
+        with _quiet_unusable_donation():
+            aotcache.cached_compile(name, fn, table,
+                                    donate_argnums=_TABLE_DONATE, x64=True)
+        name, fn = _pack_program(n_dev=n_dev, cap=cap, kk=kk, mp=cap)
+        aotcache.cached_compile(
+            name, fn, (table[0], table[1], table[3], table[2]), x64=True)
+        cap *= 4
+
+
+def device_fold_persisted(mesh: Mesh, *, u_cap: int, kk: int = 4) -> bool:
+    """True when the rung-0 fold/clear/pack programs for this shape are
+    already in the persistent AOT cache — the stream-row gate's
+    device-accumulate extension (see ``stream_programs_persisted``)."""
+    from dsi_tpu.backends.aotcache import is_persisted
+
+    n_dev = mesh.devices.size
+    rows = n_dev * u_cap
+    cap = _pow2(rows)  # mirror DeviceTable's rung-0 rounding exactly
+    table = _table_structs(n_dev, cap, kk)
+    step = _step_structs(n_dev, rows, kk)
+    name, fn = _fold_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk,
+                             rows=rows)
+    if not is_persisted(name, fn, table + step,
+                        donate_argnums=_TABLE_DONATE):
+        return False
+    name, fn = _clear_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk)
+    if not is_persisted(name, fn, table, donate_argnums=_TABLE_DONATE):
+        return False
+    name, fn = _pack_program(n_dev=n_dev, cap=cap, kk=kk, mp=cap)
+    return is_persisted(name, fn, (table[0], table[1], table[3], table[2]))
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+class DeviceTable:
+    """Persistent on-device merged word/count table, folded per step,
+    drained per sync window.
+
+    ``acc`` is the host :class:`~dsi_tpu.parallel.merge.PackedCounts`
+    every drain merges into; ``stats``, if given, receives the service's
+    counters (``folds``, ``fold_overflows``, ``sync_pulls``, ``widens``,
+    ``table_cap``, and ``fold_s``/``sync_s``/``widen_s`` wall seconds).
+    ``lag`` is how many folds may stay unconfirmed before the oldest's
+    flags are checked (the streaming engine passes its pipeline depth);
+    ``sync()``/``close()``/``widen`` flush the lag entirely.
+    """
+
+    def __init__(self, mesh: Mesh, *, kk: int, cap: int, acc,
+                 aot: bool = False, lag: int = 1,
+                 stats: Optional[dict] = None):
+        self.mesh = mesh
+        self.n_dev = int(mesh.devices.size)
+        self.kk = int(kk)
+        self.cap = _pow2(cap)
+        self.acc = acc
+        self.aot = bool(aot)
+        self.lag = max(0, int(lag))
+        self.stats = stats if stats is not None else {}
+        for key in ("folds", "fold_overflows", "sync_pulls", "widens"):
+            self.stats.setdefault(key, 0)
+        for key in ("fold_s", "sync_s", "widen_s"):
+            self.stats.setdefault(key, 0.0)
+        self._state = self._alloc(self.cap, self.kk)
+        # Occupancy per device after the last CONFIRMED fold (a no-op'd
+        # fold reports the old occupancy, so this stays exact either way).
+        self._nrows = np.zeros(self.n_dev, dtype=np.int64)
+        # (flags_handle, packed_dev, scal_dev) per unconfirmed fold — the
+        # step tensors stay referenced until their fold is proven clean,
+        # so an overflowed (no-op) fold can be replayed after a widen.
+        self._pending: Deque[Tuple] = collections.deque()
+        self.stats["table_cap"] = self.cap
+
+    # ── allocation / compiled-program plumbing ──
+
+    def _alloc(self, cap: int, kk: int):
+        """Fresh empty table arrays, sharded over the mesh.  One H2D
+        upload per (re)allocation — allocation happens once per stream
+        plus once per widen; per-sync resets go through the compiled
+        ``clear`` program instead (no upload)."""
+        sh3 = NamedSharding(self.mesh, P(AXIS, None, None))
+        sh2 = NamedSharding(self.mesh, P(AXIS, None))
+        sh1 = NamedSharding(self.mesh, P(AXIS))
+        with enable_x64(True):  # keep the u64 counts u64 through the put
+            return (
+                jax.device_put(
+                    np.full((self.n_dev, cap, kk), _PAD_KEY, np.uint32), sh3),
+                jax.device_put(
+                    np.zeros((self.n_dev, cap), np.int32), sh2),
+                jax.device_put(
+                    np.zeros((self.n_dev, cap), np.uint64), sh2),
+                jax.device_put(
+                    np.zeros((self.n_dev, cap), np.int32), sh2),
+                jax.device_put(np.zeros((self.n_dev,), np.int32), sh1))
+
+    def _fold_fn(self, rows: int):
+        if not self.aot:
+            return functools.partial(fold_step, mesh=self.mesh)
+        from dsi_tpu.backends import aotcache
+
+        name, fn = _fold_program(mesh=self.mesh, n_dev=self.n_dev,
+                                 cap=self.cap, kk=self.kk, rows=rows)
+        examples = (_table_structs(self.n_dev, self.cap, self.kk)
+                    + _step_structs(self.n_dev, rows, self.kk))
+        with _quiet_unusable_donation():  # a cold entry compiles here
+            return aotcache.cached_compile(name, fn, examples,
+                                           donate_argnums=_TABLE_DONATE,
+                                           x64=True)
+
+    def _clear_fn(self):
+        if not self.aot:
+            return functools.partial(clear_table, mesh=self.mesh)
+        from dsi_tpu.backends import aotcache
+
+        name, fn = _clear_program(mesh=self.mesh, n_dev=self.n_dev,
+                                  cap=self.cap, kk=self.kk)
+        with _quiet_unusable_donation():
+            return aotcache.cached_compile(
+                name, fn, _table_structs(self.n_dev, self.cap, self.kk),
+                donate_argnums=_TABLE_DONATE, x64=True)
+
+    def _pack_fn(self, mp: int):
+        if not self.aot:
+            return functools.partial(_pack_prefix, mp=mp)
+        from dsi_tpu.backends import aotcache
+
+        name, fn = _pack_program(n_dev=self.n_dev, cap=self.cap, kk=self.kk,
+                                 mp=mp)
+        t = _table_structs(self.n_dev, self.cap, self.kk)
+        return aotcache.cached_compile(name, fn, (t[0], t[1], t[3], t[2]),
+                                       x64=True)
+
+    # ── the fold path ──
+
+    def fold(self, packed_dev, scal_dev, scal_np: np.ndarray) -> None:
+        """Dispatch one confirmed step's fold (async, no blocking) and
+        lazily confirm folds older than ``lag``.  ``packed_dev`` is the
+        step's full-capacity packed reduce output ``[n_dev, rows, kk+3]``
+        (``shuffle._slice_pack`` layout); ``scal_np`` is the already
+        host-checked scalar block (the caller's exactness confirmation —
+        the fold LAGS that window by construction, because only callers
+        holding a confirmed step reach here)."""
+        step_kk = int(packed_dev.shape[2]) - 3
+        if step_kk != self.kk:
+            # The word window widened mid-stream (e.g. 16 -> 64 bytes):
+            # the table's key lanes can no longer represent this step's
+            # words.  Re-key via the widen protocol: drain what we have,
+            # reallocate at the new width, resume folding.
+            self._rekey(step_kk, int(packed_dev.shape[1]))
+        t0 = time.perf_counter()
+        out = self._dispatch_fold(packed_dev, scal_dev)
+        self._pending.append((out, packed_dev, scal_dev))
+        self.stats["folds"] += 1
+        while len(self._pending) > self.lag:
+            self._confirm_oldest()
+        self.stats["fold_s"] += time.perf_counter() - t0
+
+    def _dispatch_fold(self, packed_dev, scal_dev):
+        fn = self._fold_fn(int(packed_dev.shape[1]))
+        with _quiet_unusable_donation():
+            *state, flags = fn(*self._state, packed_dev, scal_dev)
+        self._state = tuple(state)
+        return flags
+
+    def _confirm_oldest(self) -> None:
+        flags, packed_dev, scal_dev = self._pending.popleft()
+        flags_np = np.asarray(flags)  # blocks until this fold lands
+        self._nrows = flags_np[:, 1].astype(np.int64)
+        if flags_np[:, 0].any():
+            self.stats["fold_overflows"] += 1
+            self._recover([(packed_dev, scal_dev)])
+
+    def _flush_pending(self):
+        """Confirm every outstanding fold; return the (packed, scal)
+        pairs of folds that no-op'd on overflow, oldest first."""
+        orphans = []
+        while self._pending:
+            flags, packed_dev, scal_dev = self._pending.popleft()
+            flags_np = np.asarray(flags)
+            self._nrows = flags_np[:, 1].astype(np.int64)
+            if flags_np[:, 0].any():
+                self.stats["fold_overflows"] += 1
+                orphans.append((packed_dev, scal_dev))
+        return orphans
+
+    # ── overflow / widen protocol ──
+
+    def _recover(self, orphans) -> None:
+        """A fold overflowed (and was therefore a global no-op).  Later
+        folds may already sit in the queue — flush them first (successes
+        merged into the old table and drain with it; further overflows
+        join the orphan list), then widen and re-fold every orphan."""
+        t0 = time.perf_counter()
+        orphans = list(orphans) + self._flush_pending()
+        while orphans:
+            rows = max(int(p.shape[1]) for p, _ in orphans)
+            self._widen(_pow2(max(4 * self.cap, rows)), self.kk)
+            still = []
+            for packed_dev, scal_dev in orphans:
+                flags_np = np.asarray(
+                    self._dispatch_fold(packed_dev, scal_dev))
+                self._nrows = flags_np[:, 1].astype(np.int64)
+                if flags_np[:, 0].any():  # rung still too narrow: again
+                    still.append((packed_dev, scal_dev))
+            orphans = still
+        self.stats["widen_s"] += time.perf_counter() - t0
+
+    def _widen(self, new_cap: int, new_kk: int) -> None:
+        """Drain the current table into the host accumulator and
+        reallocate at ``new_cap``/``new_kk``.  Into an empty table at
+        ``cap >= rows`` a single step always fits (its uniques are
+        bounded by its row count), so the re-fold loop above terminates
+        in one widen per distinct rows shape."""
+        self._pull_merge()
+        self.cap, self.kk = new_cap, new_kk
+        self._state = self._alloc(self.cap, self.kk)
+        self._nrows[:] = 0
+        self.stats["widens"] += 1
+        self.stats["table_cap"] = self.cap
+
+    def _rekey(self, new_kk: int, rows: int) -> None:
+        t0 = time.perf_counter()
+        # Outstanding folds still match the OLD width: confirm them
+        # first (overflow here recovers at the old width, which is fine
+        # — their steps' words provably fit the old window).
+        orphans = self._flush_pending()
+        if orphans:
+            self._recover(orphans)
+        self._widen(_pow2(max(self.cap, rows)), new_kk)
+        self.stats["widen_s"] += time.perf_counter() - t0
+
+    # ── drains ──
+
+    def _pull_merge(self) -> bool:
+        """Pull the occupied table prefix and merge it into the host
+        accumulator.  Returns True if anything crossed the wire."""
+        m = int(self._nrows.max())
+        if m == 0:
+            return False
+        mp = self.cap if self.aot else occupied_prefix(m, self.cap)
+        tkeys, tlens, tcnts, tparts, _ = self._state
+        packed_dev, cnts_dev = self._pack_fn(mp)(tkeys, tlens, tparts, tcnts)
+        packed = np.asarray(packed_dev)
+        cnts = np.asarray(cnts_dev)
+        for d in range(self.n_dev):
+            n = int(self._nrows[d])
+            if n == 0:
+                continue
+            r = packed[d, :n]
+            self.acc.add(r[:, :self.kk], r[:, self.kk],
+                         cnts[d, :n].astype(np.int64), r[:, self.kk + 1])
+        return True
+
+    def sync(self) -> bool:
+        """The K-step host pull: flush the fold lag, drain the table
+        into the accumulator, reset it to empty ON DEVICE (compiled
+        clear, no upload).  Returns True when a pull happened (an empty
+        window skips the wire and is not counted)."""
+        t0 = time.perf_counter()
+        orphans = self._flush_pending()
+        if orphans:
+            self._recover(orphans)
+        pulled = self._pull_merge()
+        if pulled:
+            self.stats["sync_pulls"] += 1
+            with _quiet_unusable_donation():
+                self._state = tuple(self._clear_fn()(*self._state))
+            self._nrows[:] = 0
+        self.stats["sync_s"] += time.perf_counter() - t0
+        return pulled
+
+    def close(self) -> None:
+        """Stream-end drain: flush + final pull, no reset (the table is
+        dropped with the service)."""
+        t0 = time.perf_counter()
+        orphans = self._flush_pending()
+        if orphans:
+            self._recover(orphans)
+        if self._pull_merge():
+            self.stats["sync_pulls"] += 1
+        self._state = None
+        self.stats["sync_s"] += time.perf_counter() - t0

@@ -68,17 +68,31 @@ a shared filesystem, ``mr/coordinator.go:152``); this is that lever
 re-designed for a device mesh: nMap becomes "number of stream steps", and
 the pipeline is the reference's map/shuffle/reduce-of-different-tasks
 concurrency re-created inside one process.
+
+``device_accumulate=True`` moves the cross-step merge itself on-device
+(``device/table.py``): a confirmed step's packed reduce output FOLDS into
+a persistent device-resident table with one compiled merge program, and
+the host pulls the merged table only every ``sync_every`` folds (plus
+stream end) — ``ceil(steps/K) + widens`` pulls instead of one per step,
+which on the tunnel's ~0.1 s/pull, ~25 MB/s D2H path is the difference
+the depth-2 window can actually hide.  Folds lag the deferred-exactness
+confirmation window: only steps whose overflow checks passed are folded,
+and a replayed step folds its replayed (exact) output — so the
+bit-identical depth=1 parity guarantee survives unchanged.  A fold whose
+merged uniques overflow the table's capacity rung is a global no-op that
+surfaces a widen signal; the service drains the table to the host
+accumulator, reallocates at the next rung, and re-folds the orphaned
+steps (their packed tensors are kept alive until their fold confirms,
+exactly for this).
 """
 
 from __future__ import annotations
 
 import collections
-import contextlib
 import os
 import queue
 import threading
 import time
-import warnings
 from typing import Dict, Iterable, Iterator, Optional, Sequence, Tuple
 
 import jax
@@ -86,6 +100,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from dsi_tpu.device.policy import SyncPolicy
+from dsi_tpu.device.table import DeviceTable, _quiet_unusable_donation
 from dsi_tpu.ops.wordcount import (
     default_grouper,
     exactness_retry,
@@ -102,22 +118,6 @@ from dsi_tpu.parallel.shuffle import (
     mapreduce_step_donate,
     occupied_prefix,
 )
-
-
-@contextlib.contextmanager
-def _quiet_unusable_donation():
-    """The stream step donates its chunk upload (HBM residency stays ≤
-    depth chunk buffers); on backends where no output shape matches the
-    input XLA cannot alias the donation and jax warns once per compiled
-    rung.  Expected here — the buffer is freed at execution end instead
-    of reused in place — so the warning is suppressed around OUR OWN
-    dispatches only: a process-global filter would hide the same warning
-    from the user's unrelated jax programs, where a silently-unusable
-    donation is real signal."""
-    with warnings.catch_warnings():
-        warnings.filterwarnings(
-            "ignore", message="Some donated buffers were not usable")
-        yield
 
 
 # A cut never needs to back off further than the longest word the kernels
@@ -364,7 +364,8 @@ def stream_programs_persisted(mesh: Mesh | None = None,
                               chunk_bytes: int = 1 << 20,
                               n_reduce: int = 10, max_word_len: int = 16,
                               u_cap: int = 1 << 12,
-                              fracs: Sequence[int] = (4, 2)) -> bool:
+                              fracs: Sequence[int] = (4, 2),
+                              device_accumulate: bool = False) -> bool:
     """True when every starting-rung program
     ``wordcount_streaming(..., aot=True)`` would reach first (step at
     each token-capacity frac, plus the pack program) is already in the
@@ -394,7 +395,18 @@ def stream_programs_persisted(mesh: Mesh | None = None,
                                 donate_argnums=_STEP_DONATE):
                 return False
     name, fn = _pack_program(mp=rows)
-    return is_persisted(name, fn, pack_args)
+    if not is_persisted(name, fn, pack_args):
+        return False
+    if device_accumulate:
+        # The rung-0 fold/clear/pack programs the device accumulator
+        # reaches first (device/table.py) — a cold fold compile is the
+        # same multi-minute remote hazard as a cold step compile.
+        from dsi_tpu.device.table import device_fold_persisted
+
+        if not device_fold_persisted(mesh, u_cap=u_cap,
+                                     kk=max_word_len // 4):
+            return False
+    return True
 
 
 def _aot_pack(keys, lens, cnts, parts, *, mp: int):
@@ -406,7 +418,8 @@ def warm_stream_aot(mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
                     n_reduce: int = 10,
                     word_lens: Sequence[int] = (16,),
                     caps: Sequence[int] = (1 << 12, 1 << 14, 1 << 16),
-                    fracs: Sequence[int] = (4, 2)) -> None:
+                    fracs: Sequence[int] = (4, 2),
+                    device_accumulate: bool = False) -> None:
     """Compile + persist the program shapes
     ``wordcount_streaming(..., aot=True)`` reaches at these parameters,
     from shape structs alone (no data, nothing executed) — so a later
@@ -437,6 +450,14 @@ def warm_stream_aot(mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
                                  max_word_len=mwl, u_cap=cap, mesh=mesh,
                                  t_cap_frac=frac, grouper=g)
             _aot_pack_fn(pack_args, mp=rows)
+            if device_accumulate:
+                # Fold/clear/pack shapes for the device accumulator at
+                # this step rung: the rung-0 table (cap = step rows)
+                # plus one x4 widening (device/table.py rung ladder).
+                from dsi_tpu.device.table import warm_device_fold
+
+                warm_device_fold(mesh, u_cap=cap, kk=mwl // 4,
+                                 table_rungs=2)
 
 
 def wordcount_streaming(
@@ -446,6 +467,8 @@ def wordcount_streaming(
         aot: bool = False, on_attempt=None,
         depth: Optional[int] = None,
         pipeline_stats: Optional[dict] = None,
+        device_accumulate: bool = False,
+        sync_every: Optional[int] = None,
 ) -> Optional[Dict[str, Tuple[int, int]]]:
     """Exact whole-stream word counts with bounded memory, pipelined.
 
@@ -485,6 +508,20 @@ def wordcount_streaming(
     everything) instead of data-dependent pow2 prefixes — the right trade
     on the axon platform, where one cold remote compile costs more than
     every capacity-sized pull of a whole bench run.
+
+    ``device_accumulate=True`` folds each confirmed step's reduce output
+    into a persistent on-device merge table (``device/table.py``) instead
+    of pulling + host-merging it; the host sees the merged table only
+    every ``sync_every`` folds (default ``DSI_STREAM_SYNC_EVERY``, 8) and
+    at stream end.  Results are bit-identical to the host-merge path —
+    folds consume exactly the confirmed per-step tables the host merge
+    would, replays fold their replayed exact output, and table-capacity
+    overflow widens (drain + realloc + re-fold) rather than dropping
+    keys.  ``pipeline_stats`` gains ``folds``/``fold_overflows``/
+    ``sync_pulls``/``widens``/``table_cap`` counters and ``fold_s``/
+    ``sync_s``/``widen_s`` phases; ``step_pulls`` counts per-step D2H
+    result pulls in BOTH modes, so a bench can show the amortization
+    (steps vs ``ceil(steps/K) + widens``) directly.
     """
     if mesh is None:
         mesh = default_mesh()
@@ -503,9 +540,44 @@ def wordcount_streaming(
     sharding = NamedSharding(mesh, PartitionSpec(AXIS, None))
     stats = {"depth": depth, "steps": 0, "replays": 0,
              "max_inflight_chunks": 0, "donate_chunks": True,
+             "step_pulls": 0, "device_accumulate": device_accumulate,
              "batch_s": 0.0, "batch_wait_s": 0.0, "upload_s": 0.0,
              "kernel_s": 0.0, "pull_s": 0.0, "merge_s": 0.0,
              "replay_s": 0.0}
+    # Device-resident accumulation: confirmed steps fold on-device, the
+    # host pulls every K folds.  The table allocates lazily at the first
+    # fold (its key width and capacity come from that step's shapes); the
+    # fold-flag lag is the pipeline window, so confirming a fold never
+    # blocks on kernels the window still wants in flight.
+    table_svc: Optional[DeviceTable] = None
+    policy: Optional[SyncPolicy] = None
+    if device_accumulate:
+        policy = SyncPolicy(sync_every)
+        stats["sync_every"] = policy.sync_every
+
+    def fold_confirmed(packed_dev, scal_dev, scal_np) -> None:
+        nonlocal table_svc
+        if int(scal_np[:, 0].max()) == 0:
+            return  # empty step: nothing to fold, nothing to sync for
+        if table_svc is None:
+            # Rung-0 table capacity: the step's row count (a single fold
+            # can never overflow it), unless DSI_DEVICE_TABLE_CAP asks
+            # for a smaller start — an HBM lever for low-vocabulary
+            # streams (the widen protocol recovers if the guess is
+            # wrong), and the test hook that forces mid-stream widens.
+            try:
+                cap = int(os.environ.get("DSI_DEVICE_TABLE_CAP", "0"))
+            except ValueError:
+                cap = 0
+            table_svc = DeviceTable(
+                mesh, kk=int(packed_dev.shape[2]) - 3,
+                cap=cap if cap > 0 else int(packed_dev.shape[1]),
+                acc=acc, aot=aot, lag=max(0, depth - 1), stats=stats)
+        table_svc.fold(packed_dev, scal_dev, scal_np)
+        policy.note_fold()
+        if policy.due():
+            table_svc.sync()
+            policy.reset()
     # Live host buffers = out queue (≤ depth+1) + in-flight window
     # (≤ depth) + one being filled + one being finished.
     pool = _BufferPool(n_dev, chunk_bytes, retain=2 * depth + 3)
@@ -536,11 +608,15 @@ def wordcount_streaming(
             packed = np.asarray(_slice_pack(keys, lens, cnts, parts, mp=mp))
         return packed, scal_np[:, 0], kk
 
-    def run_step_sync(chunks_np):
+    def run_step_sync(chunks_np, device_payload: bool = False):
         """The full exactness ladder for ONE batch — the replay path of a
         deferred-check failure, and the semantics ``depth=1`` reduces to.
         Each attempt re-uploads (the step program donates its input, so a
-        device buffer never survives an attempt)."""
+        device buffer never survives an attempt).  With
+        ``device_payload`` the payload returns the cleared attempt's
+        DEVICE handles (full-capacity packed tensor + scalars) instead of
+        pulling — the replayed step then folds its exact output into the
+        device table like any confirmed step."""
 
         def run(mwl: int, cap: int):
             state["cap"] = cap    # last attempt = the one that succeeded
@@ -560,6 +636,12 @@ def wordcount_streaming(
             state["grouper"], state["frac"] = g, frac  # cleared rung sticks
 
             def payload():
+                if device_payload:
+                    mp = keys.shape[1]
+                    packed_dev = (
+                        _aot_pack(keys, lens, cnts, parts, mp=mp) if aot
+                        else _slice_pack(keys, lens, cnts, parts, mp=mp))
+                    return packed_dev, scal, scal_np
                 return pull_packed(keys, lens, cnts, parts, scal_np)
 
             return (bool(scal_np[:, 3].any()), int(scal_np[:, 1].max()),
@@ -585,12 +667,16 @@ def wordcount_streaming(
         stats["upload_s"] += time.perf_counter() - t0
         keys, lens, cnts, parts, scal = step_call(
             chunks, mwl, cap, state["frac"], state["grouper"])
-        if aot:
+        if aot or device_accumulate:
             # Only scal + the packed tensor stay referenced: the four
             # result tables free as soon as the pack consumes them, so an
             # in-flight step holds one packed copy, not five tables.
-            packed_dev = _aot_pack(keys, lens, cnts, parts,
-                                   mp=keys.shape[1])
+            # Device accumulation packs eagerly even under jit — the fold
+            # consumes the packed layout, and its full-capacity shape is
+            # deterministic (no flags needed at dispatch time).
+            mp = keys.shape[1]
+            packed_dev = (_aot_pack(keys, lens, cnts, parts, mp=mp) if aot
+                          else _slice_pack(keys, lens, cnts, parts, mp=mp))
             handles = (scal, packed_dev, keys.shape[2], None)
         else:
             handles = (scal, None, keys.shape[2],
@@ -614,33 +700,48 @@ def wordcount_streaming(
                  and int(scal_np[:, 1].max()) <= cap
                  and int(scal_np[:, 2].max()) <= mwl)
         if exact:
-            t0 = time.perf_counter()
-            if int(scal_np[:, 0].max()) == 0:
-                packed, nus = None, None
-            elif packed_dev is not None:  # aot: pack already executed
-                packed, nus = np.asarray(packed_dev), scal_np[:, 0]
+            if device_accumulate:
+                # Fold instead of pull+merge: the confirmed step's packed
+                # output stays on device; the host sees it at the next
+                # sync.  This is the lagged-confirmation invariant — a
+                # fold happens only HERE, after the exactness flags of
+                # its step cleared.
+                fold_confirmed(packed_dev, scal, scal_np)
             else:
-                packed, nus, kk = pull_packed(*tables, scal_np)
-            stats["pull_s"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            if packed is not None:
-                acc.add_packed_step(packed, nus, kk)
-            stats["merge_s"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                if int(scal_np[:, 0].max()) == 0:
+                    packed, nus = None, None
+                elif packed_dev is not None:  # aot: pack already executed
+                    packed, nus = np.asarray(packed_dev), scal_np[:, 0]
+                else:
+                    packed, nus, kk = pull_packed(*tables, scal_np)
+                if packed is not None:
+                    stats["step_pulls"] += 1
+                stats["pull_s"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                if packed is not None:
+                    acc.add_packed_step(packed, nus, kk)
+                stats["merge_s"] += time.perf_counter() - t0
         else:
             # Late-detected overflow: replay just this step through the
             # ladder.  Exactly-once by construction — the optimistic
             # attempt's tables are dropped unmerged, and the replay's
-            # payload merges here and nowhere else.
+            # payload merges (or folds) here and nowhere else.
             stats["replays"] += 1
             t0 = time.perf_counter()
-            payload = run_step_sync(buf)
+            payload = run_step_sync(buf, device_payload=device_accumulate)
             if payload is None:
                 pool.give(buf)
                 stats["replay_s"] += time.perf_counter() - t0
                 raise _NeedsHostPath
-            packed, nus, kk = payload()
-            if packed is not None:
-                acc.add_packed_step(packed, nus, kk)
+            if device_accumulate:
+                packed_dev, scal_dev, scal_np = payload()
+                fold_confirmed(packed_dev, scal_dev, scal_np)
+            else:
+                packed, nus, kk = payload()
+                if packed is not None:
+                    stats["step_pulls"] += 1
+                    acc.add_packed_step(packed, nus, kk)
             stats["replay_s"] += time.perf_counter() - t0
         pool.give(buf)
 
@@ -714,6 +815,8 @@ def wordcount_streaming(
                 finish_one()
         while pending:
             finish_one()
+        if table_svc is not None:
+            table_svc.close()  # the "or at stream end" pull
         result = acc.finalize()
     except (_TokenTooLong, _NeedsHostPath):
         result = None  # caller routes the job to the host path
@@ -732,7 +835,9 @@ def wordcount_streaming(
         if pipeline_stats is not None:
             stats["batch_allocs"] = pool.allocs
             for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",
-                      "pull_s", "merge_s", "replay_s"):
-                stats[k] = round(stats[k], 4)
+                      "pull_s", "merge_s", "replay_s", "fold_s", "sync_s",
+                      "widen_s"):
+                if k in stats:
+                    stats[k] = round(stats[k], 4)
             pipeline_stats.update(stats)
     return result

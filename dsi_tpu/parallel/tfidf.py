@@ -183,6 +183,8 @@ def tfidf_sharded(
         docs: Sequence[bytes], mesh: Mesh | None = None, n_reduce: int = 10,
         max_word_len: int = 16, u_cap: int = 1 << 15,
         partitions: Optional[set] = None, packed: bool = False,
+        device_accumulate: bool = False, sync_every: Optional[int] = None,
+        wave_stats: Optional[dict] = None,
 ):
     """Whole-corpus TF-IDF over the mesh, waves of n_dev documents.
 
@@ -205,6 +207,21 @@ def tfidf_sharded(
     document from disk per wave instead of holding the corpus resident);
     a ``lengths`` attribute, when present, avoids loading documents just
     to size the waves.
+
+    ``device_accumulate=True`` batches the wave walk's D2H through the
+    device-resident accumulator service: each wave's received rows
+    APPEND into a persistent on-device postings buffer
+    (``device/postings.py``) and the host pulls once per ``sync_every``
+    waves (``DSI_STREAM_SYNC_EVERY`` default, 8) or when the buffer
+    fills — amortizing the tunnel's fixed per-pull latency exactly as
+    the streaming engine's fold does (ROADMAP item 2: the wave walk has
+    the same serialized pull shape).  Results are identical: the same
+    rows reach the same ``PostingsTable``, just in per-window batches,
+    and the padding-doc/partition filters run at drain time instead of
+    per wave.  ``wave_stats``, if given, is populated with
+    ``waves``/``appends``/``append_overflows``/``sync_pulls``/
+    ``step_pulls`` counters plus ``append_s``/``drain_s`` phases in
+    either mode.
     """
     if mesh is None:
         mesh = default_mesh()
@@ -216,6 +233,9 @@ def tfidf_sharded(
     longest = max(doc_lens, default=1)
     size_max = 1 << max(8, int(longest).bit_length())  # retry hard-cap
     n_real = len(docs)
+    stats = wave_stats if wave_stats is not None else {}
+    stats.update({"waves": len(waves), "step_pulls": 0,
+                  "device_accumulate": device_accumulate})
 
     def run(mwl: int, cap: int):
         kk = mwl // 4
@@ -236,6 +256,50 @@ def tfidf_sharded(
         from dsi_tpu.ops.wordcount import grouper_ladder
 
         groupers = grouper_ladder()
+
+        def buffer_rows(r: np.ndarray) -> None:
+            """One device's pulled rows into the host table, filtered
+            FIRST: the short last wave's padding documents and — for a
+            partition slice — other slices' rows must cut the per-slice
+            host cost, not just the final table (same rule on both the
+            per-wave and the drain path)."""
+            r = r[r[:, kk + 2] < n_real]
+            if part_arr is not None:
+                r = r[np.isin(r[:, kk + 3], part_arr)]
+            if len(r):
+                table.add(r, kk)
+
+        # Device-resident accumulation (fresh per retry rung — a rung
+        # restart discards partial device state exactly like the host
+        # table): waves append on-device, the host pulls per K-wave
+        # window or when the buffer fills (an overflowing append is a
+        # global no-op; drain-and-retry always fits, because the buffer
+        # holds at least one worst-case wave).
+        buf_dev = None
+        policy = None
+        if device_accumulate:
+            import os
+
+            from dsi_tpu.device import DevicePostings, SyncPolicy
+
+            # One worst-case wave by default (so drain-and-retry always
+            # fits); DSI_DEVICE_POSTINGS_CAP trims it for HBM-tight
+            # meshes (overflow then just syncs earlier) and lets tests
+            # force the early-drain path.
+            try:
+                pcap = int(os.environ.get("DSI_DEVICE_POSTINGS_CAP", "0"))
+            except ValueError:
+                pcap = 0
+            buf_dev = DevicePostings(mesh, width=kk + 4,
+                                     cap=pcap if pcap > 0 else n_dev * cap,
+                                     stats=stats)
+            policy = SyncPolicy(sync_every)
+            stats["sync_every"] = policy.sync_every
+
+        def drain_buf() -> None:
+            for r in buf_dev.drain():
+                buffer_rows(r)
+
         for idxs, size in waves:
             chunk = jnp.asarray(_wave_chunk(docs, idxs, n_dev, size))
             # Pad rows of a short last wave carry doc id n_real, which the
@@ -260,27 +324,44 @@ def tfidf_sharded(
             if agg_high or agg_nu > cap or agg_ml > mwl:
                 break  # this rung's results are certain to be discarded
                 # (host fallback or wider retry); more waves = pure waste
-            # Pull only the occupied prefix (max per-device received rows,
-            # pow2-rounded to bound the slice-program count): the D2H bill
-            # tracks this wave's postings, not the worst-case capacity.
             m = int(scal_np[:, 0].max())
             if m == 0:
                 continue
+            if buf_dev is not None:
+                # Append this wave's rows on-device; the host pulls per
+                # K-wave window instead of per wave.
+                if not buf_dev.append(rows, scal):
+                    drain_buf()  # buffer full: early sync, then retry
+                    policy.reset()  # the drain WAS this window's pull —
+                    # without this, due() could fire a second, nearly
+                    # empty pull one wave later
+                    if not buf_dev.append(rows, scal):
+                        # Only reachable when DSI_DEVICE_POSTINGS_CAP was
+                        # forced below one wave's rows — losing the wave
+                        # silently is never acceptable.
+                        raise RuntimeError(
+                            "device postings buffer smaller than one wave"
+                            f" (cap={buf_dev.cap})")
+                policy.note_fold()
+                if policy.due():
+                    drain_buf()
+                    policy.reset()
+                continue
+            # Pull only the occupied prefix (max per-device received rows,
+            # pow2-rounded to bound the slice-program count): the D2H bill
+            # tracks this wave's postings, not the worst-case capacity.
             mp = occupied_prefix(m, rows.shape[1])
             rows_np = np.asarray(rows[:, :mp])
+            stats["step_pulls"] += 1
             for d in range(n_dev):
                 nr = int(scal_np[d, 0])
                 if nr == 0:
                     continue
-                r = rows_np[d, :nr]
-                # Drop the short last wave's padding documents, and — for a
-                # partition slice — other slices' rows, BEFORE buffering:
-                # the filters must cut the per-slice host cost, not just
-                # the final table.
-                r = r[r[:, kk + 2] < n_real]
-                if part_arr is not None:
-                    r = r[np.isin(r[:, kk + 3], part_arr)]
-                table.add(r, kk)
+                buffer_rows(rows_np[d, :nr])
+
+        if buf_dev is not None and not (agg_high or agg_nu > cap
+                                        or agg_ml > mwl):
+            drain_buf()  # end-of-walk sync (a discarded rung skips it)
 
         return (agg_high, agg_nu, agg_ml,
                 table.finalize_packed if packed else table.finalize)

@@ -107,6 +107,22 @@ timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
   > "$OUT/wcstream.log" 2>&1
 log "wcstream rc=$? $(tail -c 160 "$OUT/wcstream.log" | tr '\n' ' ')"
 
+log "wcstream --device-accumulate on the chip (fold table, K=${SYNC_EVERY:-8})"
+# Same corpus and shapes as the step above, with the device-resident
+# accumulator service folding confirmed steps on-chip and pulling only
+# every K steps — --stats records the fold/sync/widen counters so
+# BENCH_r06+ can put stream_phases with and without on-device folding
+# side by side (the amortization story: step_pulls vs sync_pulls).  The
+# fold shapes are pre-warmed by warm_kernels --phase stream
+# (warm_stream_aot(device_accumulate=True)); a drifting --u-cap here
+# would cold-compile a fold inside this timeout.
+mkdir -p "$OUT/wcstream-dacc-wd"
+timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
+  --aot --u-cap 16384 --device-accumulate --sync-every "${SYNC_EVERY:-8}" \
+  --stats --workdir "$OUT/wcstream-dacc-wd" "$OUT"/corpus/pg-*.txt \
+  > "$OUT/wcstream-dacc.log" 2>&1
+log "wcstream-dacc rc=$? $(tail -c 200 "$OUT/wcstream-dacc.log" | tr '\n' ' ')"
+
 log "wcstream ~1 GB on the chip (GB-scale single-device stream)"
 # 1024 x 1 MB generated files; --check would double the wall with a host
 # oracle pass over 1 GB, so this step relies on wcstream's own exactness

@@ -26,6 +26,14 @@ def main() -> int:
     ap.add_argument("--pipeline-depth", type=int, default=None,
                     help="in-flight stream steps (default: "
                          "DSI_STREAM_PIPELINE_DEPTH or 2; 1 = synchronous)")
+    ap.add_argument("--device-accumulate", action="store_true",
+                    help="fold confirmed steps into the device-resident "
+                         "merge table (dsi_tpu/device/); host pulls only "
+                         "every --sync-every steps")
+    ap.add_argument("--sync-every", type=int, default=None,
+                    help="folds between host pulls with "
+                         "--device-accumulate (default: "
+                         "DSI_STREAM_SYNC_EVERY or 8)")
     args = ap.parse_args()
 
     import jax
@@ -60,6 +68,8 @@ def main() -> int:
     acc = wordcount_streaming(blocks(), mesh=mesh, n_reduce=10,
                               chunk_bytes=args.chunk_bytes,
                               depth=args.pipeline_depth,
+                              device_accumulate=args.device_accumulate,
+                              sync_every=args.sync_every,
                               pipeline_stats=pstats)
     dt = time.perf_counter() - t0
     assert acc is not None

@@ -160,11 +160,17 @@ def main() -> int:
         mesh = default_mesh()
         # bench.py's stream row shape (STREAM_CHUNK_BYTES/STREAM_U_CAP):
         # 2 MiB chunks, 2^15 start capacity + one x4 widening.
+        # device_accumulate also warms the fold/clear/pack programs so a
+        # DSI_BENCH_STREAM_DEVICE_ACC=1 row passes the persisted gate.
         warm_stream_aot(mesh=mesh, chunk_bytes=1 << 21,
-                        caps=(1 << 15, 1 << 17))
+                        caps=(1 << 15, 1 << 17), device_accumulate=True)
         # wcstream --check's shape (onchip_evidence.sh pins --u-cap 16384).
+        # device_accumulate warms the fold/clear/pack programs of the
+        # device-resident accumulator service (dsi_tpu/device/) alongside
+        # — the evidence script's --device-accumulate step must load,
+        # never cold-compile, exactly like the step programs.
         warm_stream_aot(mesh=mesh, chunk_bytes=1 << 20,
-                        caps=(1 << 14, 1 << 16))
+                        caps=(1 << 14, 1 << 16), device_accumulate=True)
         # The GB-scale on-chip stream (onchip_evidence.sh step 9) uses
         # 4 MiB chunks so per-step wire latency amortizes over 4x the
         # bytes.  Warm one rung past the corpus's measured worst chunk

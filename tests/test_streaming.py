@@ -338,6 +338,12 @@ def test_stream_programs_persisted_probe_mirrors_warm(tmp_path):
         "assert stream_programs_persisted(**kw)\n"
         "assert not stream_programs_persisted(chunk_bytes=1 << 15,\n"
         "                                     u_cap=1 << 10)\n"
+        "# Device-accumulate extension: the fold programs are extra keys\n"
+        "# (the step warm above must NOT satisfy the stricter probe).\n"
+        "assert not stream_programs_persisted(device_accumulate=True, **kw)\n"
+        "warm_stream_aot(chunk_bytes=1 << 14, caps=(1 << 10,),\n"
+        "                device_accumulate=True)\n"
+        "assert stream_programs_persisted(device_accumulate=True, **kw)\n"
         "print('probe-ok')\n"
     )
     env = dict(os.environ)
