@@ -24,6 +24,19 @@ kernel fallbacks in ``backends/tpu.py``).
 The reference has no compilation step at all (Go builds AOT by nature);
 this is the TPU-native moral equivalent of shipping compiled binaries
 (``main/test-mr.sh:19-22`` builds once per run, not once per process).
+
+Entry-name families (the human-readable prefix of each ``.aot`` file —
+the key itself also hashes platform/source/shapes/statics/donation):
+``wc_kernel*`` and ``corpus_wc*`` single-chunk programs,
+``stream_step_*``/``stream_pack_*`` streaming programs,
+``tfidf_wave_*`` the pipelined TF-IDF wave step, ``dacc_*`` the device
+accumulator's fold/clear/pack.  Grouper variants append
+``ops.wordcount.grouper_suffix``: bare names are the sort grouper,
+``*_hg`` the hash grouper — both ride the warm ladder
+(``scripts/warm_kernels.py``), so ``DSI_WC_GROUPER=hash`` runs load on
+any platform.  Donation changes the key (aliasing config), so the
+kernel-only bench row's non-donated ``stream_step_*`` entries coexist
+with the pipeline's donated ones.
 """
 
 from __future__ import annotations

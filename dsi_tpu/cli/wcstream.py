@@ -14,7 +14,7 @@ Usage:
     python -m dsi_tpu.cli.wcstream [--nreduce N] [--chunk-bytes B]
         [--devices D] [--workdir DIR] [--check] [--aot] [--u-cap U]
         [--pipeline-depth D] [--device-accumulate] [--sync-every K]
-        [--stats] inputfiles...
+        [--grouper sort|hash] [--stats] inputfiles...
 """
 
 from __future__ import annotations
@@ -66,10 +66,19 @@ def main(argv=None) -> int:
                    help="folds between host pulls with "
                         "--device-accumulate (default: "
                         "DSI_STREAM_SYNC_EVERY or 8)")
+    p.add_argument("--grouper", choices=("sort", "hash"), default=None,
+                   help="pin the kernel's token-grouping strategy "
+                        "(DSI_WC_GROUPER): 'hash' is the measured ~1.8x "
+                        "kernel win the warm ladder now pre-compiles for "
+                        "accelerators too (*_hg AOT entries); sort stays "
+                        "the always-exact fallback rung either way")
     p.add_argument("--stats", action="store_true",
                    help="print the pipeline_stats dict (phase walls + "
                         "fold/sync/widen counters) to stderr")
     args = p.parse_args(argv)
+
+    if args.grouper:
+        os.environ["DSI_WC_GROUPER"] = args.grouper
 
     from dsi_tpu.utils.platformpin import pin_platform_from_env
 

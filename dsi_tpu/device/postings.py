@@ -11,20 +11,36 @@ valid rows into a persistent on-device buffer with a compiled scatter
 once per K waves (``device/policy.py`` cadence) or when the buffer
 fills.
 
-Unlike the merge table there is no capacity *ladder*: a drain empties
-the buffer, and the capacity is chosen >= one wave's worst-case row
-count (``n_dev * u_cap``), so an append that overflows simply drains
-and retries — overflow is an early sync, never a loss.  The commit is
-still all-or-nothing across devices (``pmax`` on the overflow bit) so a
-drained-and-retried wave cannot double-append its already-committed
-shards.
+Append flags are confirmed ``lag`` appends late (the wave walk passes
+its pipeline depth, ``parallel/pipeline.py``): blocking on an append's
+tiny flags pull the moment it is dispatched would wait out every wave
+kernel queued behind it on the in-order device stream — the
+serialization the pipeline window exists to avoid.  Late detection is
+safe because overflow is ORDER-PRESERVING: an append that overflows is
+a global no-op that also sets a sticky ``dirty`` bit in device state,
+so every LATER append no-ops too until the host drains — recovery
+drains the committed prefix (strictly the waves before the first
+overflow), resets, and re-appends the orphaned waves oldest-first.
+Wave order in the per-device row streams is therefore an invariant,
+which is what keeps the accumulated postings (``merge.PostingsTable``
+preserves insertion order within a word) bit-identical to the per-wave
+pull path.
+
+Unlike the merge table the capacity has no standing *ladder*: a drain
+empties the buffer, so overflow is normally just an early sync.  The
+one exception — a single wave with more valid rows than the whole
+buffer (a forced-tiny ``DSI_DEVICE_POSTINGS_CAP``, or a mid-walk
+capacity-rung widening) — reallocates the empty buffer at the wave's
+row count instead of failing: overflow is an early sync or a widen,
+never a loss.
 """
 
 from __future__ import annotations
 
+import collections
 import functools
 import time
-from typing import List, Optional
+from typing import Callable, Deque, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,13 +52,17 @@ from dsi_tpu.parallel.shuffle import AXIS, occupied_prefix
 from dsi_tpu.utils.jaxcompat import shard_map
 
 
-def _append_device(buf, n, rows, scal, *, cap: int, width: int):
+def _append_device(buf, n, dirty, rows, scal, *, cap: int, width: int):
     """Per-device body: scatter this wave's valid rows at the write
     offset.  Rows beyond the wave's valid count and rows past the
     capacity land on the dump row / out of bounds (dropped — identical
-    either way because an overflowing append keeps the OLD buffer)."""
+    either way because a no-op'd append keeps the OLD buffer).  The
+    ``dirty`` bit is the sticky overflow shadow: once any append
+    no-ops, every later append no-ops too, so the committed buffer is
+    always an order-exact prefix of the appended waves."""
     buf = buf.reshape(cap, width)
     n0 = n.reshape(())
+    d0 = dirty.reshape(())
     r = rows.shape[-2]
     rows = rows.reshape(r, width)
     nr = scal.reshape(-1)[0]
@@ -53,26 +73,30 @@ def _append_device(buf, n, rows, scal, *, cap: int, width: int):
     new_buf = target.at[idx].set(rows)[:cap]
     new_n = n0 + nr
     ov = lax.pmax((new_n > cap).astype(jnp.int32), AXIS)
-    keep_old = ov > 0
+    # Commit is all-or-nothing across devices (pmax) AND across waves
+    # (sticky dirty): a mixed commit would break either the exactly-once
+    # guarantee or the wave order of the per-device row streams.
+    no_op = jnp.maximum(ov, d0)
+    keep_old = no_op > 0
     out_buf = jnp.where(keep_old, buf, new_buf)
     out_n = jnp.where(keep_old, n0, new_n)
-    flags = jnp.stack([ov, out_n])
-    return out_buf[None], out_n[None], flags[None]
+    flags = jnp.stack([no_op, out_n])
+    return out_buf[None], out_n[None], no_op[None], flags[None]
 
 
-def _append_impl(buf, n, rows, scal, *, mesh: Mesh):
+def _append_impl(buf, n, dirty, rows, scal, *, mesh: Mesh):
     cap, width = buf.shape[1], buf.shape[2]
     body = functools.partial(_append_device, cap=cap, width=width)
     return shard_map(
         body, mesh=mesh,
-        in_specs=(P(AXIS, None, None), P(AXIS), P(AXIS, None, None),
-                  P(AXIS, None)),
-        out_specs=(P(AXIS, None, None), P(AXIS), P(AXIS, None)),
-    )(buf, n, rows, scal)
+        in_specs=(P(AXIS, None, None), P(AXIS), P(AXIS),
+                  P(AXIS, None, None), P(AXIS, None)),
+        out_specs=(P(AXIS, None, None), P(AXIS), P(AXIS), P(AXIS, None)),
+    )(buf, n, dirty, rows, scal)
 
 
 _append_step = jax.jit(_append_impl, static_argnames=("mesh",),
-                       donate_argnums=(0, 1))
+                       donate_argnums=(0, 1, 2))
 
 
 @functools.partial(jax.jit, static_argnames=("mp",))
@@ -82,75 +106,163 @@ def _buf_prefix(buf, *, mp: int):
 
 class DevicePostings:
     """Persistent ``[n_dev, cap, width]`` uint32 append buffer over the
-    mesh.  ``append`` scatters one wave's rows (synchronously checked —
-    the wave walk already blocks on its scalars each wave, so the tiny
-    flags pull costs nothing extra); ``drain`` pulls the occupied prefix
-    and hands each device's rows to the caller, then resets.
+    mesh.  ``append`` scatters one wave's rows asynchronously; its flags
+    are confirmed ``lag`` appends late.  Drains hand each device's
+    occupied rows to ``sink`` (one callback per device, wave order
+    preserved) — triggered by ``sync`` (the K-wave cadence), ``close``
+    (end of walk), or overflow recovery.
 
     ``stats``, if given, receives ``appends``, ``append_overflows``,
-    ``sync_pulls``, ``append_s``, ``drain_s``.
+    ``sync_pulls``, ``postings_widens``, ``append_s``, ``drain_s``.
     """
 
     def __init__(self, mesh: Mesh, *, width: int, cap: int,
-                 stats: Optional[dict] = None):
+                 sink: Callable[[np.ndarray], None],
+                 lag: int = 0, stats: Optional[dict] = None):
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
         self.width = int(width)
         self.cap = 1 << max(0, int(cap) - 1).bit_length()
+        self.sink = sink
+        self.lag = max(0, int(lag))
         self.stats = stats if stats is not None else {}
-        for key in ("appends", "append_overflows", "sync_pulls"):
+        for key in ("appends", "append_overflows", "sync_pulls",
+                    "postings_widens"):
             self.stats.setdefault(key, 0)
         for key in ("append_s", "drain_s"):
             self.stats.setdefault(key, 0.0)
-        sh3 = NamedSharding(mesh, P(AXIS, None, None))
-        sh1 = NamedSharding(mesh, P(AXIS))
-        self._buf = jax.device_put(
-            np.zeros((self.n_dev, self.cap, self.width), np.uint32), sh3)
-        self._n = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
+        self._alloc(self.cap)
         self._nrows = np.zeros(self.n_dev, dtype=np.int64)
+        # (flags, rows_dev, scal_dev) per unconfirmed append — the wave
+        # tensors stay referenced until their append is proven committed,
+        # so a no-op'd append can be replayed after the drain.
+        self._pending: Deque[Tuple] = collections.deque()
 
-    def append(self, rows_dev, scal_dev) -> bool:
-        """Append one wave's valid rows.  Returns False when the buffer
-        was full (a global no-op): the caller drains and retries — which
-        always succeeds, because ``cap`` >= one wave's row count."""
+    def _alloc(self, cap: int) -> None:
+        sh3 = NamedSharding(self.mesh, P(AXIS, None, None))
+        sh1 = NamedSharding(self.mesh, P(AXIS))
+        self._buf = jax.device_put(
+            np.zeros((self.n_dev, cap, self.width), np.uint32), sh3)
+        self._n = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
+        self._dirty = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
+
+    # ── the append path ──
+
+    def _dispatch(self, rows_dev, scal_dev):
+        self._buf, self._n, self._dirty, flags = _append_step(
+            self._buf, self._n, self._dirty, rows_dev, scal_dev,
+            mesh=self.mesh)
+        return flags
+
+    def append(self, rows_dev, scal_dev) -> None:
+        """Append one wave's valid rows (async) and lazily confirm
+        appends older than ``lag``.  ``rows_dev`` is the wave's sorted
+        received-row tensor ``[n_dev, r, width]``; ``scal_dev`` the
+        per-device scalar block whose column 0 is the valid row count
+        (already host-confirmed exact by the caller)."""
         t0 = time.perf_counter()
-        self._buf, self._n, flags = _append_step(
-            self._buf, self._n, rows_dev, scal_dev, mesh=self.mesh)
-        flags_np = np.asarray(flags)
-        self._nrows = flags_np[:, 1].astype(np.int64)
-        overflowed = bool(flags_np[:, 0].any())
-        if overflowed:
-            self.stats["append_overflows"] += 1
-        else:
-            self.stats["appends"] += 1
+        flags = self._dispatch(rows_dev, scal_dev)
+        self._pending.append((flags, rows_dev, scal_dev))
+        while len(self._pending) > self.lag:
+            self._confirm_oldest()
         self.stats["append_s"] += time.perf_counter() - t0
-        return not overflowed
+
+    def _confirm_oldest(self) -> None:
+        flags, rows_dev, scal_dev = self._pending.popleft()
+        flags_np = np.asarray(flags)  # blocks until this append lands
+        if flags_np[:, 0].any():
+            self.stats["append_overflows"] += 1
+            self._recover([(rows_dev, scal_dev)])
+        else:
+            self._nrows = flags_np[:, 1].astype(np.int64)
+            self.stats["appends"] += 1
+
+    def _flush_pending(self) -> list:
+        """Confirm every outstanding append; return the (rows, scal)
+        pairs that no-op'd, oldest first."""
+        orphans = []
+        while self._pending:
+            flags, rows_dev, scal_dev = self._pending.popleft()
+            flags_np = np.asarray(flags)
+            if flags_np[:, 0].any():
+                self.stats["append_overflows"] += 1
+                orphans.append((rows_dev, scal_dev))
+            else:
+                self._nrows = flags_np[:, 1].astype(np.int64)
+                self.stats["appends"] += 1
+        return orphans
+
+    def _recover(self, orphans: list) -> None:
+        """An append no-op'd.  Every append dispatched after it no-op'd
+        too (the sticky dirty bit), so flushing collects the orphans in
+        dispatch order: drain the committed prefix, then re-append the
+        orphans oldest-first — wave order in the sink is preserved by
+        construction."""
+        orphans = orphans + self._flush_pending()
+        self._drain()
+        for rows_dev, scal_dev in orphans:
+            flags_np = np.asarray(self._dispatch(rows_dev, scal_dev))
+            if flags_np[:, 0].any():
+                # Cumulative overflow mid-recovery (earlier orphans
+                # refilled the buffer): drain what fit — in order — and
+                # retry into the empty buffer at the CURRENT cap first.
+                self._drain()
+                flags_np = np.asarray(self._dispatch(rows_dev, scal_dev))
+            if flags_np[:, 0].any():
+                # Only now is this provably a lone wave larger than the
+                # whole empty buffer (forced-tiny cap, or a capacity-rung
+                # widening mid-walk): grow the buffer to hold it —
+                # overflow widens, it never drops.  _alloc resets the
+                # sticky dirty bit along with the rest of the state.
+                new_cap = max(4 * self.cap, int(rows_dev.shape[-2]))
+                self.cap = 1 << max(0, new_cap - 1).bit_length()
+                self._alloc(self.cap)
+                self._nrows[:] = 0
+                self.stats["postings_widens"] += 1
+                flags_np = np.asarray(self._dispatch(rows_dev, scal_dev))
+                if flags_np[:, 0].any():  # cap >= rows: cannot happen
+                    raise RuntimeError(
+                        "device postings buffer smaller than one wave"
+                        f" (cap={self.cap})")
+            self._nrows = flags_np[:, 1].astype(np.int64)
+            self.stats["appends"] += 1
 
     @property
     def pending_rows(self) -> int:
         return int(self._nrows.sum())
 
-    def drain(self) -> List[np.ndarray]:
-        """Pull every device's occupied rows (ONE sliced transfer for
-        the whole buffer) and reset the buffer.  Returns one
-        ``[n_d, width]`` uint32 array per device — the caller applies
-        its own filters (padding docs, partition slices) before
-        accumulating, exactly as it did on the per-wave pull path."""
+    # ── drains ──
+
+    def _drain(self) -> None:
+        """Pull every device's committed rows (ONE sliced transfer for
+        the whole buffer), hand them to the sink, reset.  The reset
+        re-uploads only the two tiny per-device scalars; buffer bytes
+        beyond the write offset are never read and can stay stale."""
         t0 = time.perf_counter()
-        out: List[np.ndarray] = []
         m = int(self._nrows.max())
-        if m == 0:
-            self.stats["drain_s"] += time.perf_counter() - t0
-            return [np.zeros((0, self.width), np.uint32)] * self.n_dev
-        mp = occupied_prefix(m, self.cap)
-        pulled = np.asarray(_buf_prefix(self._buf, mp=mp))
-        for d in range(self.n_dev):
-            out.append(pulled[d, :int(self._nrows[d])])
-        self.stats["sync_pulls"] += 1
-        # Reset is host-side bookkeeping only: rows beyond the write
-        # offset are never read, so the buffer bytes can stay stale.
+        if m:
+            mp = occupied_prefix(m, self.cap)
+            pulled = np.asarray(_buf_prefix(self._buf, mp=mp))
+            for d in range(self.n_dev):
+                nr = int(self._nrows[d])
+                if nr:
+                    self.sink(pulled[d, :nr])
+            self.stats["sync_pulls"] += 1
         sh1 = NamedSharding(self.mesh, P(AXIS))
         self._n = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
+        self._dirty = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
         self._nrows[:] = 0
         self.stats["drain_s"] += time.perf_counter() - t0
-        return out
+
+    def sync(self) -> None:
+        """The K-wave host pull: flush the append lag (recovering any
+        late-detected overflow), then drain to the sink."""
+        orphans = self._flush_pending()
+        if orphans:
+            self._recover(orphans)
+        self._drain()
+
+    def close(self) -> None:
+        """End-of-walk drain; the buffer is dropped with the service."""
+        self.sync()
+        self._buf = None

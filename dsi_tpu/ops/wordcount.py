@@ -422,20 +422,46 @@ def default_grouper() -> str:
     """Platform-adaptive grouping strategy: ``hash`` on the CPU backend
     (where the multi-key sort is the measured kernel floor — BASELINE.md
     round 5), ``sort`` on accelerators until on-chip evidence says
-    otherwise.  ``DSI_WC_GROUPER`` pins the choice."""
+    otherwise.  ``DSI_WC_GROUPER`` pins the choice — and because the warm
+    ladder persists BOTH variants (``warm_groupers`` below, the ``*_hg``
+    AOT entries), pinning ``hash`` on an accelerator is a warm load, not
+    a cold remote compile."""
     env = os.environ.get("DSI_WC_GROUPER")
     if env in ("sort", "hash"):
         return env
     return "hash" if jax.devices()[0].platform == "cpu" else "sort"
 
 
+def grouper_suffix(grouper: str) -> str:
+    """AOT program-name suffix for a grouper variant: the sort grouper
+    keeps its historical bare names (pre-existing cache entries stay
+    valid), the hash grouper gets ``_hg``.  One definition shared by
+    every program namer (``wc_kernel`` here, ``stream_step_*`` in
+    parallel/streaming.py, ``tfidf_wave_*`` in parallel/tfidf.py) so the
+    warm ladder, the persisted probes, and the runs agree on the key by
+    construction."""
+    if grouper == "sort":
+        return ""
+    return "_hg" if grouper == "hash" else f"_g{grouper}"
+
+
+def warm_groupers() -> tuple:
+    """The grouper variants the warm AOT ladder compiles+persists for
+    every program family: both rungs, on every platform.  Distinct from
+    :func:`grouper_ladder` (the rungs ONE run walks, platform/env
+    dependent): warming only the ladder would leave an env-selected
+    ``DSI_WC_GROUPER=hash`` accelerator run cold exactly where a remote
+    compile costs minutes (VERDICT r5 weak #3)."""
+    return ("hash", "sort")
+
+
 def grouper_ladder() -> tuple:
     """The retry rungs every kernel wrapper walks: the platform's
     preferred grouper first, with the sort grouper as the always-exact
     last rung (a hash-grouper collision overflow cannot clear at frac=2;
-    the sort can never overflow there).  One definition so the three
-    wrappers (here, parallel/shuffle.py, parallel/streaming.py) cannot
-    drift."""
+    the sort can never overflow there).  One definition so the four
+    wrappers (here, parallel/shuffle.py, parallel/streaming.py,
+    parallel/tfidf.py) cannot drift."""
     g0 = default_grouper()
     return (g0, "sort") if g0 != "sort" else ("sort",)
 
@@ -451,10 +477,11 @@ def _cached_kernel(n: int, max_word_len: int, u_cap: int, t_cap_frac: int,
     lru_cached so repeat dispatches skip the cache-key fingerprinting.
 
     The ``grouper`` static enters the key/name only for the hash variant
-    — purely so sort-grouper cache filenames keep their historical,
-    readable names.  (It is NOT a warm-cache-survival guarantee: the key
-    also fingerprints this module's source, so any kernel edit misses
-    and recompiles regardless.)"""
+    (``grouper_suffix``: ``wc_kernel_hg``) — purely so sort-grouper
+    cache filenames keep their historical, readable names.  (It is NOT a
+    warm-cache-survival guarantee: the key also fingerprints this
+    module's source, so any kernel edit misses and recompiles
+    regardless.)"""
     from dsi_tpu.backends.aotcache import cached_compile
 
     example = (jax.ShapeDtypeStruct((n,), np.uint8),)
@@ -463,7 +490,7 @@ def _cached_kernel(n: int, max_word_len: int, u_cap: int, t_cap_frac: int,
     name = "wc_kernel"
     if grouper != "sort":
         static["grouper"] = grouper
-        name = f"wc_kernel_{grouper}"
+        name += grouper_suffix(grouper)
     return cached_compile(name, tokenize_group_core, example,
                           static=static, x64=True)
 

@@ -1,0 +1,224 @@
+"""Reusable dispatch/finish pipeline core for device-step engines.
+
+The PR-1 streaming word-count engine earned its throughput from four
+mechanics that have nothing to do with word counting: a background
+producer thread feeding a bounded queue (host item construction off the
+critical path), a ``depth``-deep in-flight window (dispatch step k+1
+before step k synchronizes), deferred per-step checks (a step's flags
+are read only when it leaves the window, ``depth-1`` steps late), and a
+small rotating host buffer pool (O(depth) allocations however long the
+stream).  The TF-IDF wave walk has exactly the same cost shape — build
+wave, upload, kernel, scalar check, pull, merge, every wave on the
+critical path — so this module extracts the mechanics into one core
+both engines consume (``parallel/streaming.py``,
+``parallel/tfidf.py``).
+
+The core is deliberately ignorant of devices and results: ``dispatch``
+launches whatever async work one item needs and returns an opaque
+record; ``finish`` retires the OLDEST in-flight record — that is where
+a consumer blocks on flags, replays an overflowed step through its
+exactness ladder, and merges confirmed output.  The window invariant
+the core owns: records finish in dispatch order, a record finishes
+exactly once, and at most ``depth`` records are ever in flight.
+``depth=1`` degenerates to the fully synchronous loop — no thread, no
+queue, dispatch-then-finish — which is why a consumer's pipelined and
+lockstep paths are the same function and can be compared bit-for-bit.
+
+Exceptions propagate both ways: a producer error re-raises in the
+consumer thread (stop-aware, so it cannot be lost while the consumer
+sits in a long replay), and a consumer exception unwinds through
+``run`` with the producer thread shut down and its queue drained.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+import time
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def pipeline_depth(depth: Optional[int] = None) -> int:
+    """Resolve an engine's in-flight window: an explicit ``depth`` wins,
+    else ``DSI_STREAM_PIPELINE_DEPTH`` (default 2), floored at 1 (the
+    synchronous path).  One resolver for every pipeline consumer, so the
+    stream and the wave walk cannot read the knob differently."""
+    if depth is None:
+        try:
+            depth = int(os.environ.get("DSI_STREAM_PIPELINE_DEPTH", "2"))
+        except ValueError:
+            depth = 2
+    return max(1, depth)
+
+
+class BufferPool:
+    """Small rotating pool of reusable fixed-shape host buffers.
+
+    ``take`` hands out a free buffer, allocating only when the pool is
+    dry (startup, or the consumer still holds every buffer in its
+    in-flight window); ``give`` returns one for reuse.  Never blocks —
+    the pipeline's bounded queue provides the backpressure; the pool
+    only removes the per-item ``np.zeros`` allocation + page-fault churn
+    from the steady state.  ``allocs`` counts real allocations, so a
+    caller can assert reuse (a stream of any length allocates O(depth)
+    buffers).
+    """
+
+    def __init__(self, shape: Sequence[int], retain: int,
+                 dtype=np.uint8):
+        self._shape = tuple(shape)
+        self._dtype = dtype
+        self._free: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._retain = retain
+        self.allocs = 0
+
+    def take(self) -> np.ndarray:
+        with self._lock:
+            if self._free:
+                return self._free.popleft()
+            self.allocs += 1
+        return np.zeros(self._shape, dtype=self._dtype)
+
+    def give(self, buf: Optional[np.ndarray]) -> None:
+        if buf is None or buf.shape != self._shape:
+            return
+        with self._lock:
+            if len(self._free) < self._retain:
+                self._free.append(buf)
+
+
+class StepPipeline:
+    """``depth``-deep dispatch/finish window over a produced item stream.
+
+    ``dispatch(item)`` launches one step's async work and returns an
+    opaque in-flight record (or None to skip the item); ``finish(record)``
+    retires the oldest record — deferred flag check, replay, merge all
+    live in the consumer.  ``stats`` receives ``produce_key`` (seconds
+    building items — in the producer thread at depth > 1, inline at
+    depth 1), ``wait_key`` (consumer starvation on the queue) and
+    ``inflight_key`` (peak window occupancy, bounded by ``depth``).
+    """
+
+    def __init__(self, *, depth: int,
+                 dispatch: Callable, finish: Callable,
+                 stats: dict,
+                 produce_key: str = "batch_s",
+                 wait_key: str = "batch_wait_s",
+                 inflight_key: str = "max_inflight_chunks",
+                 thread_name: str = "dsi-pipeline-producer"):
+        self.depth = max(1, int(depth))
+        self._dispatch = dispatch
+        self._finish = finish
+        self._stats = stats
+        self._produce_key = produce_key
+        self._wait_key = wait_key
+        self._inflight_key = inflight_key
+        self._thread_name = thread_name
+        stats.setdefault(produce_key, 0.0)
+        stats.setdefault(wait_key, 0.0)
+        stats.setdefault(inflight_key, 0)
+
+    # ── item feed: inline at depth=1, background thread otherwise ──
+
+    def _producer(self, make_items: Callable[[], Iterator],
+                  out_q: queue.Queue, stop: threading.Event) -> None:
+        gen = make_items()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    break
+                self._stats[self._produce_key] += time.perf_counter() - t0
+                while not stop.is_set():
+                    try:
+                        out_q.put(("item", item), timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            out_q.put(("done", None))
+        except BaseException as e:  # surfaced to the consumer thread
+            # Stop-aware retry, like the item put above: a fixed timeout
+            # could drop the error while the consumer sits in a long
+            # replay (minutes on a tunneled compile), leaving it blocked
+            # forever on a queue that will never produce the sentinel.
+            while not stop.is_set():
+                try:
+                    out_q.put(("err", e), timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
+    def _feed(self, make_items, out_q, stop,
+              started: list) -> Iterator:
+        if self.depth == 1:
+            gen = make_items()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    return
+                self._stats[self._produce_key] += time.perf_counter() - t0
+                yield item
+            return
+        thread = threading.Thread(
+            target=self._producer, args=(make_items, out_q, stop),
+            daemon=True, name=self._thread_name)
+        started.append(thread)
+        thread.start()
+        while True:
+            t0 = time.perf_counter()
+            kind, item = out_q.get()
+            self._stats[self._wait_key] += time.perf_counter() - t0
+            if kind == "done":
+                return
+            if kind == "err":
+                raise item
+            yield item
+
+    # ── the window ──
+
+    def run(self, make_items: Callable[[], Iterator]) -> None:
+        """Drive the full pipeline over ``make_items()``'s items: keep up
+        to ``depth`` dispatched records in flight, finish each in FIFO
+        order as the window fills, drain the window at stream end.  Any
+        exception (producer or consumer) unwinds with the producer thread
+        stopped and its queue drained."""
+        pending: collections.deque = collections.deque()
+        stop = threading.Event()
+        out_q: queue.Queue = queue.Queue(maxsize=self.depth + 1)
+        started: list = []
+        try:
+            for item in self._feed(make_items, out_q, stop, started):
+                rec = self._dispatch(item)
+                if rec is None:
+                    continue
+                pending.append(rec)
+                if len(pending) > self._stats[self._inflight_key]:
+                    self._stats[self._inflight_key] = len(pending)
+                if len(pending) >= self.depth:
+                    self._finish(pending.popleft())
+            while pending:
+                self._finish(pending.popleft())
+        finally:
+            if started:
+                stop.set()
+                thread = started[0]
+                # Unblock a producer stuck on a full queue; bounded — a
+                # producer mid-build exits at its next stop check.
+                deadline = time.monotonic() + 5.0
+                while (thread.is_alive()
+                       and time.monotonic() < deadline):
+                    try:
+                        out_q.get_nowait()
+                    except queue.Empty:
+                        thread.join(0.05)

@@ -84,14 +84,17 @@ surfaces a widen signal; the service drains the table to the host
 accumulator, reallocates at the next rung, and re-folds the orphaned
 steps (their packed tensors are kept alive until their fold confirms,
 exactly for this).
+
+The window/producer/pool mechanics themselves live in the shared
+dispatch/finish pipeline core (``parallel/pipeline.py``); this module
+supplies the word-count-specific dispatch (sticky-rung step launch) and
+finish (deferred exactness check, merge-or-replay) callbacks.  The
+TF-IDF wave walk (``parallel/tfidf.py``) consumes the same core.
 """
 
 from __future__ import annotations
 
-import collections
 import os
-import queue
-import threading
 import time
 from typing import Dict, Iterable, Iterator, Optional, Sequence, Tuple
 
@@ -103,12 +106,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from dsi_tpu.device.policy import SyncPolicy
 from dsi_tpu.device.table import DeviceTable, _quiet_unusable_donation
 from dsi_tpu.ops.wordcount import (
-    default_grouper,
     exactness_retry,
     grouper_ladder,
     rung0_cap,
+    warm_groupers,
 )
 from dsi_tpu.parallel.merge import PackedCounts
+from dsi_tpu.parallel.pipeline import (
+    BufferPool,
+    StepPipeline,
+    pipeline_depth,
+)
 from dsi_tpu.parallel.shuffle import (
     AXIS,
     _is_letter_byte,
@@ -161,41 +169,8 @@ def _cut_at_boundary(buf, size: int) -> int:
     raise _TokenTooLong
 
 
-class _BufferPool:
-    """Small rotating pool of reusable ``[n_dev, chunk_bytes]`` host batch
-    buffers.  ``take`` hands out a free buffer, allocating only when the
-    pool is dry (startup, or the consumer still holds every buffer in its
-    in-flight window); ``give`` returns one for reuse.  Never blocks —
-    the pipeline's bounded queue provides the backpressure; the pool only
-    removes the per-batch ``np.zeros`` allocation + page-fault churn from
-    the steady state.  ``allocs`` counts real allocations, so a caller
-    can assert reuse (a stream of any length allocates O(depth) buffers).
-    """
-
-    def __init__(self, n_dev: int, chunk_bytes: int, retain: int):
-        self._shape = (n_dev, chunk_bytes)
-        self._free: collections.deque = collections.deque()
-        self._lock = threading.Lock()
-        self._retain = retain
-        self.allocs = 0
-
-    def take(self) -> np.ndarray:
-        with self._lock:
-            if self._free:
-                return self._free.popleft()
-            self.allocs += 1
-        return np.zeros(self._shape, dtype=np.uint8)
-
-    def give(self, buf: Optional[np.ndarray]) -> None:
-        if buf is None or buf.shape != self._shape:
-            return
-        with self._lock:
-            if len(self._free) < self._retain:
-                self._free.append(buf)
-
-
 def batch_stream(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
-                 pool: Optional[_BufferPool] = None) -> Iterator[np.ndarray]:
+                 pool: Optional[BufferPool] = None) -> Iterator[np.ndarray]:
     """Slice a byte-block stream into zero-padded [n_dev, chunk_bytes]
     batches, cutting rows only at non-letter boundaries.
 
@@ -262,18 +237,6 @@ def stream_files(paths: Sequence[str],
                 yield b
 
 
-def pipeline_depth(depth: Optional[int] = None) -> int:
-    """Resolve the stream's in-flight window: an explicit ``depth`` wins,
-    else ``DSI_STREAM_PIPELINE_DEPTH`` (default 2), floored at 1 (the
-    synchronous path)."""
-    if depth is None:
-        try:
-            depth = int(os.environ.get("DSI_STREAM_PIPELINE_DEPTH", "2"))
-        except ValueError:
-            depth = 2
-    return max(1, depth)
-
-
 def _step_program(*, n_dev: int, n_reduce: int, max_word_len: int,
                   u_cap: int, mesh: Mesh, t_cap_frac: int,
                   grouper: str = "sort"):
@@ -281,9 +244,12 @@ def _step_program(*, n_dev: int, n_reduce: int, max_word_len: int,
     ``mapreduce_step`` shape — single definition shared by the
     cached-compile path, the warmer, and the cache-existence probe, so a
     probe's key is by construction the key a run compiles.  The sort
-    grouper keeps its historical, readable name; the hash grouper gets a
-    distinct suffix.  (Naming only — cache invalidation is governed by
-    the source fingerprint, so kernel edits recompile either way.)"""
+    grouper keeps its historical, readable name; the hash grouper gets
+    the ``_hg`` suffix (``ops.wordcount.grouper_suffix`` — the warm
+    ladder persists BOTH variants, so an env-selected hash run loads
+    instead of cold-compiling).  (Naming only — cache invalidation is
+    governed by the source fingerprint, so kernel edits recompile either
+    way.)"""
     import dsi_tpu.ops.wordcount as _wc
     import dsi_tpu.parallel.shuffle as _sh
 
@@ -296,12 +262,11 @@ def _step_program(*, n_dev: int, n_reduce: int, max_word_len: int,
     fn._aot_code_deps = (_wc, _sh)
     name = (f"stream_step_d{n_dev}_r{n_reduce}_w{max_word_len}"
             f"_u{u_cap}_f{t_cap_frac}")
-    if grouper != "sort":
-        name += f"_g{grouper}"
+    name += _wc.grouper_suffix(grouper)
     return name, fn
 
 
-def _aot_step_fn(example_chunks, **kw):
+def _aot_step_fn(example_chunks, donate: bool = True, **kw):
     """Compiled ``mapreduce_step`` via the persistent AOT executable cache
     (``backends/aotcache.py``) — for single-device bench processes on the
     axon platform, where a fresh-process ``jax.jit`` pays a remote compile
@@ -309,14 +274,18 @@ def _aot_step_fn(example_chunks, **kw):
     #1a).  Multi-device meshes compile in-process (the cache auto-disables
     disk persistence there).  ``example_chunks`` may be a
     ``ShapeDtypeStruct`` (warming compiles without executing).  The chunk
-    argument is donated (the pipeline re-uploads per attempt)."""
+    argument is donated (the pipeline re-uploads per attempt) unless
+    ``donate=False`` — the kernel-only bench row's variant, whose
+    HBM-resident chunk must survive every rep (a distinct cache key:
+    donation is part of the executable's aliasing config)."""
     from dsi_tpu.backends import aotcache
 
     name, fn = _step_program(**kw)
     with _quiet_unusable_donation():  # a cold entry compiles right here
-        return aotcache.cached_compile(name, fn, (example_chunks,),
-                                       donate_argnums=_STEP_DONATE,
-                                       x64=True)
+        return aotcache.cached_compile(
+            name, fn, (example_chunks,),
+            donate_argnums=_STEP_DONATE if donate else (),
+            x64=True)
 
 
 def _aot_step(chunks, **kw):
@@ -435,11 +404,12 @@ def warm_stream_aot(mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
-    # Warm the platform's preferred grouper alongside the always-available
-    # sort rung (ops/wordcount.default_grouper): on the chip that is sort
-    # only (names unchanged — the warmed executables stay valid); on CPU
-    # the hash grouper is the first rung a run reaches.
-    groupers = {"sort", default_grouper()}
+    # Warm BOTH groupers on every platform (ops/wordcount.warm_groupers):
+    # the hash grouper is promoted into the accelerator warm ladder as
+    # ``*_hg`` entries, so a DSI_WC_GROUPER=hash run on the chip loads a
+    # serialized executable instead of paying the remote cold compile —
+    # sort stays the always-exact fallback rung either way.
+    groupers = warm_groupers()
     for mwl in word_lens:
         for cap in caps:
             chunks, rows, pack_args = _stream_examples(n_dev, chunk_bytes,
@@ -458,6 +428,93 @@ def warm_stream_aot(mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
 
                 warm_device_fold(mesh, u_cap=cap, kk=mwl // 4,
                                  table_rungs=2)
+
+
+def warm_kernel_row(mesh: Mesh | None = None, chunk_bytes: int = 1 << 21,
+                    n_reduce: int = 10, max_word_len: int = 16,
+                    u_cap: int = 1 << 15) -> None:
+    """Compile + persist the NON-donated step programs the bench's
+    kernel-only row runs (both grouper variants), from shape structs
+    alone — the rep loop re-executes one program on an HBM-resident
+    buffer, so its input cannot be donated, and a non-donated program is
+    a distinct cache key from the pipeline's donated one."""
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    chunks, _, _ = _stream_examples(n_dev, chunk_bytes, u_cap, max_word_len)
+    for g in warm_groupers():
+        _aot_step_fn(chunks, donate=False, n_dev=n_dev, n_reduce=n_reduce,
+                     max_word_len=max_word_len, u_cap=u_cap, mesh=mesh,
+                     t_cap_frac=4, grouper=g)
+
+
+def kernel_row_persisted(mesh: Mesh | None = None,
+                         chunk_bytes: int = 1 << 21, n_reduce: int = 10,
+                         max_word_len: int = 16,
+                         u_cap: int = 1 << 15) -> bool:
+    """True when every program the kernel-only bench row would execute
+    (the non-donated step at both grouper rungs) is already persisted —
+    the row's cold-compile gate, same discipline as
+    ``stream_programs_persisted``."""
+    from dsi_tpu.backends.aotcache import is_persisted
+
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    chunks, _, _ = _stream_examples(n_dev, chunk_bytes, u_cap, max_word_len)
+    for g in warm_groupers():
+        name, fn = _step_program(n_dev=n_dev, n_reduce=n_reduce,
+                                 max_word_len=max_word_len, u_cap=u_cap,
+                                 mesh=mesh, t_cap_frac=4, grouper=g)
+        if not is_persisted(name, fn, (chunks,)):
+            return False
+    return True
+
+
+def stream_kernel_reps(chunk_np: np.ndarray, mesh: Mesh | None = None,
+                       n_reduce: int = 10, max_word_len: int = 16,
+                       u_cap: int = 1 << 15, reps: int = 5,
+                       grouper: str = "sort", aot: bool = True):
+    """Wire-independent kernel-only measurement: upload ``chunk_np``
+    ONCE, run the stream's ``mapreduce_step`` ``reps`` times on the
+    HBM-resident buffer (non-donated program, so the buffer survives
+    every rep), blocking on the tiny scalar block per rep.  Returns
+    ``(times, exact)`` — per-rep wall seconds (one untimed warm call
+    first: executable load + first-dispatch costs stay out of the
+    kernel number) and whether every rep's exactness flags were clean
+    (a rate for an overflowing kernel must never enter a trend).
+
+    This is the number a ~60 s healthy-tunnel window can still produce
+    when multi-minute transfers can't: on-chip compute MB/s with exactly
+    one chunk upload and ``reps`` scalar pulls on the wire.
+    """
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    sharding = NamedSharding(mesh, PartitionSpec(AXIS, None))
+    chunks = jax.device_put(chunk_np, sharding)
+    kw = dict(n_dev=n_dev, n_reduce=n_reduce, max_word_len=max_word_len,
+              u_cap=u_cap, mesh=mesh, t_cap_frac=4, grouper=grouper)
+    if aot:
+        fn = _aot_step_fn(chunks, donate=False, **kw)
+    else:
+        from dsi_tpu.parallel.shuffle import mapreduce_step
+
+        def fn(c):
+            return mapreduce_step(c, **kw)
+    exact = True
+    times = []
+    for rep in range(reps + 1):
+        t0 = time.perf_counter()
+        keys, lens, cnts, parts, scal = fn(chunks)
+        scal_np = np.asarray(scal)  # blocks: the kernel actually ran
+        if rep:
+            times.append(time.perf_counter() - t0)
+        exact = exact and not scal_np[:, 4].any() \
+            and int(scal_np[:, 1].max()) <= u_cap \
+            and int(scal_np[:, 2].max()) <= max_word_len \
+            and not scal_np[:, 3].any()
+    return times, exact
 
 
 def wordcount_streaming(
@@ -580,7 +637,7 @@ def wordcount_streaming(
             policy.reset()
     # Live host buffers = out queue (≤ depth+1) + in-flight window
     # (≤ depth) + one being filled + one being finished.
-    pool = _BufferPool(n_dev, chunk_bytes, retain=2 * depth + 3)
+    pool = BufferPool((n_dev, chunk_bytes), retain=2 * depth + 3)
 
     def step_call(chunks_dev, mwl, cap, frac, g):
         kw = dict(n_dev=n_dev, n_reduce=n_reduce, max_word_len=mwl,
@@ -649,9 +706,7 @@ def wordcount_streaming(
 
         return exactness_retry(run, chunk_bytes, state["mwl"], state["cap"])
 
-    pending: collections.deque = collections.deque()
-
-    def dispatch(buf: np.ndarray) -> None:
+    def dispatch(buf: np.ndarray):
         """Optimistically launch one step at the sticky rung — upload +
         async kernel dispatch, no synchronization.  Under aot the pack
         program is dispatched HERE too (its full-capacity shape is
@@ -681,15 +736,13 @@ def wordcount_streaming(
         else:
             handles = (scal, None, keys.shape[2],
                        (keys, lens, cnts, parts))
-        pending.append((buf, mwl, cap, handles))
         stats["steps"] += 1
-        if len(pending) > stats["max_inflight_chunks"]:
-            stats["max_inflight_chunks"] = len(pending)
+        return (buf, mwl, cap, handles)
 
-    def finish_one() -> None:
+    def finish_one(record) -> None:
         """Retire the oldest in-flight step: deferred exactness check,
         then merge (clean) or replay-at-wider-shape (overflow)."""
-        buf, mwl, cap, (scal, packed_dev, kk, tables) = pending.popleft()
+        buf, mwl, cap, (scal, packed_dev, kk, tables) = record
         t0 = time.perf_counter()
         scal_np = np.asarray(scal)   # blocks until this step's kernel lands
         stats["kernel_s"] += time.perf_counter() - t0
@@ -745,93 +798,23 @@ def wordcount_streaming(
             stats["replay_s"] += time.perf_counter() - t0
         pool.give(buf)
 
-    # ── batch feed: inline at depth=1, background thread otherwise ──
-    stop = threading.Event()
-    out_q: queue.Queue = queue.Queue(maxsize=depth + 1)
-    batcher_thread: Optional[threading.Thread] = None
-
-    def batcher() -> None:
-        gen = batch_stream(blocks, n_dev, chunk_bytes, pool=pool)
-        try:
-            while True:
-                t0 = time.perf_counter()
-                try:
-                    b = next(gen)
-                except StopIteration:
-                    break
-                stats["batch_s"] += time.perf_counter() - t0
-                while not stop.is_set():
-                    try:
-                        out_q.put(("batch", b), timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
-                    return
-            out_q.put(("done", None))
-        except BaseException as e:  # surfaced to the main thread
-            # Stop-aware retry, like the batch put above: a fixed timeout
-            # could drop the error while the main thread sits in a long
-            # replay (minutes on a tunneled compile), leaving it blocked
-            # forever on a queue that will never produce the sentinel.
-            while not stop.is_set():
-                try:
-                    out_q.put(("err", e), timeout=0.2)
-                    break
-                except queue.Full:
-                    continue
-
-    def feed() -> Iterator[np.ndarray]:
-        nonlocal batcher_thread
-        if depth == 1:
-            gen = batch_stream(blocks, n_dev, chunk_bytes, pool=pool)
-            while True:
-                t0 = time.perf_counter()
-                try:
-                    b = next(gen)
-                except StopIteration:
-                    return
-                stats["batch_s"] += time.perf_counter() - t0
-                yield b
-            return
-        batcher_thread = threading.Thread(target=batcher, daemon=True,
-                                          name="dsi-stream-batcher")
-        batcher_thread.start()
-        while True:
-            t0 = time.perf_counter()
-            kind, item = out_q.get()
-            stats["batch_wait_s"] += time.perf_counter() - t0
-            if kind == "done":
-                return
-            if kind == "err":
-                raise item
-            yield item
+    # ── the window itself: the shared dispatch/finish pipeline core ──
+    pipe = StepPipeline(depth=depth, dispatch=dispatch, finish=finish_one,
+                        stats=stats, produce_key="batch_s",
+                        wait_key="batch_wait_s",
+                        inflight_key="max_inflight_chunks",
+                        thread_name="dsi-stream-batcher")
 
     result: Optional[Dict[str, Tuple[int, int]]]
     try:
-        for buf in feed():
-            dispatch(buf)
-            if len(pending) >= depth:
-                finish_one()
-        while pending:
-            finish_one()
+        pipe.run(lambda: batch_stream(blocks, n_dev, chunk_bytes,
+                                      pool=pool))
         if table_svc is not None:
             table_svc.close()  # the "or at stream end" pull
         result = acc.finalize()
     except (_TokenTooLong, _NeedsHostPath):
         result = None  # caller routes the job to the host path
     finally:
-        if batcher_thread is not None:
-            stop.set()
-            # Unblock a batcher stuck on a full queue; bounded — a
-            # batcher mid-build exits at its next stop check.
-            deadline = time.monotonic() + 5.0
-            while (batcher_thread.is_alive()
-                   and time.monotonic() < deadline):
-                try:
-                    out_q.get_nowait()
-                except queue.Empty:
-                    batcher_thread.join(0.05)
         if pipeline_stats is not None:
             stats["batch_allocs"] = pool.allocs
             for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",

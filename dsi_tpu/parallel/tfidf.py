@@ -43,24 +43,28 @@ either way.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dsi_tpu.utils.jaxcompat import (enable_x64, x64_scoped,
                                      shard_map as _shard_map)
 
 from dsi_tpu.ops.wordcount import (
     _PAD_KEY64,
-    exactness_retry,
+    grouper_ladder,
+    grouper_suffix,
     pack_key_lanes,
+    rung0_cap,
     unpack_key_lanes,
 )
 from dsi_tpu.parallel.merge import PostingsTable
+from dsi_tpu.parallel.pipeline import StepPipeline, pipeline_depth
 from dsi_tpu.parallel.shuffle import (
     AXIS,
     default_mesh,
@@ -144,6 +148,56 @@ tfidf_wave_step = x64_scoped(jax.jit(
     static_argnames=("n_dev", "n_reduce", "max_word_len", "u_cap",
                      "t_cap_frac", "mesh", "grouper")))
 
+#: jax.jit donate_argnums for the pipelined wave program: the chunk
+#: upload is consumed by the kernel (the window re-uploads per attempt),
+#: so an in-flight window never doubles chunk residency in HBM.  The
+#: tiny doc-id vector is not worth donating.
+_WAVE_DONATE = (0,)
+
+
+def _wave_program(*, n_dev: int, n_reduce: int, max_word_len: int,
+                  u_cap: int, size: int, mesh: Mesh, t_cap_frac: int,
+                  grouper: str = "sort"):
+    """The (name, fn) pair for one compiled wave-step shape — same
+    single-definition discipline as ``streaming._step_program``, so a
+    cache-existence probe's key is by construction the key a run
+    compiles.  ``size`` enters the name for readability only (the cache
+    key already hashes the example avals)."""
+    import dsi_tpu.ops.wordcount as _wc
+    import dsi_tpu.parallel.shuffle as _sh
+
+    def fn(chunk, ids):
+        return _tfidf_wave_step_impl(chunk, ids, n_dev=n_dev,
+                                     n_reduce=n_reduce,
+                                     max_word_len=max_word_len,
+                                     u_cap=u_cap, mesh=mesh,
+                                     t_cap_frac=t_cap_frac,
+                                     grouper=grouper)
+
+    fn._aot_code_deps = (_wc, _sh)
+    name = (f"tfidf_wave_d{n_dev}_r{n_reduce}_w{max_word_len}"
+            f"_u{u_cap}_s{size}_f{t_cap_frac}")
+    name += grouper_suffix(grouper)
+    return name, fn
+
+
+def _wave_fn(example_args, **kw):
+    """Compiled wave step via the AOT executable cache
+    (``backends/aotcache.py``), chunk donated.  On a single real device
+    the compiled program persists to disk (a fresh process loads instead
+    of re-paying the remote compile — the stream-step rationale); on the
+    multi-device virtual mesh the cache compiles in-process and serves
+    as the per-shape memo, skipping jit's per-call dispatch machinery on
+    the wave hot path."""
+    from dsi_tpu.backends import aotcache
+    from dsi_tpu.device.table import _quiet_unusable_donation
+
+    name, fn = _wave_program(**kw)
+    with _quiet_unusable_donation():  # a cold entry compiles right here
+        return aotcache.cached_compile(name, fn, example_args,
+                                       donate_argnums=_WAVE_DONATE,
+                                       x64=True)
+
 
 def plan_waves(doc_lens: Sequence[int],
                n_dev: int) -> List[Tuple[List[int], int]]:
@@ -179,18 +233,42 @@ def _wave_chunk(docs: Sequence[bytes], idxs: Sequence[int], n_dev: int,
     return out
 
 
+class _AbortRung(Exception):
+    """A wave proved this capacity/word-window rung's results will be
+    discarded (non-ASCII input, or a word wider than the packed window):
+    unwind the pipeline — dispatching more waves is pure waste."""
+
+
 def tfidf_sharded(
         docs: Sequence[bytes], mesh: Mesh | None = None, n_reduce: int = 10,
         max_word_len: int = 16, u_cap: int = 1 << 15,
         partitions: Optional[set] = None, packed: bool = False,
         device_accumulate: bool = False, sync_every: Optional[int] = None,
-        wave_stats: Optional[dict] = None,
+        wave_stats: Optional[dict] = None, depth: Optional[int] = None,
 ):
-    """Whole-corpus TF-IDF over the mesh, waves of n_dev documents.
+    """Whole-corpus TF-IDF over the mesh, waves of n_dev documents,
+    pipelined ``depth`` waves deep.
 
     Returns ``{word: (reduce_partition, [(doc_index, tf), ...])}`` — exact,
     or None when any document needs the host path (non-ASCII bytes, words
-    longer than 64).  Same retry discipline as ``wordcount_sharded``.
+    longer than 64).  Same exactness discipline as ``wordcount_streaming``:
+    waves dispatch optimistically at a sticky (capacity, grouper, frac)
+    rung, their scalar checks are deferred until they leave the in-flight
+    window (``depth - 1`` waves late), and a failed check replays exactly
+    that wave through the ladder at the wider — then sticky — shape.
+    Results are bit-identical to the ``depth=1`` lockstep path: the
+    accumulator only ever ingests a wave already proven exact, in wave
+    order, and a wave's valid rows (content and device-sorted order) do
+    not depend on the capacity rung that produced them.
+
+    ``depth`` (default ``DSI_STREAM_PIPELINE_DEPTH``, 2) is the in-flight
+    wave window, driven by the shared dispatch/finish pipeline core
+    (``parallel/pipeline.py``): a background materializer thread builds
+    ``_wave_chunk`` blocks into a bounded queue while the main thread
+    uploads (chunk DONATED to the kernel — an in-flight window holds at
+    most ``depth`` chunk buffers in HBM) and dispatches ahead without
+    synchronizing.  ``depth=1`` is fully synchronous: no thread,
+    dispatch then check.
 
     ``partitions`` restricts the host accumulator to those reduce
     partitions — the module's large-corpus story made concrete: running the
@@ -209,53 +287,71 @@ def tfidf_sharded(
     to size the waves.
 
     ``device_accumulate=True`` batches the wave walk's D2H through the
-    device-resident accumulator service: each wave's received rows
-    APPEND into a persistent on-device postings buffer
-    (``device/postings.py``) and the host pulls once per ``sync_every``
-    waves (``DSI_STREAM_SYNC_EVERY`` default, 8) or when the buffer
-    fills — amortizing the tunnel's fixed per-pull latency exactly as
-    the streaming engine's fold does (ROADMAP item 2: the wave walk has
-    the same serialized pull shape).  Results are identical: the same
-    rows reach the same ``PostingsTable``, just in per-window batches,
-    and the padding-doc/partition filters run at drain time instead of
-    per wave.  ``wave_stats``, if given, is populated with
-    ``waves``/``appends``/``append_overflows``/``sync_pulls``/
-    ``step_pulls`` counters plus ``append_s``/``drain_s`` phases in
-    either mode.
+    device-resident accumulator service: each CONFIRMED wave's received
+    rows APPEND into a persistent on-device postings buffer
+    (``device/postings.py``, append flags lagged by the pipeline depth)
+    and the host pulls once per ``sync_every`` waves
+    (``DSI_STREAM_SYNC_EVERY`` default, 8) or when the buffer fills —
+    amortizing the tunnel's fixed per-pull latency exactly as the
+    streaming engine's fold does.  Results are identical: the same rows
+    reach the same ``PostingsTable`` in the same per-device order (the
+    buffer's sticky-overflow protocol preserves wave order through
+    recovery), and the padding-doc/partition filters run at drain time.
+
+    ``wave_stats``, if given, is populated with the per-phase wall
+    seconds ``wave_phases`` mirrors of ``stream_phases``:
+    ``materialize_s`` (background wave build), ``materialize_wait_s``
+    (main-thread starvation), ``upload_s``, ``kernel_s`` (time blocked
+    on a wave's deferred scalar check), ``pull_s``, ``merge_s``,
+    ``replay_s`` — plus ``waves``, ``depth``, ``replays``,
+    ``max_inflight_waves``, ``step_pulls``, and the device-accumulate
+    counters (``appends``/``append_overflows``/``sync_pulls``/
+    ``postings_widens``/``append_s``/``drain_s``/``sync_every``).
     """
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
+    depth = pipeline_depth(depth)
     doc_lens = getattr(docs, "lengths", None)
     if doc_lens is None:
         doc_lens = [len(d) for d in docs]
     waves = plan_waves(doc_lens, n_dev)
     longest = max(doc_lens, default=1)
-    size_max = 1 << max(8, int(longest).bit_length())  # retry hard-cap
+    size_max = 1 << max(8, int(longest).bit_length())  # capacity hard ref
     n_real = len(docs)
     stats = wave_stats if wave_stats is not None else {}
-    stats.update({"waves": len(waves), "step_pulls": 0,
-                  "device_accumulate": device_accumulate})
+    stats.update({"waves": len(waves), "step_pulls": 0, "depth": depth,
+                  "replays": 0, "device_accumulate": device_accumulate,
+                  "upload_s": 0.0, "kernel_s": 0.0, "pull_s": 0.0,
+                  "merge_s": 0.0, "replay_s": 0.0})
+    groupers = grouper_ladder()
+    sh_chunk = NamedSharding(mesh, P(AXIS, None))
+    sh_ids = NamedSharding(mesh, P(AXIS))
 
-    def run(mwl: int, cap: int):
+    def run(mwl: int):
+        """One word-window rung: the whole pipelined wave walk at packed
+        width ``mwl``.  Returns ``("ok", payload)``, ``("high", None)``
+        (non-ASCII: the job is the host path's) or ``("widen", None)``
+        (a word overflowed the window: rerun at the 64-byte rung).
+        Capacity overflow never discards the rung — the overflowing wave
+        alone replays wider and the widened capacity sticks."""
         kk = mwl // 4
-        # Buffer each wave's surviving rows AS THE WAVES RUN — raw uint32
-        # tables copied out of the wave's transfer buffer (no device-shaped
-        # block stays alive), grouped/decoded once at payload time by the
-        # vectorized PostingsTable (parallel/merge.py; VERDICT r3 weakness
-        # #3 replaced the per-row Python walk).  Host state is O(postings
-        # in this slice) — same asymptotics as the dict it replaces, ~5x
-        # smaller constant.  A retry rung discards the whole table and
-        # starts fresh, so partial rungs can't leak into the result.
+        # Buffer each wave's surviving rows AS THE WAVES CONFIRM — raw
+        # uint32 tables copied out of the wave's transfer buffer (no
+        # device-shaped block stays alive), grouped/decoded once at
+        # payload time by the vectorized PostingsTable (parallel/
+        # merge.py).  Host state is O(postings in this slice).  A
+        # discarded rung (word-window widen) drops the whole table, so
+        # partial rungs can't leak into the result.
         table = PostingsTable()
         part_arr = (None if partitions is None
                     else np.fromiter(partitions, dtype=np.uint32))
-        agg_high = False
-        agg_nu = 0
-        agg_ml = 0
-        from dsi_tpu.ops.wordcount import grouper_ladder
-
-        groupers = grouper_ladder()
+        # Sticky dispatch rung, exactly the streaming engine's: only
+        # ever moves toward more headroom, so a corpus that widens once
+        # doesn't replay every later wave.
+        state = {"cap": rung0_cap(size_max, u_cap),
+                 "grouper": groupers[0], "frac": 4}
+        outcome = {"high": False, "widen": False}
 
         def buffer_rows(r: np.ndarray) -> None:
             """One device's pulled rows into the host table, filtered
@@ -269,12 +365,12 @@ def tfidf_sharded(
             if len(r):
                 table.add(r, kk)
 
-        # Device-resident accumulation (fresh per retry rung — a rung
-        # restart discards partial device state exactly like the host
-        # table): waves append on-device, the host pulls per K-wave
-        # window or when the buffer fills (an overflowing append is a
-        # global no-op; drain-and-retry always fits, because the buffer
-        # holds at least one worst-case wave).
+        # Device-resident accumulation (fresh per rung — a rung restart
+        # discards partial device state exactly like the host table):
+        # confirmed waves append on-device with lagged flags, the host
+        # pulls per K-wave window; overflow drains early (or widens for
+        # a lone outsized wave) — never a loss, and wave order survives
+        # recovery (device/postings.py sticky-overflow protocol).
         buf_dev = None
         policy = None
         if device_accumulate:
@@ -290,84 +386,154 @@ def tfidf_sharded(
                 pcap = int(os.environ.get("DSI_DEVICE_POSTINGS_CAP", "0"))
             except ValueError:
                 pcap = 0
-            buf_dev = DevicePostings(mesh, width=kk + 4,
-                                     cap=pcap if pcap > 0 else n_dev * cap,
-                                     stats=stats)
+            buf_dev = DevicePostings(
+                mesh, width=kk + 4,
+                cap=pcap if pcap > 0 else n_dev * state["cap"],
+                sink=buffer_rows, lag=max(0, depth - 1), stats=stats)
             policy = SyncPolicy(sync_every)
             stats["sync_every"] = policy.sync_every
 
-        def drain_buf() -> None:
-            for r in buf_dev.drain():
-                buffer_rows(r)
+        def materialize():
+            for idxs, size in waves:
+                chunk_np = _wave_chunk(docs, idxs, n_dev, size)
+                # Pad rows of a short last wave carry doc id n_real,
+                # which buffer_rows discards.
+                ids_np = np.array(list(idxs) + [n_real] * (n_dev - len(idxs)),
+                                  dtype=np.int32)
+                yield (size, chunk_np, ids_np)
 
-        for idxs, size in waves:
-            chunk = jnp.asarray(_wave_chunk(docs, idxs, n_dev, size))
-            # Pad rows of a short last wave carry doc id n_real, which the
-            # host walk below discards.
-            ids = jnp.asarray(
-                np.array(list(idxs) + [n_real] * (n_dev - len(idxs)),
-                         dtype=np.int32))
-            for g in groupers:
-                for frac in (4, 2):
-                    rows, scal = tfidf_wave_step(
-                        chunk, ids, n_dev=n_dev, n_reduce=n_reduce,
-                        max_word_len=mwl, u_cap=cap, mesh=mesh,
-                        t_cap_frac=frac, grouper=g)
-                    scal_np = np.asarray(scal)
-                    if not scal_np[:, 4].any():
-                        break
-                if not scal_np[:, 4].any():
+        def wave_call(chunk_np, ids_np, size, cap, frac, g):
+            """Upload + async wave dispatch at one rung.  Each attempt
+            re-uploads: the compiled program donates its chunk."""
+            t0 = time.perf_counter()
+            chunk = jax.device_put(chunk_np, sh_chunk)
+            ids = jax.device_put(ids_np, sh_ids)
+            stats["upload_s"] += time.perf_counter() - t0
+            fn = _wave_fn((chunk, ids), n_dev=n_dev, n_reduce=n_reduce,
+                          max_word_len=mwl, u_cap=cap, size=size,
+                          mesh=mesh, t_cap_frac=frac, grouper=g)
+            from dsi_tpu.device.table import _quiet_unusable_donation
+
+            with _quiet_unusable_donation():
+                return fn(chunk, ids)
+
+        def dispatch(item):
+            size, chunk_np, ids_np = item
+            rows, scal = wave_call(chunk_np, ids_np, size, state["cap"],
+                                   state["frac"], state["grouper"])
+            return (size, chunk_np, ids_np, rows, scal, state["cap"])
+
+        def replay_wave(size, chunk_np, ids_np):
+            """The full exactness ladder for ONE wave — the replay path
+            of a deferred-check failure.  The cleared rung sticks for
+            every later dispatch."""
+            stats["replays"] += 1
+            t0 = time.perf_counter()
+            cap = state["cap"]
+            try:
+                while True:
+                    for g in groupers:
+                        for frac in (4, 2):
+                            rows, scal = wave_call(chunk_np, ids_np, size,
+                                                   cap, frac, g)
+                            scal_np = np.asarray(scal)
+                            if not scal_np[:, 4].any():
+                                break
+                        if not scal_np[:, 4].any():
+                            break
+                    if bool(scal_np[:, 3].any()):
+                        outcome["high"] = True
+                        raise _AbortRung
+                    if int(scal_np[:, 2].max()) > mwl:
+                        outcome["widen"] = True
+                        raise _AbortRung
+                    if int(scal_np[:, 1].max()) > cap:
+                        cap *= 4  # uniques <= tokens <= size/2: terminates
+                        continue
                     break
-            agg_high = agg_high or bool(scal_np[:, 3].any())
-            agg_nu = max(agg_nu, int(scal_np[:, 1].max()))
-            agg_ml = max(agg_ml, int(scal_np[:, 2].max()))
-            if agg_high or agg_nu > cap or agg_ml > mwl:
-                break  # this rung's results are certain to be discarded
-                # (host fallback or wider retry); more waves = pure waste
+            finally:
+                stats["replay_s"] += time.perf_counter() - t0
+            state["cap"], state["grouper"], state["frac"] = cap, g, frac
+            return rows, scal, scal_np
+
+        def commit(rows, scal, scal_np):
             m = int(scal_np[:, 0].max())
             if m == 0:
-                continue
+                return
             if buf_dev is not None:
-                # Append this wave's rows on-device; the host pulls per
-                # K-wave window instead of per wave.
-                if not buf_dev.append(rows, scal):
-                    drain_buf()  # buffer full: early sync, then retry
-                    policy.reset()  # the drain WAS this window's pull —
-                    # without this, due() could fire a second, nearly
-                    # empty pull one wave later
-                    if not buf_dev.append(rows, scal):
-                        # Only reachable when DSI_DEVICE_POSTINGS_CAP was
-                        # forced below one wave's rows — losing the wave
-                        # silently is never acceptable.
-                        raise RuntimeError(
-                            "device postings buffer smaller than one wave"
-                            f" (cap={buf_dev.cap})")
+                pulls_before = stats["sync_pulls"]
+                buf_dev.append(rows, scal)
                 policy.note_fold()
-                if policy.due():
-                    drain_buf()
+                if stats["sync_pulls"] != pulls_before:
+                    policy.reset()  # an overflow recovery just drained:
+                    # that WAS this window's pull — without the reset,
+                    # due() would fire a second, nearly empty one
+                elif policy.due():
+                    buf_dev.sync()
                     policy.reset()
-                continue
-            # Pull only the occupied prefix (max per-device received rows,
-            # pow2-rounded to bound the slice-program count): the D2H bill
-            # tracks this wave's postings, not the worst-case capacity.
+                return
+            # Pull only the occupied prefix (max per-device received
+            # rows, pow2-rounded to bound the slice-program count): the
+            # D2H bill tracks this wave's postings, not capacity.
+            t0 = time.perf_counter()
             mp = occupied_prefix(m, rows.shape[1])
             rows_np = np.asarray(rows[:, :mp])
             stats["step_pulls"] += 1
+            stats["pull_s"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
             for d in range(n_dev):
                 nr = int(scal_np[d, 0])
-                if nr == 0:
-                    continue
-                buffer_rows(rows_np[d, :nr])
+                if nr:
+                    buffer_rows(rows_np[d, :nr])
+            stats["merge_s"] += time.perf_counter() - t0
 
-        if buf_dev is not None and not (agg_high or agg_nu > cap
-                                        or agg_ml > mwl):
-            drain_buf()  # end-of-walk sync (a discarded rung skips it)
+        def finish(rec):
+            """Retire the oldest in-flight wave: deferred scalar check,
+            then commit (clean) or replay-at-wider-shape (overflow)."""
+            size, chunk_np, ids_np, rows, scal, cap = rec
+            t0 = time.perf_counter()
+            scal_np = np.asarray(scal)  # blocks until the kernel lands
+            stats["kernel_s"] += time.perf_counter() - t0
+            if bool(scal_np[:, 3].any()):
+                outcome["high"] = True
+                raise _AbortRung
+            if int(scal_np[:, 2].max()) > mwl:
+                outcome["widen"] = True
+                raise _AbortRung
+            if scal_np[:, 4].any() or int(scal_np[:, 1].max()) > cap:
+                # Late-detected overflow: replay just this wave.
+                # Exactly-once by construction — the optimistic attempt's
+                # rows are dropped uncommitted, the replay's commit here
+                # and nowhere else.
+                rows, scal, scal_np = replay_wave(size, chunk_np, ids_np)
+            commit(rows, scal, scal_np)
 
-        return (agg_high, agg_nu, agg_ml,
-                table.finalize_packed if packed else table.finalize)
+        pipe = StepPipeline(depth=depth, dispatch=dispatch, finish=finish,
+                            stats=stats, produce_key="materialize_s",
+                            wait_key="materialize_wait_s",
+                            inflight_key="max_inflight_waves",
+                            thread_name="dsi-wave-materializer")
+        try:
+            pipe.run(materialize)
+        except _AbortRung:
+            return ("high" if outcome["high"] else "widen", None)
+        if buf_dev is not None:
+            buf_dev.close()  # end-of-walk sync
+        return ("ok", table.finalize_packed if packed else table.finalize)
 
-    payload = exactness_retry(run, size_max, max_word_len, u_cap)
-    return None if payload is None else payload()
+    # The word-window ladder (exactness_retry's outer rung, hand-rolled
+    # because capacity now widens per wave INSIDE a rung): a word wider
+    # than the packed window re-keys every row, so that one overflow
+    # class still restarts the walk.
+    for mwl in ((max_word_len, 64) if max_word_len < 64
+                else (max_word_len,)):
+        status, payload = run(mwl)
+        if status == "high":
+            return None
+        if status == "widen":
+            continue
+        return payload()
+    return None  # a word wider than 64 bytes: the job is the host path's
 
 
 class FileDocs:

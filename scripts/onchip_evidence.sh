@@ -107,6 +107,23 @@ timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
   > "$OUT/wcstream.log" 2>&1
 log "wcstream rc=$? $(tail -c 160 "$OUT/wcstream.log" | tr '\n' ' ')"
 
+log "wcstream --grouper hash on the chip (hash-grouper A/B vs the sort run above)"
+# Same corpus and shapes as the sort-grouper step above, with the hash
+# grouper env-selected (DSI_WC_GROUPER via --grouper): the ~1.8x kernel
+# win measured on CPU (BASELINE r5) gets its on-chip verdict from the
+# two runs' stream_phases kernel_s side by side.  The *_hg executables
+# are pre-warmed by warm_kernels --phase stream (warm_groupers covers
+# both variants), so this loads — never cold-compiles.  The benches
+# above also carry kernel_sort_mbps / kernel_hash_mbps (the HBM-resident
+# rep loop, DSI_BENCH_KERNEL_REPS), the wire-independent form of the
+# same comparison.
+mkdir -p "$OUT/wcstream-hg-wd"
+timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
+  --aot --u-cap 16384 --grouper hash --stats \
+  --workdir "$OUT/wcstream-hg-wd" "$OUT"/corpus/pg-*.txt \
+  > "$OUT/wcstream-hg.log" 2>&1
+log "wcstream-hg rc=$? $(tail -c 200 "$OUT/wcstream-hg.log" | tr '\n' ' ')"
+
 log "wcstream --device-accumulate on the chip (fold table, K=${SYNC_EVERY:-8})"
 # Same corpus and shapes as the step above, with the device-resident
 # accumulator service folding confirmed steps on-chip and pulling only

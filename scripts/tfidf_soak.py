@@ -40,6 +40,18 @@ def main() -> int:
     ap.add_argument("--n-reduce", type=int, default=10)
     ap.add_argument("--verify-docs", type=int, default=8)
     ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    help="in-flight wave window (default: "
+                         "DSI_STREAM_PIPELINE_DEPTH or 2; 1 = the "
+                         "synchronous lockstep walk)")
+    ap.add_argument("--device-accumulate", action="store_true",
+                    help="batch the wave walk's D2H through the "
+                         "device-resident postings buffer (dsi_tpu/"
+                         "device/postings.py)")
+    ap.add_argument("--sync-every", type=int, default=None,
+                    help="waves between host pulls with "
+                         "--device-accumulate (default: "
+                         "DSI_STREAM_SYNC_EVERY or 8)")
     args = ap.parse_args()
 
     import jax
@@ -69,9 +81,13 @@ def main() -> int:
 
     mesh = default_mesh(args.devices)
     partitions = set(range(args.slice)) if args.slice else None
+    wave_stats: dict = {}
     t0 = time.perf_counter()
     res = tfidf_sharded(docs, mesh=mesh, n_reduce=args.n_reduce,
-                        u_cap=1 << 15, partitions=partitions, packed=True)
+                        u_cap=1 << 15, partitions=partitions, packed=True,
+                        depth=args.pipeline_depth,
+                        device_accumulate=args.device_accumulate,
+                        sync_every=args.sync_every, wave_stats=wave_stats)
     wall = time.perf_counter() - t0
     assert res is not None, "tfidf fell back to host"
 
@@ -104,12 +120,24 @@ def main() -> int:
                 sample_ok = False
 
     rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    # Per-phase attribution (tfidf_sharded wave_stats), mirroring the
+    # stream row's stream_phases: says WHERE the soak's seconds went —
+    # and whether the pipeline actually took check/pull off the critical
+    # path (kernel_s = time blocked on deferred scalar checks).
+    wave_phases = {
+        k: (round(v, 3) if isinstance(v, float) else v)
+        for k, v in wave_stats.items()
+        if k.endswith("_s") or k in (
+            "waves", "depth", "replays", "max_inflight_waves",
+            "step_pulls", "appends", "append_overflows", "sync_pulls",
+            "postings_widens", "sync_every", "device_accumulate")}
     print(json.dumps({
         "tfidf_mb": round(total_mb, 1), "wall_s": round(wall, 1),
         "mbps": round(total_mb / wall, 2), "n_docs": len(docs),
         "slice": f"{args.slice}/{args.n_reduce}" if partitions else "full",
         "uniques": len(res), "postings": postings,
-        "sample_parity": sample_ok, "peak_rss_mb": round(rss_mb, 1)}))
+        "sample_parity": sample_ok, "peak_rss_mb": round(rss_mb, 1),
+        "wave_phases": wave_phases}))
     return 0 if sample_ok else 1
 
 

@@ -1,0 +1,173 @@
+"""Pipelined TF-IDF wave walk (parallel/tfidf.py on the shared
+dispatch/finish core, parallel/pipeline.py).
+
+Oracle discipline as everywhere else: every (depth, device_accumulate,
+forced-overflow) grid point must agree BIT-FOR-BIT with the depth=1
+lockstep walk and with a host Counter over the Go tokenizer semantics —
+including per-word posting-list ORDER, which is how a wave-order bug in
+the window or the postings buffer's overflow recovery would surface.
+"""
+
+import collections
+import re
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import numpy as np
+
+from dsi_tpu.parallel.shuffle import default_mesh
+from dsi_tpu.parallel.tfidf import tfidf_sharded
+
+WORDS = re.compile(r"[A-Za-z]+")
+
+
+def _mesh():
+    return default_mesh(8)
+
+
+def _letters(i: int) -> str:
+    return "".join(chr(97 + (i // 26 ** j) % 26) for j in range(3))
+
+
+VOCAB = [_letters(i) for i in range(800)]
+
+
+def _overflow_docs(n_docs: int = 18, seed: int = 31):
+    """Docs whose early waves fit u_cap=64 and whose later waves overflow
+    it (vocab >> 64 uniques per doc), with lengths arranged so the
+    longest-first wave plan puts LOW-vocab docs first — the capacity
+    overflow then arrives mid-walk, inside a full pipeline window."""
+    rng = np.random.default_rng(seed)
+    docs = []
+    for i in range(n_docs):
+        if i < n_docs // 2:  # long, low-vocab: scheduled first
+            words = [VOCAB[j] for j in rng.integers(0, 8, 500)]
+        else:  # shorter, high-vocab: overflow u_cap=64 mid-walk
+            words = [VOCAB[j] for j in rng.integers(0, 400, 300)]
+        docs.append((" ".join(words) + "\n").encode())
+    return docs
+
+
+def _df_oracle(docs):
+    df = collections.Counter()
+    for d in docs:
+        for w in set(WORDS.findall(d.decode())):
+            df[w] += 1
+    return dict(df)
+
+
+def test_pipeline_parity_grid_with_forced_replay():
+    """depth x device_accumulate grid over a stream that forces a
+    mid-walk capacity overflow: every point bit-identical to the depth=1
+    lockstep path (counts, partitions, AND per-word posting order), with
+    the deferred check actually replaying (counts would double on a
+    commit-then-replay bug, halve on a dropped wave)."""
+    docs = _overflow_docs()
+    mesh = _mesh()
+    base_st: dict = {}
+    base = tfidf_sharded(docs, mesh=mesh, n_reduce=10, u_cap=64, depth=1,
+                         wave_stats=base_st)
+    assert base is not None
+    assert base_st["replays"] >= 1  # the overflow path really ran
+    got_df = {w: len(pairs) for w, (_, pairs) in base.items()}
+    assert got_df == _df_oracle(docs)  # exact vs the host oracle
+
+    for depth in (2, 3):
+        for dacc in (False, True):
+            st: dict = {}
+            res = tfidf_sharded(docs, mesh=mesh, n_reduce=10, u_cap=64,
+                                depth=depth, device_accumulate=dacc,
+                                sync_every=3, wave_stats=st)
+            assert res is not None
+            assert res == base, (depth, dacc)
+            assert st["replays"] >= 1, (depth, dacc)
+            assert st["max_inflight_waves"] <= depth
+            if dacc:
+                assert st["step_pulls"] == 0
+                assert st["appends"] >= 1
+
+
+def test_pipeline_sticky_capacity_bounds_replays():
+    """The widened capacity sticks: once one wave replays wider, later
+    waves dispatch at the wide rung directly — replays are bounded by
+    the in-flight window plus the overflow transition, not the walk."""
+    docs = _overflow_docs(n_docs=24)
+    st: dict = {}
+    res = tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=64,
+                        depth=3, wave_stats=st)
+    assert res is not None
+    assert st["waves"] == 3  # 24 docs / 8 devices
+    # One replay per wave still in flight at the transition, at most.
+    assert 1 <= st["replays"] <= 3
+
+
+def test_pipeline_depth_env_default(monkeypatch):
+    monkeypatch.setenv("DSI_STREAM_PIPELINE_DEPTH", "3")
+    docs = _overflow_docs(n_docs=8, seed=3)
+    st: dict = {}
+    res = tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9,
+                        wave_stats=st)
+    assert res is not None and st["depth"] == 3
+    st = {}
+    res = tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9,
+                        depth=1, wave_stats=st)
+    assert res is not None and st["depth"] == 1
+
+
+def test_pipeline_postings_overflow_recovery_preserves_order(monkeypatch):
+    """The lagged device-postings buffer under a forced-tiny capacity:
+    appends no-op mid-window (sticky dirty bit), recovery drains and
+    re-appends — and the result is STILL bit-identical to the lockstep
+    host-pull walk, proving wave order survived the recovery."""
+    monkeypatch.setenv("DSI_DEVICE_POSTINGS_CAP", "256")
+    rng = np.random.default_rng(7)
+    docs = [(" ".join(VOCAB[j] for j in rng.integers(0, 300, 350))
+             + "\n").encode() for _ in range(24)]
+    mesh = _mesh()
+    base = tfidf_sharded(docs, mesh=mesh, n_reduce=10, u_cap=1 << 9,
+                         depth=1)
+    st: dict = {}
+    # sync_every far beyond the wave count: only overflow can drain
+    # before the end-of-walk sync, so recovery MUST run.
+    res = tfidf_sharded(docs, mesh=mesh, n_reduce=10, u_cap=1 << 9,
+                        depth=3, device_accumulate=True,
+                        sync_every=10_000, wave_stats=st)
+    assert base is not None and res is not None
+    assert res == base
+    assert st["append_overflows"] >= 1
+    assert st["step_pulls"] == 0
+
+
+def test_pipeline_wave_phases_attribution():
+    """wave_phases mirrors stream_phases: the per-phase walls exist, are
+    finite, and the background materializer actually ran off the main
+    thread (materialize_wait_s key present at depth > 1)."""
+    docs = _overflow_docs(n_docs=16, seed=11)
+    st: dict = {}
+    res = tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9,
+                        depth=2, wave_stats=st)
+    assert res is not None
+    for k in ("materialize_s", "materialize_wait_s", "upload_s",
+              "kernel_s", "pull_s", "merge_s", "replay_s"):
+        assert k in st and st[k] >= 0.0, k
+    assert st["waves"] == 2 and st["max_inflight_waves"] <= 2
+
+
+def test_pipeline_partition_slices_union_unchanged():
+    """The partition-slice contract survives the pipelined walk: slices
+    union to the full result, each holding only its words."""
+    docs = _overflow_docs(n_docs=10, seed=5)
+    mesh = _mesh()
+    full = tfidf_sharded(docs, mesh=mesh, n_reduce=6, u_cap=1 << 9,
+                         depth=3)
+    lo = tfidf_sharded(docs, mesh=mesh, n_reduce=6, u_cap=1 << 9,
+                       depth=3, partitions={0, 1, 2})
+    hi = tfidf_sharded(docs, mesh=mesh, n_reduce=6, u_cap=1 << 9,
+                       depth=3, partitions={3, 4, 5})
+    assert full is not None and lo is not None and hi is not None
+    assert set(lo) | set(hi) == set(full)
+    assert not set(lo) & set(hi)
+    for w, (part, pairs) in lo.items():
+        assert part in {0, 1, 2} and pairs == full[w][1]
