@@ -78,6 +78,16 @@ Environment knobs:
                           walk over the cycled corpus, token-invariant
                           gated, with tfidf_phases mirroring
                           stream_phases.
+  DSI_BENCH_GREP_MB       size of the streaming-grep engine row (default
+                          16; 0 disables; accelerators opt-in like the
+                          tfidf row): grep_streaming over the cycled
+                          corpus, parity-gated line-for-line against the
+                          host-grep oracle, with grep_phases and the
+                          oracle's own MB/s alongside.
+                          DSI_BENCH_GREP_PATTERN picks the literal
+                          (default "the"); DSI_BENCH_GREP_DEVICE_ACC=1
+                          folds the match histogram + top-k candidates
+                          on device (dsi_tpu/device/topk.py).
   DSI_BENCH_FRAMEWORK_MB  corpus size for the distributed N-worker row
                           (default 48; 0 disables it; auto-shrunk so its
                           oracle pass costs ~100 s on a slow box, skipped
@@ -465,16 +475,16 @@ def tpu_child(result_path: str) -> int:
         result.pop("stream_skipped", None)
         result.update(stream)
         emit(result)
-    # Wire-independent kernel-only row + the TF-IDF engine row: same
-    # never-trade-the-verdict discipline — each re-emits the (already
-    # durable) result with its keys or a skip reason.
+    # Wire-independent kernel-only row + the TF-IDF and grep engine
+    # rows: same never-trade-the-verdict discipline — each re-emits the
+    # (already durable) result with its keys or a skip reason.
     if parity:
-        for row_fn in (run_kernel_row, run_tfidf_row):
+        for key, row_fn in (("kernel_skipped", run_kernel_row),
+                            ("tfidf_skipped", run_tfidf_row),
+                            ("grep_skipped", run_grep_row)):
             try:
                 result.update(row_fn(files))
             except Exception as e:
-                key = ("kernel_skipped" if row_fn is run_kernel_row
-                       else "tfidf_skipped")
                 result[key] = f"row failed: {type(e).__name__}: {e}"
             emit(result)
     return 0
@@ -720,6 +730,107 @@ def run_tfidf_row(files) -> dict:
     return {"tfidf_mbps": round(total_mb / dt, 2),
             "tfidf_mb": round(total_mb, 1), "tfidf_s": round(dt, 2),
             "tfidf_parity": True, "tfidf_phases": phases}
+
+
+def run_grep_row(files) -> dict:
+    """The streaming grep engine row (DSI_BENCH_GREP_MB, default 16; 0
+    disables; accelerators run it only when the knob is set explicitly):
+    ``grep_streaming`` (``parallel/grepstream.py``) over the bench
+    corpus cycled to ~the requested size, parity-gated against the
+    single-pass host-grep oracle (same lines, matched counts,
+    occurrences, histogram, and top-k — any divergence suppresses the
+    rate), with ``grep_phases`` mirroring ``stream_phases`` and the
+    oracle's own MB/s alongside (``grep_oracle_mbps``) so the row reads
+    as engine-vs-host, not a bare number.
+
+    DSI_BENCH_GREP_PATTERN picks the literal (default "the");
+    DSI_BENCH_GREP_DEVICE_ACC=1 runs the row with the on-device top-k/
+    histogram service (device/topk.py) folding confirmed steps and
+    pulling every DSI_STREAM_SYNC_EVERY steps — step_pulls vs
+    sync_pulls/widens is the amortization BENCH_r06+ compares.
+    """
+    explicit = "DSI_BENCH_GREP_MB" in os.environ
+    mb = env_float("DSI_BENCH_GREP_MB", 16.0)
+    if mb <= 0:
+        return {}
+    import jax
+
+    pattern = os.environ.get("DSI_BENCH_GREP_PATTERN", "the")
+    if jax.devices()[0].platform != "cpu" and not explicit:
+        return {"grep_skipped": "accelerator grep row is opt-in "
+                                "(set DSI_BENCH_GREP_MB)"}
+    from dsi_tpu.parallel.grepstream import (GREP_CHUNK_BYTES,
+                                             grep_host_oracle,
+                                             grep_streaming,
+                                             grepstream_persisted)
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.streaming import stream_files
+    from dsi_tpu.utils.tracing import Span
+
+    device_acc = os.environ.get("DSI_BENCH_GREP_DEVICE_ACC") == "1"
+    single = len(jax.devices()) == 1
+    aot = jax.devices()[0].platform != "cpu" and single
+    if (aot and os.environ.get("DSI_BENCH_WARM_ALL") != "1"
+            and not grepstream_persisted(chunk_bytes=GREP_CHUNK_BYTES,
+                                         pattern_len=len(pattern),
+                                         device_accumulate=device_acc)):
+        return {"grep_skipped":
+                "grep stream programs not in the AOT cache (cold compile "
+                "risk); warm via scripts/warm_kernels.py --phase grep"}
+
+    corpus_bytes = sum(os.path.getsize(p) for p in files)
+    cycles = max(1, round(mb * 1e6 / corpus_bytes))
+
+    def blocks():
+        for c in range(cycles):
+            if c:
+                yield b"\n"
+            yield from stream_files(files)
+
+    # The oracle first: parity ground truth AND the host baseline rate.
+    with Span("bench.grep_oracle") as pt:
+        want = grep_host_oracle(blocks(), pattern)
+    oracle_s = pt.elapsed_s
+    total_mb = corpus_bytes * cycles / 1e6
+
+    mesh = default_mesh()
+    pstats: dict = {}
+    with Span("bench.grep") as pt:
+        res = grep_streaming(blocks(), pattern, mesh=mesh,
+                             chunk_bytes=GREP_CHUNK_BYTES, aot=aot,
+                             device_accumulate=device_acc,
+                             pipeline_stats=pstats)
+    dt = pt.elapsed_s
+    if res is None:
+        return {"grep_skipped": "grep stream needed the host path "
+                                "(non-literal pattern or over-wide line)"}
+    parity = res == want
+    phases = {k: pstats[k] for k in ("batch_s", "batch_wait_s", "upload_s",
+                                     "kernel_s", "pull_s", "merge_s",
+                                     "replay_s", "depth", "replays",
+                                     "l_cap", "device_accumulate",
+                                     "sync_every", "step_pulls", "folds",
+                                     "fold_s", "fold_overflows",
+                                     "sync_pulls", "sync_s", "widens",
+                                     "widen_s", "table_cap",
+                                     "topk_snapshots", "hist_folds",
+                                     "hist_pulls")
+              if k in pstats}
+    log(f"grep row: {total_mb:.1f} MB in {dt:.2f}s = {total_mb / dt:.2f} "
+        f"MB/s vs oracle {total_mb / oracle_s:.2f} MB/s (pattern="
+        f"{pattern!r}, matched={res.matched}, parity={parity}, "
+        f"phases={phases})")
+    if not parity:
+        return {"grep_skipped": f"parity mismatch vs host-grep oracle "
+                                f"over {total_mb:.1f} MB (throughput "
+                                f"suppressed)",
+                "grep_parity": False}
+    return {"grep_mbps": round(total_mb / dt, 2),
+            "grep_mb": round(total_mb, 1), "grep_s": round(dt, 2),
+            "grep_matched": res.matched,
+            "grep_oracle_mbps": round(total_mb / oracle_s, 2),
+            "grep_vs_oracle": round(oracle_s / dt, 2),
+            "grep_parity": True, "grep_phases": phases}
 
 
 def framework_row_mb() -> float:
@@ -1247,9 +1358,10 @@ def main() -> None:
 
     for k in res:
         # Honesty rows measured in the child ride the verdict verbatim:
-        # the stream row, the kernel-only rep row, and the tfidf engine
-        # row (each either measured or carrying an explicit skip reason).
-        if k.startswith(("stream_", "kernel_", "tfidf_")):
+        # the stream row, the kernel-only rep row, and the tfidf/grep
+        # engine rows (each either measured or carrying an explicit skip
+        # reason).
+        if k.startswith(("stream_", "kernel_", "tfidf_", "grep_")):
             out[k] = res[k]
     out.update(fw)
     if tpu_error:

@@ -1,0 +1,131 @@
+"""Streaming grep entry point — the grep engine on the shared pipeline
+core (``parallel/grepstream.py``) as a user-facing command, mirroring
+``wcstream``'s knobs.
+
+Files become one bounded-memory block stream cut at newline boundaries;
+every stream step runs ONE compiled literal-match program (the
+``ops/grepk.py`` shifted-compare idiom) whose ``l_cap`` escalation is
+the pipeline's sticky-rung replay, and the result is the whole-stream
+match statistics: total/matched lines, occurrences, the per-line
+match-count histogram, and the exact top-k lines by occurrence count.
+``--device-accumulate`` keeps the histogram and the top-k candidate
+table ON DEVICE (``dsi_tpu/device/topk.py``), pulling every
+``--sync-every`` steps instead of every step.
+
+Falls back to the host oracle scan when the engine declines (non-literal
+pattern, or a line wider than ``--chunk-bytes``) — correctness never
+depends on the device kernel.
+
+Usage:
+    python -m dsi_tpu.cli.grepstream --pattern PAT [--chunk-bytes B]
+        [--devices D] [--pipeline-depth D] [--device-accumulate]
+        [--sync-every K] [--topk K] [--aot] [--stats] [--check]
+        inputfiles...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="+")
+    p.add_argument("--pattern", default=None,
+                   help="literal pattern (default: DSI_GREP_PATTERN)")
+    p.add_argument("--chunk-bytes", type=_positive_int, default=1 << 20,
+                   help="per-device bytes per stream step (also the line "
+                        "length ceiling: a wider line routes the stream "
+                        "to the host scan)")
+    p.add_argument("--devices", type=int, default=None,
+                   help="mesh size (default: all local devices)")
+    p.add_argument("--pipeline-depth", type=_positive_int, default=None,
+                   help="in-flight stream steps (default: "
+                        "DSI_STREAM_PIPELINE_DEPTH or 2; 1 = synchronous)")
+    p.add_argument("--device-accumulate", action="store_true",
+                   help="fold histograms + top-k candidates into the "
+                        "device-resident service (dsi_tpu/device/topk.py) "
+                        "and pull only every --sync-every steps — results "
+                        "are bit-identical")
+    p.add_argument("--sync-every", type=_positive_int, default=None,
+                   help="folds between host pulls with --device-accumulate "
+                        "(default: DSI_STREAM_SYNC_EVERY or 8)")
+    p.add_argument("--topk", type=_positive_int, default=16,
+                   help="top-k lines by occurrence count to report")
+    p.add_argument("--aot", action="store_true",
+                   help="route the device services through the persistent "
+                        "AOT executable cache (single-device axon runs "
+                        "load serialized executables; the step programs "
+                        "always go through the cache)")
+    p.add_argument("--stats", action="store_true",
+                   help="print the pipeline_stats dict (phase walls + "
+                        "fold/sync/widen/snapshot counters) to stderr")
+    p.add_argument("--check", action="store_true",
+                   help="run the host oracle scan over the same stream "
+                        "and verify parity (exit 2 on mismatch)")
+    args = p.parse_args(argv)
+
+    pattern = args.pattern or os.environ.get("DSI_GREP_PATTERN")
+    if not pattern:
+        print("grepstream: no pattern (--pattern or DSI_GREP_PATTERN)",
+              file=sys.stderr)
+        return 1
+
+    from dsi_tpu.utils.platformpin import pin_platform_from_env
+
+    pin_platform_from_env()
+
+    from dsi_tpu.parallel.grepstream import grep_host_oracle, grep_streaming
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.streaming import stream_files
+
+    mesh = default_mesh(args.devices)
+    pstats: dict = {}
+    res = grep_streaming(stream_files(args.files), pattern, mesh=mesh,
+                         chunk_bytes=args.chunk_bytes,
+                         depth=args.pipeline_depth, aot=args.aot,
+                         device_accumulate=args.device_accumulate,
+                         sync_every=args.sync_every, topk=args.topk,
+                         pipeline_stats=pstats)
+    if args.stats:
+        print(f"grepstream: pipeline_stats={pstats}", file=sys.stderr)
+    host_path = res is None
+    if host_path:
+        try:
+            res = grep_host_oracle(stream_files(args.files), pattern,
+                                   topk=args.topk)
+        except UnicodeEncodeError:
+            print("grepstream: pattern is not plain ASCII; use the "
+                  "tpu_grep MR app for regex tiers", file=sys.stderr)
+            return 1
+        print("grepstream: stream needed the host path; ran the host scan",
+              file=sys.stderr)
+
+    print(f"lines={res.lines} matched={res.matched} "
+          f"occurrences={res.occurrences}")
+    print("hist=" + ",".join(str(h) for h in res.hist))
+    for line_no, occ in res.topk:
+        print(f"top line={line_no} occ={occ}")
+
+    if args.check and not host_path:
+        want = grep_host_oracle(stream_files(args.files), pattern,
+                                topk=args.topk)
+        if res != want:
+            print("grepstream: PARITY FAILURE vs host oracle",
+                  file=sys.stderr)
+            return 2
+        print("grepstream: parity OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

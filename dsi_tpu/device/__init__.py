@@ -11,6 +11,9 @@ host visibility).
   word/count table the streaming word count folds into.
 * :mod:`~dsi_tpu.device.postings` — :class:`DevicePostings`, the
   append-only postings buffer the TF-IDF wave walk batches pulls with.
+* :mod:`~dsi_tpu.device.topk` — :class:`DeviceTopK` and
+  :class:`DeviceHistogram`, the top-k-by-count table and match-count
+  histogram the grep/indexer streaming engines fold into.
 * :mod:`~dsi_tpu.device.policy` — :class:`SyncPolicy`, the one owner of
   the every-K-folds pull cadence.
 """
@@ -22,12 +25,28 @@ from dsi_tpu.device.table import (
     warm_device_fold,
 )
 from dsi_tpu.device.postings import DevicePostings
+from dsi_tpu.device.topk import (
+    DeviceHistogram,
+    DeviceTopK,
+    KeyCounts,
+    histogram_persisted,
+    topk_service_persisted,
+    warm_histogram,
+    warm_topk_service,
+)
 
 __all__ = [
+    "DeviceHistogram",
     "DevicePostings",
     "DeviceTable",
+    "DeviceTopK",
+    "KeyCounts",
     "SyncPolicy",
     "device_fold_persisted",
+    "histogram_persisted",
     "sync_every_default",
+    "topk_service_persisted",
     "warm_device_fold",
+    "warm_histogram",
+    "warm_topk_service",
 ]

@@ -1,0 +1,359 @@
+"""On-device top-k / histogram service for grep & indexer workloads.
+
+The streaming grep and indexer engines (``parallel/grepstream.py``)
+produce per-step *statistics* — per-line match-occurrence counts, and
+per-word posting (document-frequency) increments — whose host merge is
+tiny but whose per-step D2H pull carries the tunnel's fixed transfer
+latency every single step, exactly the cost shape ``DeviceTable`` solved
+for the word-count stream.  This module grows the ROADMAP's named next
+consumer on the same fold machinery:
+
+* :class:`DeviceTopK` — a persistent donated (key, count) table with one
+  compiled merge program per confirmed step, built directly ON
+  :class:`~dsi_tpu.device.table.DeviceTable`: folds lag the engines'
+  deferred-exactness window (``lag`` = pipeline depth), a fold whose
+  merged uniques overflow the capacity rung is a global no-op recovered
+  by the drain→realloc×4→re-fold orphan protocol, and counts are uint64
+  (cross-step sums outlive uint32 long before a stream ends).  What the
+  subclass changes is the SYNC shape: instead of drain+clear, a sync
+  pulls a compiled count-sorted **top-k snapshot** — ``k`` rows over the
+  wire, not capacity — leaving the table resident so the final
+  ``close()`` drain (into the host accumulator) stays exact.  The engine
+  therefore reports the current leaders every K folds for the price of
+  k rows, and host *data* pulls drop from one-per-step to
+  ``widens + 1`` (the close), with ``ceil(folds/K)`` snapshot pulls on
+  top — the amortization ``step_pulls`` vs ``sync_pulls``/``widens``/
+  ``topk_snapshots`` makes visible.
+* :class:`DeviceHistogram` — a persistent uint64 slot vector (per-line
+  match-count buckets plus running totals) folded with one compiled
+  donated add per confirmed step.  Addition cannot overflow a rung
+  (slots are static, counts uint64), so there is no widen path and no
+  flags to confirm — the degenerate, always-exact end of the fold
+  machinery.  Syncs pull the tiny vector without clearing (running
+  totals stay device-resident); ``close`` returns the final totals.
+* :class:`KeyCounts` — the host accumulator for DeviceTable drains whose
+  keys are opaque u64 identities (grep's global line numbers) rather
+  than word spellings; ``PackedCounts`` keeps serving the word-keyed
+  tables (the indexer's document-frequency drain).
+
+Exactness contract, same as every service here: the engines' results are
+bit-identical to their depth=1 host-merge paths because folds consume
+exactly the confirmed per-step tensors the host merge would, widen
+drains never drop keys, and the final close drain hands the host the
+complete remainder.  Snapshots are observability only — they are never
+an input to the result.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsi_tpu.device.table import (
+    DeviceTable,
+    _clear_program,
+    _fold_program,
+    _pack_program,
+    _pow2,
+    _quiet_unusable_donation,
+    _step_structs,
+    _table_structs,
+)
+from dsi_tpu.parallel.shuffle import AXIS
+from dsi_tpu.utils.jaxcompat import enable_x64, x64_scoped
+
+
+class KeyCounts:
+    """Host accumulator for drains whose kk=2 key lanes encode one opaque
+    uint64 identity (hi, lo) — e.g. grep's global line numbers.  Mirrors
+    the slice of the ``PackedCounts`` interface ``DeviceTable._pull_merge``
+    drives (``add(keys, lens, cnts, parts)``); lens/parts are carried by
+    the wire format but meaningless for opaque keys and ignored."""
+
+    def __init__(self):
+        self._counts: Dict[int, int] = {}
+
+    def add(self, keys: np.ndarray, lens, cnts, parts) -> None:
+        k = np.asarray(keys, dtype=np.uint64)
+        key64 = (k[:, 0] << np.uint64(32)) | k[:, 1]
+        for key, c in zip(key64.tolist(), np.asarray(cnts).tolist()):
+            self._counts[key] = self._counts.get(key, 0) + int(c)
+
+    def finalize(self) -> Dict[int, int]:
+        return dict(self._counts)
+
+
+def _topk_impl(tkeys, tlens, tcnts, *, k: int):
+    """Count-descending top-``k`` slice of each device's table shard:
+    sort along the capacity dimension by bitwise-NOT count (uint64
+    descending as an ascending sort; empty rows carry count 0 → ~0 =
+    u64-max → they sort last) with the key lanes as ascending
+    tie-breakers, then take the first k rows.  Per-row sort along dim 1
+    needs no cross-device communication, so the sharded table sorts in
+    place."""
+    kk = tkeys.shape[2]
+    with enable_x64(True):
+        neg = ~tcnts
+        ops = (neg,) + tuple(tkeys[:, :, j] for j in range(kk)) + (tlens,)
+        s = lax.sort(ops, dimension=1, num_keys=1 + kk)
+        scnts = ~s[0][:, :k]
+    skeys = jnp.stack([s[1 + j][:, :k] for j in range(kk)], axis=2)
+    slens = s[1 + kk][:, :k]
+    return skeys, slens, scnts
+
+
+def _topk_program(*, n_dev: int, cap: int, kk: int, k: int):
+    def fn(tkeys, tlens, tcnts):
+        return _topk_impl(tkeys, tlens, tcnts, k=k)
+
+    return f"topk_pack_d{n_dev}_c{cap}_k{kk}_t{k}", fn
+
+
+_topk_jit = x64_scoped(jax.jit(_topk_impl, static_argnames=("k",)))
+
+
+class DeviceTopK(DeviceTable):
+    """Persistent on-device (key, count) table with count-sorted top-k
+    snapshot syncs.
+
+    Everything about folding, lagged confirmation, overflow recovery and
+    the final drain is inherited verbatim from :class:`DeviceTable`; the
+    one behavioral change is :meth:`sync`, which pulls the k heaviest
+    rows (``snapshot``) instead of draining — the table stays resident
+    so cross-window counts keep summing on device and the ``close()``
+    drain remains the single exact hand-off to the host accumulator.
+
+    Counting contract: ``topk_snapshots`` counts snapshot pulls (k rows
+    each); ``sync_pulls`` counts DATA drains only (the close, inherited)
+    and ``widens`` the recovery drains — so an engine's host pulls are
+    ``topk_snapshots + widens + 1`` against ``steps`` on the per-step
+    path.
+    """
+
+    def __init__(self, mesh: Mesh, *, kk: int, cap: int, k: int, acc,
+                 aot: bool = False, lag: int = 1,
+                 stats: Optional[dict] = None):
+        super().__init__(mesh, kk=kk, cap=cap, acc=acc, aot=aot, lag=lag,
+                         stats=stats)
+        self.k = int(k)
+        self.stats.setdefault("topk_snapshots", 0)
+        #: Last snapshot: ((count, key_lanes_tuple, len), ...) count
+        #: desc, key asc — observability only, never a result input.
+        self.snapshot: Tuple = ()
+
+    def _topk_fn(self):
+        if not self.aot:
+            return functools.partial(_topk_jit, k=self.k)
+        from dsi_tpu.backends import aotcache
+
+        name, fn = _topk_program(n_dev=self.n_dev, cap=self.cap,
+                                 kk=self.kk, k=self.k)
+        t = _table_structs(self.n_dev, self.cap, self.kk)
+        return aotcache.cached_compile(name, fn, (t[0], t[1], t[2]),
+                                       x64=True)
+
+    def sync(self) -> bool:
+        """The K-fold snapshot pull: flush the fold lag (recovering any
+        late-detected overflow), then pull the top-k rows — no drain, no
+        clear.  Returns True when a snapshot crossed the wire (an empty
+        table skips it)."""
+        t0 = time.perf_counter()
+        orphans = self._flush_pending()
+        if orphans:
+            self._recover(orphans)
+        pulled = False
+        if int(self._nrows.max()):
+            tkeys, tlens, tcnts, _, _ = self._state
+            skeys, slens, scnts = self._topk_fn()(tkeys, tlens, tcnts)
+            keys_np = np.asarray(skeys)
+            lens_np = np.asarray(slens)
+            cnts_np = np.asarray(scnts)
+            rows: List[Tuple] = []
+            for d in range(self.n_dev):
+                # Rows past this shard's occupancy sorted last with
+                # count 0 (pad) — drop them by count, not by position,
+                # so a shard with < k rows contributes exactly its own.
+                for i in range(min(self.k, int(self._nrows[d]))):
+                    c = int(cnts_np[d, i])
+                    if c <= 0:
+                        break
+                    rows.append((c, tuple(keys_np[d, i].tolist()),
+                                 int(lens_np[d, i])))
+            rows.sort(key=lambda r: (-r[0], r[1]))
+            self.snapshot = tuple(rows[:self.k])
+            self.stats["topk_snapshots"] += 1
+            pulled = True
+        self.stats["sync_s"] += time.perf_counter() - t0
+        return pulled
+
+
+def warm_topk_service(mesh: Mesh, *, kk: int, rows: int, cap: int, k: int,
+                      table_rungs: int = 2) -> None:
+    """Compile + persist the fold/clear/pack/snapshot shapes a
+    :class:`DeviceTopK` reaches at this per-fold ``rows`` shape: the
+    given capacity rung plus ``table_rungs - 1`` ×4 widenings, from
+    shape structs alone — same discipline as
+    ``table.warm_device_fold``."""
+    from dsi_tpu.backends import aotcache
+
+    n_dev = mesh.devices.size
+    cap = _pow2(cap)
+    for _ in range(max(1, table_rungs)):
+        table = _table_structs(n_dev, cap, kk)
+        step = _step_structs(n_dev, rows, kk)
+        name, fn = _fold_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk,
+                                 rows=rows)
+        with _quiet_unusable_donation():
+            aotcache.cached_compile(name, fn, table + step,
+                                    donate_argnums=(0, 1, 2, 3, 4),
+                                    x64=True)
+        name, fn = _clear_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk)
+        with _quiet_unusable_donation():
+            aotcache.cached_compile(name, fn, table,
+                                    donate_argnums=(0, 1, 2, 3, 4),
+                                    x64=True)
+        name, fn = _pack_program(n_dev=n_dev, cap=cap, kk=kk, mp=cap)
+        aotcache.cached_compile(
+            name, fn, (table[0], table[1], table[3], table[2]), x64=True)
+        name, fn = _topk_program(n_dev=n_dev, cap=cap, kk=kk, k=k)
+        aotcache.cached_compile(name, fn, (table[0], table[1], table[2]),
+                                x64=True)
+        cap *= 4
+
+
+def topk_service_persisted(mesh: Mesh, *, kk: int, rows: int, cap: int,
+                           k: int) -> bool:
+    """True when the rung-0 programs a :class:`DeviceTopK` executes at
+    this shape are already in the persistent AOT cache."""
+    from dsi_tpu.backends.aotcache import is_persisted
+    from dsi_tpu.device.table import _TABLE_DONATE
+
+    n_dev = mesh.devices.size
+    cap = _pow2(cap)
+    table = _table_structs(n_dev, cap, kk)
+    step = _step_structs(n_dev, rows, kk)
+    name, fn = _fold_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk,
+                             rows=rows)
+    if not is_persisted(name, fn, table + step,
+                        donate_argnums=_TABLE_DONATE):
+        return False
+    name, fn = _pack_program(n_dev=n_dev, cap=cap, kk=kk, mp=cap)
+    if not is_persisted(name, fn, (table[0], table[1], table[3], table[2])):
+        return False
+    name, fn = _topk_program(n_dev=n_dev, cap=cap, kk=kk, k=k)
+    return is_persisted(name, fn, (table[0], table[1], table[2]))
+
+
+# ── histogram ──────────────────────────────────────────────────────────
+
+
+def _hist_fold_impl(state, step):
+    with enable_x64(True):
+        return state + step.astype(jnp.uint64)
+
+
+_hist_fold_jit = x64_scoped(jax.jit(_hist_fold_impl, donate_argnums=(0,)))
+
+
+def _hist_program(*, n_dev: int, slots: int):
+    def fn(state, step):
+        return _hist_fold_impl(state, step)
+
+    return f"topk_hist_fold_d{n_dev}_s{slots}", fn
+
+
+def _hist_structs(n_dev: int, slots: int):
+    sds = jax.ShapeDtypeStruct
+    return (sds((n_dev, slots), jnp.uint64), sds((n_dev, slots), jnp.uint32))
+
+
+class DeviceHistogram:
+    """Persistent ``[n_dev, slots]`` uint64 accumulation vector over the
+    mesh, folded with one compiled donated add per confirmed step.  The
+    engines use the slots for per-line match-count buckets plus running
+    totals (lines/matched/occurrences ride the same vector, so one fold
+    program and one pull cover all the stream's scalars).
+
+    No flags, no lag, no widen: a uint64 add cannot overflow a rung and
+    cannot fail, so confirmation is trivially the dispatch itself — the
+    degenerate end of the fold machinery, by design.
+
+    ``pull()`` returns the running totals summed over devices without
+    clearing; ``close()`` is the final pull.  ``stats`` receives
+    ``hist_folds``/``hist_pulls``/``hist_s``.
+    """
+
+    def __init__(self, mesh: Mesh, *, slots: int, aot: bool = False,
+                 stats: Optional[dict] = None):
+        self.mesh = mesh
+        self.n_dev = int(mesh.devices.size)
+        self.slots = int(slots)
+        self.aot = bool(aot)
+        self.stats = stats if stats is not None else {}
+        for key in ("hist_folds", "hist_pulls"):
+            self.stats.setdefault(key, 0)
+        self.stats.setdefault("hist_s", 0.0)
+        sh = NamedSharding(mesh, P(AXIS, None))
+        with enable_x64(True):
+            self._state = jax.device_put(
+                np.zeros((self.n_dev, self.slots), np.uint64), sh)
+
+    def _fold_fn(self):
+        if not self.aot:
+            return _hist_fold_jit
+        from dsi_tpu.backends import aotcache
+
+        name, fn = _hist_program(n_dev=self.n_dev, slots=self.slots)
+        with _quiet_unusable_donation():
+            return aotcache.cached_compile(
+                name, fn, _hist_structs(self.n_dev, self.slots),
+                donate_argnums=(0,), x64=True)
+
+    def fold(self, step_dev) -> None:
+        """Add one confirmed step's ``[n_dev, slots]`` uint32 vector into
+        the running totals (async, donated state)."""
+        t0 = time.perf_counter()
+        with _quiet_unusable_donation():
+            self._state = self._fold_fn()(self._state, step_dev)
+        self.stats["hist_folds"] += 1
+        self.stats["hist_s"] += time.perf_counter() - t0
+
+    def pull(self) -> np.ndarray:
+        """Running totals summed over devices — ``[slots]`` int64.  No
+        clear: the vector keeps accumulating on device."""
+        t0 = time.perf_counter()
+        out = np.asarray(self._state).astype(np.int64).sum(axis=0)
+        self.stats["hist_pulls"] += 1
+        self.stats["hist_s"] += time.perf_counter() - t0
+        return out
+
+    def close(self) -> np.ndarray:
+        out = self.pull()
+        self._state = None
+        return out
+
+
+def warm_histogram(mesh: Mesh, *, slots: int) -> None:
+    """Compile + persist the histogram fold at this slot count."""
+    from dsi_tpu.backends import aotcache
+
+    name, fn = _hist_program(n_dev=mesh.devices.size, slots=slots)
+    with _quiet_unusable_donation():
+        aotcache.cached_compile(name, fn,
+                                _hist_structs(mesh.devices.size, slots),
+                                donate_argnums=(0,), x64=True)
+
+
+def histogram_persisted(mesh: Mesh, *, slots: int) -> bool:
+    from dsi_tpu.backends.aotcache import is_persisted
+
+    name, fn = _hist_program(n_dev=mesh.devices.size, slots=slots)
+    return is_persisted(name, fn, _hist_structs(mesh.devices.size, slots),
+                        donate_argnums=(0,))

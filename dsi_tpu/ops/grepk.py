@@ -26,6 +26,20 @@ import dsi_tpu.ops.wordcount as _wordcount_mod
 from dsi_tpu.ops.wordcount import _pad_pow2, _shift_left
 
 
+def cold_ok() -> bool:
+    """THE cold-compile bypass knob: ``DSI_COLD_OK=1`` disables every
+    device-readiness gate (this module's, the NFA tier's, and anything
+    the streaming grep/indexer/top-k programs grow) for processes whose
+    JOB the compiles are — scripts/warm_kernels.py sets it around its
+    warm blocks.  The historical per-tier names ``DSI_GREP_COLD_OK`` /
+    ``DSI_NFA_COLD_OK`` remain as aliases so existing scripts and soak
+    recipes keep working, but new gates must consult this one function
+    rather than growing a third env var."""
+    return any(os.environ.get(v) == "1"
+               for v in ("DSI_COLD_OK", "DSI_GREP_COLD_OK",
+                         "DSI_NFA_COLD_OK"))
+
+
 def device_ready(name: str, fn, example, static) -> bool:
     """Whether dispatching this compiled shape NOW is a millisecond load
     or a multi-minute remote compile — the bench's
@@ -33,10 +47,9 @@ def device_ready(name: str, fn, example, static) -> bool:
     tier's rung gate (ADVICE r4: the l_cap escalation rung is a
     separately compiled shape, and an ungated escalation cold-compiles
     inside a worker task).  CPU backends are always ready (compiles are
-    seconds); ``DSI_GREP_COLD_OK=1`` / ``DSI_NFA_COLD_OK=1`` bypass the
-    gate for scripts/warm_kernels.py, whose job the compiles are."""
-    if os.environ.get("DSI_GREP_COLD_OK") == "1" \
-            or os.environ.get("DSI_NFA_COLD_OK") == "1":
+    seconds); ``DSI_COLD_OK=1`` (see :func:`cold_ok`) bypasses the gate
+    for scripts/warm_kernels.py, whose job the compiles are."""
+    if cold_ok():
         return True
     if jax.devices()[0].platform == "cpu":
         return True

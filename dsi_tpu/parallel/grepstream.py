@@ -1,0 +1,1048 @@
+"""Streaming grep / indexer engines on the shared pipeline core.
+
+The grep and indexer apps (``apps/tpu_grep.py``, ``apps/tpu_indexer.py``
+— the working realizations of the reference's ``mrapps/dgrep.go`` /
+``mrapps/indexer.go`` intent) run per-file through the MR framework:
+every file pays a full host round-trip, and no cross-step state lives on
+device.  This module gives both workloads the treatment word count and
+TF-IDF already got — engines that consume the shared dispatch/finish
+pipeline core (``parallel/pipeline.py``) with the same contract those
+engines honor bit-identically:
+
+* a background producer feeds a bounded queue (``batch_lines`` /
+  ``_wave_chunk`` materialization off the critical path),
+* a ``depth``-deep in-flight window of donated per-step uploads through
+  ``aotcache.cached_compile(donate_argnums)``,
+* per-step scalar checks DEFERRED until a step leaves the window, with
+  exactly-once replay at sticky rungs — for grep that rung is the
+  ``l_cap`` line-capacity ladder (``ops/grepk.line_cap_rungs``): the
+  kernel's former host-fallback escalation folded into the pipeline's
+  replay protocol, so a short-line stream replays one step at the wider
+  compiled shape and the shape sticks, instead of abandoning the device
+  path,
+* cross-step state on device via ``dsi_tpu/device/``: grep folds
+  per-line match-count histograms (:class:`DeviceHistogram`) and top-k
+  match candidates (:class:`DeviceTopK`), the indexer appends postings
+  (:class:`DevicePostings`) and folds per-word document-frequency rows
+  into the same top-k table — all lagging the deferred-exactness window
+  and syncing under ``SyncPolicy``, so host pulls drop from one-per-step
+  to the K-fold cadence plus widens.
+
+Grep semantics, stated exactly (the oracle below implements the same
+rules byte-for-byte): the stream is '\\n'-delimited byte lines (a
+trailing newline opens no final empty line); a line's match count is the
+number of positions where the literal pattern's bytes occur (overlapping
+occurrences count); the engine reports total lines / matched lines /
+occurrences, a ``bins``-bucket per-line match-count histogram (bucket =
+``min(occ, bins-1)``), and the top-k lines by occurrence count (ties to
+the earlier line).  Per-(step, device) top-k candidate pruning on device
+is EXACT: a line in the global top-k is necessarily in the top-k of its
+own step and device under the same (count desc, line asc) order, so the
+pruned candidate multiset always contains the global winners.
+
+Indexer semantics: documents are processed in waves of ``n_dev`` (one
+per device, ``plan_waves`` sizing), the posting step is the word-count
+map prologue with a (tf ≡ 1, doc, part) payload — one posting row per
+distinct word per document — shuffled to the partition owner exactly as
+in ``parallel/shuffle.py``; the result is ``{word: (part, [doc ids in
+wave order])}`` plus the top-k words by document frequency.  Posting
+order is an invariant through every path (the per-wave pull path and the
+``DevicePostings`` sticky-overflow recovery both preserve it).
+
+Both engines return None only when the input needs the host path (a
+non-literal pattern or a line wider than the chunk for grep; non-ASCII
+bytes or >64-byte words for the indexer) — correctness never depends on
+a kernel (``backends/tpu.py`` contract).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Iterable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsi_tpu.device.policy import SyncPolicy
+from dsi_tpu.device.table import _pow2, _quiet_unusable_donation
+from dsi_tpu.device.topk import DeviceHistogram, DeviceTopK, KeyCounts
+from dsi_tpu.ops.grepk import is_literal_pattern, line_cap_rungs
+from dsi_tpu.ops.wordcount import (
+    _PAD_KEY64,
+    _shift_left,
+    grouper_ladder,
+    pack_key_lanes,
+    rung0_cap,
+    unpack_key_lanes,
+    warm_groupers,
+)
+from dsi_tpu.parallel.merge import PackedCounts, PostingsTable
+from dsi_tpu.parallel.pipeline import (
+    BufferPool,
+    StepPipeline,
+    pipeline_depth,
+)
+from dsi_tpu.parallel.shuffle import (
+    AXIS,
+    default_mesh,
+    map_prologue,
+    occupied_prefix,
+    shuffle_rows,
+)
+from dsi_tpu.utils.jaxcompat import enable_x64, shard_map
+
+import dsi_tpu.ops.grepk as _grepk_mod
+import dsi_tpu.ops.wordcount as _wc_mod
+import dsi_tpu.parallel.shuffle as _sh_mod
+
+#: Histogram buckets for per-line match counts: bucket b < bins-1 holds
+#: lines with exactly b occurrences, the last bucket everything wider.
+GREP_BINS = 8
+
+#: Bench grep-row chunk shape — ONE definition shared by the bench's
+#: cache-existence gate, the row's run, and scripts/warm_kernels.py
+#: --phase grep, so the probed key cannot drift from the key the run
+#: compiles (the STREAM_CHUNK_BYTES discipline).
+GREP_CHUNK_BYTES = 1 << 21
+
+#: jax.jit donate_argnums for the grep step program: the chunk upload is
+#: consumed by the kernel (pattern/lens/bases survive — the pattern is
+#: uploaded once per stream and reused every step).
+_GREP_DONATE = (0,)
+
+#: Default top-k candidate rows kept per stream/walk.
+DEFAULT_TOPK = 16
+
+
+class _LineTooLong(Exception):
+    """A line wider than one chunk row: the stream needs the host path."""
+
+
+def _topk_cap_env() -> int:
+    """The ``DSI_DEVICE_TOPK_CAP`` override (0 = unset/malformed) — the
+    HBM lever for the top-k candidate table's starting rung, and the
+    test hook that forces the widen path mid-stream.  One parser for
+    both engines, so the knob cannot be read differently."""
+    try:
+        return max(0, int(os.environ.get("DSI_DEVICE_TOPK_CAP", "0")))
+    except ValueError:
+        return 0
+
+
+def _default_topk_cap(n_dev: int, k: int) -> int:
+    """Rung-0 capacity for grep's candidate table: enough for ~hundreds
+    of folds between widens at the default shapes, overridable by
+    ``DSI_DEVICE_TOPK_CAP``."""
+    return _topk_cap_env() or _pow2(max(1 << 14, n_dev * k))
+
+
+# ── line batching ──────────────────────────────────────────────────────
+
+
+def batch_lines(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
+                pool: Optional[BufferPool] = None):
+    """Slice a byte-block stream into zero-padded ``[n_dev, chunk_bytes]``
+    batches, cutting rows only at newline boundaries so no line straddles
+    a row.  Yields ``(batch, lens, row_lines)`` — per-row valid byte
+    counts and per-row line counts (the host side of the device's line
+    accounting: newlines plus an unterminated tail line).
+
+    With ``pool`` batches come from the engine's rotating buffer set;
+    the consumer hands each batch back via ``pool.give`` once its step
+    is confirmed.  A line wider than ``chunk_bytes`` raises
+    :class:`_LineTooLong` — the stream is the host path's then.
+    """
+    carry = bytearray()
+
+    def new_batch() -> np.ndarray:
+        if pool is not None:
+            return pool.take()
+        return np.zeros((n_dev, chunk_bytes), dtype=np.uint8)
+
+    batch = new_batch()
+    lens = np.zeros(n_dev, dtype=np.int32)
+    row_lines = np.zeros(n_dev, dtype=np.int64)
+    row = 0
+
+    def fill_rows(final: bool):
+        nonlocal batch, lens, row_lines, row
+        while carry and (len(carry) > chunk_bytes or final):
+            if len(carry) <= chunk_bytes:
+                cut = len(carry)  # final tail: whole remainder fits
+            else:
+                win = np.frombuffer(memoryview(carry)[:chunk_bytes],
+                                    dtype=np.uint8)
+                hits = np.flatnonzero(win == 10)
+                del win  # release the export before the carry resize
+                if hits.size == 0:
+                    raise _LineTooLong
+                cut = int(hits[-1]) + 1  # cut AFTER the last newline
+            view = np.frombuffer(carry, dtype=np.uint8, count=cut)
+            batch[row, :cut] = view
+            n_nl = int(np.count_nonzero(view == 10))
+            del view
+            del carry[:cut]
+            batch[row, cut:] = 0
+            lens[row] = cut
+            row_lines[row] = n_nl + (1 if batch[row, cut - 1] != 10 else 0)
+            row += 1
+            if row == n_dev:
+                yield batch, lens, row_lines
+                batch = new_batch()
+                lens = np.zeros(n_dev, dtype=np.int32)
+                row_lines = np.zeros(n_dev, dtype=np.int64)
+                row = 0
+
+    for block in blocks:
+        carry.extend(block)
+        yield from fill_rows(final=False)
+    yield from fill_rows(final=True)
+    if row:
+        batch[row:] = 0  # recycled buffer: stale tail rows must not count
+        yield batch, lens, row_lines
+    elif pool is not None:
+        pool.give(batch)
+
+
+# ── the grep step program ──────────────────────────────────────────────
+
+
+def _grep_step_device(chunk, pat, dlen, base, *, l_cap: int, bins: int,
+                      k: int):
+    """Per-device step body (runs under shard_map): literal match mask
+    (``len(pattern)`` shifted compares, the ``ops/grepk.py`` idiom) →
+    per-line occurrence counts (cumsum line ids + segment-sum) →
+    histogram, totals, and the top-k candidate rows in DeviceTable's
+    packed (key lanes, len, count, part) layout with the GLOBAL line
+    number (``base`` + local) as the kk=2 key."""
+    n = chunk.shape[-1]
+    m = pat.shape[-1]
+    chunk = chunk.reshape(-1)
+    pat = pat.reshape(-1)
+    dlen0 = dlen.reshape(())
+    base0 = base.reshape(())
+
+    match = jnp.ones(n, jnp.bool_)
+    for j in range(m):  # static unroll over the (short) pattern
+        match &= _shift_left(chunk, j) == pat[j]
+
+    pos = jnp.arange(n, dtype=jnp.int32)
+    valid = pos < dlen0
+    is_nl = (chunk == 10) & valid
+    nl_i32 = is_nl.astype(jnp.int32)
+    line_id = jnp.cumsum(nl_i32) - nl_i32  # newlines strictly before i
+    nl_total = jnp.sum(nl_i32)
+    last = jnp.where(dlen0 > 0, chunk[jnp.maximum(dlen0 - 1, 0)],
+                     jnp.uint8(10))
+    n_lines = nl_total + jnp.where((dlen0 > 0) & (last != 10), 1, 0)
+    overflow = n_lines > l_cap
+
+    # Padding bytes are zeros and the pattern is printable ASCII, so a
+    # match can neither start in nor extend into padding; occurrences
+    # therefore attribute to real lines only.
+    seg = jnp.minimum(line_id, l_cap)
+    occ = jax.ops.segment_sum(match.astype(jnp.int32), seg,
+                              num_segments=l_cap + 1,
+                              indices_are_sorted=True)[:l_cap]
+    lrange = jnp.arange(l_cap, dtype=jnp.int32)
+    line_valid = lrange < n_lines
+    occv = jnp.where(line_valid, occ, 0)
+    matched = jnp.sum((occv > 0).astype(jnp.int32))
+    occurrences = jnp.sum(occv)
+
+    bucket = jnp.where(line_valid, jnp.minimum(occv, bins - 1), bins)
+    hist = jax.ops.segment_sum(jnp.ones(l_cap, jnp.uint32), bucket,
+                               num_segments=bins + 1)[:bins]
+    hist_ext = jnp.concatenate(
+        [hist, jnp.stack([n_lines, matched, occurrences]).astype(jnp.uint32)])
+
+    # Top-k candidates among matched lines, (count desc, line asc): the
+    # per-device pruning that keeps candidate folds k rows per step.
+    is_cand = line_valid & (occ > 0)
+    big = jnp.int32(0x7FFFFFFF)
+    neg = jnp.where(is_cand, big - occv, big)
+    sneg, slid = lax.sort((neg, lrange), num_keys=2)
+    top_occ = jnp.where(sneg[:k] < big, big - sneg[:k], 0)
+    top_lid = slid[:k]
+    n_cand = jnp.minimum(matched, k)
+    cvalid = jnp.arange(k, dtype=jnp.int32) < n_cand
+    with enable_x64(True):
+        gline = base0 + top_lid.astype(jnp.uint64)
+        hi = jnp.where(cvalid, (gline >> 32).astype(jnp.uint32),
+                       jnp.uint32(0))
+        lo = jnp.where(cvalid, gline.astype(jnp.uint32), jnp.uint32(0))
+    cand = jnp.stack(
+        [hi, lo,
+         jnp.where(cvalid, jnp.uint32(8), jnp.uint32(0)),
+         jnp.where(cvalid, top_occ.astype(jnp.uint32), jnp.uint32(0)),
+         jnp.zeros(k, jnp.uint32)], axis=1)
+
+    # Pin to int32: under the x64-scoped compile, literal-int promotion
+    # would widen these to int64 and drift off the struct-warmed fold
+    # program's [n_dev, 5] int32 contract (device/table._step_structs).
+    scal = jnp.stack([n_cand, n_lines, overflow.astype(jnp.int32),
+                      matched, occurrences]).astype(jnp.int32)
+    return hist_ext[None], cand[None], scal[None]
+
+
+def _grep_step_impl(chunks, pats, lens, bases, *, l_cap: int, bins: int,
+                    k: int, mesh: Mesh):
+    body = functools.partial(_grep_step_device, l_cap=l_cap, bins=bins, k=k)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS, None), P(AXIS, None, None), P(AXIS, None)),
+    )(chunks, pats, lens, bases)
+
+
+def _grep_program(*, n_dev: int, chunk_bytes: int, m: int, l_cap: int,
+                  bins: int, k: int, mesh: Mesh):
+    """(name, fn) for one compiled grep step shape — single definition
+    shared by the run, the warmer, and the cache-existence probe (the
+    ``streaming._step_program`` discipline)."""
+
+    def fn(chunks, pats, lens, bases):
+        return _grep_step_impl(chunks, pats, lens, bases, l_cap=l_cap,
+                               bins=bins, k=k, mesh=mesh)
+
+    fn._aot_code_deps = (_wc_mod, _grepk_mod)
+    name = (f"grep_stream_d{n_dev}_c{chunk_bytes}_m{m}_l{l_cap}"
+            f"_b{bins}_t{k}")
+    return name, fn
+
+
+def _grep_examples(n_dev: int, chunk_bytes: int, m: int):
+    sds = jax.ShapeDtypeStruct
+    return (sds((n_dev, chunk_bytes), jnp.uint8),
+            sds((n_dev, m), jnp.uint8),
+            sds((n_dev,), jnp.int32),
+            sds((n_dev,), jnp.uint64))
+
+
+def _grep_fn(example_args, **kw):
+    """Compiled grep step via the persistent AOT executable cache —
+    serialized loads for fresh single-device axon processes, per-shape
+    memo on the virtual multi-device mesh (the ``tfidf._wave_fn``
+    rationale)."""
+    from dsi_tpu.backends import aotcache
+
+    name, fn = _grep_program(**kw)
+    with _quiet_unusable_donation():  # a cold entry compiles right here
+        return aotcache.cached_compile(name, fn, example_args,
+                                       donate_argnums=_GREP_DONATE,
+                                       x64=True)
+
+
+# ── grep engine ────────────────────────────────────────────────────────
+
+
+class GrepStreamResult(NamedTuple):
+    """Whole-stream grep statistics.  ``hist[b]`` is the number of lines
+    with ``min(occurrences, bins-1) == b``; ``topk`` is ``((line_no,
+    occ), ...)`` count desc, line asc — exact, not approximate."""
+
+    lines: int
+    matched: int
+    occurrences: int
+    hist: Tuple[int, ...]
+    topk: Tuple[Tuple[int, int], ...]
+
+
+def _count_occurrences(line: bytes, pat: bytes) -> int:
+    """Overlapping occurrence count — the engine counts every position
+    where the pattern starts, so the oracle must too (``bytes.count`` is
+    non-overlapping and would disagree on self-overlapping patterns)."""
+    n = 0
+    i = line.find(pat)
+    while i >= 0:
+        n += 1
+        i = line.find(pat, i + 1)
+    return n
+
+
+def grep_host_oracle(blocks: Iterable[bytes], pattern: str, *,
+                     bins: int = GREP_BINS,
+                     topk: int = DEFAULT_TOPK) -> GrepStreamResult:
+    """Single-pass host oracle with the engine's exact semantics — the
+    parity ground truth for the bench row, the CLI ``--check``, and the
+    test grid (one definition so the three cannot drift)."""
+    pat = pattern.encode("ascii")
+    hist = [0] * bins
+    matched = occurrences = line_no = 0
+    cands: List[Tuple[int, int]] = []
+    carry = b""
+
+    def take(line: bytes) -> None:
+        nonlocal matched, occurrences, line_no
+        occ = _count_occurrences(line, pat)
+        hist[min(occ, bins - 1)] += 1
+        if occ:
+            matched += 1
+            occurrences += occ
+            cands.append((line_no, occ))
+        line_no += 1
+
+    for block in blocks:
+        parts = (carry + bytes(block)).split(b"\n")
+        carry = parts.pop()  # the unterminated tail stays pending
+        for line in parts:
+            take(line)
+    if carry:
+        take(carry)  # a final line without a trailing newline
+    top = tuple(sorted(cands, key=lambda r: (-r[1], r[0]))[:topk])
+    return GrepStreamResult(line_no, matched, occurrences, tuple(hist), top)
+
+
+def grep_streaming(
+        blocks: Iterable[bytes], pattern: str, mesh: Mesh | None = None,
+        chunk_bytes: int = 1 << 20, depth: Optional[int] = None,
+        aot: bool = False, device_accumulate: bool = False,
+        sync_every: Optional[int] = None, topk: int = DEFAULT_TOPK,
+        bins: int = GREP_BINS, pipeline_stats: Optional[dict] = None,
+) -> Optional[GrepStreamResult]:
+    """Whole-stream literal grep with bounded memory, pipelined.
+
+    Returns a :class:`GrepStreamResult`, or None when the stream needs
+    the host path (non-literal pattern, or a line wider than
+    ``chunk_bytes``).  Every step runs one compiled program per
+    ``l_cap`` rung; a step whose line count overflows the optimistic
+    rung (average line >= 8 bytes) is detected ``depth - 1`` steps late
+    and replays exactly that step at the ``n + 1`` hard-bound rung —
+    which then STICKS for every later step (``ops/grepk.line_cap_rungs``
+    escalation as pipeline replay, not host fallback).  Results are
+    bit-identical to ``depth=1`` because the accumulators only ever
+    ingest confirmed per-step tensors, which the replay reproduces
+    exactly (occurrence counts do not depend on the rung).
+
+    ``device_accumulate=True`` folds each confirmed step's histogram
+    vector into a persistent :class:`DeviceHistogram` and its top-k
+    candidate rows into a :class:`DeviceTopK` (lag = pipeline depth),
+    pulling only a top-k snapshot + the histogram vector every
+    ``sync_every`` folds (``DSI_STREAM_SYNC_EVERY`` default) plus the
+    final close drain — ``step_pulls`` drops to 0 and ``sync_pulls``
+    counts the K-fold windows (+1 close), with ``widens`` the
+    drain→realloc×4→re-fold recoveries of a candidate table that
+    outgrew its rung.  Results stay bit-identical: histogram folds are
+    exact uint64 adds, candidate keys (global line numbers) are unique,
+    and the close drain hands the host the complete multiset the
+    per-step path would have pulled.
+
+    ``pipeline_stats`` mirrors ``wordcount_streaming``'s dict
+    (``batch_s``/``batch_wait_s``/``upload_s``/``kernel_s``/``pull_s``/
+    ``merge_s``/``replay_s``, ``steps``/``replays``/``step_pulls``/
+    ``sync_pulls``/``l_cap`` plus the service counters).
+    """
+    if not is_literal_pattern(pattern):
+        return None
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    depth = pipeline_depth(depth)
+    m = len(pattern)
+    rungs = line_cap_rungs(chunk_bytes)
+    state = {"l_cap": rungs[0]}
+    stats = {"depth": depth, "steps": 0, "replays": 0, "step_pulls": 0,
+             "sync_pulls": 0, "device_accumulate": device_accumulate,
+             "l_cap": rungs[0], "batch_s": 0.0, "batch_wait_s": 0.0,
+             "upload_s": 0.0, "kernel_s": 0.0, "pull_s": 0.0,
+             "merge_s": 0.0, "replay_s": 0.0}
+    sh2 = NamedSharding(mesh, P(AXIS, None))
+    sh1 = NamedSharding(mesh, P(AXIS))
+    pat_np = np.tile(np.frombuffer(pattern.encode("ascii"), np.uint8),
+                     (n_dev, 1))
+    pat_dev = jax.device_put(pat_np, sh2)  # once per stream, never donated
+    pool = BufferPool((n_dev, chunk_bytes), retain=2 * depth + 3)
+    next_line = [0]
+
+    # Host-merge accumulators (the depth=1-equivalent path).
+    hist_h = np.zeros(bins, dtype=np.int64)
+    totals = np.zeros(3, dtype=np.int64)  # lines, matched, occurrences
+    cand_h: List[Tuple[int, int]] = []
+
+    # Device services.
+    acc = KeyCounts()
+    hist_svc: Optional[DeviceHistogram] = None
+    topk_svc: Optional[DeviceTopK] = None
+    policy: Optional[SyncPolicy] = None
+    if device_accumulate:
+        policy = SyncPolicy(sync_every)
+        stats["sync_every"] = policy.sync_every
+        hist_svc = DeviceHistogram(mesh, slots=bins + 3, aot=aot,
+                                   stats=stats)
+        topk_svc = DeviceTopK(mesh, kk=2, cap=_default_topk_cap(n_dev, topk),
+                              k=topk, acc=acc, aot=aot,
+                              lag=max(0, depth - 1), stats=stats)
+
+    def step_call(buf, lens_np, bases_np, l_cap):
+        t0 = time.perf_counter()
+        chunks = jax.device_put(buf, sh2)
+        lens = jax.device_put(lens_np, sh1)
+        with enable_x64(True):  # keep the u64 bases u64 through the put
+            bases = jax.device_put(bases_np.astype(np.uint64), sh1)
+        stats["upload_s"] += time.perf_counter() - t0
+        fn = _grep_fn((chunks, pat_dev, lens, bases), n_dev=n_dev,
+                      chunk_bytes=chunk_bytes, m=m, l_cap=l_cap, bins=bins,
+                      k=topk, mesh=mesh)
+        with _quiet_unusable_donation():
+            return fn(chunks, pat_dev, lens, bases)
+
+    def dispatch(item):
+        buf, lens_np, row_lines = item
+        bases = np.zeros(n_dev, dtype=np.int64)
+        bases[0] = next_line[0]
+        np.cumsum(row_lines[:-1], out=bases[1:])
+        bases[1:] += next_line[0]
+        next_line[0] += int(row_lines.sum())
+        hist_d, cand_d, scal = step_call(buf, lens_np, bases,
+                                         state["l_cap"])
+        stats["steps"] += 1
+        return (buf, lens_np, row_lines, bases, state["l_cap"],
+                hist_d, cand_d, scal)
+
+    def replay_step(buf, lens_np, bases_np, used_l_cap):
+        """Late-detected line-capacity overflow: replay just this step
+        at the wider sticky rung.  Exactly-once — the optimistic
+        attempt's tensors are dropped unmerged."""
+        stats["replays"] += 1
+        t0 = time.perf_counter()
+        try:
+            for l_cap in rungs:
+                if l_cap <= used_l_cap:
+                    continue
+                hist_d, cand_d, scal = step_call(buf, lens_np, bases_np,
+                                                 l_cap)
+                scal_np = np.asarray(scal)
+                if not scal_np[:, 2].any():
+                    state["l_cap"] = max(state["l_cap"], l_cap)
+                    stats["l_cap"] = state["l_cap"]
+                    return hist_d, cand_d, scal, scal_np
+        finally:
+            stats["replay_s"] += time.perf_counter() - t0
+        raise RuntimeError("grep l_cap ladder exhausted (n+1 must fit)")
+
+    def finish_one(record) -> None:
+        buf, lens_np, row_lines, bases_np, l_cap_used, hist_d, cand_d, \
+            scal = record
+        t0 = time.perf_counter()
+        scal_np = np.asarray(scal)  # blocks until this step's kernel lands
+        stats["kernel_s"] += time.perf_counter() - t0
+        if scal_np[:, 2].any():  # l_cap overflow: replay wider, sticky
+            hist_d, cand_d, scal, scal_np = replay_step(
+                buf, lens_np, bases_np, l_cap_used)
+        if not np.array_equal(scal_np[:, 1].astype(np.int64), row_lines):
+            # The global line numbering depends on host/device agreeing
+            # on per-row line counts; a disagreement is an engine bug and
+            # must fail loudly, never skew the keys silently.
+            pool.give(buf)
+            raise RuntimeError(
+                f"host/device line-count disagreement: "
+                f"{row_lines.tolist()} vs {scal_np[:, 1].tolist()}")
+        if device_accumulate:
+            hist_svc.fold(hist_d)
+            if int(scal_np[:, 0].max()) > 0:
+                topk_svc.fold(cand_d, scal, scal_np)
+            policy.note_fold()
+            if policy.due():
+                topk_svc.sync()
+                hist_svc.pull()
+                stats["sync_pulls"] += 1
+                policy.reset()
+        else:
+            t0 = time.perf_counter()
+            hist_np = np.asarray(hist_d)
+            cand_np = np.asarray(cand_d)
+            stats["step_pulls"] += 1
+            stats["pull_s"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            hist_h[:] += hist_np[:, :bins].astype(np.int64).sum(axis=0)
+            totals[:] += hist_np[:, bins:].astype(np.int64).sum(axis=0)
+            for d in range(n_dev):
+                nc = int(scal_np[d, 0])
+                for i in range(nc):
+                    line = (int(cand_np[d, i, 0]) << 32) | int(
+                        cand_np[d, i, 1])
+                    cand_h.append((line, int(cand_np[d, i, 3])))
+            stats["merge_s"] += time.perf_counter() - t0
+        pool.give(buf)
+
+    pipe = StepPipeline(depth=depth, dispatch=dispatch, finish=finish_one,
+                        stats=stats, produce_key="batch_s",
+                        wait_key="batch_wait_s",
+                        inflight_key="max_inflight_chunks",
+                        thread_name="dsi-grep-batcher")
+
+    result: Optional[GrepStreamResult]
+    try:
+        pipe.run(lambda: batch_lines(blocks, n_dev, chunk_bytes,
+                                     pool=pool))
+        if device_accumulate:
+            topk_svc.close()  # the exact final drain into the KeyCounts
+            final = hist_svc.close()
+            hist_h = final[:bins]
+            totals = final[bins:]
+            cand_h = [(line, occ) for line, occ in acc.finalize().items()]
+        top = tuple(sorted(cand_h, key=lambda r: (-r[1], r[0]))[:topk])
+        result = GrepStreamResult(int(totals[0]), int(totals[1]),
+                                  int(totals[2]),
+                                  tuple(int(x) for x in hist_h), top)
+    except _LineTooLong:
+        result = None  # caller routes the job to the host path
+    finally:
+        if pipeline_stats is not None:
+            stats["batch_allocs"] = pool.allocs
+            for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",
+                      "pull_s", "merge_s", "replay_s", "fold_s", "sync_s",
+                      "widen_s", "hist_s"):
+                if k in stats:
+                    stats[k] = round(stats[k], 4)
+            pipeline_stats.update(stats)
+    return result
+
+
+def warm_grepstream_aot(mesh: Mesh | None = None,
+                        chunk_bytes: int = 1 << 20, pattern_len: int = 3,
+                        bins: int = GREP_BINS, topk: int = DEFAULT_TOPK,
+                        device_accumulate: bool = False) -> None:
+    """Compile + persist the grep step programs at BOTH ``l_cap`` rungs
+    (the optimistic and the ``n + 1`` replay shape — an ungated
+    escalation must load, never cold-compile) plus, with
+    ``device_accumulate``, the top-k fold/snapshot and histogram fold
+    shapes.  From shape structs alone; mirror of ``warm_stream_aot``."""
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    examples = _grep_examples(n_dev, chunk_bytes, pattern_len)
+    for l_cap in line_cap_rungs(chunk_bytes):
+        _grep_fn(examples, n_dev=n_dev, chunk_bytes=chunk_bytes,
+                 m=pattern_len, l_cap=l_cap, bins=bins, k=topk, mesh=mesh)
+    if device_accumulate:
+        from dsi_tpu.device.topk import warm_histogram, warm_topk_service
+
+        warm_topk_service(mesh, kk=2, rows=topk,
+                          cap=_default_topk_cap(n_dev, topk), k=topk,
+                          table_rungs=2)
+        warm_histogram(mesh, slots=bins + 3)
+
+
+def grepstream_persisted(mesh: Mesh | None = None,
+                         chunk_bytes: int = 1 << 20, pattern_len: int = 3,
+                         bins: int = GREP_BINS, topk: int = DEFAULT_TOPK,
+                         device_accumulate: bool = False) -> bool:
+    """True when every program a ``grep_streaming`` run at these shapes
+    can reach (both ``l_cap`` rungs; plus the device services') is in
+    the persistent AOT cache — the bench grep row's cold-compile gate,
+    same discipline as ``stream_programs_persisted``."""
+    from dsi_tpu.backends.aotcache import is_persisted
+
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    examples = _grep_examples(n_dev, chunk_bytes, pattern_len)
+    for l_cap in line_cap_rungs(chunk_bytes):
+        name, fn = _grep_program(n_dev=n_dev, chunk_bytes=chunk_bytes,
+                                 m=pattern_len, l_cap=l_cap, bins=bins,
+                                 k=topk, mesh=mesh)
+        if not is_persisted(name, fn, examples,
+                            donate_argnums=_GREP_DONATE):
+            return False
+    if device_accumulate:
+        from dsi_tpu.device.topk import (histogram_persisted,
+                                         topk_service_persisted)
+
+        if not topk_service_persisted(mesh, kk=2, rows=topk,
+                                      cap=_default_topk_cap(n_dev, topk),
+                                      k=topk):
+            return False
+        if not histogram_persisted(mesh, slots=bins + 3):
+            return False
+    return True
+
+
+# ── the indexer posting step ───────────────────────────────────────────
+
+
+def _idx_device_step(chunk: jax.Array, doc_id: jax.Array, *, n_dev: int,
+                     n_reduce: int, max_word_len: int, u_cap: int,
+                     t_cap_frac: int, grouper: str = "sort"):
+    """Per-device wave body: the word-count map prologue over its
+    document with a (tf ≡ 1, doc, part) payload — one posting row per
+    distinct word per document — routed by the shared shuffle primitive
+    and partitioned valid-first, exactly the TF-IDF wave discipline
+    minus the term frequency.  A second output carries the received
+    rows with the doc lane dropped: DeviceTable's packed (keys, len,
+    count, part) layout with count ≡ 1, i.e. the wave's
+    document-frequency increments ready to fold into the top-k table."""
+    k = max_word_len // 4
+    chunk = chunk.reshape(-1)
+    doc = doc_id.reshape(())
+
+    packed_u, len_u, cnt_u, part, dest, (
+        n_unique, max_len, has_high, token_overflow) = map_prologue(
+        chunk, n_dev=n_dev, n_reduce=n_reduce, max_word_len=max_word_len,
+        u_cap=u_cap, t_cap_frac=t_cap_frac, grouper=grouper)
+
+    rows = jnp.concatenate(
+        [packed_u, len_u[:, None].astype(jnp.uint32),
+         jnp.ones((u_cap, 1), jnp.uint32),
+         jnp.broadcast_to(doc.astype(jnp.uint32), (u_cap,))[:, None],
+         part[:, None]], axis=1)
+    recv = shuffle_rows(rows, dest, n_dev=n_dev, u_cap=u_cap, k=k)
+
+    with enable_x64(True):  # every op touching u64 operands needs it
+        keys64 = pack_key_lanes(tuple(recv[:, j] for j in range(k)))
+        pay64 = pack_key_lanes(tuple(recv[:, k + j] for j in range(4)))
+        k64 = len(keys64)
+        is_pad = (keys64[0] == jnp.array(_PAD_KEY64, jnp.uint64)) \
+            .astype(jnp.uint8)
+        sorted_cols = lax.sort((is_pad,) + keys64 + pay64, num_keys=1)
+        srecv = jnp.stack(
+            unpack_key_lanes(sorted_cols[1:1 + k64], k)
+            + unpack_key_lanes(sorted_cols[1 + k64:], 4), axis=1)
+    n_rows = jnp.sum(sorted_cols[0] == 0, dtype=jnp.int32)
+
+    df = jnp.concatenate([srecv[:, :k + 2], srecv[:, k + 3:k + 4]], axis=1)
+    scalars = jnp.stack([n_rows, n_unique, max_len,
+                         has_high.astype(jnp.int32),
+                         token_overflow.astype(jnp.int32)]) \
+        .astype(jnp.int32)  # x64 literal promotion must not widen these
+    return srecv[None], df[None], scalars[None]
+
+
+def _idx_wave_step_impl(chunks, doc_ids, *, n_dev: int, n_reduce: int,
+                        max_word_len: int, u_cap: int, mesh: Mesh,
+                        t_cap_frac: int = 4, grouper: str = "sort"):
+    body = functools.partial(_idx_device_step, n_dev=n_dev,
+                             n_reduce=n_reduce, max_word_len=max_word_len,
+                             u_cap=u_cap, t_cap_frac=t_cap_frac,
+                             grouper=grouper)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS)),
+        out_specs=(P(AXIS, None, None), P(AXIS, None, None),
+                   P(AXIS, None)))(chunks, doc_ids)
+
+
+#: jax.jit donate_argnums for the wave program (chunk consumed; the tiny
+#: doc-id vector is not worth donating) — the TF-IDF wave's contract.
+_IDX_DONATE = (0,)
+
+
+def _idx_program(*, n_dev: int, n_reduce: int, max_word_len: int,
+                 u_cap: int, size: int, mesh: Mesh, t_cap_frac: int,
+                 grouper: str = "sort"):
+    from dsi_tpu.ops.wordcount import grouper_suffix
+
+    def fn(chunk, ids):
+        return _idx_wave_step_impl(chunk, ids, n_dev=n_dev,
+                                   n_reduce=n_reduce,
+                                   max_word_len=max_word_len, u_cap=u_cap,
+                                   mesh=mesh, t_cap_frac=t_cap_frac,
+                                   grouper=grouper)
+
+    fn._aot_code_deps = (_wc_mod, _sh_mod)
+    name = (f"idx_wave_d{n_dev}_r{n_reduce}_w{max_word_len}"
+            f"_u{u_cap}_s{size}_f{t_cap_frac}")
+    name += grouper_suffix(grouper)
+    return name, fn
+
+
+def _idx_fn(example_args, **kw):
+    from dsi_tpu.backends import aotcache
+
+    name, fn = _idx_program(**kw)
+    with _quiet_unusable_donation():
+        return aotcache.cached_compile(name, fn, example_args,
+                                       donate_argnums=_IDX_DONATE,
+                                       x64=True)
+
+
+class _AbortRung(Exception):
+    """A wave proved this word-window rung's results will be discarded
+    (non-ASCII input, or a word wider than the packed window)."""
+
+
+def indexer_streaming(
+        docs: Sequence[bytes], mesh: Mesh | None = None, n_reduce: int = 10,
+        max_word_len: int = 16, u_cap: int = 1 << 15,
+        depth: Optional[int] = None, device_accumulate: bool = False,
+        sync_every: Optional[int] = None, topk: int = DEFAULT_TOPK,
+        stats: Optional[dict] = None,
+):
+    """Whole-corpus inverted index over the mesh, waves of ``n_dev``
+    documents, pipelined ``depth`` waves deep.
+
+    Returns ``(postings, topk)`` where ``postings`` is ``{word: (part,
+    [doc indices in wave order])}`` and ``topk`` is ``((df, word), ...)``
+    — the k words with the highest document frequency, df desc, word asc
+    — or None when any document needs the host path (non-ASCII bytes,
+    words longer than 64).  Same exactness discipline as
+    ``tfidf_sharded``: waves dispatch optimistically at a sticky
+    (capacity, grouper, frac) rung, scalar checks are deferred until a
+    wave leaves the window, a failed check replays exactly that wave,
+    and a word wider than the packed window restarts the walk at the
+    64-byte rung.
+
+    ``device_accumulate=True`` appends each confirmed wave's posting
+    rows into a persistent :class:`DevicePostings` buffer (the order-
+    preserving sticky-overflow protocol from the TF-IDF walk) AND folds
+    its document-frequency rows (count ≡ 1 per posting) into a
+    :class:`DeviceTopK` table — the host sees postings once per
+    ``sync_every`` waves and the df leaders as k-row snapshots, with
+    the close drain completing the exact result.  Both the postings
+    (including per-word posting order) and the top-k are bit-identical
+    to the per-wave pull path.
+    """
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    depth = pipeline_depth(depth)
+    from dsi_tpu.parallel.tfidf import _wave_chunk, plan_waves
+
+    doc_lens = getattr(docs, "lengths", None)
+    if doc_lens is None:
+        doc_lens = [len(d) for d in docs]
+    waves = plan_waves(doc_lens, n_dev)
+    longest = max(doc_lens, default=1)
+    size_max = 1 << max(8, int(longest).bit_length())
+    n_real = len(docs)
+    st = stats if stats is not None else {}
+    st.update({"waves": len(waves), "step_pulls": 0, "depth": depth,
+               "replays": 0, "device_accumulate": device_accumulate,
+               "upload_s": 0.0, "kernel_s": 0.0, "pull_s": 0.0,
+               "merge_s": 0.0, "replay_s": 0.0})
+    groupers = grouper_ladder()
+    sh_chunk = NamedSharding(mesh, P(AXIS, None))
+    sh_ids = NamedSharding(mesh, P(AXIS))
+
+    def run(mwl: int):
+        kk = mwl // 4
+        table = PostingsTable()
+        state = {"cap": rung0_cap(size_max, u_cap),
+                 "grouper": groupers[0], "frac": 4}
+        outcome = {"high": False, "widen": False}
+
+        def buffer_rows(r: np.ndarray) -> None:
+            """One device's pulled posting rows into the host table,
+            the short last wave's padding documents filtered FIRST."""
+            r = r[r[:, kk + 2] < n_real]
+            if len(r):
+                table.add(r, kk)
+
+        buf_dev = None
+        topk_svc: Optional[DeviceTopK] = None
+        df_acc = PackedCounts()
+        policy = None
+        if device_accumulate:
+            from dsi_tpu.device import DevicePostings
+
+            try:
+                pcap = int(os.environ.get("DSI_DEVICE_POSTINGS_CAP", "0"))
+            except ValueError:
+                pcap = 0
+            buf_dev = DevicePostings(
+                mesh, width=kk + 4,
+                cap=pcap if pcap > 0 else n_dev * state["cap"],
+                sink=buffer_rows, lag=max(0, depth - 1), stats=st)
+            policy = SyncPolicy(sync_every)
+            st["sync_every"] = policy.sync_every
+
+        def materialize():
+            for idxs, size in waves:
+                chunk_np = _wave_chunk(docs, idxs, n_dev, size)
+                ids_np = np.array(
+                    list(idxs) + [n_real] * (n_dev - len(idxs)),
+                    dtype=np.int32)
+                yield (size, chunk_np, ids_np)
+
+        def wave_call(chunk_np, ids_np, size, cap, frac, g):
+            t0 = time.perf_counter()
+            chunk = jax.device_put(chunk_np, sh_chunk)
+            ids = jax.device_put(ids_np, sh_ids)
+            st["upload_s"] += time.perf_counter() - t0
+            fn = _idx_fn((chunk, ids), n_dev=n_dev, n_reduce=n_reduce,
+                         max_word_len=mwl, u_cap=cap, size=size, mesh=mesh,
+                         t_cap_frac=frac, grouper=g)
+            with _quiet_unusable_donation():
+                return fn(chunk, ids)
+
+        def dispatch(item):
+            size, chunk_np, ids_np = item
+            rows, df, scal = wave_call(chunk_np, ids_np, size,
+                                       state["cap"], state["frac"],
+                                       state["grouper"])
+            return (size, chunk_np, ids_np, rows, df, scal, state["cap"])
+
+        def replay_wave(size, chunk_np, ids_np):
+            st["replays"] += 1
+            t0 = time.perf_counter()
+            cap = state["cap"]
+            try:
+                while True:
+                    for g in groupers:
+                        for frac in (4, 2):
+                            rows, df, scal = wave_call(chunk_np, ids_np,
+                                                       size, cap, frac, g)
+                            scal_np = np.asarray(scal)
+                            if not scal_np[:, 4].any():
+                                break
+                        if not scal_np[:, 4].any():
+                            break
+                    if bool(scal_np[:, 3].any()):
+                        outcome["high"] = True
+                        raise _AbortRung
+                    if int(scal_np[:, 2].max()) > mwl:
+                        outcome["widen"] = True
+                        raise _AbortRung
+                    if int(scal_np[:, 1].max()) > cap:
+                        cap *= 4  # uniques <= tokens <= size/2: terminates
+                        continue
+                    break
+            finally:
+                st["replay_s"] += time.perf_counter() - t0
+            state["cap"], state["grouper"], state["frac"] = cap, g, frac
+            return rows, df, scal, scal_np
+
+        def commit(rows, df, scal, scal_np):
+            nonlocal topk_svc
+            m = int(scal_np[:, 0].max())
+            if m == 0:
+                return
+            if buf_dev is not None:
+                # The df fold rides the SAME confirmation: only waves the
+                # postings path accepted fold their frequency rows.
+                if topk_svc is None:
+                    # Rung-0 df-table capacity: the wave's row count (a
+                    # single fold can never overflow it), unless the
+                    # shared DSI_DEVICE_TOPK_CAP override asks smaller.
+                    topk_svc = DeviceTopK(
+                        mesh, kk=kk,
+                        cap=_topk_cap_env() or int(df.shape[1]),
+                        k=topk, acc=df_acc, aot=False,
+                        lag=max(0, depth - 1), stats=st)
+                pulls_before = st["sync_pulls"]
+                buf_dev.append(rows, scal)
+                topk_svc.fold(df, scal, scal_np)
+                policy.note_fold()
+                if st["sync_pulls"] != pulls_before:
+                    policy.reset()  # an overflow recovery just drained:
+                    # that WAS this window's pull
+                elif policy.due():
+                    buf_dev.sync()
+                    topk_svc.sync()
+                    policy.reset()
+                return
+            t0 = time.perf_counter()
+            mp = occupied_prefix(m, rows.shape[1])
+            rows_np = np.asarray(rows[:, :mp])
+            st["step_pulls"] += 1
+            st["pull_s"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for d in range(n_dev):
+                nr = int(scal_np[d, 0])
+                if nr:
+                    buffer_rows(rows_np[d, :nr])
+            st["merge_s"] += time.perf_counter() - t0
+
+        def finish(rec):
+            size, chunk_np, ids_np, rows, df, scal, cap = rec
+            t0 = time.perf_counter()
+            scal_np = np.asarray(scal)  # blocks until the kernel lands
+            st["kernel_s"] += time.perf_counter() - t0
+            if bool(scal_np[:, 3].any()):
+                outcome["high"] = True
+                raise _AbortRung
+            if int(scal_np[:, 2].max()) > mwl:
+                outcome["widen"] = True
+                raise _AbortRung
+            if scal_np[:, 4].any() or int(scal_np[:, 1].max()) > cap:
+                rows, df, scal, scal_np = replay_wave(size, chunk_np,
+                                                      ids_np)
+            commit(rows, df, scal, scal_np)
+
+        st.setdefault("sync_pulls", 0)
+        pipe = StepPipeline(depth=depth, dispatch=dispatch, finish=finish,
+                            stats=st, produce_key="materialize_s",
+                            wait_key="materialize_wait_s",
+                            inflight_key="max_inflight_waves",
+                            thread_name="dsi-idx-materializer")
+        try:
+            pipe.run(materialize)
+        except _AbortRung:
+            return ("high" if outcome["high"] else "widen", None)
+        if buf_dev is not None:
+            buf_dev.close()
+            if topk_svc is not None:
+                topk_svc.close()
+
+        def payload():
+            postings = {
+                w: (part, [d for d, _ in pairs])
+                for w, (part, pairs) in table.finalize().items()}
+            if device_accumulate and topk_svc is not None:
+                df_map = {w: c for w, (c, _) in df_acc.finalize().items()}
+            else:
+                df_map = {w: len(ds) for w, (_, ds) in postings.items()}
+            top = tuple(sorted(((c, w) for w, c in df_map.items()),
+                               key=lambda r: (-r[0], r[1]))[:topk])
+            return postings, top
+
+        return ("ok", payload)
+
+    for mwl in ((max_word_len, 64) if max_word_len < 64
+                else (max_word_len,)):
+        status, payload = run(mwl)
+        if status == "high":
+            return None
+        if status == "widen":
+            continue
+        return payload()
+    return None  # a word wider than 64 bytes: the job is the host path's
+
+
+def write_indexer_output(result, doc_names: Sequence[str], n_reduce: int,
+                         workdir: str = ".") -> List[str]:
+    """Materialise mr-out-<r> files byte-identical to the host indexer
+    app's reduce output (``"<count> <doc1>,<doc2>,..."`` with documents
+    sorted and deduplicated), via the shared partitioned writer."""
+    from dsi_tpu.parallel.shuffle import write_partitioned_output
+
+    postings, _ = result if isinstance(result, tuple) else (result, ())
+    formatted = {}
+    for w, (part, doc_ids) in postings.items():
+        names = sorted({doc_names[d] for d in doc_ids})
+        formatted[w] = (f"{len(names)} {','.join(names)}", part)
+    return write_partitioned_output(formatted, n_reduce, workdir)
+
+
+def warm_indexer_aot(mesh: Mesh | None = None, sizes: Sequence[int] = (
+        1 << 18,), n_reduce: int = 10, word_lens: Sequence[int] = (16,),
+        caps: Sequence[int] = (1 << 14,), fracs: Sequence[int] = (4, 2),
+        topk: int = DEFAULT_TOPK, device_accumulate: bool = False) -> None:
+    """Compile + persist the ``idx_wave_*`` shapes an
+    ``indexer_streaming`` run reaches at these wave sizes/capacities
+    (both grouper variants), plus — with ``device_accumulate`` — the
+    df top-k fold shapes.  From shape structs alone."""
+    if mesh is None:
+        mesh = default_mesh()
+    n_dev = mesh.devices.size
+    sds = jax.ShapeDtypeStruct
+    for mwl in word_lens:
+        for cap in caps:
+            for size in sizes:
+                examples = (sds((n_dev, size), jnp.uint8),
+                            sds((n_dev,), jnp.int32))
+                for frac in fracs:
+                    for g in sorted(warm_groupers()):
+                        _idx_fn(examples, n_dev=n_dev, n_reduce=n_reduce,
+                                max_word_len=mwl, u_cap=cap, size=size,
+                                mesh=mesh, t_cap_frac=frac, grouper=g)
+            if device_accumulate:
+                from dsi_tpu.device.topk import warm_topk_service
+
+                warm_topk_service(mesh, kk=mwl // 4, rows=n_dev * cap,
+                                  cap=n_dev * cap, k=topk, table_rungs=2)

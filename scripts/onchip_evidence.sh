@@ -140,6 +140,21 @@ timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
   > "$OUT/wcstream-dacc.log" 2>&1
 log "wcstream-dacc rc=$? $(tail -c 200 "$OUT/wcstream-dacc.log" | tr '\n' ' ')"
 
+log "grepstream --check on the chip (streaming grep engine + on-device top-k/histogram)"
+# Same corpus as the wcstream steps; the CLI's default --chunk-bytes
+# (1 MiB) and pattern length 3 MUST stay in lockstep with the shapes
+# scripts/warm_kernels.py --phase grep pre-compiles (both l_cap rungs +
+# the top-k fold/snapshot and histogram fold programs) — a drifting
+# shape here pays a cold axon compile inside this timeout.  --check runs
+# the host-grep oracle over the same stream: the parity verdict is the
+# step's PASS, and --stats records step_pulls vs sync_pulls/widens/
+# topk_snapshots (the pull-amortization evidence for BENCH_r06+).
+timeout -k 30s 3600s python -m dsi_tpu.cli.grepstream --check --devices 1 \
+  --pattern the --device-accumulate --sync-every "${SYNC_EVERY:-8}" \
+  --aot --stats "$OUT"/corpus/pg-*.txt \
+  > "$OUT/grepstream.log" 2>&1
+log "grepstream rc=$? $(tail -c 200 "$OUT/grepstream.log" | tr '\n' ' ')"
+
 log "wcstream ~1 GB on the chip (GB-scale single-device stream)"
 # 1024 x 1 MB generated files; --check would double the wall with a host
 # oracle pass over 1 GB, so this step relies on wcstream's own exactness

@@ -54,11 +54,26 @@ def _bench_line(path: str) -> str:
         return f"  (unparseable: {d[-200:]!r})"
     keys = ("metric", "value", "unit", "vs_baseline", "median_mbps",
             "total_mb", "platform", "oracle_mbps", "stream_mbps",
-            "stream_mb", "stream_parity", "tpu_error")
+            "stream_mb", "stream_parity",
+            # PR-3 rows: the wire-independent HBM-resident kernel reps
+            # (sort vs hash) and the framework row's native-sequential
+            # oracle decomposition.
+            "kernel_sort_mbps", "kernel_hash_mbps", "kernel_mb",
+            "tfidf_mbps", "tfidf_parity",
+            "native_oracle_mbps", "native_vs_python",
+            "framework_mbps", "framework_vs_oracle", "framework_vs_native",
+            # The streaming grep engine row (parity-gated vs the
+            # host-grep oracle).
+            "grep_mbps", "grep_mb", "grep_matched", "grep_oracle_mbps",
+            "grep_vs_oracle", "grep_parity",
+            "tpu_error")
     parts = [f"{k}={d[k]}" for k in keys if k in d]
     phases = d.get("phases")
     if phases:
         parts.append("phases=" + json.dumps(phases))
+    for k in ("stream_phases", "tfidf_phases", "grep_phases"):
+        if k in d:
+            parts.append(f"{k}=" + json.dumps(d[k]))
     return "  " + "  ".join(parts)
 
 
@@ -217,6 +232,12 @@ def main() -> None:
         print(f"harness {name}:{_harness(f'{out}/harness_{name}.log')}")
     print("wcstream --check (single-device mesh):")
     print(_tail(f"{out}/wcstream.log", 3))
+    if os.path.exists(f"{out}/wcstream-dacc.log"):
+        print("wcstream --device-accumulate (fold table, K-step pulls):")
+        print(_tail(f"{out}/wcstream-dacc.log", 3))
+    if os.path.exists(f"{out}/grepstream.log"):
+        print("grepstream --check (streaming grep + on-device top-k/histogram):")
+        print(_tail(f"{out}/grepstream.log", 5))
     print("wcstream ~1 GB:")
     print(_tail(f"{out}/wcstream-1g.log", 4))
     print("chain log:")

@@ -31,12 +31,14 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--file-size", type=int, default=300000,
                     help="harness split size (test_mr.sh ensure_corpus)")
-    ap.add_argument("--phase", choices=("harness", "stream", "all"),
+    ap.add_argument("--phase", choices=("harness", "stream", "grep", "all"),
                     default="all",
                     help="which program group to warm: 'harness' = the "
                          "per-task worker kernels test_mr.sh runs touch; "
                          "'stream' = the streaming step/pack programs; "
-                         "'all' = both.  Remote compiles cost tens of "
+                         "'grep' = the grep/indexer stream engines + the "
+                         "on-device top-k/histogram service; 'all' = "
+                         "everything.  Remote compiles cost tens of "
                          "minutes EACH on the axon tunnel, so the ladder "
                          "(warm_loop.sh) warms the group it is about to "
                          "collect evidence with, not everything up front.")
@@ -62,10 +64,12 @@ def main() -> int:
         from dsi_tpu.ops.grepk import grep_host_result
         from dsi_tpu.ops.wordcount import count_words_host_result
 
-        # Every grep tier now gates dispatch on rung readiness
+        # Every grep tier gates dispatch on rung readiness
         # (grepk.device_ready); compiling is THIS script's job, so
-        # bypass the gate for the whole harness-warm block.
-        os.environ["DSI_GREP_COLD_OK"] = "1"
+        # bypass the gate for the whole harness-warm block via the one
+        # unified knob (grepk.cold_ok — the old per-tier names remain
+        # as aliases).
+        os.environ["DSI_COLD_OK"] = "1"
 
         t0 = time.perf_counter()
         res = count_words_host_result(raw)
@@ -115,11 +119,9 @@ def main() -> int:
         # compiled program is PATTERN-INDEPENDENT (the transition table
         # ships as an argument), so warming the smallest state bucket at
         # this shape serves every variable-length pattern of <= 12
-        # atoms.  DSI_NFA_COLD_OK bypasses the tier's own
+        # atoms.  DSI_COLD_OK (already set above) bypasses the tier's
         # cold-compile gate — compiling here is this script's job.
         from dsi_tpu.ops.nfak import nfagrep_host_result
-
-        os.environ["DSI_NFA_COLD_OK"] = "1"
         # Pin past the dispatch cost model: this call exists to exercise
         # (and compile) the kernel; the calibration below then measures
         # both sides and decides real dispatch.
@@ -164,9 +166,8 @@ def main() -> int:
             print(f"nfagrep cost model s{s_bucket}: {entry} "
                   f"({time.perf_counter() - t0:.1f}s)", flush=True)
         finally:
-            del os.environ["DSI_NFA_COLD_OK"]
             del os.environ["DSI_NFA_DISPATCH"]
-            del os.environ["DSI_GREP_COLD_OK"]
+            del os.environ["DSI_COLD_OK"]
 
     if args.phase in ("stream", "all"):
         # Stream-row programs: bench.py runs wordcount_streaming(aot=True,
@@ -208,6 +209,38 @@ def main() -> int:
         warm_stream_aot(mesh=mesh, chunk_bytes=1 << 22,
                         caps=(1 << 14, 1 << 16, 1 << 18))
         print(f"stream programs: {time.perf_counter() - t0:.1f}s",
+              flush=True)
+
+    if args.phase in ("grep", "all"):
+        # Grep/indexer stream engines + the on-device top-k/histogram
+        # service (parallel/grepstream.py, device/topk.py).  Two grep
+        # shapes, both in lockstep with their consumers:
+        #   * 1 MiB chunks — onchip_evidence.sh's grepstream --check
+        #     step (CLI default --chunk-bytes),
+        #   * GREP_CHUNK_BYTES (2 MiB) — bench.py's DSI_BENCH_GREP_MB
+        #     row.
+        # Both warm BOTH l_cap rungs (the optimistic and the n+1 replay
+        # shape: a sticky-rung escalation on the chip must load, never
+        # cold-compile) and the device-accumulate fold/snapshot
+        # programs.  Pattern length 3 = the evidence/bench default
+        # literal ("the"); other lengths are distinct compiled shapes —
+        # rerun with your pattern before soaking a different literal.
+        from dsi_tpu.parallel.grepstream import (GREP_CHUNK_BYTES,
+                                                 warm_grepstream_aot,
+                                                 warm_indexer_aot)
+        from dsi_tpu.parallel.shuffle import default_mesh
+
+        t0 = time.perf_counter()
+        mesh = default_mesh()
+        warm_grepstream_aot(mesh=mesh, chunk_bytes=1 << 20,
+                            device_accumulate=True)
+        warm_grepstream_aot(mesh=mesh, chunk_bytes=GREP_CHUNK_BYTES,
+                            device_accumulate=True)
+        # Indexer posting-wave shapes at the harness document scale (one
+        # 256 KiB wave rung, both groupers) plus the df top-k folds.
+        warm_indexer_aot(mesh=mesh, sizes=(1 << 18,), caps=(1 << 14,),
+                         device_accumulate=True)
+        print(f"grep/indexer programs: {time.perf_counter() - t0:.1f}s",
               flush=True)
 
     print(f"aot stats: {aotcache.stats}", flush=True)

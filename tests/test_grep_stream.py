@@ -1,0 +1,440 @@
+"""Streaming grep / indexer engines (parallel/grepstream.py) and the
+on-device top-k/histogram service (device/topk.py).
+
+Oracle discipline as everywhere else: every engine path — depth x
+device_accumulate x forced l_cap replay x forced top-k widen — must
+agree BIT-FOR-BIT with the depth=1 host-merge path and with a
+pure-Python oracle over the same bytes (including per-word posting
+order for the indexer), so any divergence is an engine/service bug,
+never a tolerance.
+"""
+
+import re
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import numpy as np
+
+from dsi_tpu.parallel.grepstream import (
+    GrepStreamResult,
+    batch_lines,
+    grep_host_oracle,
+    grep_streaming,
+    indexer_streaming,
+    write_indexer_output,
+    _LineTooLong,
+)
+from dsi_tpu.parallel.shuffle import default_mesh
+
+WORDS = re.compile(r"[A-Za-z]+")
+
+
+def _mesh():
+    return default_mesh(8)
+
+
+def _letters(i: int) -> str:
+    return "".join(chr(97 + (i // 26 ** j) % 26) for j in range(3))
+
+
+VOCAB = [_letters(i) for i in range(600)]
+
+
+# ── batch_lines ────────────────────────────────────────────────────────
+
+
+def test_batch_lines_cuts_only_at_newlines():
+    blocks = [b"alpha\nbeta\n", b"gam", b"ma\ndelta\nepsilon"]
+    batches = list(batch_lines(blocks, n_dev=2, chunk_bytes=8))
+    text = b""
+    total_lines = 0
+    for batch, lens, row_lines in batches:
+        for d in range(2):
+            row = bytes(batch[d, :lens[d]])
+            assert not batch[d, lens[d]:].any()  # zero tail
+            # no line straddles a row: every non-final row ends in \n
+            text += row
+            total_lines += int(row_lines[d])
+    assert text == b"".join(blocks)
+    # 5 lines, the last unterminated
+    assert total_lines == 5
+
+
+def test_batch_lines_line_wider_than_chunk_raises():
+    with pytest.raises(_LineTooLong):
+        list(batch_lines([b"x" * 100], n_dev=2, chunk_bytes=16))
+
+
+def test_batch_lines_exact_chunk_final_line_fits():
+    # A final unterminated line of exactly chunk_bytes must NOT raise.
+    batches = list(batch_lines([b"y" * 16], n_dev=1, chunk_bytes=16))
+    assert len(batches) == 1
+    batch, lens, row_lines = batches[0]
+    assert int(lens[0]) == 16 and int(row_lines[0]) == 1
+
+
+# ── grep: oracle + host path ───────────────────────────────────────────
+
+
+def _grep_blocks(seed: int, n_blocks: int = 8):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_blocks):
+        words = [VOCAB[j] for j in rng.integers(0, 400, 120)]
+        lines = []
+        cur = []
+        for w in words:
+            cur.append(w)
+            if rng.random() < 0.2:
+                lines.append(" ".join(cur))
+                cur = []
+        lines.append(" ".join(cur))
+        out.append(("\n".join(lines) + "\n").encode())
+    return out
+
+
+def test_grep_host_path_matches_oracle():
+    blocks = _grep_blocks(1)
+    want = grep_host_oracle(list(blocks), "aba")
+    st: dict = {}
+    res = grep_streaming(list(blocks), "aba", mesh=_mesh(),
+                         chunk_bytes=1 << 11, depth=2, pipeline_stats=st)
+    assert res == want
+    assert isinstance(res, GrepStreamResult)
+    assert st["step_pulls"] >= 1 and st["sync_pulls"] == 0
+    assert sum(res.hist) == res.lines  # every line lands in one bucket
+
+
+def test_grep_overlapping_occurrences_counted():
+    # 'aa' in 'aaaa' occurs 3 times (overlapping) — engine and oracle
+    # must agree on the overlap rule.
+    blocks = [b"aaaa\naa\nxx\n"]
+    want = grep_host_oracle(list(blocks), "aa")
+    assert want.occurrences == 4 and want.matched == 2
+    res = grep_streaming(list(blocks), "aa", mesh=_mesh(),
+                         chunk_bytes=1 << 11, depth=1)
+    assert res == want
+
+
+def test_grep_host_path_rejections():
+    mesh = _mesh()
+    # non-literal pattern: the regex tiers' job, not this engine's
+    assert grep_streaming([b"x\n"], "th.e", mesh=mesh,
+                          chunk_bytes=1 << 11) is None
+    # a line wider than the chunk: host path
+    assert grep_streaming([b"z" * 5000], "z", mesh=mesh,
+                          chunk_bytes=1 << 11) is None
+    # empty stream: zeros, not None
+    res = grep_streaming([], "the", mesh=mesh, chunk_bytes=1 << 11)
+    assert res.lines == 0 and res.matched == 0 and res.topk == ()
+
+
+# ── grep: the parity grid ──────────────────────────────────────────────
+
+
+def test_grep_parity_grid_depth_x_device_accumulate():
+    """depth x device_accumulate x K bit-identical to the depth=1
+    host-merge path (and to the oracle)."""
+    blocks = _grep_blocks(7)
+    mesh = _mesh()
+    want = grep_host_oracle(list(blocks), "aba")
+    base = grep_streaming(list(blocks), "aba", mesh=mesh,
+                          chunk_bytes=1 << 11, depth=1)
+    assert base == want
+    for depth in (1, 3):
+        for dacc, k in ((False, None), (True, 1), (True, 4)):
+            st: dict = {}
+            res = grep_streaming(list(blocks), "aba", mesh=mesh,
+                                 chunk_bytes=1 << 11, depth=depth,
+                                 device_accumulate=dacc, sync_every=k,
+                                 pipeline_stats=st)
+            assert res == base, (depth, dacc, k)
+            if dacc:
+                assert st["step_pulls"] == 0
+
+
+def test_grep_forced_l_cap_replay_sticky():
+    """Short lines overflow the optimistic avg-line>=8B rung: the step
+    replays at the n+1 hard bound through the pipeline (NOT the host
+    fallback), the wider rung sticks, and results stay bit-identical."""
+    blocks = [b"a\n" * 2000, b"aba\nx\n" * 500, b"a\n" * 2000]
+    mesh = _mesh()
+    want = grep_host_oracle(list(blocks), "aba")
+    st: dict = {}
+    res = grep_streaming(list(blocks), "aba", mesh=mesh,
+                         chunk_bytes=1 << 11, depth=2, pipeline_stats=st)
+    assert res == want
+    assert st["replays"] >= 1
+    assert st["l_cap"] == (1 << 11) + 1  # the hard-bound rung stuck
+    # ...and exactly once per overflowing step, not once per later step:
+    assert st["replays"] <= st["steps"]
+    # same stream through the device services, same answer
+    st2: dict = {}
+    res2 = grep_streaming(list(blocks), "aba", mesh=mesh,
+                          chunk_bytes=1 << 11, depth=2,
+                          device_accumulate=True, sync_every=2,
+                          pipeline_stats=st2)
+    assert res2 == want
+    assert st2["replays"] >= 1 and st2["step_pulls"] == 0
+
+
+def test_grep_forced_topk_widen_never_drops(monkeypatch):
+    """A candidate table forced to a tiny rung overflows mid-stream:
+    the fold no-ops, the service drains + widens + re-folds, and the
+    final top-k is still bit-identical — overflow surfaces a widen
+    signal, it never drops candidates."""
+    monkeypatch.setenv("DSI_DEVICE_TOPK_CAP", "32")
+    blocks = [(" aba x" * 8 + "\n").encode() * 30] * 60
+    mesh = _mesh()
+    want = grep_host_oracle(list(blocks), "aba")
+    st: dict = {}
+    res = grep_streaming(list(blocks), "aba", mesh=mesh,
+                         chunk_bytes=1 << 11, depth=2,
+                         device_accumulate=True, sync_every=3,
+                         pipeline_stats=st)
+    assert res == want
+    assert st["widens"] >= 1 and st["fold_overflows"] >= 1
+    assert st["step_pulls"] == 0
+    assert st["table_cap"] > 32  # the rung actually moved
+
+
+def test_grep_sync_accounting_windows_plus_close():
+    """Device path accounting: zero per-step pulls; one snapshot+hist
+    pull bundle per K confirmed folds plus the close drain — the
+    ceil(steps/K)+widens amortization the service exists for."""
+    line = (" ".join(VOCAB[:30]) + " aba\n").encode() * 6
+    blocks = [line] * 400  # ~290 KB -> ~18 steps of 8 x 2 KiB
+    mesh = _mesh()
+    for k in (3, 8):
+        st: dict = {}
+        res = grep_streaming(list(blocks), "aba", mesh=mesh,
+                             chunk_bytes=1 << 11, depth=2,
+                             device_accumulate=True, sync_every=k,
+                             pipeline_stats=st)
+        assert res is not None and res.matched > 0
+        assert st["step_pulls"] == 0 and st["widens"] == 0
+        windows = st["folds"] // k
+        assert st["folds"] == st["steps"] >= k
+        assert st["sync_pulls"] == windows + 1  # windows + close drain
+        assert st["hist_pulls"] == windows + 1
+        assert st["topk_snapshots"] == windows
+
+
+def test_grep_property_random_streams():
+    """Property: random streams x random K x both paths, equal to the
+    oracle and to each other."""
+    mesh = _mesh()
+    for seed in (11, 29):
+        rng = np.random.default_rng(seed)
+        blocks = _grep_blocks(seed, n_blocks=int(rng.integers(3, 7)))
+        pat = ["aba", "ab", "aaa"][int(rng.integers(0, 3))]
+        k = int(rng.integers(1, 6))
+        want = grep_host_oracle(list(blocks), pat)
+        res = grep_streaming(list(blocks), pat, mesh=mesh,
+                             chunk_bytes=1 << 11, depth=2,
+                             device_accumulate=True, sync_every=k)
+        assert res == want, (seed, pat, k)
+
+
+# ── indexer ────────────────────────────────────────────────────────────
+
+
+def _idx_docs(n_docs: int, seed: int):
+    rng = np.random.default_rng(seed)
+    return [(" ".join(VOCAB[j] for j in
+                      rng.integers(0, 180, int(rng.integers(30, 120))))
+             + "\n").encode() for _ in range(n_docs)]
+
+
+def _idx_oracle(docs):
+    """{word: sorted doc list} + {word: df} from the host tokenizer."""
+    posts: dict = {}
+    for d, doc in enumerate(docs):
+        for w in sorted(set(WORDS.findall(doc.decode()))):
+            posts.setdefault(w, []).append(d)
+    return posts
+
+
+def test_indexer_matches_oracle_and_posting_order():
+    mesh = _mesh()
+    docs = _idx_docs(13, seed=5)
+    want = _idx_oracle(docs)
+    st: dict = {}
+    base = indexer_streaming(docs, mesh=mesh, n_reduce=10, u_cap=1 << 9,
+                             depth=1, stats=st)
+    assert base is not None
+    postings, top = base
+    assert set(postings) == set(want)
+    for w, docs_w in want.items():
+        # doc SETS match the oracle; ORDER is the wave order, stable
+        assert sorted(postings[w][1]) == docs_w, w
+    # df top-k: count desc, word asc, exact
+    df = {w: len(ds) for w, ds in want.items()}
+    want_top = tuple(sorted(((c, w) for w, c in df.items()),
+                            key=lambda r: (-r[0], r[1]))[:16])
+    assert top == want_top
+    assert st["step_pulls"] >= 1
+
+
+def test_indexer_parity_grid_bit_identical():
+    """depth x device_accumulate x K: identical postings (per-word doc
+    ORDER included) and identical df top-k."""
+    mesh = _mesh()
+    docs = _idx_docs(21, seed=9)
+    base = indexer_streaming(docs, mesh=mesh, n_reduce=10, u_cap=1 << 9,
+                             depth=1)
+    assert base is not None
+    for depth in (1, 3):
+        for dacc, k in ((False, None), (True, 2), (True, 7)):
+            st: dict = {}
+            res = indexer_streaming(docs, mesh=mesh, n_reduce=10,
+                                    u_cap=1 << 9, depth=depth,
+                                    device_accumulate=dacc, sync_every=k,
+                                    stats=st)
+            assert res is not None
+            assert res == base, (depth, dacc, k)
+            if dacc:
+                assert st["step_pulls"] == 0
+                assert st["appends"] >= 1 and st["folds"] >= 1
+
+
+def test_indexer_forced_topk_widen(monkeypatch):
+    """The df table forced below the vocabulary widens mid-walk and the
+    result is still bit-identical — same acceptance as the stream's
+    fold table."""
+    monkeypatch.setenv("DSI_DEVICE_TOPK_CAP", "32")
+    mesh = _mesh()
+    docs = _idx_docs(16, seed=3)
+    base = indexer_streaming(docs, mesh=mesh, n_reduce=10, u_cap=1 << 9,
+                             depth=1)
+    st: dict = {}
+    res = indexer_streaming(docs, mesh=mesh, n_reduce=10, u_cap=1 << 9,
+                            depth=2, device_accumulate=True, sync_every=2,
+                            stats=st)
+    assert base is not None and res is not None
+    assert res == base
+    assert st["widens"] >= 1 and st["fold_overflows"] >= 1
+    assert st["step_pulls"] == 0
+
+
+def test_indexer_forced_postings_overflow(monkeypatch):
+    """A postings buffer trimmed below the window drains early (the
+    sticky-dirty order-preserving recovery) while the df folds ride the
+    same confirmations — nothing lost, nothing doubled, order intact."""
+    monkeypatch.setenv("DSI_DEVICE_POSTINGS_CAP", "256")
+    mesh = _mesh()
+    docs = _idx_docs(40, seed=13)
+    base = indexer_streaming(docs, mesh=mesh, n_reduce=10, u_cap=1 << 9,
+                             depth=1)
+    st: dict = {}
+    res = indexer_streaming(docs, mesh=mesh, n_reduce=10, u_cap=1 << 9,
+                            depth=2, device_accumulate=True,
+                            sync_every=10_000, stats=st)
+    assert base is not None and res is not None
+    assert res == base
+    assert st["append_overflows"] >= 1
+
+
+def test_indexer_host_path_rejections():
+    mesh = _mesh()
+    # non-ASCII: the host app's job
+    assert indexer_streaming(["caf\xe9".encode("utf-8")], mesh=mesh,
+                             n_reduce=10, u_cap=1 << 9) is None
+    # a word wider than 64 bytes: host path
+    assert indexer_streaming([b"x" * 80 + b" y"], mesh=mesh, n_reduce=10,
+                             u_cap=1 << 9) is None
+
+
+def test_write_indexer_output_matches_host_app_format(tmp_path):
+    """mr-out-* files byte-identical to the sequential indexer app over
+    the same documents."""
+    from dsi_tpu.apps import indexer as app
+    from dsi_tpu.mr.sequential import run_sequential
+    from tests.harness import merged_output
+
+    docs = _idx_docs(6, seed=21)
+    names = []
+    for i, doc in enumerate(docs):
+        p = tmp_path / f"doc-{i}.txt"
+        p.write_bytes(doc)
+        names.append(str(p))
+    res = indexer_streaming(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9)
+    assert res is not None
+    wd = tmp_path / "out"
+    wd.mkdir()
+    write_indexer_output(res, names, 10, str(wd))
+    oracle_out = tmp_path / "mr-correct.txt"
+    run_sequential(app.Map, app.Reduce, names, str(oracle_out))
+    with open(oracle_out, encoding="utf-8") as f:
+        want = sorted(l for l in f if l.strip())
+    assert merged_output(str(wd)) == want
+
+
+# ── warm ladder / AOT coverage ─────────────────────────────────────────
+
+
+def test_grep_warm_covers_everything(tmp_path, monkeypatch):
+    """warm_grepstream_aot(device_accumulate=True) must pre-compile
+    every program a device-accumulated aot run then executes — both
+    l_cap rungs, the top-k fold/pack/snapshot shapes, the histogram
+    fold — so a chip run is loads, never compiles."""
+    from dsi_tpu.backends import aotcache
+    from dsi_tpu.parallel.grepstream import (grepstream_persisted,
+                                             warm_grepstream_aot)
+
+    monkeypatch.setenv("DSI_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    mesh = default_mesh(1)
+    warm_grepstream_aot(mesh=mesh, chunk_bytes=1 << 14,
+                        device_accumulate=True)
+    # The persisted probe itself answers False in this 8-virtual-device
+    # process BY DESIGN (is_persisted mirrors cached_compile's load
+    # policy: deserialized executables reject multi-device args), so the
+    # no-new-compiles assertion below is the coverage check here — the
+    # same discipline as the stream engine's warm test.
+    assert not grepstream_persisted(mesh=mesh, chunk_bytes=1 << 14,
+                                    device_accumulate=True)
+    compiles_after_warm = aotcache.stats["compiles"]
+    blocks = [b"the quick fox\nthe end\n" * 200] * 3
+    want = grep_host_oracle(list(blocks), "the")
+    st: dict = {}
+    res = grep_streaming(list(blocks), "the", mesh=mesh,
+                         chunk_bytes=1 << 14, depth=2, aot=True,
+                         device_accumulate=True, sync_every=2,
+                         pipeline_stats=st)
+    assert res == want
+    assert st["folds"] >= 1 and st["step_pulls"] == 0
+    assert aotcache.stats["compiles"] == compiles_after_warm
+
+
+# ── unified cold-compile knob ──────────────────────────────────────────
+
+
+def test_cold_ok_unified_knob_and_aliases(monkeypatch):
+    from dsi_tpu.ops.grepk import cold_ok
+
+    for var in ("DSI_COLD_OK", "DSI_GREP_COLD_OK", "DSI_NFA_COLD_OK"):
+        monkeypatch.delenv(var, raising=False)
+    assert not cold_ok()
+    for var in ("DSI_COLD_OK", "DSI_GREP_COLD_OK", "DSI_NFA_COLD_OK"):
+        monkeypatch.setenv(var, "1")
+        assert cold_ok(), var
+        monkeypatch.delenv(var)
+
+
+# ── CLI ────────────────────────────────────────────────────────────────
+
+
+def test_grepstream_cli_check_against_oracle(tmp_path):
+    """The engine is reachable without importing internals: grepstream
+    --check end-to-end (device-accumulated) vs the host oracle."""
+    from dsi_tpu.cli import grepstream as cli
+    from dsi_tpu.utils.corpus import ensure_corpus
+
+    files = ensure_corpus(str(tmp_path / "inputs"), n_files=2,
+                          file_size=20_000)
+    rc = cli.main(["--pattern", "the", "--chunk-bytes", "4096",
+                   "--check", "--device-accumulate", "--sync-every", "4",
+                   "--topk", "8"] + files)
+    assert rc == 0  # --check exits 2 on a parity failure
