@@ -88,6 +88,13 @@ Environment knobs:
                           (default "the"); DSI_BENCH_GREP_DEVICE_ACC=1
                           folds the match histogram + top-k candidates
                           on device (dsi_tpu/device/topk.py).
+  DSI_BENCH_CKPT          the stream row's checkpoint/restore cost keys
+                          (ckpt_overhead_pct / resume_gap_s, dsi_tpu/
+                          ckpt): a checkpointed pass vs the plain pass
+                          plus a resumed pass, both parity-gated.  CPU
+                          boxes run it whenever the stream row measured;
+                          accelerators opt in with 1 (two more stream
+                          passes on a time-boxed window); 0 disables.
   DSI_BENCH_FRAMEWORK_MB  corpus size for the distributed N-worker row
                           (default 48; 0 disables it; auto-shrunk so its
                           oracle pass costs ~100 s on a slow box, skipped
@@ -601,9 +608,117 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
         return {"stream_skipped": f"parity mismatch over {mb:.1f} MB "
                                   f"(throughput suppressed)",
                 "stream_parity": False}
-    return {"stream_mbps": round(mb / dt, 2), "stream_mb": round(mb, 1),
-            "stream_s": round(dt, 2), "stream_parity": True,
-            "stream_phases": phases}
+    row = {"stream_mbps": round(mb / dt, 2), "stream_mb": round(mb, 1),
+           "stream_s": round(dt, 2), "stream_parity": True,
+           "stream_phases": phases}
+    try:
+        row.update(run_stream_ckpt_row(files, mesh, device_acc, oracle,
+                                       corpus_bytes, stream_mb))
+    except Exception as e:  # never trade the stream row for the ckpt one
+        row["ckpt_skipped"] = f"ckpt row failed: {type(e).__name__}: {e}"
+    return row
+
+
+def run_stream_ckpt_row(files, mesh, device_acc, oracle,
+                        corpus_bytes, stream_mb) -> dict:
+    """The checkpoint/restore cost row riding the stream row
+    (``dsi_tpu/ckpt``): three passes over a bounded slice of the stream
+    — a plain WARM pass (its own baseline: the stream row's pass may
+    have paid one-time compiles, which would make a naive comparison
+    report negative overhead), a checkpointed pass
+    (``ckpt_overhead_pct``, acceptance target <=5% at the row's
+    cadence on the CPU box), and a resumed pass from the final retained
+    checkpoint (``resume_gap_s`` = the engine's restore wall: load +
+    re-upload + re-warm + seek), each parity-gated against the oracle
+    counts.
+
+    The slice is capped at ~16 MB (overhead is a ratio; it does not
+    need the full row size, and three extra 64 MB passes would threaten
+    the CPU-fallback wall budget).  CPU boxes run it whenever the
+    stream row measured; accelerators opt in via ``DSI_BENCH_CKPT=1``
+    (three more stream passes on a time-boxed tunnel window must be a
+    choice, not a default), and ``DSI_BENCH_CKPT=0`` disables
+    everywhere.  Always returns measured keys XOR ``ckpt_skipped`` —
+    the bench-contract discipline.
+    """
+    explicit = os.environ.get("DSI_BENCH_CKPT")
+    if explicit == "0":
+        return {"ckpt_skipped": "disabled (DSI_BENCH_CKPT=0)"}
+    import jax
+
+    if jax.devices()[0].platform != "cpu" and explicit != "1":
+        return {"ckpt_skipped": "accelerator ckpt row is opt-in "
+                                "(set DSI_BENCH_CKPT=1)"}
+    import shutil
+
+    from dsi_tpu.ckpt import checkpoint_every_default
+    from dsi_tpu.parallel.streaming import (stream_files,
+                                            wordcount_streaming)
+    from dsi_tpu.utils.tracing import Span
+
+    ckpt_dir = os.path.join(WORKDIR, "ckpt-row")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    cycles = max(1, round(min(stream_mb, 16.0) * 1e6 / corpus_bytes))
+
+    def blocks():
+        for c in range(cycles):
+            if c:
+                yield b"\n"
+            yield from stream_files(files)
+
+    def run(**kw):
+        pstats: dict = {}
+        with Span("bench.stream_ckpt") as pt:
+            acc = wordcount_streaming(
+                blocks(), mesh=mesh, n_reduce=N_REDUCE,
+                chunk_bytes=STREAM_CHUNK_BYTES, u_cap=STREAM_U_CAP,
+                aot=True, device_accumulate=device_acc,
+                pipeline_stats=pstats, **kw)
+        ok = (acc is not None and len(acc) == len(oracle)
+              and all(acc.get(w, (0, 0))[0] == c * cycles
+                      for w, c in oracle.items()))
+        return ok, pt.elapsed_s, pstats
+
+    # Cadence: the env default, shrunk so even a small contract-test row
+    # writes a few checkpoints (a row that never checkpoints measures
+    # nothing).  The big-row default stays the documented cadence.
+    n_dev = mesh.devices.size
+    est_steps = max(1, int(corpus_bytes * cycles
+                           // (n_dev * STREAM_CHUNK_BYTES)))
+    every = max(1, min(checkpoint_every_default(),
+                       max(1, est_steps // 4)))
+    try:
+        base_ok, base_s, _ = run()  # warm plain baseline
+        if not base_ok:
+            return {"ckpt_skipped": "baseline pass parity mismatch"}
+        ck_ok, ck_s, pstats = run(checkpoint_dir=ckpt_dir,
+                                  checkpoint_every=every)
+        saves = pstats.get("ckpt_saves", 0)
+        if not ck_ok:
+            return {"ckpt_skipped": "checkpointed pass parity mismatch "
+                                    "(overhead suppressed)"}
+        if not saves:
+            return {"ckpt_skipped": f"stream too short to checkpoint "
+                                    f"(0 saves at every={every})"}
+        overhead = 100.0 * (ck_s - base_s) / base_s
+        resume_ok, _, rstats = run(checkpoint_dir=ckpt_dir,
+                                   checkpoint_every=every, resume=True)
+    finally:
+        # Every exit path — skip returns and exceptions included — must
+        # drop the row's snapshot files, or stale state-*.npz piles up
+        # in the bench workdir across runs.
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    log(f"ckpt row: overhead {overhead:.1f}% ({ck_s:.2f}s vs {base_s:.2f}s"
+        f" warm, {saves} saves at every={every}), resume gap "
+        f"{rstats.get('resume_gap_s', 0)}s from cursor "
+        f"{rstats.get('resume_cursor', 0)} (parity={resume_ok})")
+    if not resume_ok:
+        return {"ckpt_skipped": "resume parity mismatch (gap suppressed)",
+                "resume_parity": False}
+    return {"ckpt_overhead_pct": round(overhead, 1), "ckpt_every": every,
+            "ckpt_saves": saves,
+            "resume_gap_s": rstats.get("resume_gap_s", 0.0),
+            "resume_parity": True}
 
 
 def run_kernel_row(files) -> dict:
@@ -966,6 +1081,15 @@ def run_framework_row(bench_oracle_mbps: float) -> dict:
             if p.poll() is None:
                 p.kill()
                 p.wait()
+        # Killed writers leave .tmp-* commit orphans (atomic_write's
+        # temp prefix) — in the framework sandbox, and in the stream
+        # row's checkpoint dir when an earlier interrupted bench died
+        # mid-save.  Both directories are quiesced here, so the reap is
+        # safe by construction.
+        from dsi_tpu.utils.atomicio import reap_tmp_files
+
+        reap_tmp_files(fw_dir)
+        reap_tmp_files(os.path.join(WORKDIR, "ckpt-row"))
     row.update(native_row)
     if "framework_mbps" in row and "native_oracle_mbps" in row:
         # The decomposition: framework_vs_oracle ==
@@ -1358,10 +1482,11 @@ def main() -> None:
 
     for k in res:
         # Honesty rows measured in the child ride the verdict verbatim:
-        # the stream row, the kernel-only rep row, and the tfidf/grep
-        # engine rows (each either measured or carrying an explicit skip
-        # reason).
-        if k.startswith(("stream_", "kernel_", "tfidf_", "grep_")):
+        # the stream row, the kernel-only rep row, the tfidf/grep engine
+        # rows, and the stream row's checkpoint/resume cost keys (each
+        # either measured or carrying an explicit skip reason).
+        if k.startswith(("stream_", "kernel_", "tfidf_", "grep_",
+                         "ckpt_", "resume_")):
             out[k] = res[k]
     out.update(fw)
     if tpu_error:

@@ -22,6 +22,10 @@ Package layout:
   apps/      application plugins (wc, grep, indexer, crash, ...)
   ops/       single-device TPU kernels (tokenize, hash, segment reduce)
   parallel/  device mesh, shard_map all_to_all shuffle, multi-chip pipeline
+  device/    device-resident accumulator services (fold table, top-k,
+             histogram, postings buffer)
+  ckpt/      checkpoint/restore for the streaming engines: cadence policy,
+             CRC'd durable manifest store, crash fault injection
   backends/  host (reference-semantics) and tpu execution backends
   utils/     config, corpus generation, atomic IO, codecs, tracing
   cli/       process entry points (mrcoordinator, mrworker, mrsequential)

@@ -1,0 +1,71 @@
+"""Checkpoint/restore for the streaming engines.
+
+The reference system's whole fault-tolerance story is re-execution: a
+task that dies is re-run from its input files (10 s presumed-dead
+timeout, ``mr/coordinator.go``), and the control-plane journal
+(``mr/journal.py``) extends that to coordinator death.  The streaming
+engines broke that model's assumption — their value IS the gigabytes of
+cross-step state held on device (`dsi_tpu/device/`) with ``step_pulls=0``
+— so a worker death lost the whole stream and the only recovery was a
+full replay.  This package closes that gap:
+
+* :mod:`~dsi_tpu.ckpt.policy` — :class:`CheckpointPolicy`, the cadence
+  (every K confirmed steps and/or T seconds), mirroring
+  ``device/policy.SyncPolicy``;
+* :mod:`~dsi_tpu.ckpt.store` — :class:`CheckpointStore`, the durable
+  versioned (payload, manifest) pairs with CRC sidecars, parent-dir
+  fsync, newest-valid-wins loading and last-two retention;
+* :mod:`~dsi_tpu.ckpt.fault` — :func:`fault_point`, the named
+  kill-points (``DSI_FAULT_POINT``/``DSI_FAULT_STEP``) that let tests
+  and ``onchip_evidence.sh`` prove resume against REAL crashes.
+
+The consistency contract, owned here and honored by every engine
+(``parallel/streaming.py``, ``parallel/grepstream.py``,
+``parallel/tfidf.py``): a checkpoint is taken only at a CONFIRMED-step
+boundary and contains (a) the host accumulators, (b) drain-free images
+of every live device service (flushed of lagged flags, pulled but NOT
+cleared), (c) the sticky dispatch-rung state, and (d) the input cursor
+of the last confirmed step.  Steps in the in-flight window — dispatched
+but with deferred checks unread — are deliberately EXCLUDED: their
+outputs were never merged, so re-reading the input from the cursor and
+re-processing them preserves exactly-once through the same
+replay-at-sticky-rungs ladder that makes the pipelined engines
+bit-identical to ``depth=1``.  Resume therefore yields bit-identical
+final output to an uninterrupted run — the parity gate
+tests/test_checkpoint.py enforces per engine, fault point, depth, and
+device_accumulate mode.
+"""
+
+from dsi_tpu.ckpt.fault import (
+    FAULT_EXIT,
+    FAULT_POINTS,
+    FaultInjected,
+    fault_point,
+    reset_faults,
+)
+from dsi_tpu.ckpt.policy import (
+    CheckpointPolicy,
+    checkpoint_every_default,
+    checkpoint_secs_default,
+)
+from dsi_tpu.ckpt.store import (
+    CKPT_VERSION,
+    CheckpointMismatch,
+    CheckpointStore,
+    skip_stream,
+)
+
+__all__ = [
+    "CKPT_VERSION",
+    "CheckpointMismatch",
+    "CheckpointPolicy",
+    "CheckpointStore",
+    "FAULT_EXIT",
+    "FAULT_POINTS",
+    "FaultInjected",
+    "checkpoint_every_default",
+    "checkpoint_secs_default",
+    "fault_point",
+    "reset_faults",
+    "skip_stream",
+]

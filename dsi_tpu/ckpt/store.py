@@ -1,0 +1,201 @@
+"""Versioned, CRC-guarded checkpoint store.
+
+One checkpoint = one numpy payload (``state-<seq>.npz``: every array the
+engine needs back, host accumulators and pulled device-service images
+alike) plus one JSON manifest (``manifest-<seq>.json``: format version,
+engine name, job identity, cursor and sticky-rung metadata, and the
+payload's name + CRC32).  Both files go through the shared durable-write
+path (``utils/atomicio.write_bytes_durable``: temp + fsync + rename +
+CRC32 sidecar + parent-dir fsync) — the same discipline the control
+plane's journal uses, so a crash at ANY instant leaves either a fully
+valid checkpoint or recognisable garbage, never a half-truth:
+
+* crash mid-payload: a ``.tmp-*`` orphan, no manifest — invisible to
+  the loader, reaped by the next save (and by the bench's try/finally);
+* crash between payload and manifest: a payload with no manifest —
+  invisible;
+* torn/corrupt file that somehow survives rename: the CRC sidecar (and
+  the payload CRC recorded in the manifest) fails verification and the
+  loader falls back to the previous checkpoint — which is why the last
+  TWO checkpoints are retained and only older ones garbage-collected.
+
+The manifest carries the job identity (engine name + the shape knobs
+that change byte layout); resuming against a different job is refused
+rather than silently corrupting state — the journal-header rule.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import zlib
+from typing import Dict, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from dsi_tpu.utils.atomicio import (
+    read_bytes_verified,
+    reap_tmp_files,
+    write_bytes_durable,
+)
+
+#: Bumped whenever the payload/manifest layout changes incompatibly; a
+#: loader refuses versions it does not know instead of misreading them.
+CKPT_VERSION = 1
+
+_MANIFEST_RE = re.compile(r"^manifest-(\d{6})\.json$")
+
+
+class CheckpointMismatch(RuntimeError):
+    """A valid checkpoint exists but belongs to a different job."""
+
+
+def skip_stream(blocks: Iterable[bytes], skip: int) -> Iterator[bytes]:
+    """Drop the first ``skip`` bytes of a block stream — the resume
+    seek.  The engines' batchers are pure functions of the byte stream,
+    so feeding them the suffix from the confirmed cursor reproduces the
+    uninterrupted run's remaining batches exactly."""
+    remaining = int(skip)
+    for b in blocks:
+        if remaining:
+            if len(b) <= remaining:
+                remaining -= len(b)
+                continue
+            b = bytes(memoryview(b)[remaining:])
+            remaining = 0
+        yield b
+
+
+class CheckpointStore:
+    """Save/load numbered (payload, manifest) checkpoint pairs in one
+    directory, newest-valid-wins, last two retained."""
+
+    def __init__(self, directory: str, engine: str, job: Dict):
+        self.dir = directory
+        self.engine = engine
+        #: The identity a checkpoint must match to be resumable: every
+        #: knob that changes byte layout or stream cutting (chunk size,
+        #: mesh width, reduce count, pattern, ...).  JSON-normalised so
+        #: tuple-vs-list spelling differences can't refuse a real match.
+        self.job = json.loads(json.dumps(job))
+        os.makedirs(self.dir, exist_ok=True)
+
+    # ── paths ──
+
+    def _manifest_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"manifest-{seq:06d}.json")
+
+    def _payload_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"state-{seq:06d}.npz")
+
+    def _seqs(self) -> list[int]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        return sorted(int(m.group(1)) for n in names
+                      if (m := _MANIFEST_RE.match(n)))
+
+    # ── writing ──
+
+    def reset(self) -> None:
+        """Start a fresh lineage: remove every manifest/payload/sidecar
+        (and orphan temp file) so a later ``--resume`` can never pick up
+        a checkpoint from a PREVIOUS job's run that this run has since
+        diverged from."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for n in names:
+            if (n.startswith(("manifest-", "state-", ".tmp-"))
+                    and not os.path.isdir(os.path.join(self.dir, n))):
+                try:
+                    os.remove(os.path.join(self.dir, n))
+                except OSError:
+                    pass
+        # Make the unlinks durable BEFORE the new lineage's first save:
+        # without this, a crash after save() could resurrect a
+        # higher-seq checkpoint of the PREVIOUS run (same job identity,
+        # diverged state) and load_latest would prefer it.
+        from dsi_tpu.utils.atomicio import fsync_dir
+
+        fsync_dir(self.dir)
+
+    def save(self, arrays: Dict[str, np.ndarray], meta: Dict) -> int:
+        """Commit one checkpoint; returns its sequence number.  The
+        payload lands durably BEFORE the manifest that names it, so the
+        manifest's existence implies a complete payload."""
+        seqs = self._seqs()
+        seq = (seqs[-1] + 1) if seqs else 1
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+        crc = write_bytes_durable(self._payload_path(seq), payload)
+        manifest = {
+            "version": CKPT_VERSION,
+            "engine": self.engine,
+            "job": self.job,
+            "seq": seq,
+            "payload": os.path.basename(self._payload_path(seq)),
+            "payload_crc32": crc,
+            "meta": meta,
+        }
+        write_bytes_durable(
+            self._manifest_path(seq),
+            json.dumps(manifest, sort_keys=True).encode("utf-8"))
+        self._gc(keep_from=seq - 1)
+        reap_tmp_files(self.dir)
+        return seq
+
+    def _gc(self, keep_from: int) -> None:
+        """Remove checkpoints older than ``keep_from`` (last-two
+        retention: the newest may be the one a concurrent crash tore,
+        the one before it is the fallback)."""
+        for seq in self._seqs():
+            if seq >= keep_from:
+                continue
+            for path in (self._manifest_path(seq), self._payload_path(seq)):
+                for p in (path, path + ".crc32"):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+
+    # ── reading ──
+
+    def load_latest(self) -> Optional[Tuple[Dict, Dict[str, np.ndarray]]]:
+        """Newest checkpoint that passes every check — manifest CRC,
+        version, job identity, payload CRC — or None when no usable
+        checkpoint exists.  A corrupt newest falls back to its
+        predecessor (that is what last-two retention buys); a VALID
+        manifest for a different job refuses loudly instead, because
+        silently starting fresh would overwrite a good lineage."""
+        for seq in reversed(self._seqs()):
+            raw = read_bytes_verified(self._manifest_path(seq))
+            if raw is None:
+                continue  # torn manifest: fall back to the previous
+            try:
+                manifest = json.loads(raw)
+            except ValueError:
+                continue
+            if manifest.get("version") != CKPT_VERSION:
+                continue
+            if (manifest.get("engine") != self.engine
+                    or manifest.get("job") != self.job):
+                raise CheckpointMismatch(
+                    f"checkpoint {self._manifest_path(seq)} belongs to a "
+                    f"different job (engine/job mismatch); refusing to "
+                    f"resume")
+            payload = read_bytes_verified(
+                os.path.join(self.dir, manifest["payload"]))
+            if payload is None:
+                continue
+            if zlib.crc32(payload) != manifest["payload_crc32"]:
+                continue
+            with np.load(io.BytesIO(payload)) as z:
+                arrays = {k: z[k] for k in z.files}
+            return manifest["meta"], arrays
+        return None

@@ -19,7 +19,8 @@ depends on the device kernel.
 Usage:
     python -m dsi_tpu.cli.grepstream --pattern PAT [--chunk-bytes B]
         [--devices D] [--pipeline-depth D] [--device-accumulate]
-        [--sync-every K] [--topk K] [--aot] [--stats] [--check]
+        [--sync-every K] [--checkpoint-dir DIR] [--checkpoint-every K]
+        [--resume] [--topk K] [--aot] [--stats] [--check]
         inputfiles...
 """
 
@@ -59,6 +60,15 @@ def main(argv=None) -> int:
     p.add_argument("--sync-every", type=_positive_int, default=None,
                    help="folds between host pulls with --device-accumulate "
                         "(default: DSI_STREAM_SYNC_EVERY or 8)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable crash-resume checkpoints (dsi_tpu/ckpt)")
+    p.add_argument("--checkpoint-every", type=_positive_int, default=None,
+                   help="confirmed steps between checkpoints (default: "
+                        "DSI_STREAM_CKPT_EVERY or 32)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest valid checkpoint in "
+                        "--checkpoint-dir; results are bit-identical to "
+                        "an uninterrupted run")
     p.add_argument("--topk", type=_positive_int, default=16,
                    help="top-k lines by occurrence count to report")
     p.add_argument("--aot", action="store_true",
@@ -74,6 +84,9 @@ def main(argv=None) -> int:
                         "and verify parity (exit 2 on mismatch)")
     args = p.parse_args(argv)
 
+    if args.resume and not args.checkpoint_dir:
+        p.error("--resume requires --checkpoint-dir")
+
     pattern = args.pattern or os.environ.get("DSI_GREP_PATTERN")
     if not pattern:
         print("grepstream: no pattern (--pattern or DSI_GREP_PATTERN)",
@@ -88,14 +101,31 @@ def main(argv=None) -> int:
     from dsi_tpu.parallel.shuffle import default_mesh
     from dsi_tpu.parallel.streaming import stream_files
 
+    from dsi_tpu.ckpt import CheckpointMismatch
+
     mesh = default_mesh(args.devices)
     pstats: dict = {}
-    res = grep_streaming(stream_files(args.files), pattern, mesh=mesh,
-                         chunk_bytes=args.chunk_bytes,
-                         depth=args.pipeline_depth, aot=args.aot,
-                         device_accumulate=args.device_accumulate,
-                         sync_every=args.sync_every, topk=args.topk,
-                         pipeline_stats=pstats)
+    try:
+        res = grep_streaming(
+            stream_files(args.files), pattern, mesh=mesh,
+            chunk_bytes=args.chunk_bytes, depth=args.pipeline_depth,
+            aot=args.aot, device_accumulate=args.device_accumulate,
+            sync_every=args.sync_every, topk=args.topk,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every, resume=args.resume,
+            pipeline_stats=pstats)
+    except CheckpointMismatch as e:
+        # A valid checkpoint for a DIFFERENT job (other pattern/shape):
+        # refuse loudly rather than corrupt or overwrite the lineage.
+        print(f"grepstream: {e}", file=sys.stderr)
+        return 1
+    if args.resume and not pstats.get("resume_cursor"):
+        # Legitimate when the crash predated the first checkpoint, but a
+        # typo'd --checkpoint-dir looks identical — never replay a whole
+        # stream silently.
+        print("grepstream: --resume found no usable checkpoint in "
+              f"{args.checkpoint_dir}; started from scratch",
+              file=sys.stderr)
     if args.stats:
         print(f"grepstream: pipeline_stats={pstats}", file=sys.stderr)
     host_path = res is None

@@ -10,7 +10,7 @@ and exits when the coordinator does.
 Usage:
     python -m dsi_tpu.cli.mrrun [--workers 3] [--nreduce 10]
         [--backend host|tpu|native] [--workdir DIR] [--task-timeout S]
-        [--check] <app> inputfiles...
+        [--journal FILE [--resume]] [--check] <app> inputfiles...
 
 ``--check`` additionally runs the sequential oracle and byte-compares the
 merged output (sort mr-out-* | grep ., test-mr.sh:52-53), exiting non-zero
@@ -38,6 +38,17 @@ def main(argv=None) -> int:
     p.add_argument("--task-timeout", type=float, default=10.0)
     p.add_argument("--journal", default="",
                    help="coordinator checkpoint journal (resume support)")
+    p.add_argument("--resume", action="store_true",
+                   help="assert this run resumes a crashed job from "
+                        "--journal: completed tasks replay as DONE (their "
+                        "output files were already atomically committed), "
+                        "in-progress tasks hand out afresh.  Requires "
+                        "--journal and errors if the journal file does "
+                        "not exist (nothing to resume is a caller "
+                        "mistake, not a fresh start).  NOTE the "
+                        "coordinator resumes from any EXISTING --journal "
+                        "either way — this flag adds the assertion, and "
+                        "mrrun warns when resuming implicitly without it")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="whole-job wall budget, seconds")
     p.add_argument("--check", action="store_true",
@@ -51,6 +62,20 @@ def main(argv=None) -> int:
     if os.sep in app or app.endswith(".py"):
         app = os.path.abspath(app)  # workers run with cwd=workdir
     journal = os.path.abspath(args.journal) if args.journal else ""
+    if args.resume:
+        if not journal:
+            p.error("--resume requires --journal")
+        if not os.path.exists(journal):
+            print(f"mrrun: --resume: journal not found: {journal}",
+                  file=sys.stderr)
+            return 1
+    elif journal and os.path.exists(journal):
+        # The coordinator keys resume off journal existence alone; say
+        # so out loud when the caller did not ask for it — a fresh job
+        # against a stale journal would silently skip completed tasks.
+        print(f"mrrun: existing journal {journal} will be RESUMED "
+              "(pass --resume to assert this, or delete the journal "
+              "for a fresh job)", file=sys.stderr)
     env = dict(os.environ)
     env.setdefault("DSI_MR_SOCKET", os.path.join(workdir, "mr.sock"))
 

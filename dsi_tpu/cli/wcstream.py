@@ -14,6 +14,7 @@ Usage:
     python -m dsi_tpu.cli.wcstream [--nreduce N] [--chunk-bytes B]
         [--devices D] [--workdir DIR] [--check] [--aot] [--u-cap U]
         [--pipeline-depth D] [--device-accumulate] [--sync-every K]
+        [--checkpoint-dir DIR] [--checkpoint-every K] [--resume]
         [--grouper sort|hash] [--stats] inputfiles...
 """
 
@@ -66,6 +67,18 @@ def main(argv=None) -> int:
                    help="folds between host pulls with "
                         "--device-accumulate (default: "
                         "DSI_STREAM_SYNC_EVERY or 8)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable crash-resume checkpoints (dsi_tpu/ckpt): "
+                        "durable snapshots of the accumulators + device "
+                        "table + input cursor land here; see --resume")
+    p.add_argument("--checkpoint-every", type=_positive_int, default=None,
+                   help="confirmed steps between checkpoints (default: "
+                        "DSI_STREAM_CKPT_EVERY or 32)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest valid checkpoint in "
+                        "--checkpoint-dir (restores state, seeks the "
+                        "input to the confirmed cursor; final output is "
+                        "bit-identical to an uninterrupted run)")
     p.add_argument("--grouper", choices=("sort", "hash"), default=None,
                    help="pin the kernel's token-grouping strategy "
                         "(DSI_WC_GROUPER): 'hash' is the measured ~1.8x "
@@ -77,6 +90,9 @@ def main(argv=None) -> int:
                         "fold/sync/widen counters) to stderr")
     args = p.parse_args(argv)
 
+    if args.resume and not args.checkpoint_dir:
+        p.error("--resume requires --checkpoint-dir")
+
     if args.grouper:
         os.environ["DSI_WC_GROUPER"] = args.grouper
 
@@ -87,16 +103,33 @@ def main(argv=None) -> int:
     from dsi_tpu.parallel.shuffle import default_mesh, write_partitioned_output
     from dsi_tpu.parallel.streaming import stream_files, wordcount_streaming
 
+    from dsi_tpu.ckpt import CheckpointMismatch
+
     mesh = default_mesh(args.devices)
     pstats: dict = {}
-    acc = wordcount_streaming(stream_files(args.files), mesh=mesh,
-                              n_reduce=args.nreduce,
-                              chunk_bytes=args.chunk_bytes,
-                              u_cap=args.u_cap, aot=args.aot,
-                              depth=args.pipeline_depth,
-                              device_accumulate=args.device_accumulate,
-                              sync_every=args.sync_every,
-                              pipeline_stats=pstats)
+    try:
+        acc = wordcount_streaming(
+            stream_files(args.files), mesh=mesh, n_reduce=args.nreduce,
+            chunk_bytes=args.chunk_bytes, u_cap=args.u_cap, aot=args.aot,
+            depth=args.pipeline_depth,
+            device_accumulate=args.device_accumulate,
+            sync_every=args.sync_every,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every, resume=args.resume,
+            pipeline_stats=pstats)
+    except CheckpointMismatch as e:
+        # A valid checkpoint for a DIFFERENT job (other corpus shape /
+        # mesh / mode): resuming would corrupt it, starting fresh would
+        # overwrite it — the caller must fix the command or the dir.
+        print(f"wcstream: {e}", file=sys.stderr)
+        return 1
+    if args.resume and not pstats.get("resume_cursor"):
+        # Legitimate when the crash predated the first checkpoint, but a
+        # typo'd --checkpoint-dir looks identical — say it out loud so a
+        # GB-scale from-scratch replay is never a silent surprise.
+        print("wcstream: --resume found no usable checkpoint in "
+              f"{args.checkpoint_dir}; started from scratch",
+              file=sys.stderr)
     if args.stats:
         print(f"wcstream: pipeline_stats={pstats}", file=sys.stderr)
     if acc is None:

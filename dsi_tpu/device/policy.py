@@ -60,3 +60,12 @@ class SyncPolicy:
 
     def reset(self) -> None:
         self._since = 0
+
+    # Checkpoint image of the cadence position (dsi_tpu/ckpt): a
+    # resumed stream must sync at the SAME step the uninterrupted one
+    # would, so the folds-since-last-pull counter rides the manifest.
+    def snapshot(self) -> int:
+        return self._since
+
+    def restore(self, since: int) -> None:
+        self._since = max(0, int(since))

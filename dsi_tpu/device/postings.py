@@ -231,6 +231,45 @@ class DevicePostings:
     def pending_rows(self) -> int:
         return int(self._nrows.sum())
 
+    # ── checkpoint image (dsi_tpu/ckpt) ──
+
+    def checkpoint_state(self) -> dict:
+        """Drain-free snapshot: flush the lagged append flags (an
+        overflow recovery drains into the sink, so callers snapshot
+        this buffer BEFORE the host table), then pull the committed
+        prefix WITHOUT resetting.  After the flush the sticky dirty bit
+        is provably clear — a dirty buffer is resolved by recovery
+        before this returns — so the image needs only rows + counts."""
+        orphans = self._flush_pending()
+        if orphans:
+            self._recover(orphans)
+        m = int(self._nrows.max())
+        if m:
+            mp = occupied_prefix(m, self.cap)
+            buf = np.asarray(_buf_prefix(self._buf, mp=mp))
+        else:
+            buf = np.zeros((self.n_dev, 0, self.width), dtype=np.uint32)
+        return {"buf": buf, "nrows": self._nrows.copy(),
+                "cap": np.array(self.cap, dtype=np.int64)}
+
+    def restore_state(self, img: dict) -> None:
+        """Re-upload a :meth:`checkpoint_state` image (resume):
+        reallocate at the image's capacity (a pre-crash widen sticks),
+        scatter the committed prefix back, clear the dirty bit."""
+        self.cap = int(img["cap"])
+        buf = np.asarray(img["buf"], dtype=np.uint32)
+        full = np.zeros((self.n_dev, self.cap, self.width), dtype=np.uint32)
+        if buf.shape[1]:
+            full[:, :buf.shape[1]] = buf
+        sh3 = NamedSharding(self.mesh, P(AXIS, None, None))
+        sh1 = NamedSharding(self.mesh, P(AXIS))
+        nrows = np.asarray(img["nrows"], dtype=np.int64)
+        self._buf = jax.device_put(full, sh3)
+        self._n = jax.device_put(nrows.astype(np.int32), sh1)
+        self._dirty = jax.device_put(np.zeros(self.n_dev, np.int32), sh1)
+        self._nrows = nrows.copy()
+        self._pending.clear()
+
     # ── drains ──
 
     def _drain(self) -> None:

@@ -515,6 +515,47 @@ class DeviceTable:
         self._widen(_pow2(max(self.cap, rows)), new_kk)
         self.stats["widen_s"] += time.perf_counter() - t0
 
+    # ── checkpoint image (dsi_tpu/ckpt) ──
+
+    def checkpoint_state(self) -> dict:
+        """Drain-free snapshot image: flush the lagged flags first (so
+        the image reflects exactly the CONFIRMED folds — recovery of a
+        late-detected overflow may widen, whose drain lands in ``acc``,
+        which is why callers snapshot the device services BEFORE the
+        host accumulator), then pull the five table arrays WITHOUT
+        clearing.  The stream continues with the table resident; the
+        image is pure numpy, ready for ``np.savez``."""
+        orphans = self._flush_pending()
+        if orphans:
+            self._recover(orphans)
+        tkeys, tlens, tcnts, tparts, tn = self._state
+        return {"keys": np.asarray(tkeys), "lens": np.asarray(tlens),
+                "cnts": np.asarray(tcnts), "parts": np.asarray(tparts),
+                "tn": np.asarray(tn), "nrows": self._nrows.copy()}
+
+    def restore_state(self, img: dict) -> None:
+        """Re-upload a :meth:`checkpoint_state` image — re-entering
+        ``device_accumulate`` mid-table on resume.  Capacity and key
+        width follow the image (a widen before the crash is preserved,
+        so the resumed stream starts at the rung that had already
+        cleared)."""
+        keys = np.asarray(img["keys"], dtype=np.uint32)
+        self.cap = int(keys.shape[1])
+        self.kk = int(keys.shape[2])
+        sh3 = NamedSharding(self.mesh, P(AXIS, None, None))
+        sh2 = NamedSharding(self.mesh, P(AXIS, None))
+        sh1 = NamedSharding(self.mesh, P(AXIS))
+        with enable_x64(True):  # keep the u64 counts u64 through the put
+            self._state = (
+                jax.device_put(keys, sh3),
+                jax.device_put(np.asarray(img["lens"], np.int32), sh2),
+                jax.device_put(np.asarray(img["cnts"], np.uint64), sh2),
+                jax.device_put(np.asarray(img["parts"], np.int32), sh2),
+                jax.device_put(np.asarray(img["tn"], np.int32), sh1))
+        self._nrows = np.asarray(img["nrows"], dtype=np.int64).copy()
+        self._pending.clear()
+        self.stats["table_cap"] = self.cap
+
     # ── drains ──
 
     def _pull_merge(self) -> bool:

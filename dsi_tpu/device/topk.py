@@ -89,6 +89,25 @@ class KeyCounts:
     def finalize(self) -> Dict[int, int]:
         return dict(self._counts)
 
+    # ── checkpoint image (dsi_tpu/ckpt) ──
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        if not self._counts:
+            return {}
+        n = len(self._counts)
+        return {"keys": np.fromiter(self._counts.keys(), dtype=np.uint64,
+                                    count=n),
+                "cnts": np.fromiter(self._counts.values(), dtype=np.int64,
+                                    count=n)}
+
+    def restore(self, arrays: Dict[str, np.ndarray]) -> None:
+        self._counts = {}
+        if not arrays or "keys" not in arrays:
+            return
+        for k, c in zip(np.asarray(arrays["keys"], np.uint64).tolist(),
+                        np.asarray(arrays["cnts"], np.int64).tolist()):
+            self._counts[int(k)] = int(c)
+
 
 def _topk_impl(tkeys, tlens, tcnts, *, k: int):
     """Count-descending top-``k`` slice of each device's table shard:
@@ -338,6 +357,20 @@ class DeviceHistogram:
         out = self.pull()
         self._state = None
         return out
+
+    # ── checkpoint image (dsi_tpu/ckpt) ──
+
+    def checkpoint_state(self) -> dict:
+        """Drain-free image of the running totals.  A histogram fold is
+        a donated add with no flags, so the last dispatched fold IS
+        confirmed the moment the pull lands — no lag to flush."""
+        return {"hist": np.asarray(self._state)}
+
+    def restore_state(self, img: dict) -> None:
+        sh = NamedSharding(self.mesh, P(AXIS, None))
+        with enable_x64(True):  # keep the u64 totals u64 through the put
+            self._state = jax.device_put(
+                np.asarray(img["hist"], np.uint64), sh)
 
 
 def warm_histogram(mesh: Mesh, *, slots: int) -> None:

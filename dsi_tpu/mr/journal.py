@@ -25,6 +25,8 @@ import json
 import os
 from typing import List, Optional, TextIO
 
+from dsi_tpu.utils.atomicio import fsync_dir
+
 
 class Journal:
     """Append-only completion log with atomic-enough line writes."""
@@ -126,6 +128,12 @@ class Journal:
                         f.truncate(keep)
                         size = keep
         self._fh = open(self.path, "a")
+        # Record writes fsync the FILE, but a freshly created journal's
+        # directory entry was never made durable — a crash right after
+        # open() could lose the whole file and with it every completion
+        # appended later.  One parent-dir fsync (the checkpoint store's
+        # shared durable-write discipline, utils/atomicio.py) closes it.
+        fsync_dir(os.path.dirname(os.path.abspath(self.path)) or ".")
         if size == 0:  # empty counts as fresh: a torn header must be rewritten
             self._write({"kind": "header", "files": self.files,
                          "n_reduce": self.n_reduce})

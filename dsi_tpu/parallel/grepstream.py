@@ -68,6 +68,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dsi_tpu.ckpt import (
+    CheckpointPolicy,
+    CheckpointStore,
+    fault_point,
+    skip_stream,
+)
 from dsi_tpu.device.policy import SyncPolicy
 from dsi_tpu.device.table import _pow2, _quiet_unusable_donation
 from dsi_tpu.device.topk import DeviceHistogram, DeviceTopK, KeyCounts
@@ -145,7 +151,8 @@ def _default_topk_cap(n_dev: int, k: int) -> int:
 
 
 def batch_lines(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
-                pool: Optional[BufferPool] = None):
+                pool: Optional[BufferPool] = None,
+                offsets: Optional[list] = None):
     """Slice a byte-block stream into zero-padded ``[n_dev, chunk_bytes]``
     batches, cutting rows only at newline boundaries so no line straddles
     a row.  Yields ``(batch, lens, row_lines)`` — per-row valid byte
@@ -156,8 +163,13 @@ def batch_lines(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
     the consumer hands each batch back via ``pool.give`` once its step
     is confirmed.  A line wider than ``chunk_bytes`` raises
     :class:`_LineTooLong` — the stream is the host path's then.
+
+    With ``offsets`` (the checkpoint cursor hook, the ``batch_stream``
+    contract) the stream offset just past each yielded batch's content
+    is appended, before the yield.
     """
     carry = bytearray()
+    consumed = 0
 
     def new_batch() -> np.ndarray:
         if pool is not None:
@@ -170,7 +182,7 @@ def batch_lines(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
     row = 0
 
     def fill_rows(final: bool):
-        nonlocal batch, lens, row_lines, row
+        nonlocal batch, lens, row_lines, row, consumed
         while carry and (len(carry) > chunk_bytes or final):
             if len(carry) <= chunk_bytes:
                 cut = len(carry)  # final tail: whole remainder fits
@@ -187,11 +199,14 @@ def batch_lines(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
             n_nl = int(np.count_nonzero(view == 10))
             del view
             del carry[:cut]
+            consumed += cut
             batch[row, cut:] = 0
             lens[row] = cut
             row_lines[row] = n_nl + (1 if batch[row, cut - 1] != 10 else 0)
             row += 1
             if row == n_dev:
+                if offsets is not None:
+                    offsets.append(consumed)
                 yield batch, lens, row_lines
                 batch = new_batch()
                 lens = np.zeros(n_dev, dtype=np.int32)
@@ -204,6 +219,8 @@ def batch_lines(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
     yield from fill_rows(final=True)
     if row:
         batch[row:] = 0  # recycled buffer: stale tail rows must not count
+        if offsets is not None:
+            offsets.append(consumed)
         yield batch, lens, row_lines
     elif pool is not None:
         pool.give(batch)
@@ -404,6 +421,8 @@ def grep_streaming(
         aot: bool = False, device_accumulate: bool = False,
         sync_every: Optional[int] = None, topk: int = DEFAULT_TOPK,
         bins: int = GREP_BINS, pipeline_stats: Optional[dict] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: Optional[int] = None, resume: bool = False,
 ) -> Optional[GrepStreamResult]:
     """Whole-stream literal grep with bounded memory, pipelined.
 
@@ -436,6 +455,13 @@ def grep_streaming(
     (``batch_s``/``batch_wait_s``/``upload_s``/``kernel_s``/``pull_s``/
     ``merge_s``/``replay_s``, ``steps``/``replays``/``step_pulls``/
     ``sync_pulls``/``l_cap`` plus the service counters).
+
+    ``checkpoint_dir``/``checkpoint_every``/``resume`` follow the
+    ``wordcount_streaming`` crash-resume contract (``dsi_tpu/ckpt``):
+    snapshots at confirmed-step boundaries carry the host accumulators
+    (or the device histogram/top-k images), the global line counter,
+    the sticky ``l_cap`` rung, and the byte cursor; resumed output is
+    bit-identical to an uninterrupted run.
     """
     if not is_literal_pattern(pattern):
         return None
@@ -478,6 +504,83 @@ def grep_streaming(
                               k=topk, acc=acc, aot=aot,
                               lag=max(0, depth - 1), stats=stats)
 
+    # ── checkpoint/restore (dsi_tpu/ckpt) ──
+    ck_store: Optional[CheckpointStore] = None
+    ck_policy: Optional[CheckpointPolicy] = None
+    ck_cursor = {"offset": 0, "lines": 0}
+    offsets: Optional[list] = None
+    dispatch_idx = [0]
+    start_offset = 0
+    if checkpoint_dir:
+        ck_store = CheckpointStore(checkpoint_dir, "grep", {
+            "n_dev": n_dev, "chunk_bytes": chunk_bytes,
+            "pattern": pattern, "bins": bins, "topk": topk,
+            "device_accumulate": bool(device_accumulate)})
+        ck_policy = CheckpointPolicy(checkpoint_every)
+        offsets = []
+        stats.update({"ckpt_saves": 0, "ckpt_s": 0.0,
+                      "ckpt_every": ck_policy.every})
+        if resume:
+            t_res = time.perf_counter()
+            loaded = ck_store.load_latest()
+            if loaded is not None:
+                meta, arrays = loaded
+                start_offset = int(meta["cursor"])
+                ck_cursor.update(offset=start_offset,
+                                 lines=int(meta["lines"]))
+                next_line[0] = int(meta["lines"])
+                state["l_cap"] = int(meta["l_cap"])
+                stats["l_cap"] = state["l_cap"]
+                if device_accumulate:
+                    acc.restore({k[3:]: v for k, v in arrays.items()
+                                 if k.startswith("kc_")})
+                    if "hist" in arrays:
+                        hist_svc.restore_state({"hist": arrays["hist"]})
+                    if meta.get("table_cap"):
+                        topk_svc.restore_state(
+                            {k[6:]: v for k, v in arrays.items()
+                             if k.startswith("table_")})
+                    policy.restore(meta.get("sync_since", 0))
+                else:
+                    if "gs_hist" in arrays:
+                        hist_h[:] = arrays["gs_hist"]
+                        totals[:] = arrays["gs_totals"]
+                    if "gs_cands" in arrays:
+                        cand_h.extend(
+                            (int(a), int(b))
+                            for a, b in arrays["gs_cands"].tolist())
+            stats["resume_gap_s"] = round(time.perf_counter() - t_res, 4)
+            stats["resume_cursor"] = start_offset
+        else:
+            ck_store.reset()
+
+    def save_ckpt() -> None:
+        """Consistent snapshot at a confirmed-step boundary — device
+        images first (flushing the top-k lag can widen, whose drain
+        lands in the KeyCounts accumulator), host residue second."""
+        t0 = time.perf_counter()
+        arrays: dict = {}
+        meta = {"cursor": ck_cursor["offset"], "lines": ck_cursor["lines"],
+                "l_cap": state["l_cap"]}
+        if device_accumulate:
+            for k, v in topk_svc.checkpoint_state().items():
+                arrays["table_" + k] = v
+            meta["table_cap"] = topk_svc.cap
+            meta["table_kk"] = topk_svc.kk
+            arrays["hist"] = hist_svc.checkpoint_state()["hist"]
+            for k, v in acc.snapshot().items():
+                arrays["kc_" + k] = v
+            meta["sync_since"] = policy.snapshot()
+        else:
+            arrays["gs_hist"] = hist_h.copy()
+            arrays["gs_totals"] = totals.copy()
+            if cand_h:
+                arrays["gs_cands"] = np.array(cand_h, dtype=np.int64)
+        ck_store.save(arrays, meta)
+        stats["ckpt_saves"] += 1
+        stats["ckpt_s"] += time.perf_counter() - t0
+        fault_point("post-ckpt")
+
     def step_call(buf, lens_np, bases_np, l_cap):
         t0 = time.perf_counter()
         chunks = jax.device_put(buf, sh2)
@@ -501,8 +604,13 @@ def grep_streaming(
         hist_d, cand_d, scal = step_call(buf, lens_np, bases,
                                          state["l_cap"])
         stats["steps"] += 1
+        rec_offset = 0
+        if offsets is not None:
+            rec_offset = start_offset + offsets[dispatch_idx[0]]
+            dispatch_idx[0] += 1
+        fault_point("post-dispatch")
         return (buf, lens_np, row_lines, bases, state["l_cap"],
-                hist_d, cand_d, scal)
+                hist_d, cand_d, scal, rec_offset, next_line[0])
 
     def replay_step(buf, lens_np, bases_np, used_l_cap):
         """Late-detected line-capacity overflow: replay just this step
@@ -527,7 +635,7 @@ def grep_streaming(
 
     def finish_one(record) -> None:
         buf, lens_np, row_lines, bases_np, l_cap_used, hist_d, cand_d, \
-            scal = record
+            scal, rec_offset, rec_lines = record
         t0 = time.perf_counter()
         scal_np = np.asarray(scal)  # blocks until this step's kernel lands
         stats["kernel_s"] += time.perf_counter() - t0
@@ -548,6 +656,7 @@ def grep_streaming(
                 topk_svc.fold(cand_d, scal, scal_np)
             policy.note_fold()
             if policy.due():
+                fault_point("pre-sync")
                 topk_svc.sync()
                 hist_svc.pull()
                 stats["sync_pulls"] += 1
@@ -568,6 +677,16 @@ def grep_streaming(
                         cand_np[d, i, 1])
                     cand_h.append((line, int(cand_np[d, i, 3])))
             stats["merge_s"] += time.perf_counter() - t0
+        # Confirmed: merged/folded, nothing later is.  Fault before the
+        # cursor advances — the torn-update instant.
+        fault_point("mid-fold")
+        if ck_store is not None:
+            ck_cursor["offset"] = rec_offset
+            ck_cursor["lines"] = rec_lines
+            ck_policy.note_step()
+            if ck_policy.due():
+                save_ckpt()
+                ck_policy.reset()
         pool.give(buf)
 
     pipe = StepPipeline(depth=depth, dispatch=dispatch, finish=finish_one,
@@ -576,11 +695,13 @@ def grep_streaming(
                         inflight_key="max_inflight_chunks",
                         thread_name="dsi-grep-batcher")
 
+    feed = skip_stream(blocks, start_offset) if start_offset else blocks
     result: Optional[GrepStreamResult]
     try:
-        pipe.run(lambda: batch_lines(blocks, n_dev, chunk_bytes,
-                                     pool=pool))
+        pipe.run(lambda: batch_lines(feed, n_dev, chunk_bytes,
+                                     pool=pool, offsets=offsets))
         if device_accumulate:
+            fault_point("pre-sync")
             topk_svc.close()  # the exact final drain into the KeyCounts
             final = hist_svc.close()
             hist_h = final[:bins]
@@ -597,7 +718,7 @@ def grep_streaming(
             stats["batch_allocs"] = pool.allocs
             for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",
                       "pull_s", "merge_s", "replay_s", "fold_s", "sync_s",
-                      "widen_s", "hist_s"):
+                      "widen_s", "hist_s", "ckpt_s"):
                 if k in stats:
                     stats[k] = round(stats[k], 4)
             pipeline_stats.update(stats)
@@ -772,6 +893,8 @@ def indexer_streaming(
         depth: Optional[int] = None, device_accumulate: bool = False,
         sync_every: Optional[int] = None, topk: int = DEFAULT_TOPK,
         stats: Optional[dict] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: Optional[int] = None, resume: bool = False,
 ):
     """Whole-corpus inverted index over the mesh, waves of ``n_dev``
     documents, pipelined ``depth`` waves deep.
@@ -796,6 +919,17 @@ def indexer_streaming(
     the close drain completing the exact result.  Both the postings
     (including per-word posting order) and the top-k are bit-identical
     to the per-wave pull path.
+
+    ``checkpoint_dir``/``checkpoint_every``/``resume`` follow the
+    streaming engines' crash-resume contract (``dsi_tpu/ckpt``): the
+    cursor is the CONFIRMED-wave ordinal (waves are planned
+    deterministically from doc lengths, so skipping the first n waves
+    on resume reproduces the walk), snapshots carry the postings table
+    residue, the device buffers' drain-free images, and the sticky
+    rung; the checkpoint records its word-window rung, and a rung that
+    widens after resume simply restarts wider, exactly as the
+    uninterrupted walk would.  Resumed postings (incl. per-word order)
+    and df top-k are bit-identical to an uninterrupted run.
     """
     if mesh is None:
         mesh = default_mesh()
@@ -818,6 +952,29 @@ def indexer_streaming(
     groupers = grouper_ladder()
     sh_chunk = NamedSharding(mesh, P(AXIS, None))
     sh_ids = NamedSharding(mesh, P(AXIS))
+
+    # ── checkpoint/restore (dsi_tpu/ckpt): wave-cursor variant ──
+    ck_store: Optional[CheckpointStore] = None
+    resume_meta = None
+    resume_arrays = None
+    if checkpoint_dir:
+        import zlib
+
+        # The wave plan — and with it the cursor's meaning — is a
+        # function of the full per-doc length vector, so the vector's
+        # CRC is part of the job identity: same count + same total with
+        # shuffled lengths must refuse, not silently misalign waves.
+        lens_crc = zlib.crc32(np.asarray(doc_lens, np.int64).tobytes())
+        ck_store = CheckpointStore(checkpoint_dir, "indexer", {
+            "n_dev": n_dev, "n_reduce": n_reduce, "u_cap": u_cap,
+            "n_docs": n_real, "doc_lens_crc32": lens_crc,
+            "topk": topk, "device_accumulate": bool(device_accumulate)})
+        if resume:
+            loaded = ck_store.load_latest()
+            if loaded is not None:
+                resume_meta, resume_arrays = loaded
+        else:
+            ck_store.reset()
 
     def run(mwl: int):
         kk = mwl // 4
@@ -851,8 +1008,81 @@ def indexer_streaming(
             policy = SyncPolicy(sync_every)
             st["sync_every"] = policy.sync_every
 
+        # A checkpoint belongs to ONE word-window rung (a widen re-keys
+        # every row and restarts the walk, discarding rung state): apply
+        # the loaded image only when this run() is at its rung.
+        ck_policy: Optional[CheckpointPolicy] = None
+        ck_wave = [0]  # confirmed-wave cursor (absolute ordinal)
+        start_wave = 0
+        if ck_store is not None:
+            ck_policy = CheckpointPolicy(checkpoint_every)
+            st.setdefault("ckpt_saves", 0)
+            st.setdefault("ckpt_s", 0.0)
+            st["ckpt_every"] = ck_policy.every
+            if resume_meta is not None and int(resume_meta["mwl"]) == mwl:
+                t_res = time.perf_counter()
+                start_wave = int(resume_meta["wave"])
+                ck_wave[0] = start_wave
+                state.update({"cap": int(resume_meta["cap"]),
+                              "grouper": resume_meta["grouper"],
+                              "frac": int(resume_meta["frac"])})
+                table.restore({k[3:]: v for k, v in resume_arrays.items()
+                               if k.startswith("pt_")})
+                if device_accumulate:
+                    if resume_meta.get("pb_cap"):
+                        buf_dev.restore_state(
+                            {"buf": resume_arrays["pb_buf"],
+                             "nrows": resume_arrays["pb_nrows"],
+                             "cap": resume_meta["pb_cap"]})
+                    df_acc.restore(
+                        {k[3:]: v for k, v in resume_arrays.items()
+                         if k.startswith("df_")})
+                    if resume_meta.get("table_cap"):
+                        topk_svc = DeviceTopK(
+                            mesh, kk=int(resume_meta["table_kk"]),
+                            cap=int(resume_meta["table_cap"]), k=topk,
+                            acc=df_acc, aot=False,
+                            lag=max(0, depth - 1), stats=st)
+                        topk_svc.restore_state(
+                            {k[6:]: v for k, v in resume_arrays.items()
+                             if k.startswith("table_")})
+                    policy.restore(resume_meta.get("sync_since", 0))
+                st["resume_gap_s"] = round(time.perf_counter() - t_res, 4)
+                st["resume_wave"] = start_wave
+
+        def save_ckpt() -> None:
+            """Consistent snapshot at a confirmed-wave boundary.
+            Device images first — flushing the postings buffer's lag
+            drains into the host table on overflow recovery, and
+            flushing the df top-k's lag can widen into ``df_acc`` —
+            host residue second, so both sides of any such move land
+            in the same image."""
+            t0 = time.perf_counter()
+            arrays: dict = {}
+            meta = {"mwl": mwl, "wave": ck_wave[0], "cap": state["cap"],
+                    "grouper": state["grouper"], "frac": state["frac"]}
+            if buf_dev is not None:
+                pb = buf_dev.checkpoint_state()
+                arrays["pb_buf"] = pb["buf"]
+                arrays["pb_nrows"] = pb["nrows"]
+                meta["pb_cap"] = int(pb["cap"])
+                if topk_svc is not None:
+                    for k, v in topk_svc.checkpoint_state().items():
+                        arrays["table_" + k] = v
+                    meta["table_cap"] = topk_svc.cap
+                    meta["table_kk"] = topk_svc.kk
+                for k, v in df_acc.snapshot().items():
+                    arrays["df_" + k] = v
+                meta["sync_since"] = policy.snapshot()
+            for k, v in table.snapshot().items():
+                arrays["pt_" + k] = v
+            ck_store.save(arrays, meta)
+            st["ckpt_saves"] += 1
+            st["ckpt_s"] += time.perf_counter() - t0
+            fault_point("post-ckpt")
+
         def materialize():
-            for idxs, size in waves:
+            for idxs, size in waves[start_wave:]:
                 chunk_np = _wave_chunk(docs, idxs, n_dev, size)
                 ids_np = np.array(
                     list(idxs) + [n_real] * (n_dev - len(idxs)),
@@ -875,6 +1105,7 @@ def indexer_streaming(
             rows, df, scal = wave_call(chunk_np, ids_np, size,
                                        state["cap"], state["frac"],
                                        state["grouper"])
+            fault_point("post-dispatch")
             return (size, chunk_np, ids_np, rows, df, scal, state["cap"])
 
         def replay_wave(size, chunk_np, ids_np):
@@ -932,6 +1163,7 @@ def indexer_streaming(
                     policy.reset()  # an overflow recovery just drained:
                     # that WAS this window's pull
                 elif policy.due():
+                    fault_point("pre-sync")
                     buf_dev.sync()
                     topk_svc.sync()
                     policy.reset()
@@ -963,6 +1195,15 @@ def indexer_streaming(
                 rows, df, scal, scal_np = replay_wave(size, chunk_np,
                                                       ids_np)
             commit(rows, df, scal, scal_np)
+            # Confirmed (empty waves included — the cursor must advance
+            # past them too); fault before the cursor moves.
+            fault_point("mid-fold")
+            if ck_policy is not None:
+                ck_wave[0] += 1
+                ck_policy.note_step()
+                if ck_policy.due():
+                    save_ckpt()
+                    ck_policy.reset()
 
         st.setdefault("sync_pulls", 0)
         pipe = StepPipeline(depth=depth, dispatch=dispatch, finish=finish,
@@ -975,6 +1216,7 @@ def indexer_streaming(
         except _AbortRung:
             return ("high" if outcome["high"] else "widen", None)
         if buf_dev is not None:
+            fault_point("pre-sync")
             buf_dev.close()
             if topk_svc is not None:
                 topk_svc.close()
@@ -993,8 +1235,13 @@ def indexer_streaming(
 
         return ("ok", payload)
 
-    for mwl in ((max_word_len, 64) if max_word_len < 64
-                else (max_word_len,)):
+    rungs = ((max_word_len, 64) if max_word_len < 64 else (max_word_len,))
+    if resume_meta is not None:
+        # The checkpoint is at a rung: start there (an earlier rung had
+        # provably aborted before the checkpointed one began).
+        rungs = tuple(m for m in rungs
+                      if m >= int(resume_meta["mwl"])) or rungs
+    for mwl in rungs:
         status, payload = run(mwl)
         if status == "high":
             return None

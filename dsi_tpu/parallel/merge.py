@@ -136,6 +136,32 @@ class PackedCounts:
         return {w: (int(c), int(p))
                 for w, c, p in zip(words, cnts.tolist(), parts.tolist())}
 
+    # ── checkpoint image (dsi_tpu/ckpt) ──
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        """Checkpoint image: the merged table as four arrays, compacted
+        first so the image is bounded by vocabulary, not by the window.
+        Empty accumulator -> empty dict (no keys saved)."""
+        self._compact()
+        if not self._bufs:
+            return {}
+        keys, lens, cnts, parts = self._bufs[0]
+        return {"keys": keys, "lens": lens, "cnts": cnts, "parts": parts}
+
+    def restore(self, arrays: Dict[str, np.ndarray]) -> None:
+        """Load a :meth:`snapshot` image, replacing any current state.
+        Final results are invariant to how the same (word, count)
+        contributions were buffered, so a restored accumulator
+        finalizes bit-identically to the uninterrupted one."""
+        if not arrays or "keys" not in arrays or len(arrays["keys"]) == 0:
+            self._bufs, self._pending = [], 0
+            return
+        self._bufs = [(np.array(arrays["keys"], dtype=np.uint32),
+                       np.array(arrays["lens"], dtype=np.int32),
+                       np.array(arrays["cnts"], dtype=np.int64),
+                       np.array(arrays["parts"], dtype=np.int32))]
+        self._pending = len(self._bufs[0][0])
+
 
 class PostingsTable:
     """TF-IDF accumulator over packed (word, tf, doc, part) row batches.
@@ -161,6 +187,27 @@ class PostingsTable:
         elif kk != self._kk:  # one retry rung per table by contract
             raise ValueError(f"mixed key widths: {self._kk} vs {kk}")
         self._bufs.append(np.array(rows, dtype=np.uint32))
+
+    # ── checkpoint image (dsi_tpu/ckpt) ──
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        """Checkpoint image: every buffered row, concatenated in
+        insertion order — order is part of the postings contract
+        (per-word doc order is an engine invariant), and the stable
+        finalize lexsort preserves it, so a restored table groups
+        bit-identically."""
+        if not self._bufs:
+            return {}
+        rows = (np.concatenate(self._bufs) if len(self._bufs) > 1
+                else self._bufs[0])
+        return {"rows": rows, "kk": np.array(self._kk, dtype=np.int64)}
+
+    def restore(self, arrays: Dict[str, np.ndarray]) -> None:
+        if not arrays or "rows" not in arrays or len(arrays["rows"]) == 0:
+            self._bufs, self._kk = [], None
+            return
+        self._kk = int(arrays["kk"])
+        self._bufs = [np.array(arrays["rows"], dtype=np.uint32)]
 
     def finalize(self) -> Dict[str, Tuple[int, List[Tuple[int, int]]]]:
         return self.finalize_packed().to_dict()

@@ -103,6 +103,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from dsi_tpu.ckpt import (
+    CheckpointPolicy,
+    CheckpointStore,
+    fault_point,
+    skip_stream,
+)
 from dsi_tpu.device.policy import SyncPolicy
 from dsi_tpu.device.table import DeviceTable, _quiet_unusable_donation
 from dsi_tpu.ops.wordcount import (
@@ -170,7 +176,8 @@ def _cut_at_boundary(buf, size: int) -> int:
 
 
 def batch_stream(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
-                 pool: Optional[BufferPool] = None) -> Iterator[np.ndarray]:
+                 pool: Optional[BufferPool] = None,
+                 offsets: Optional[list] = None) -> Iterator[np.ndarray]:
     """Slice a byte-block stream into zero-padded [n_dev, chunk_bytes]
     batches, cutting rows only at non-letter boundaries.
 
@@ -179,8 +186,16 @@ def batch_stream(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
     the consumer must hand each yielded batch back via ``pool.give`` once
     it no longer reads it (the pipeline returns a buffer when its step is
     confirmed exact).  Rows are always written in full — data then zero
-    tail — so a recycled buffer never leaks stale bytes."""
+    tail — so a recycled buffer never leaks stale bytes.
+
+    With ``offsets`` (the checkpoint cursor hook), the stream offset
+    just past each yielded batch's content is appended per batch —
+    appended BEFORE the yield, so the consumer can read ``offsets[i]``
+    the moment batch ``i`` arrives.  Batching is a pure function of the
+    byte stream, so resuming from ``skip_stream(blocks, offsets[i])``
+    reproduces batches ``i+1, i+2, ...`` exactly."""
     carry = bytearray()
+    consumed = 0
 
     def new_batch() -> np.ndarray:
         if pool is not None:
@@ -191,7 +206,7 @@ def batch_stream(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
     row = 0
 
     def fill_rows(final: bool):
-        nonlocal row, carry, batch
+        nonlocal row, carry, batch, consumed
         while carry and (len(carry) >= chunk_bytes + 1 or final):
             cut = _cut_at_boundary(carry, chunk_bytes)
             if cut == 0:
@@ -204,9 +219,12 @@ def batch_stream(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
             batch[row, :cut] = view
             del view           # release the bytearray export before the
             del carry[:cut]    # resize (a live view blocks it)
+            consumed += cut
             batch[row, cut:] = 0
             row += 1
             if row == n_dev:
+                if offsets is not None:
+                    offsets.append(consumed)
                 yield batch
                 batch = new_batch()
                 row = 0
@@ -217,6 +235,8 @@ def batch_stream(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
     yield from fill_rows(final=True)
     if row:
         batch[row:] = 0  # recycled buffer: stale tail rows must not count
+        if offsets is not None:
+            offsets.append(consumed)
         yield batch      # tail batch; remaining rows are empty chunks
     elif pool is not None:
         pool.give(batch)  # taken but never filled: straight back
@@ -526,6 +546,9 @@ def wordcount_streaming(
         pipeline_stats: Optional[dict] = None,
         device_accumulate: bool = False,
         sync_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: Optional[int] = None,
+        resume: bool = False,
 ) -> Optional[Dict[str, Tuple[int, int]]]:
     """Exact whole-stream word counts with bounded memory, pipelined.
 
@@ -579,6 +602,18 @@ def wordcount_streaming(
     ``sync_s``/``widen_s`` phases; ``step_pulls`` counts per-step D2H
     result pulls in BOTH modes, so a bench can show the amortization
     (steps vs ``ceil(steps/K) + widens``) directly.
+
+    ``checkpoint_dir`` enables crash-resume (``dsi_tpu/ckpt``): every
+    ``checkpoint_every`` CONFIRMED steps (``DSI_STREAM_CKPT_EVERY``
+    default) the engine writes a durable snapshot — host accumulator,
+    a drain-free image of the device table (if live), the sticky rung
+    state, and the input-byte cursor of the last confirmed step
+    (in-flight/deferred-check steps are excluded, so replay stays
+    exactly-once).  ``resume=True`` restores the newest valid
+    checkpoint, seeks the block stream to the cursor, and continues;
+    the final result is bit-identical to an uninterrupted run.
+    ``pipeline_stats`` gains ``ckpt_saves``/``ckpt_s`` and, on resume,
+    ``resume_gap_s``/``resume_cursor``.
     """
     if mesh is None:
         mesh = default_mesh()
@@ -612,6 +647,67 @@ def wordcount_streaming(
         policy = SyncPolicy(sync_every)
         stats["sync_every"] = policy.sync_every
 
+    # ── checkpoint/restore (dsi_tpu/ckpt) ──
+    ck_store: Optional[CheckpointStore] = None
+    ck_policy: Optional[CheckpointPolicy] = None
+    ck_cursor = {"offset": 0, "steps": 0}  # last CONFIRMED step's end
+    offsets: Optional[list] = None
+    dispatch_idx = [0]
+    start_offset = 0
+    if checkpoint_dir:
+        ck_store = CheckpointStore(checkpoint_dir, "wordcount", {
+            "n_dev": n_dev, "n_reduce": n_reduce,
+            "chunk_bytes": chunk_bytes,
+            "device_accumulate": bool(device_accumulate)})
+        ck_policy = CheckpointPolicy(checkpoint_every)
+        offsets = []
+        stats.update({"ckpt_saves": 0, "ckpt_s": 0.0,
+                      "ckpt_every": ck_policy.every})
+        if resume:
+            t_res = time.perf_counter()
+            loaded = ck_store.load_latest()
+            if loaded is not None:
+                meta, arrays = loaded
+                start_offset = int(meta["cursor"])
+                ck_cursor.update(offset=start_offset,
+                                 steps=int(meta["steps"]))
+                state.update({"cap": int(meta["cap"]),
+                              "mwl": int(meta["mwl"]),
+                              "grouper": meta["grouper"],
+                              "frac": int(meta["frac"])})
+                acc.restore({k[4:]: v for k, v in arrays.items()
+                             if k.startswith("acc_")})
+                if device_accumulate and meta.get("table_cap"):
+                    # Re-enter device_accumulate mid-table: the image's
+                    # capacity/width win (a pre-crash widen sticks).
+                    table_svc = DeviceTable(
+                        mesh, kk=int(meta["table_kk"]),
+                        cap=int(meta["table_cap"]), acc=acc, aot=aot,
+                        lag=max(0, depth - 1), stats=stats)
+                    table_svc.restore_state(
+                        {k[6:]: v for k, v in arrays.items()
+                         if k.startswith("table_")})
+                    policy.restore(meta.get("sync_since", 0))
+                if aot:
+                    # Re-warm the sticky-rung executables now (persistent
+                    # cache loads), so the first resumed step dispatches
+                    # instead of compiling — the cost lands in
+                    # resume_gap_s where it belongs.
+                    chunks_sds, rows, pack_args = _stream_examples(
+                        n_dev, chunk_bytes, state["cap"], state["mwl"])
+                    _aot_step_fn(chunks_sds, n_dev=n_dev,
+                                 n_reduce=n_reduce,
+                                 max_word_len=state["mwl"],
+                                 u_cap=state["cap"], mesh=mesh,
+                                 t_cap_frac=state["frac"],
+                                 grouper=state["grouper"])
+                    _aot_pack_fn(pack_args, mp=rows)
+            stats["resume_gap_s"] = round(time.perf_counter() - t_res, 4)
+            stats["resume_cursor"] = start_offset
+        else:
+            ck_store.reset()  # fresh lineage: stale checkpoints must
+            # never be resumable into a run that diverged from them
+
     def fold_confirmed(packed_dev, scal_dev, scal_np) -> None:
         nonlocal table_svc
         if int(scal_np[:, 0].max()) == 0:
@@ -633,8 +729,35 @@ def wordcount_streaming(
         table_svc.fold(packed_dev, scal_dev, scal_np)
         policy.note_fold()
         if policy.due():
+            fault_point("pre-sync")
             table_svc.sync()
             policy.reset()
+
+    def save_ckpt() -> None:
+        """One consistent snapshot at a confirmed-step boundary.  The
+        device table's image is captured FIRST: flushing its lagged
+        flags can trigger a widen whose drain lands in the host
+        accumulator, and the snapshot must hold both sides of that
+        move.  Everything in the in-flight window is deliberately
+        absent — those steps were never merged, and resume re-processes
+        them from the cursor."""
+        t0 = time.perf_counter()
+        arrays: dict = {}
+        meta = {"cursor": ck_cursor["offset"], "steps": ck_cursor["steps"],
+                "cap": state["cap"], "mwl": state["mwl"],
+                "grouper": state["grouper"], "frac": state["frac"]}
+        if table_svc is not None:
+            for k, v in table_svc.checkpoint_state().items():
+                arrays["table_" + k] = v
+            meta["table_cap"] = table_svc.cap
+            meta["table_kk"] = table_svc.kk
+            meta["sync_since"] = policy.snapshot()
+        for k, v in acc.snapshot().items():
+            arrays["acc_" + k] = v
+        ck_store.save(arrays, meta)
+        stats["ckpt_saves"] += 1
+        stats["ckpt_s"] += time.perf_counter() - t0
+        fault_point("post-ckpt")
     # Live host buffers = out queue (≤ depth+1) + in-flight window
     # (≤ depth) + one being filled + one being finished.
     pool = BufferPool((n_dev, chunk_bytes), retain=2 * depth + 3)
@@ -737,12 +860,20 @@ def wordcount_streaming(
             handles = (scal, None, keys.shape[2],
                        (keys, lens, cnts, parts))
         stats["steps"] += 1
-        return (buf, mwl, cap, handles)
+        rec_offset = 0
+        if offsets is not None:
+            # Cursor of THIS step: absolute stream offset just past its
+            # batch's content (offsets[i] is appended before batch i is
+            # queued, so it is always present here).
+            rec_offset = start_offset + offsets[dispatch_idx[0]]
+            dispatch_idx[0] += 1
+        fault_point("post-dispatch")
+        return (buf, mwl, cap, rec_offset, handles)
 
     def finish_one(record) -> None:
         """Retire the oldest in-flight step: deferred exactness check,
         then merge (clean) or replay-at-wider-shape (overflow)."""
-        buf, mwl, cap, (scal, packed_dev, kk, tables) = record
+        buf, mwl, cap, rec_offset, (scal, packed_dev, kk, tables) = record
         t0 = time.perf_counter()
         scal_np = np.asarray(scal)   # blocks until this step's kernel lands
         stats["kernel_s"] += time.perf_counter() - t0
@@ -796,6 +927,17 @@ def wordcount_streaming(
                     stats["step_pulls"] += 1
                     acc.add_packed_step(packed, nus, kk)
             stats["replay_s"] += time.perf_counter() - t0
+        # This step is now CONFIRMED: its output is merged/folded and
+        # nothing after it is.  The fault point sits BEFORE the cursor
+        # advances — the classic torn-update instant.
+        fault_point("mid-fold")
+        if ck_store is not None:
+            ck_cursor["offset"] = rec_offset
+            ck_cursor["steps"] += 1
+            ck_policy.note_step()
+            if ck_policy.due():
+                save_ckpt()
+                ck_policy.reset()
         pool.give(buf)
 
     # ── the window itself: the shared dispatch/finish pipeline core ──
@@ -805,11 +947,13 @@ def wordcount_streaming(
                         inflight_key="max_inflight_chunks",
                         thread_name="dsi-stream-batcher")
 
+    feed = skip_stream(blocks, start_offset) if start_offset else blocks
     result: Optional[Dict[str, Tuple[int, int]]]
     try:
-        pipe.run(lambda: batch_stream(blocks, n_dev, chunk_bytes,
-                                      pool=pool))
+        pipe.run(lambda: batch_stream(feed, n_dev, chunk_bytes,
+                                      pool=pool, offsets=offsets))
         if table_svc is not None:
+            fault_point("pre-sync")
             table_svc.close()  # the "or at stream end" pull
         result = acc.finalize()
     except (_TokenTooLong, _NeedsHostPath):
@@ -819,7 +963,7 @@ def wordcount_streaming(
             stats["batch_allocs"] = pool.allocs
             for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",
                       "pull_s", "merge_s", "replay_s", "fold_s", "sync_s",
-                      "widen_s"):
+                      "widen_s", "ckpt_s"):
                 if k in stats:
                     stats[k] = round(stats[k], 4)
             pipeline_stats.update(stats)

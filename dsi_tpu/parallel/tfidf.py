@@ -52,6 +52,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dsi_tpu.ckpt import CheckpointPolicy, CheckpointStore, fault_point
 from dsi_tpu.utils.jaxcompat import (enable_x64, x64_scoped,
                                      shard_map as _shard_map)
 
@@ -245,6 +246,8 @@ def tfidf_sharded(
         partitions: Optional[set] = None, packed: bool = False,
         device_accumulate: bool = False, sync_every: Optional[int] = None,
         wave_stats: Optional[dict] = None, depth: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: Optional[int] = None, resume: bool = False,
 ):
     """Whole-corpus TF-IDF over the mesh, waves of n_dev documents,
     pipelined ``depth`` waves deep.
@@ -307,6 +310,14 @@ def tfidf_sharded(
     ``max_inflight_waves``, ``step_pulls``, and the device-accumulate
     counters (``appends``/``append_overflows``/``sync_pulls``/
     ``postings_widens``/``append_s``/``drain_s``/``sync_every``).
+
+    ``checkpoint_dir``/``checkpoint_every``/``resume`` follow the
+    streaming engines' crash-resume contract (``dsi_tpu/ckpt``): the
+    cursor is the CONFIRMED-wave ordinal (``plan_waves`` is
+    deterministic in doc lengths), snapshots carry the postings-table
+    residue, the device buffer's drain-free image, and the sticky rung,
+    tagged with the word-window rung they belong to; resumed output is
+    bit-identical to an uninterrupted walk.
     """
     if mesh is None:
         mesh = default_mesh()
@@ -327,6 +338,31 @@ def tfidf_sharded(
     groupers = grouper_ladder()
     sh_chunk = NamedSharding(mesh, P(AXIS, None))
     sh_ids = NamedSharding(mesh, P(AXIS))
+
+    # ── checkpoint/restore (dsi_tpu/ckpt): wave-cursor variant ──
+    ck_store: Optional[CheckpointStore] = None
+    resume_meta = None
+    resume_arrays = None
+    if checkpoint_dir:
+        import zlib
+
+        # The wave plan — and with it the cursor's meaning — is a
+        # function of the full per-doc length vector, so the vector's
+        # CRC is part of the job identity: same count + same total with
+        # shuffled lengths must refuse, not silently misalign waves.
+        lens_crc = zlib.crc32(np.asarray(doc_lens, np.int64).tobytes())
+        ck_store = CheckpointStore(checkpoint_dir, "tfidf", {
+            "n_dev": n_dev, "n_reduce": n_reduce, "u_cap": u_cap,
+            "n_docs": n_real, "doc_lens_crc32": lens_crc,
+            "partitions": (sorted(int(p) for p in partitions)
+                           if partitions is not None else None),
+            "device_accumulate": bool(device_accumulate)})
+        if resume:
+            loaded = ck_store.load_latest()
+            if loaded is not None:
+                resume_meta, resume_arrays = loaded
+        else:
+            ck_store.reset()
 
     def run(mwl: int):
         """One word-window rung: the whole pipelined wave walk at packed
@@ -393,8 +429,60 @@ def tfidf_sharded(
             policy = SyncPolicy(sync_every)
             stats["sync_every"] = policy.sync_every
 
+        # A checkpoint belongs to ONE word-window rung (the widen
+        # restart discards rung state): apply the loaded image only at
+        # its own rung.
+        ck_policy: Optional[CheckpointPolicy] = None
+        ck_wave = [0]
+        start_wave = 0
+        if ck_store is not None:
+            ck_policy = CheckpointPolicy(checkpoint_every)
+            stats.setdefault("ckpt_saves", 0)
+            stats.setdefault("ckpt_s", 0.0)
+            stats["ckpt_every"] = ck_policy.every
+            if resume_meta is not None and int(resume_meta["mwl"]) == mwl:
+                t_res = time.perf_counter()
+                start_wave = int(resume_meta["wave"])
+                ck_wave[0] = start_wave
+                state.update({"cap": int(resume_meta["cap"]),
+                              "grouper": resume_meta["grouper"],
+                              "frac": int(resume_meta["frac"])})
+                table.restore({k[3:]: v for k, v in resume_arrays.items()
+                               if k.startswith("pt_")})
+                if buf_dev is not None and resume_meta.get("pb_cap"):
+                    buf_dev.restore_state(
+                        {"buf": resume_arrays["pb_buf"],
+                         "nrows": resume_arrays["pb_nrows"],
+                         "cap": resume_meta["pb_cap"]})
+                if policy is not None:
+                    policy.restore(resume_meta.get("sync_since", 0))
+                stats["resume_gap_s"] = round(
+                    time.perf_counter() - t_res, 4)
+                stats["resume_wave"] = start_wave
+
+        def save_ckpt() -> None:
+            """Consistent snapshot at a confirmed-wave boundary: the
+            device buffer's drain-free image FIRST (flushing its lag
+            can drain into the host table), host residue second."""
+            t0 = time.perf_counter()
+            arrays: dict = {}
+            meta = {"mwl": mwl, "wave": ck_wave[0], "cap": state["cap"],
+                    "grouper": state["grouper"], "frac": state["frac"]}
+            if buf_dev is not None:
+                pb = buf_dev.checkpoint_state()
+                arrays["pb_buf"] = pb["buf"]
+                arrays["pb_nrows"] = pb["nrows"]
+                meta["pb_cap"] = int(pb["cap"])
+                meta["sync_since"] = policy.snapshot()
+            for k, v in table.snapshot().items():
+                arrays["pt_" + k] = v
+            ck_store.save(arrays, meta)
+            stats["ckpt_saves"] += 1
+            stats["ckpt_s"] += time.perf_counter() - t0
+            fault_point("post-ckpt")
+
         def materialize():
-            for idxs, size in waves:
+            for idxs, size in waves[start_wave:]:
                 chunk_np = _wave_chunk(docs, idxs, n_dev, size)
                 # Pad rows of a short last wave carry doc id n_real,
                 # which buffer_rows discards.
@@ -421,6 +509,7 @@ def tfidf_sharded(
             size, chunk_np, ids_np = item
             rows, scal = wave_call(chunk_np, ids_np, size, state["cap"],
                                    state["frac"], state["grouper"])
+            fault_point("post-dispatch")
             return (size, chunk_np, ids_np, rows, scal, state["cap"])
 
         def replay_wave(size, chunk_np, ids_np):
@@ -469,6 +558,7 @@ def tfidf_sharded(
                     # that WAS this window's pull — without the reset,
                     # due() would fire a second, nearly empty one
                 elif policy.due():
+                    fault_point("pre-sync")
                     buf_dev.sync()
                     policy.reset()
                 return
@@ -507,6 +597,15 @@ def tfidf_sharded(
                 # and nowhere else.
                 rows, scal, scal_np = replay_wave(size, chunk_np, ids_np)
             commit(rows, scal, scal_np)
+            # Confirmed (empty waves included); fault before the cursor
+            # moves — the torn-update instant.
+            fault_point("mid-fold")
+            if ck_policy is not None:
+                ck_wave[0] += 1
+                ck_policy.note_step()
+                if ck_policy.due():
+                    save_ckpt()
+                    ck_policy.reset()
 
         pipe = StepPipeline(depth=depth, dispatch=dispatch, finish=finish,
                             stats=stats, produce_key="materialize_s",
@@ -518,6 +617,7 @@ def tfidf_sharded(
         except _AbortRung:
             return ("high" if outcome["high"] else "widen", None)
         if buf_dev is not None:
+            fault_point("pre-sync")
             buf_dev.close()  # end-of-walk sync
         return ("ok", table.finalize_packed if packed else table.finalize)
 
@@ -525,8 +625,13 @@ def tfidf_sharded(
     # because capacity now widens per wave INSIDE a rung): a word wider
     # than the packed window re-keys every row, so that one overflow
     # class still restarts the walk.
-    for mwl in ((max_word_len, 64) if max_word_len < 64
-                else (max_word_len,)):
+    rungs = ((max_word_len, 64) if max_word_len < 64 else (max_word_len,))
+    if resume_meta is not None:
+        # Start at the checkpoint's rung: an earlier rung had provably
+        # aborted before the checkpointed rung began its walk.
+        rungs = tuple(m for m in rungs
+                      if m >= int(resume_meta["mwl"])) or rungs
+    for mwl in rungs:
         status, payload = run(mwl)
         if status == "high":
             return None

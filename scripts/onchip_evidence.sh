@@ -155,6 +155,27 @@ timeout -k 30s 3600s python -m dsi_tpu.cli.grepstream --check --devices 1 \
   > "$OUT/grepstream.log" 2>&1
 log "grepstream rc=$? $(tail -c 200 "$OUT/grepstream.log" | tr '\n' ' ')"
 
+log "wcstream crash-resume on the chip (DSI_FAULT_POINT=mid-fold kill + --resume --check)"
+# A REAL crash (os._exit 87, no teardown) injected mid-engine, then a
+# fresh-process --resume over the same corpus with the parity oracle:
+# the checkpoint subsystem's evidence is an actual process death on the
+# chip, not a mock.  Shapes stay in lockstep with the warmed wcstream
+# step above (--u-cap 16384, --aot), so neither run cold-compiles;
+# --checkpoint-every 1 guarantees a checkpoint exists before the kill.
+rm -rf "$OUT/ckptstream-ck"
+mkdir -p "$OUT/ckptstream-wd"
+DSI_FAULT_POINT=mid-fold DSI_FAULT_STEP=2 timeout -k 30s 3600s \
+  python -m dsi_tpu.cli.wcstream --devices 1 --aot --u-cap 16384 \
+  --checkpoint-dir "$OUT/ckptstream-ck" --checkpoint-every 1 \
+  --workdir "$OUT/ckptstream-wd" "$OUT"/corpus/pg-*.txt \
+  > "$OUT/ckptstream.log" 2>&1
+log "ckptstream crash rc=$? (87 = injected fault fired)"
+timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --devices 1 --aot \
+  --u-cap 16384 --checkpoint-dir "$OUT/ckptstream-ck" --resume --check \
+  --stats --workdir "$OUT/ckptstream-wd" "$OUT"/corpus/pg-*.txt \
+  >> "$OUT/ckptstream.log" 2>&1
+log "ckptstream resume rc=$? $(tail -c 200 "$OUT/ckptstream.log" | tr '\n' ' ')"
+
 log "wcstream ~1 GB on the chip (GB-scale single-device stream)"
 # 1024 x 1 MB generated files; --check would double the wall with a host
 # oracle pass over 1 GB, so this step relies on wcstream's own exactness

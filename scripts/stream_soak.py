@@ -34,7 +34,18 @@ def main() -> int:
                     help="folds between host pulls with "
                          "--device-accumulate (default: "
                          "DSI_STREAM_SYNC_EVERY or 8)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="enable crash-resume checkpoints (dsi_tpu/ckpt)")
+    ap.add_argument("--checkpoint-every", type=int, default=None,
+                    help="confirmed steps between checkpoints (default: "
+                         "DSI_STREAM_CKPT_EVERY or 32)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the newest valid checkpoint in "
+                         "--checkpoint-dir (kill the soak with "
+                         "DSI_FAULT_POINT/DSI_FAULT_STEP to exercise it)")
     args = ap.parse_args()
+    if args.resume and not args.checkpoint_dir:
+        ap.error("--resume requires --checkpoint-dir")
 
     import jax
 
@@ -70,6 +81,9 @@ def main() -> int:
                               depth=args.pipeline_depth,
                               device_accumulate=args.device_accumulate,
                               sync_every=args.sync_every,
+                              checkpoint_dir=args.checkpoint_dir,
+                              checkpoint_every=args.checkpoint_every,
+                              resume=args.resume,
                               pipeline_stats=pstats)
     dt = time.perf_counter() - t0
     assert acc is not None

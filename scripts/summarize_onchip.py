@@ -66,6 +66,11 @@ def _bench_line(path: str) -> str:
             # host-grep oracle).
             "grep_mbps", "grep_mb", "grep_matched", "grep_oracle_mbps",
             "grep_vs_oracle", "grep_parity",
+            # Checkpoint/restore cost keys riding the stream row
+            # (dsi_tpu/ckpt): checkpointed-pass overhead vs the plain
+            # pass, and the resumed pass's restore wall.
+            "ckpt_overhead_pct", "ckpt_every", "ckpt_saves",
+            "resume_gap_s", "resume_parity",
             "tpu_error")
     parts = [f"{k}={d[k]}" for k in keys if k in d]
     phases = d.get("phases")
@@ -238,6 +243,10 @@ def main() -> None:
     if os.path.exists(f"{out}/grepstream.log"):
         print("grepstream --check (streaming grep + on-device top-k/histogram):")
         print(_tail(f"{out}/grepstream.log", 5))
+    if os.path.exists(f"{out}/ckptstream.log"):
+        print("wcstream crash-resume (DSI_FAULT_POINT kill + --resume "
+              "--check):")
+        print(_tail(f"{out}/ckptstream.log", 5))
     print("wcstream ~1 GB:")
     print(_tail(f"{out}/wcstream-1g.log", 4))
     print("chain log:")

@@ -89,6 +89,15 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
     if "stream_mbps" in v:
         assert v["stream_parity"] is True
         assert v["stream_mb"] >= 2
+        # The checkpoint/restore cost keys ride the measured stream row
+        # under the same measured-XOR-skipped contract (dsi_tpu/ckpt):
+        # either both cost numbers with their parity gate, or a reason.
+        assert ("ckpt_skipped" in v) != ("ckpt_overhead_pct" in v)
+        if "ckpt_overhead_pct" in v:
+            assert v["resume_parity"] is True
+            assert v["ckpt_saves"] >= 1
+            assert v["resume_gap_s"] >= 0
+            assert isinstance(v["ckpt_overhead_pct"], (int, float))
     # The distributed N-worker row (the reference's own headline shape,
     # test-mr.sh:36-53) rides the same verdict: measured or skipped.
     assert ("framework_skipped" in v) != ("framework_mbps" in v)
@@ -115,3 +124,5 @@ def test_stream_row_disabled_leaves_no_stream_keys(tmp_path):
     assert rc == 0
     assert not any(k.startswith("stream_") for k in v)
     assert not any(k.startswith("framework_") for k in v)
+    # No stream row -> no checkpoint cost keys either (they ride it).
+    assert not any(k.startswith(("ckpt_", "resume_")) for k in v)

@@ -1,0 +1,506 @@
+"""Crash-resume parity for the checkpoint/restore subsystem (dsi_tpu/ckpt).
+
+The contract under test is the strongest the engines can make: kill a
+streaming engine at a named fault point (``DSI_FAULT_POINT``), resume
+from the last durable checkpoint, and the FINAL output — word-count
+table, grep histogram/top-k, indexer postings including per-word order
+and df top-k — is bit-identical to an uninterrupted run.  The grid runs
+in-process (``DSI_FAULT_MODE=raise``: the fault raises instead of
+``os._exit`` so one interpreter can afford engine x fault-point x mode
+cells inside the tier-1 budget); the CLI tests at the bottom use the
+real thing — ``os._exit`` mid-engine in a subprocess, resume in a fresh
+process — so the durable-write path is exercised by actual process
+death, not a stand-in.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import numpy as np
+
+from dsi_tpu.ckpt import (
+    FAULT_EXIT,
+    FAULT_POINTS,
+    CheckpointMismatch,
+    CheckpointPolicy,
+    CheckpointStore,
+    FaultInjected,
+    checkpoint_every_default,
+    reset_faults,
+    skip_stream,
+)
+from dsi_tpu.parallel.grepstream import (
+    grep_host_oracle,
+    grep_streaming,
+    indexer_streaming,
+)
+from dsi_tpu.parallel.shuffle import default_mesh
+from dsi_tpu.parallel.streaming import wordcount_streaming
+from dsi_tpu.parallel.tfidf import tfidf_sharded
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mesh():
+    return default_mesh(4)
+
+
+def _letters(i: int) -> str:
+    return "".join(chr(97 + (i // 26 ** j) % 26) for j in range(3))
+
+
+WC_WORDS = [_letters(i) for i in range(120)]
+WC_TEXT = ((" ".join(WC_WORDS) + "\n") * 80).encode()  # ~38 KB, ~10 steps
+WC_CHUNK = 1 << 10
+
+_GREP_LINES = []
+for _i in range(3000):
+    _GREP_LINES.append(b"ab " * (_i % 5) + b"line" + str(_i).encode())
+GREP_TEXT = b"\n".join(_GREP_LINES) + b"\n"  # ~45 KB, ~6 steps
+GREP_CHUNK = 1 << 11
+
+IDX_DOCS = [(" ".join(WC_WORDS[(3 * i) % 90:(3 * i) % 90 + 14])
+             + " common words").encode() for i in range(20)]  # 5 waves
+
+#: point -> which occurrence to kill at, tuned so a checkpoint exists
+#: BEFORE the crash for every point (every=2): resume must restore real
+#: state, not just start over.
+_FAULT_AT = {"post-dispatch": 4, "mid-fold": 4, "pre-sync": 2,
+             "post-ckpt": 2}
+
+_BASE = {}
+
+
+def _fault_env(monkeypatch, point, step):
+    monkeypatch.setenv("DSI_FAULT_MODE", "raise")
+    monkeypatch.setenv("DSI_FAULT_POINT", point)
+    monkeypatch.setenv("DSI_FAULT_STEP", str(step))
+
+
+def _clear_fault(monkeypatch):
+    for k in ("DSI_FAULT_MODE", "DSI_FAULT_POINT", "DSI_FAULT_STEP"):
+        monkeypatch.delenv(k, raising=False)
+
+
+def _run_wc(ckpt=None, resume=False, dacc=False, depth=2, stats=None):
+    reset_faults()
+    return wordcount_streaming(
+        [WC_TEXT], mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK,
+        u_cap=256, depth=depth, device_accumulate=dacc, sync_every=2,
+        checkpoint_dir=ckpt, checkpoint_every=2, resume=resume,
+        pipeline_stats=stats)
+
+
+def _run_grep(ckpt=None, resume=False, dacc=False, depth=2, stats=None):
+    reset_faults()
+    return grep_streaming(
+        [GREP_TEXT], "ab", mesh=_mesh(), chunk_bytes=GREP_CHUNK,
+        depth=depth, device_accumulate=dacc, sync_every=2, topk=8,
+        checkpoint_dir=ckpt, checkpoint_every=2, resume=resume,
+        pipeline_stats=stats)
+
+
+def _run_idx(ckpt=None, resume=False, dacc=False, depth=2, stats=None):
+    reset_faults()
+    return indexer_streaming(
+        IDX_DOCS, mesh=_mesh(), n_reduce=10, u_cap=1 << 9, depth=depth,
+        device_accumulate=dacc, sync_every=2, topk=8,
+        checkpoint_dir=ckpt, checkpoint_every=2, resume=resume,
+        stats=stats)
+
+
+_RUNNERS = {"wc": _run_wc, "grep": _run_grep, "idx": _run_idx}
+
+
+def _baseline(engine, dacc):
+    key = (engine, dacc)
+    if key not in _BASE:
+        _BASE[key] = _RUNNERS[engine](dacc=dacc)
+        assert _BASE[key] is not None
+    return _BASE[key]
+
+
+def _crash_resume(engine, monkeypatch, tmp_path, point, dacc, depth=2):
+    """Run with a fault armed (expect it to fire), then resume and
+    return the resumed result."""
+    run = _RUNNERS[engine]
+    ck = str(tmp_path / "ck")
+    _fault_env(monkeypatch, point, _FAULT_AT[point])
+    with pytest.raises(FaultInjected):
+        run(ckpt=ck, dacc=dacc, depth=depth)
+    _clear_fault(monkeypatch)
+    stats = {}
+    res = run(ckpt=ck, resume=True, dacc=dacc, depth=depth, stats=stats)
+    return res, stats
+
+
+# ── the crash-resume parity grid ───────────────────────────────────────
+
+
+@pytest.mark.parametrize("dacc", [False, True])
+@pytest.mark.parametrize("point", FAULT_POINTS)
+def test_wc_crash_resume_parity(monkeypatch, tmp_path, point, dacc):
+    if point == "pre-sync" and not dacc:
+        pytest.skip("pre-sync exists only on the device-accumulate path")
+    res, stats = _crash_resume("wc", monkeypatch, tmp_path, point, dacc)
+    assert res == _baseline("wc", dacc)
+    if point in ("post-ckpt", "mid-fold"):
+        # A checkpoint provably existed before the crash: the resume
+        # must have restored it (sought past the cursor), not replayed
+        # the stream from byte 0.
+        assert stats["resume_cursor"] > 0
+
+
+@pytest.mark.parametrize("dacc", [False, True])
+@pytest.mark.parametrize("point", FAULT_POINTS)
+def test_grep_crash_resume_parity(monkeypatch, tmp_path, point, dacc):
+    if point == "pre-sync" and not dacc:
+        pytest.skip("pre-sync exists only on the device-accumulate path")
+    res, stats = _crash_resume("grep", monkeypatch, tmp_path, point, dacc)
+    assert res == _baseline("grep", dacc)
+    assert res == grep_host_oracle([GREP_TEXT], "ab", topk=8)
+
+
+@pytest.mark.parametrize("dacc", [False, True])
+@pytest.mark.parametrize("point", ("post-dispatch", "mid-fold",
+                                   "pre-sync", "post-ckpt"))
+def test_indexer_crash_resume_parity(monkeypatch, tmp_path, point, dacc):
+    if point == "pre-sync" and not dacc:
+        pytest.skip("pre-sync exists only on the device-accumulate path")
+    res, stats = _crash_resume("idx", monkeypatch, tmp_path, point, dacc)
+    base = _baseline("idx", dacc)
+    # Postings equality includes per-word doc order; topk includes df
+    # count ties broken by word.
+    assert res == base
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_wc_crash_resume_parity_across_depths(monkeypatch, tmp_path,
+                                              depth):
+    res, _ = _crash_resume("wc", monkeypatch, tmp_path, "mid-fold",
+                           dacc=True, depth=depth)
+    assert res == _baseline("wc", True)
+
+
+def test_wc_resume_across_forced_widen(monkeypatch, tmp_path):
+    """A device-table widen straddling a checkpoint: the tiny forced
+    rung widens mid-stream (drain into the host accumulator + realloc),
+    a checkpoint lands between widens, the crash loses the tail, and
+    resume must reconstruct the widened table image exactly."""
+    monkeypatch.setenv("DSI_DEVICE_TABLE_CAP", "16")
+    ck = str(tmp_path / "ck")
+    _fault_env(monkeypatch, "mid-fold", 6)
+    stats = {}
+    with pytest.raises(FaultInjected):
+        _run_wc(ckpt=ck, dacc=True, stats=stats)
+    assert stats.get("widens", 0) >= 1  # the forced rung actually widened
+    _clear_fault(monkeypatch)
+    res = _run_wc(ckpt=ck, resume=True, dacc=True)
+    assert res == _baseline("wc", True)
+
+
+def test_grep_resume_across_forced_topk_widen(monkeypatch, tmp_path):
+    monkeypatch.setenv("DSI_DEVICE_TOPK_CAP", "8")
+    ck = str(tmp_path / "ck")
+    _fault_env(monkeypatch, "mid-fold", 6)
+    stats = {}
+    with pytest.raises(FaultInjected):
+        _run_grep(ckpt=ck, dacc=True, stats=stats)
+    assert stats.get("widens", 0) >= 1
+    _clear_fault(monkeypatch)
+    res = _run_grep(ckpt=ck, resume=True, dacc=True)
+    assert res == _baseline("grep", True)
+
+
+def test_tfidf_crash_resume_parity(monkeypatch, tmp_path):
+    """The wave-cursor checkpoint on the TF-IDF walk (the indexer grid
+    above exercises the same machinery more heavily)."""
+    docs = IDX_DOCS
+    base = tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9)
+    ck = str(tmp_path / "ck")
+    _fault_env(monkeypatch, "mid-fold", 4)
+    reset_faults()
+    with pytest.raises(FaultInjected):
+        tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9,
+                      device_accumulate=True, sync_every=2,
+                      checkpoint_dir=ck, checkpoint_every=2)
+    _clear_fault(monkeypatch)
+    reset_faults()
+    res = tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9,
+                        device_accumulate=True, sync_every=2,
+                        checkpoint_dir=ck, checkpoint_every=2, resume=True)
+    assert res == base
+
+
+def test_resume_skips_confirmed_work(monkeypatch, tmp_path):
+    """Resume is a restore + tail replay, not a rerun: the resumed run
+    processes strictly fewer steps than the whole stream holds."""
+    full_stats = {}
+    _run_wc(stats=full_stats)
+    res, stats = _crash_resume("wc", monkeypatch, tmp_path, "post-ckpt",
+                               dacc=False)
+    assert res == _baseline("wc", False)
+    assert stats["resume_cursor"] > 0
+    assert stats["steps"] < full_stats["steps"]
+
+
+# ── store / policy / plumbing units ────────────────────────────────────
+
+
+def test_checkpoint_policy_cadence_and_env(monkeypatch):
+    p = CheckpointPolicy(3)
+    for _ in range(2):
+        p.note_step()
+        assert not p.due()
+    p.note_step()
+    assert p.due()
+    p.reset()
+    assert not p.due()
+    monkeypatch.setenv("DSI_STREAM_CKPT_EVERY", "7")
+    assert checkpoint_every_default() == 7
+    assert checkpoint_every_default(2) == 2
+    monkeypatch.setenv("DSI_STREAM_CKPT_EVERY", "junk")
+    assert checkpoint_every_default() == 32
+
+
+def test_checkpoint_policy_time_trigger(monkeypatch):
+    p = CheckpointPolicy(1000, secs=0.01)
+    p.note_step()
+    import time
+
+    time.sleep(0.02)
+    assert p.due()
+    p.reset()
+    assert not p.due()  # no step since reset: time alone never fires
+
+
+def test_store_roundtrip_gc_and_fallback(tmp_path):
+    st = CheckpointStore(str(tmp_path), "wc", {"n_dev": 4})
+    for i in range(3):
+        st.save({"a": np.arange(i + 1)}, {"cursor": 10 * i})
+    # Last-two retention: seqs 1 is gone, 2 and 3 remain.
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "manifest-000001.json" not in names
+    assert "manifest-000002.json" in names and "manifest-000003.json" in names
+    meta, arrays = st.load_latest()
+    assert meta["cursor"] == 20 and np.array_equal(arrays["a"],
+                                                   np.arange(3))
+    # Corrupt the newest payload: the loader must fall back to seq 2.
+    p3 = str(tmp_path / "state-000003.npz")
+    with open(p3, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0xFF]))
+    meta, arrays = st.load_latest()
+    assert meta["cursor"] == 10 and np.array_equal(arrays["a"],
+                                                   np.arange(2))
+
+
+def test_store_refuses_other_job_and_resets(tmp_path):
+    st = CheckpointStore(str(tmp_path), "wc", {"chunk": 1024})
+    st.save({"a": np.zeros(1)}, {"cursor": 1})
+    other = CheckpointStore(str(tmp_path), "wc", {"chunk": 2048})
+    with pytest.raises(CheckpointMismatch):
+        other.load_latest()
+    other.reset()
+    assert st.load_latest() is None  # lineage gone
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.startswith(("manifest-", "state-"))]
+
+
+def test_store_torn_manifest_is_invisible(tmp_path):
+    st = CheckpointStore(str(tmp_path), "wc", {})
+    st.save({"a": np.ones(2)}, {"cursor": 5})
+    # A manifest whose sidecar disagrees (torn write) must not load.
+    st.save({"a": np.ones(3)}, {"cursor": 9})
+    with open(str(tmp_path / "manifest-000002.json"), "ab") as f:
+        f.write(b" ")
+    meta, _ = st.load_latest()
+    assert meta["cursor"] == 5
+
+
+def test_skip_stream_seeks_exactly():
+    blocks = [b"abc", b"", b"defg", b"hi"]
+    assert b"".join(skip_stream(blocks, 0)) == b"abcdefghi"
+    assert b"".join(skip_stream(blocks, 4)) == b"efghi"
+    assert b"".join(skip_stream(blocks, 9)) == b""
+    assert b"".join(skip_stream(blocks, 50)) == b""
+
+
+def test_atomicio_durable_write_verify_and_reap(tmp_path):
+    from dsi_tpu.utils.atomicio import (read_bytes_verified,
+                                        reap_tmp_files,
+                                        write_bytes_durable)
+
+    p = str(tmp_path / "blob")
+    crc = write_bytes_durable(p, b"hello world")
+    assert os.path.exists(p + ".crc32")
+    assert read_bytes_verified(p) == b"hello world"
+    import zlib
+
+    assert crc == zlib.crc32(b"hello world")
+    with open(p, "ab") as f:  # tamper: sidecar now disagrees
+        f.write(b"!")
+    assert read_bytes_verified(p) is None
+    assert read_bytes_verified(str(tmp_path / "absent")) is None
+    open(str(tmp_path / ".tmp-orphan.x"), "w").close()
+    assert reap_tmp_files(str(tmp_path)) == 1
+    assert not os.path.exists(str(tmp_path / ".tmp-orphan.x"))
+
+
+def test_fault_point_counts_per_point(monkeypatch):
+    from dsi_tpu.ckpt import fault_point
+
+    reset_faults()
+    monkeypatch.setenv("DSI_FAULT_MODE", "raise")
+    monkeypatch.setenv("DSI_FAULT_POINT", "mid-fold")
+    monkeypatch.setenv("DSI_FAULT_STEP", "2")
+    fault_point("post-dispatch")  # other points never consume the budget
+    fault_point("mid-fold")
+    fault_point("post-dispatch")
+    with pytest.raises(FaultInjected):
+        fault_point("mid-fold")
+    reset_faults()
+
+
+def test_device_snapshot_roundtrip_byte_equal_drain(tmp_path):
+    """Seeded-random snapshot round trip (the hypothesis twin lives in
+    tests/test_property_fuzz.py and runs where hypothesis is
+    installed): arbitrary service states, imaged by checkpoint_state,
+    pushed through the real durable store, restored into a fresh
+    service, must drain byte-equal."""
+    from dsi_tpu.device import DeviceHistogram, DevicePostings, DeviceTable
+
+    rng = np.random.default_rng(7)
+    mesh = default_mesh(8)
+    n_dev, cap, kk = 8, 8, 2
+
+    class Capture:
+        def __init__(self):
+            self.rows = []
+
+        def add(self, keys, lens, cnts, parts):
+            self.rows.append((np.array(keys), np.array(lens),
+                              np.array(cnts), np.array(parts)))
+
+    for trial in range(4):
+        nrows = rng.integers(0, cap + 1, n_dev)
+        img = {"keys": rng.integers(0, 2 ** 32, (n_dev, cap, kk),
+                                    dtype=np.uint32),
+               "lens": rng.integers(0, 9, (n_dev, cap), dtype=np.int32),
+               "cnts": rng.integers(0, 2 ** 63, (n_dev, cap)).astype(
+                   np.uint64),
+               "parts": rng.integers(0, 10, (n_dev, cap), dtype=np.int32),
+               "tn": nrows.astype(np.int32), "nrows": nrows}
+        store = CheckpointStore(str(tmp_path / f"t{trial}"), "fuzz", {})
+        a1, a2 = Capture(), Capture()
+        t1 = DeviceTable(mesh, kk=kk, cap=cap, acc=a1)
+        t1.restore_state(img)
+        store.save(t1.checkpoint_state(), {})
+        _, arrays = store.load_latest()
+        t2 = DeviceTable(mesh, kk=kk, cap=cap, acc=a2)
+        t2.restore_state(arrays)
+        t1.close()
+        t2.close()
+        assert len(a1.rows) == len(a2.rows)
+        for ra, rb in zip(a1.rows, a2.rows):
+            for x, y in zip(ra, rb):
+                assert np.array_equal(x, y)
+
+    # Postings buffer: random committed prefix, order must survive.
+    width = kk + 4
+    m = 5
+    img = {"buf": rng.integers(0, 2 ** 32, (n_dev, m, width),
+                               dtype=np.uint32),
+           "nrows": rng.integers(0, m + 1, n_dev),
+           "cap": np.array(cap, dtype=np.int64)}
+    sink1, sink2 = [], []
+    p1 = DevicePostings(mesh, width=width, cap=cap,
+                        sink=lambda r: sink1.append(np.array(r)))
+    p1.restore_state(img)
+    st = p1.checkpoint_state()
+    store = CheckpointStore(str(tmp_path / "pb"), "fuzz", {})
+    store.save({"buf": st["buf"], "nrows": st["nrows"]},
+               {"cap": int(st["cap"])})
+    meta, arrays = store.load_latest()
+    p2 = DevicePostings(mesh, width=width, cap=cap,
+                        sink=lambda r: sink2.append(np.array(r)))
+    p2.restore_state({"buf": arrays["buf"], "nrows": arrays["nrows"],
+                      "cap": meta["cap"]})
+    p1.close()
+    p2.close()
+    assert len(sink1) == len(sink2)
+    assert all(np.array_equal(a, b) for a, b in zip(sink1, sink2))
+
+    # Histogram vector.
+    hstate = rng.integers(0, 2 ** 63, (n_dev, 6)).astype(np.uint64)
+    h1 = DeviceHistogram(mesh, slots=6)
+    h1.restore_state({"hist": hstate})
+    store = CheckpointStore(str(tmp_path / "h"), "fuzz", {})
+    store.save(h1.checkpoint_state(), {})
+    _, arrays = store.load_latest()
+    h2 = DeviceHistogram(mesh, slots=6)
+    h2.restore_state(arrays)
+    assert np.array_equal(h1.close(), h2.close())
+
+
+# ── the real thing: process death + fresh-process resume ───────────────
+
+
+def _cli_env(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.setdefault("DSI_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return env
+
+
+def test_cli_wcstream_real_crash_resume(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(WC_TEXT * 3)  # ~115 KB: ~7 steps at 16 KB/step
+    env = _cli_env(tmp_path)
+    ck = str(tmp_path / "ck")
+    wd = str(tmp_path / "wd")
+    cmd = [sys.executable, "-m", "dsi_tpu.cli.wcstream", "--devices", "2",
+           "--chunk-bytes", "8192", "--checkpoint-dir", ck,
+           "--checkpoint-every", "1", "--workdir", wd, str(corpus)]
+    env_crash = dict(env)
+    env_crash.update({"DSI_FAULT_POINT": "mid-fold", "DSI_FAULT_STEP": "3"})
+    p = subprocess.run(cmd, env=env_crash, capture_output=True, text=True,
+                       timeout=300)
+    assert p.returncode == FAULT_EXIT, p.stderr[-2000:]
+    assert any(n.startswith("manifest-") for n in os.listdir(ck))
+    p = subprocess.run(cmd + ["--resume", "--check"], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "parity OK" in p.stderr
+
+
+@pytest.mark.slow
+def test_cli_grepstream_real_crash_resume(tmp_path):
+    corpus = tmp_path / "g.txt"
+    corpus.write_bytes(GREP_TEXT * 4)
+    env = _cli_env(tmp_path)
+    ck = str(tmp_path / "ck")
+    cmd = [sys.executable, "-m", "dsi_tpu.cli.grepstream", "--devices",
+           "2", "--pattern", "ab", "--chunk-bytes", "16384",
+           "--device-accumulate", "--sync-every", "2",
+           "--checkpoint-dir", ck, "--checkpoint-every", "1",
+           str(corpus)]
+    env_crash = dict(env)
+    env_crash.update({"DSI_FAULT_POINT": "mid-fold", "DSI_FAULT_STEP": "3"})
+    p = subprocess.run(cmd, env=env_crash, capture_output=True, text=True,
+                       timeout=300)
+    assert p.returncode == FAULT_EXIT, p.stderr[-2000:]
+    p = subprocess.run(cmd + ["--resume", "--check"], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "parity OK" in p.stderr

@@ -269,3 +269,166 @@ def test_fuzz_hash_vs_sort_grouper_shapes(monkeypatch):
         rs = count_words_host_result(text.encode(), u_cap=u_cap)
         assert rh == rs and rh is not None, (trial, n_vocab, n_tokens,
                                              u_cap)
+
+
+# ---- checkpoint snapshot round-trips (dsi_tpu/ckpt + device services) ----
+#
+# The crash-resume property reduced to its serialization core: an
+# ARBITRARY service state, imaged by checkpoint_state(), pushed through
+# the real durable store (npz payload + CRC'd manifest on disk), and
+# restored into a fresh service must drain BYTE-EQUAL to the original.
+# Keys/counts are raw random bits (no decode step is involved in a
+# drain), so this fuzzes the layout/dtype/sharding plumbing rather than
+# tokenizer-reachable states only.
+
+from hypothesis.extra import numpy as hnp  # noqa: E402
+
+from dsi_tpu.ckpt import CheckpointStore  # noqa: E402
+from dsi_tpu.device import (DeviceHistogram, DevicePostings,  # noqa: E402
+                            DeviceTable, DeviceTopK)
+from dsi_tpu.parallel.shuffle import default_mesh  # noqa: E402
+
+_N_DEV, _CAP, _KK = 8, 8, 2
+
+
+class _CaptureAcc:
+    """Drain sink recording raw arrays — byte-level ground truth with
+    no spelling decode in the way."""
+
+    def __init__(self):
+        self.rows = []
+
+    def add(self, keys, lens, cnts, parts):
+        self.rows.append((np.array(keys), np.array(lens),
+                          np.array(cnts), np.array(parts)))
+
+    def equal(self, other) -> bool:
+        return len(self.rows) == len(other.rows) and all(
+            all(np.array_equal(x, y) for x, y in zip(a, b))
+            for a, b in zip(self.rows, other.rows))
+
+
+def _table_img(draw):
+    nrows = draw(hnp.arrays(np.int64, (_N_DEV,),
+                            elements=st.integers(0, _CAP)))
+    return {
+        "keys": draw(hnp.arrays(np.uint32, (_N_DEV, _CAP, _KK),
+                                elements=st.integers(0, 2 ** 32 - 1))),
+        "lens": draw(hnp.arrays(np.int32, (_N_DEV, _CAP),
+                                elements=st.integers(0, 8))),
+        "cnts": draw(hnp.arrays(np.uint64, (_N_DEV, _CAP),
+                                elements=st.integers(0, 2 ** 64 - 1))),
+        "parts": draw(hnp.arrays(np.int32, (_N_DEV, _CAP),
+                                 elements=st.integers(0, 9))),
+        "tn": nrows.astype(np.int32),
+        "nrows": nrows,
+    }
+
+
+def _roundtrip(tmpdir, svc_factory, img):
+    """restore(img) -> checkpoint_state -> durable store -> restore into
+    a fresh service; returns (original service, restored service)."""
+    s1 = svc_factory()
+    s1.restore_state(img)
+    state = s1.checkpoint_state()
+    store = CheckpointStore(str(tmpdir), "fuzz", {"shape": "fixed"})
+    meta = {k: int(v) for k, v in state.items() if np.ndim(v) == 0}
+    store.save({k: v for k, v in state.items() if np.ndim(v) > 0}, meta)
+    loaded_meta, arrays = store.load_latest()
+    arrays.update({k: np.array(v) for k, v in loaded_meta.items()})
+    s2 = svc_factory()
+    s2.restore_state(arrays)
+    return s1, s2
+
+
+@settings(max_examples=6, deadline=None)
+@given(st.data())
+def test_fuzz_device_table_snapshot_roundtrip(tmp_path_factory, data):
+    mesh = default_mesh(_N_DEV)
+    img = _table_img(data.draw)
+    accs = []
+
+    def factory():
+        accs.append(_CaptureAcc())
+        return DeviceTable(mesh, kk=_KK, cap=_CAP, acc=accs[-1])
+
+    s1, s2 = _roundtrip(tmp_path_factory.mktemp("ck"), factory, img)
+    s1.close()
+    s2.close()
+    assert accs[0].equal(accs[1])
+
+
+@settings(max_examples=4, deadline=None)
+@given(st.data())
+def test_fuzz_device_topk_snapshot_roundtrip(tmp_path_factory, data):
+    mesh = default_mesh(_N_DEV)
+    img = _table_img(data.draw)
+    accs = []
+
+    def factory():
+        accs.append(_CaptureAcc())
+        return DeviceTopK(mesh, kk=_KK, cap=_CAP, k=4, acc=accs[-1])
+
+    s1, s2 = _roundtrip(tmp_path_factory.mktemp("ck"), factory, img)
+    s1.close()
+    s2.close()
+    assert accs[0].equal(accs[1])
+
+
+@settings(max_examples=6, deadline=None)
+@given(st.data())
+def test_fuzz_device_postings_snapshot_roundtrip(tmp_path_factory, data):
+    mesh = default_mesh(_N_DEV)
+    width = _KK + 4
+    m = data.draw(st.integers(0, _CAP))
+    img = {
+        "buf": data.draw(hnp.arrays(np.uint32, (_N_DEV, m, width),
+                                    elements=st.integers(0, 2 ** 32 - 1))),
+        "nrows": data.draw(hnp.arrays(np.int64, (_N_DEV,),
+                                      elements=st.integers(0, m))),
+        "cap": np.array(_CAP, dtype=np.int64),
+    }
+    sinks = []
+
+    def factory():
+        rows = []
+        sinks.append(rows)
+        return DevicePostings(mesh, width=width, cap=_CAP,
+                              sink=lambda r, rows=rows: rows.append(
+                                  np.array(r)))
+
+    s1, s2 = _roundtrip(tmp_path_factory.mktemp("ck"), factory, img)
+    s1.close()
+    s2.close()
+    assert len(sinks[0]) == len(sinks[1])
+    assert all(np.array_equal(a, b) for a, b in zip(sinks[0], sinks[1]))
+
+
+@settings(max_examples=6, deadline=None)
+@given(hnp.arrays(np.uint64, (_N_DEV, 6),
+                  elements=st.integers(0, 2 ** 64 - 1)))
+def test_fuzz_device_histogram_snapshot_roundtrip(tmp_path_factory, state):
+    mesh = default_mesh(_N_DEV)
+    h1 = DeviceHistogram(mesh, slots=6)
+    h1.restore_state({"hist": state})
+    img = h1.checkpoint_state()
+    store = CheckpointStore(str(tmp_path_factory.mktemp("ck")), "fuzz", {})
+    store.save(img, {})
+    _, arrays = store.load_latest()
+    h2 = DeviceHistogram(mesh, slots=6)
+    h2.restore_state(arrays)
+    assert np.array_equal(h1.close(), h2.close())
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.lists(st.tuples(st.integers(0, 2 ** 64 - 1),
+                          st.integers(1, 2 ** 40)), max_size=30))
+def test_fuzz_keycounts_snapshot_roundtrip(pairs):
+    from dsi_tpu.device import KeyCounts
+
+    kc = KeyCounts()
+    for k, c in pairs:
+        kc._counts[k] = kc._counts.get(k, 0) + c
+    kc2 = KeyCounts()
+    kc2.restore(kc.snapshot())
+    assert kc2.finalize() == kc.finalize()
