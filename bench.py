@@ -497,6 +497,22 @@ def tpu_child(result_path: str) -> int:
     return 0
 
 
+
+def bench_tracer():
+    """The bench's handle on the unified tracer (dsi_tpu/obs):
+    DSI_BENCH_TRACE=1 turns on in-memory span buffering so the engine
+    rows publish per-phase span rollups (``stream_spans``/``tfidf_spans``
+    /``grep_spans``) in the verdict; DSI_TRACE_DIR additionally flushes
+    the full trace durably at process exit (atomicio durable writes,
+    ``.tmp-*`` reap on configure — the ckpt store's discipline)."""
+    from dsi_tpu.obs import get_tracer
+
+    tr = get_tracer()
+    if os.environ.get("DSI_BENCH_TRACE") == "1":
+        tr.enabled = True
+    return tr
+
+
 def stream_row_mb() -> float:
     return env_float("DSI_BENCH_STREAM_MB", 64.0)
 
@@ -567,6 +583,8 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
 
     mesh = default_mesh()
     pstats: dict = {}
+    tracer = bench_tracer()
+    mark = tracer.mark()
     with Span("bench.stream") as pt:
         acc = wordcount_streaming(blocks(), mesh=mesh, n_reduce=N_REDUCE,
                                   chunk_bytes=STREAM_CHUNK_BYTES,
@@ -611,6 +629,12 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
     row = {"stream_mbps": round(mb / dt, 2), "stream_mb": round(mb, 1),
            "stream_s": round(dt, 2), "stream_parity": True,
            "stream_phases": phases}
+    if tracer.enabled:
+        # The per-phase span rollup (dsi_tpu/obs): same measurements as
+        # stream_phases plus per-span counts/max — BENCH_r*.json carries
+        # it whenever the bench runs traced (DSI_BENCH_TRACE=1 buffers
+        # in-memory; DSI_TRACE_DIR also flushes the full trace durably).
+        row["stream_spans"] = tracer.rollup(mark)
     try:
         row.update(run_stream_ckpt_row(files, mesh, device_acc, oracle,
                                        corpus_bytes, stream_mb))
@@ -815,6 +839,8 @@ def run_tfidf_row(files) -> dict:
     docs = FileDocs(list(files) * cycles)
     total_mb = sum(docs.lengths) / 1e6
     phases: dict = {}
+    tracer = bench_tracer()
+    mark = tracer.mark()
     with Span("bench.tfidf") as pt:
         res = tfidf_sharded(docs, mesh=default_mesh(), n_reduce=N_REDUCE,
                             u_cap=STREAM_U_CAP, packed=True,
@@ -842,9 +868,12 @@ def run_tfidf_row(files) -> dict:
                                  f"({got_tokens} != "
                                  f"{oracle_tokens * cycles})",
                 "tfidf_parity": False}
-    return {"tfidf_mbps": round(total_mb / dt, 2),
-            "tfidf_mb": round(total_mb, 1), "tfidf_s": round(dt, 2),
-            "tfidf_parity": True, "tfidf_phases": phases}
+    row = {"tfidf_mbps": round(total_mb / dt, 2),
+           "tfidf_mb": round(total_mb, 1), "tfidf_s": round(dt, 2),
+           "tfidf_parity": True, "tfidf_phases": phases}
+    if tracer.enabled:
+        row["tfidf_spans"] = tracer.rollup(mark)
+    return row
 
 
 def run_grep_row(files) -> dict:
@@ -910,6 +939,8 @@ def run_grep_row(files) -> dict:
 
     mesh = default_mesh()
     pstats: dict = {}
+    tracer = bench_tracer()
+    mark = tracer.mark()
     with Span("bench.grep") as pt:
         res = grep_streaming(blocks(), pattern, mesh=mesh,
                              chunk_bytes=GREP_CHUNK_BYTES, aot=aot,
@@ -940,12 +971,15 @@ def run_grep_row(files) -> dict:
                                 f"over {total_mb:.1f} MB (throughput "
                                 f"suppressed)",
                 "grep_parity": False}
-    return {"grep_mbps": round(total_mb / dt, 2),
-            "grep_mb": round(total_mb, 1), "grep_s": round(dt, 2),
-            "grep_matched": res.matched,
-            "grep_oracle_mbps": round(total_mb / oracle_s, 2),
-            "grep_vs_oracle": round(oracle_s / dt, 2),
-            "grep_parity": True, "grep_phases": phases}
+    row = {"grep_mbps": round(total_mb / dt, 2),
+           "grep_mb": round(total_mb, 1), "grep_s": round(dt, 2),
+           "grep_matched": res.matched,
+           "grep_oracle_mbps": round(total_mb / oracle_s, 2),
+           "grep_vs_oracle": round(oracle_s / dt, 2),
+           "grep_parity": True, "grep_phases": phases}
+    if tracer.enabled:
+        row["grep_spans"] = tracer.rollup(mark)
+    return row
 
 
 def framework_row_mb() -> float:

@@ -79,6 +79,19 @@ def fault_point(point: str) -> None:
         raise FaultInjected(f"injected fault at {point} #{n}")
     print(f"FAULT: injected crash at {point} #{n}", file=sys.stderr,
           flush=True)
+    # Commit the trace buffer BEFORE dying: the tracer flush rides the
+    # same atomicio durable-write path as the checkpoints, so a traced
+    # crash leaves a complete, loadable trace.json — the observability
+    # half of the crash-resume evidence.  Never let tracing break the
+    # fault itself.
+    try:
+        from dsi_tpu.obs import trace as _obs_trace
+
+        tracer = _obs_trace.get_tracer()
+        tracer.event("fault", point=point, n=n)
+        tracer.flush()
+    except Exception:
+        pass
     # A real crash: no interpreter unwind, no atexit, no buffered-IO
     # flush — anything the checkpoint path did not make durable BEFORE
     # this instant is gone, which is the whole point.

@@ -35,6 +35,7 @@ from typing import Dict, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from dsi_tpu.obs import trace_event as _trace_event
 from dsi_tpu.utils.atomicio import (
     read_bytes_verified,
     reap_tmp_files,
@@ -148,6 +149,8 @@ class CheckpointStore:
             json.dumps(manifest, sort_keys=True).encode("utf-8"))
         self._gc(keep_from=seq - 1)
         reap_tmp_files(self.dir)
+        _trace_event("ckpt_save", lane="ckpt", engine=self.engine,
+                     seq=seq, bytes=len(payload))
         return seq
 
     def _gc(self, keep_from: int) -> None:
@@ -197,5 +200,7 @@ class CheckpointStore:
                 continue
             with np.load(io.BytesIO(payload)) as z:
                 arrays = {k: z[k] for k in z.files}
+            _trace_event("ckpt_restore", lane="ckpt",
+                         engine=self.engine, seq=seq)
             return manifest["meta"], arrays
         return None

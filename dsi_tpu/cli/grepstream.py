@@ -82,10 +82,19 @@ def main(argv=None) -> int:
     p.add_argument("--check", action="store_true",
                    help="run the host oracle scan over the same stream "
                         "and verify parity (exit 2 on mismatch)")
+    p.add_argument("--trace-dir", default=None,
+                   help="write this run's unified trace (dsi_tpu/obs): "
+                        "Perfetto trace.json + trace.jsonl event log; "
+                        "render with scripts/tracecat.py")
     args = p.parse_args(argv)
 
     if args.resume and not args.checkpoint_dir:
         p.error("--resume requires --checkpoint-dir")
+
+    if args.trace_dir:
+        from dsi_tpu.obs import configure_tracing
+
+        configure_tracing(trace_dir=args.trace_dir)
 
     pattern = args.pattern or os.environ.get("DSI_GREP_PATTERN")
     if not pattern:
@@ -128,6 +137,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
     if args.stats:
         print(f"grepstream: pipeline_stats={pstats}", file=sys.stderr)
+    if args.trace_dir:
+        from dsi_tpu.obs import flush_tracing_report
+
+        flush_tracing_report(args.trace_dir, "grepstream")
     host_path = res is None
     if host_path:
         try:

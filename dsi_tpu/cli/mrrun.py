@@ -53,6 +53,14 @@ def main(argv=None) -> int:
                    help="whole-job wall budget, seconds")
     p.add_argument("--check", action="store_true",
                    help="run the sequential oracle and verify parity")
+    p.add_argument("--trace-dir", default=None,
+                   help="unified job trace (dsi_tpu/obs): the "
+                        "coordinator and every worker inherit "
+                        "DSI_TRACE_DIR and each commits a "
+                        "trace-<pid>.json/.jsonl at exit (assign/"
+                        "complete/requeue events, per-task spans, "
+                        "heartbeat ages); render the whole directory "
+                        "with scripts/tracecat.py")
     args = p.parse_args(argv)
 
     workdir = os.path.abspath(args.workdir)
@@ -78,6 +86,16 @@ def main(argv=None) -> int:
               "for a fresh job)", file=sys.stderr)
     env = dict(os.environ)
     env.setdefault("DSI_MR_SOCKET", os.path.join(workdir, "mr.sock"))
+    if args.trace_dir:
+        trace_dir = os.path.abspath(args.trace_dir)
+        env["DSI_TRACE_DIR"] = trace_dir
+        from dsi_tpu.obs import configure_tracing, trace_event
+
+        # mrrun's own lane records the job lifecycle; children commit
+        # their trace-<pid>.* files at exit via the env inheritance.
+        configure_tracing(trace_dir=trace_dir, basename="trace-mrrun")
+        trace_event("mrrun.start", app=args.app, workers=args.workers,
+                    nreduce=args.nreduce, files=len(files))
 
     # Clear stale oracle files so a failed job can't pass --check against
     # a previous run's ground truth (the reference harness's rm,
@@ -195,6 +213,13 @@ def main(argv=None) -> int:
         print(f"mrrun: coordinator exited rc={coord.returncode}",
               file=sys.stderr)
         rc = 1
+    if args.trace_dir:
+        from dsi_tpu.obs import flush_tracing, trace_event
+
+        trace_event("mrrun.exit", rc=rc)
+        flush_tracing()
+        print(f"mrrun: traces in {args.trace_dir} (render: python "
+              f"scripts/tracecat.py {args.trace_dir})", file=sys.stderr)
     if rc != 0:
         return rc
     if args.check:

@@ -88,6 +88,11 @@ def main(argv=None) -> int:
     p.add_argument("--stats", action="store_true",
                    help="print the pipeline_stats dict (phase walls + "
                         "fold/sync/widen counters) to stderr")
+    p.add_argument("--trace-dir", default=None,
+                   help="write this run's unified trace (dsi_tpu/obs) "
+                        "there: trace.json (Perfetto-loadable, one lane "
+                        "per pipeline stage) + trace.jsonl (event log); "
+                        "render with scripts/tracecat.py")
     args = p.parse_args(argv)
 
     if args.resume and not args.checkpoint_dir:
@@ -95,6 +100,11 @@ def main(argv=None) -> int:
 
     if args.grouper:
         os.environ["DSI_WC_GROUPER"] = args.grouper
+
+    if args.trace_dir:
+        from dsi_tpu.obs import configure_tracing
+
+        configure_tracing(trace_dir=args.trace_dir)
 
     from dsi_tpu.utils.platformpin import pin_platform_from_env
 
@@ -132,6 +142,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
     if args.stats:
         print(f"wcstream: pipeline_stats={pstats}", file=sys.stderr)
+    if args.trace_dir:
+        from dsi_tpu.obs import flush_tracing_report
+
+        flush_tracing_report(args.trace_dir, "wcstream")
     if acc is None:
         # Host fallback: the sequential oracle semantics, partitioned output.
         print("wcstream: stream needs the host path; running host word count",

@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import collections
 import functools
-import time
 from typing import Callable, Deque, Optional, Tuple
 
 import jax
@@ -48,6 +47,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dsi_tpu.obs import span as _span
 from dsi_tpu.parallel.shuffle import AXIS, occupied_prefix
 from dsi_tpu.utils.jaxcompat import shard_map
 
@@ -160,12 +160,12 @@ class DevicePostings:
         received-row tensor ``[n_dev, r, width]``; ``scal_dev`` the
         per-device scalar block whose column 0 is the valid row count
         (already host-confirmed exact by the caller)."""
-        t0 = time.perf_counter()
-        flags = self._dispatch(rows_dev, scal_dev)
-        self._pending.append((flags, rows_dev, scal_dev))
-        while len(self._pending) > self.lag:
-            self._confirm_oldest()
-        self.stats["append_s"] += time.perf_counter() - t0
+        with _span("append", lane="fold", stats=self.stats,
+                   key="append_s"):
+            flags = self._dispatch(rows_dev, scal_dev)
+            self._pending.append((flags, rows_dev, scal_dev))
+            while len(self._pending) > self.lag:
+                self._confirm_oldest()
 
     def _confirm_oldest(self) -> None:
         flags, rows_dev, scal_dev = self._pending.popleft()
@@ -277,21 +277,23 @@ class DevicePostings:
         the whole buffer), hand them to the sink, reset.  The reset
         re-uploads only the two tiny per-device scalars; buffer bytes
         beyond the write offset are never read and can stay stale."""
-        t0 = time.perf_counter()
-        m = int(self._nrows.max())
-        if m:
-            mp = occupied_prefix(m, self.cap)
-            pulled = np.asarray(_buf_prefix(self._buf, mp=mp))
-            for d in range(self.n_dev):
-                nr = int(self._nrows[d])
-                if nr:
-                    self.sink(pulled[d, :nr])
-            self.stats["sync_pulls"] += 1
-        sh1 = NamedSharding(self.mesh, P(AXIS))
-        self._n = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
-        self._dirty = jax.device_put(np.zeros((self.n_dev,), np.int32), sh1)
-        self._nrows[:] = 0
-        self.stats["drain_s"] += time.perf_counter() - t0
+        with _span("drain", lane="sync", stats=self.stats,
+                   key="drain_s"):
+            m = int(self._nrows.max())
+            if m:
+                mp = occupied_prefix(m, self.cap)
+                pulled = np.asarray(_buf_prefix(self._buf, mp=mp))
+                for d in range(self.n_dev):
+                    nr = int(self._nrows[d])
+                    if nr:
+                        self.sink(pulled[d, :nr])
+                self.stats["sync_pulls"] += 1
+            sh1 = NamedSharding(self.mesh, P(AXIS))
+            self._n = jax.device_put(np.zeros((self.n_dev,), np.int32),
+                                     sh1)
+            self._dirty = jax.device_put(
+                np.zeros((self.n_dev,), np.int32), sh1)
+            self._nrows[:] = 0
 
     def sync(self) -> None:
         """The K-wave host pull: flush the append lag (recovering any

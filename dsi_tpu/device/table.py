@@ -54,7 +54,6 @@ from __future__ import annotations
 import collections
 import contextlib
 import functools
-import time
 import warnings
 from typing import Deque, Optional, Tuple
 
@@ -64,6 +63,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dsi_tpu.obs import span as _span, trace_event as _trace_event
 from dsi_tpu.ops.wordcount import (
     _PAD_KEY,
     _PAD_KEY64,
@@ -433,13 +433,13 @@ class DeviceTable:
             # words.  Re-key via the widen protocol: drain what we have,
             # reallocate at the new width, resume folding.
             self._rekey(step_kk, int(packed_dev.shape[1]))
-        t0 = time.perf_counter()
-        out = self._dispatch_fold(packed_dev, scal_dev)
-        self._pending.append((out, packed_dev, scal_dev))
-        self.stats["folds"] += 1
-        while len(self._pending) > self.lag:
-            self._confirm_oldest()
-        self.stats["fold_s"] += time.perf_counter() - t0
+        with _span("fold", stats=self.stats, key="fold_s",
+                   fold=self.stats["folds"]):
+            out = self._dispatch_fold(packed_dev, scal_dev)
+            self._pending.append((out, packed_dev, scal_dev))
+            self.stats["folds"] += 1
+            while len(self._pending) > self.lag:
+                self._confirm_oldest()
 
     def _dispatch_fold(self, packed_dev, scal_dev):
         fn = self._fold_fn(int(packed_dev.shape[1]))
@@ -476,20 +476,19 @@ class DeviceTable:
         folds may already sit in the queue — flush them first (successes
         merged into the old table and drain with it; further overflows
         join the orphan list), then widen and re-fold every orphan."""
-        t0 = time.perf_counter()
-        orphans = list(orphans) + self._flush_pending()
-        while orphans:
-            rows = max(int(p.shape[1]) for p, _ in orphans)
-            self._widen(_pow2(max(4 * self.cap, rows)), self.kk)
-            still = []
-            for packed_dev, scal_dev in orphans:
-                flags_np = np.asarray(
-                    self._dispatch_fold(packed_dev, scal_dev))
-                self._nrows = flags_np[:, 1].astype(np.int64)
-                if flags_np[:, 0].any():  # rung still too narrow: again
-                    still.append((packed_dev, scal_dev))
-            orphans = still
-        self.stats["widen_s"] += time.perf_counter() - t0
+        with _span("widen", stats=self.stats, key="widen_s"):
+            orphans = list(orphans) + self._flush_pending()
+            while orphans:
+                rows = max(int(p.shape[1]) for p, _ in orphans)
+                self._widen(_pow2(max(4 * self.cap, rows)), self.kk)
+                still = []
+                for packed_dev, scal_dev in orphans:
+                    flags_np = np.asarray(
+                        self._dispatch_fold(packed_dev, scal_dev))
+                    self._nrows = flags_np[:, 1].astype(np.int64)
+                    if flags_np[:, 0].any():  # rung still too narrow
+                        still.append((packed_dev, scal_dev))
+                orphans = still
 
     def _widen(self, new_cap: int, new_kk: int) -> None:
         """Drain the current table into the host accumulator and
@@ -503,17 +502,18 @@ class DeviceTable:
         self._nrows[:] = 0
         self.stats["widens"] += 1
         self.stats["table_cap"] = self.cap
+        _trace_event("table_widen", lane="widen", cap=self.cap,
+                     kk=self.kk)
 
     def _rekey(self, new_kk: int, rows: int) -> None:
-        t0 = time.perf_counter()
-        # Outstanding folds still match the OLD width: confirm them
-        # first (overflow here recovers at the old width, which is fine
-        # — their steps' words provably fit the old window).
-        orphans = self._flush_pending()
-        if orphans:
-            self._recover(orphans)
-        self._widen(_pow2(max(self.cap, rows)), new_kk)
-        self.stats["widen_s"] += time.perf_counter() - t0
+        with _span("widen", stats=self.stats, key="widen_s", rekey=True):
+            # Outstanding folds still match the OLD width: confirm them
+            # first (overflow here recovers at the old width, which is
+            # fine — their steps' words provably fit the old window).
+            orphans = self._flush_pending()
+            if orphans:
+                self._recover(orphans)
+            self._widen(_pow2(max(self.cap, rows)), new_kk)
 
     # ── checkpoint image (dsi_tpu/ckpt) ──
 
@@ -583,27 +583,25 @@ class DeviceTable:
         into the accumulator, reset it to empty ON DEVICE (compiled
         clear, no upload).  Returns True when a pull happened (an empty
         window skips the wire and is not counted)."""
-        t0 = time.perf_counter()
-        orphans = self._flush_pending()
-        if orphans:
-            self._recover(orphans)
-        pulled = self._pull_merge()
-        if pulled:
-            self.stats["sync_pulls"] += 1
-            with _quiet_unusable_donation():
-                self._state = tuple(self._clear_fn()(*self._state))
-            self._nrows[:] = 0
-        self.stats["sync_s"] += time.perf_counter() - t0
+        with _span("sync", stats=self.stats, key="sync_s"):
+            orphans = self._flush_pending()
+            if orphans:
+                self._recover(orphans)
+            pulled = self._pull_merge()
+            if pulled:
+                self.stats["sync_pulls"] += 1
+                with _quiet_unusable_donation():
+                    self._state = tuple(self._clear_fn()(*self._state))
+                self._nrows[:] = 0
         return pulled
 
     def close(self) -> None:
         """Stream-end drain: flush + final pull, no reset (the table is
         dropped with the service)."""
-        t0 = time.perf_counter()
-        orphans = self._flush_pending()
-        if orphans:
-            self._recover(orphans)
-        if self._pull_merge():
-            self.stats["sync_pulls"] += 1
-        self._state = None
-        self.stats["sync_s"] += time.perf_counter() - t0
+        with _span("sync", stats=self.stats, key="sync_s", close=True):
+            orphans = self._flush_pending()
+            if orphans:
+                self._recover(orphans)
+            if self._pull_merge():
+                self.stats["sync_pulls"] += 1
+            self._state = None

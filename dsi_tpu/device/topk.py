@@ -47,7 +47,6 @@ an input to the result.
 from __future__ import annotations
 
 import functools
-import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -66,6 +65,7 @@ from dsi_tpu.device.table import (
     _step_structs,
     _table_structs,
 )
+from dsi_tpu.obs import span as _span
 from dsi_tpu.parallel.shuffle import AXIS
 from dsi_tpu.utils.jaxcompat import enable_x64, x64_scoped
 
@@ -183,33 +183,34 @@ class DeviceTopK(DeviceTable):
         late-detected overflow), then pull the top-k rows — no drain, no
         clear.  Returns True when a snapshot crossed the wire (an empty
         table skips it)."""
-        t0 = time.perf_counter()
-        orphans = self._flush_pending()
-        if orphans:
-            self._recover(orphans)
-        pulled = False
-        if int(self._nrows.max()):
-            tkeys, tlens, tcnts, _, _ = self._state
-            skeys, slens, scnts = self._topk_fn()(tkeys, tlens, tcnts)
-            keys_np = np.asarray(skeys)
-            lens_np = np.asarray(slens)
-            cnts_np = np.asarray(scnts)
-            rows: List[Tuple] = []
-            for d in range(self.n_dev):
-                # Rows past this shard's occupancy sorted last with
-                # count 0 (pad) — drop them by count, not by position,
-                # so a shard with < k rows contributes exactly its own.
-                for i in range(min(self.k, int(self._nrows[d]))):
-                    c = int(cnts_np[d, i])
-                    if c <= 0:
-                        break
-                    rows.append((c, tuple(keys_np[d, i].tolist()),
-                                 int(lens_np[d, i])))
-            rows.sort(key=lambda r: (-r[0], r[1]))
-            self.snapshot = tuple(rows[:self.k])
-            self.stats["topk_snapshots"] += 1
-            pulled = True
-        self.stats["sync_s"] += time.perf_counter() - t0
+        with _span("sync", stats=self.stats, key="sync_s",
+                   snapshot=True):
+            orphans = self._flush_pending()
+            if orphans:
+                self._recover(orphans)
+            pulled = False
+            if int(self._nrows.max()):
+                tkeys, tlens, tcnts, _, _ = self._state
+                skeys, slens, scnts = self._topk_fn()(tkeys, tlens, tcnts)
+                keys_np = np.asarray(skeys)
+                lens_np = np.asarray(slens)
+                cnts_np = np.asarray(scnts)
+                rows: List[Tuple] = []
+                for d in range(self.n_dev):
+                    # Rows past this shard's occupancy sorted last with
+                    # count 0 (pad) — drop them by count, not by
+                    # position, so a shard with < k rows contributes
+                    # exactly its own.
+                    for i in range(min(self.k, int(self._nrows[d]))):
+                        c = int(cnts_np[d, i])
+                        if c <= 0:
+                            break
+                        rows.append((c, tuple(keys_np[d, i].tolist()),
+                                     int(lens_np[d, i])))
+                rows.sort(key=lambda r: (-r[0], r[1]))
+                self.snapshot = tuple(rows[:self.k])
+                self.stats["topk_snapshots"] += 1
+                pulled = True
         return pulled
 
 
@@ -338,19 +339,19 @@ class DeviceHistogram:
     def fold(self, step_dev) -> None:
         """Add one confirmed step's ``[n_dev, slots]`` uint32 vector into
         the running totals (async, donated state)."""
-        t0 = time.perf_counter()
-        with _quiet_unusable_donation():
-            self._state = self._fold_fn()(self._state, step_dev)
-        self.stats["hist_folds"] += 1
-        self.stats["hist_s"] += time.perf_counter() - t0
+        with _span("hist_fold", lane="fold", stats=self.stats,
+                   key="hist_s"):
+            with _quiet_unusable_donation():
+                self._state = self._fold_fn()(self._state, step_dev)
+            self.stats["hist_folds"] += 1
 
     def pull(self) -> np.ndarray:
         """Running totals summed over devices — ``[slots]`` int64.  No
         clear: the vector keeps accumulating on device."""
-        t0 = time.perf_counter()
-        out = np.asarray(self._state).astype(np.int64).sum(axis=0)
-        self.stats["hist_pulls"] += 1
-        self.stats["hist_s"] += time.perf_counter() - t0
+        with _span("hist_pull", lane="sync", stats=self.stats,
+                   key="hist_s"):
+            out = np.asarray(self._state).astype(np.int64).sum(axis=0)
+            self.stats["hist_pulls"] += 1
         return out
 
     def close(self) -> np.ndarray:

@@ -27,11 +27,13 @@ from __future__ import annotations
 
 import heapq
 import os
+import sys
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from dsi_tpu.config import JobConfig
+from dsi_tpu.obs import get_registry
 from dsi_tpu.mr import rpc
 from dsi_tpu.mr.journal import Journal
 from dsi_tpu.mr.types import (LOG_COMPLETED, LOG_IN_PROGRESS, LOG_UNTOUCHED,
@@ -59,6 +61,14 @@ class Coordinator:
         self._map_ready = list(range(self.n_map))
         self._reduce_ready = list(range(n_reduce))
         self.mu = threading.Lock()
+        # Worker liveness (observability + the speculative-execution
+        # hook): last-contact time per WorkerId — every RPC carrying an
+        # id refreshes it — and which worker holds each in-progress
+        # task, so a requeue can report WHOSE heartbeat went stale and
+        # how stale it was (the reference reassigns silently,
+        # coordinator.go:70-77).
+        self._worker_seen: Dict[str, float] = {}
+        self._task_worker: Dict[tuple, str] = {}
         # Straggler watchdog: ONE monitor thread over a deadline heap
         # replaces the reference's goroutine-per-assignment
         # (mr/coordinator.go:70-77,99-106) — a per-task Timer thread melts
@@ -120,7 +130,10 @@ class Coordinator:
         (mr/coordinator.go:43-114)."""
         reply = {"TaskStatus": int(TaskStatus.WAITING), "NMap": self.n_map,
                  "CMap": 0, "NReduce": self.n_reduce, "CReduce": 0, "Filename": ""}
+        wid = str(args.get("WorkerId") or "")
         with self.mu:
+            if wid:
+                self._worker_seen[wid] = time.monotonic()
             if self.c_map < self.n_map:
                 tba = self._pop_untouched(self._map_ready, self.map_log)
                 if tba is None:
@@ -131,8 +144,10 @@ class Coordinator:
                     reply["Filename"] = self.files[tba]
                     reply["CMap"] = tba
                     self._arm_timeout(tba, "map")  # :70-77
+                    if wid:
+                        self._task_worker[("map", tba)] = wid
                     log_event("assign", kind="map", task=tba,
-                              file=self.files[tba])
+                              file=self.files[tba], worker=wid or None)
             elif self.c_reduce < self.n_reduce:  # map barrier passed (:79)
                 tba = self._pop_untouched(self._reduce_ready, self.reduce_log)
                 if tba is None:
@@ -142,7 +157,10 @@ class Coordinator:
                     reply["TaskStatus"] = int(TaskStatus.REDUCE)
                     reply["CReduce"] = tba
                     self._arm_timeout(tba, "reduce")  # :99-106
-                    log_event("assign", kind="reduce", task=tba)
+                    if wid:
+                        self._task_worker[("reduce", tba)] = wid
+                    log_event("assign", kind="reduce", task=tba,
+                              worker=wid or None)
             else:
                 reply["TaskStatus"] = int(TaskStatus.DONE)  # :109-112
         return reply
@@ -151,13 +169,18 @@ class Coordinator:
         """Reference: RecieveMapComplete [sic] (mr/coordinator.go:27-33), with
         the unique-transition counting fix."""
         t = int(args["TaskNumber"])
+        wid = str(args.get("WorkerId") or "")
         with self.mu:
+            if wid:
+                self._worker_seen[wid] = time.monotonic()
+            self._task_worker.pop(("map", t), None)
             if self.map_log[t] != LOG_COMPLETED:  # fix: count first completion only
                 self.map_log[t] = LOG_COMPLETED
                 self.c_map += 1
                 if self._journal is not None:
                     self._journal.record("map", t)
-                log_event("complete", kind="map", task=t, c_map=self.c_map)
+                log_event("complete", kind="map", task=t, c_map=self.c_map,
+                          worker=wid or None)
             else:
                 log_event("duplicate_completion", kind="map", task=t)
         return {}
@@ -165,14 +188,18 @@ class Coordinator:
     def reduce_complete(self, args: dict) -> dict:
         """Reference: RecieveReduceComplete [sic] (mr/coordinator.go:35-41)."""
         t = int(args["TaskNumber"])
+        wid = str(args.get("WorkerId") or "")
         with self.mu:
+            if wid:
+                self._worker_seen[wid] = time.monotonic()
+            self._task_worker.pop(("reduce", t), None)
             if self.reduce_log[t] != LOG_COMPLETED:
                 self.reduce_log[t] = LOG_COMPLETED
                 self.c_reduce += 1
                 if self._journal is not None:
                     self._journal.record("reduce", t)
                 log_event("complete", kind="reduce", task=t,
-                          c_reduce=self.c_reduce)
+                          c_reduce=self.c_reduce, worker=wid or None)
             else:
                 log_event("duplicate_completion", kind="reduce", task=t)
         return {}
@@ -206,7 +233,14 @@ class Coordinator:
 
     def _watchdog(self) -> None:
         """The single straggler-monitor thread: sleep until the earliest
-        armed deadline, then requeue any task still in-progress."""
+        armed deadline, then requeue any task still in-progress.
+
+        A requeue is never silent (the reference reassigns without a
+        word, and debugging a 10 s stall took strace-level archaeology):
+        it logs the reason and the assignee's heartbeat age to stderr
+        and the trace's control-plane lane, and republishes the
+        per-worker heartbeat-age gauge — the signal speculative
+        execution will consume (ROADMAP)."""
         with self._deadline_cv:
             while not self._closing:
                 if not self._deadlines:
@@ -224,8 +258,24 @@ class Coordinator:
                     heapq.heappush(
                         self._map_ready if kind == "map"
                         else self._reduce_ready, task_id)
+                    wid = self._task_worker.pop((kind, task_id), "")
+                    seen = self._worker_seen.get(wid)
+                    hb_age = (round(now - seen, 3)
+                              if seen is not None else None)
+                    ages = {w: round(now - t, 3)
+                            for w, t in self._worker_seen.items()}
+                    get_registry().set_gauge(
+                        "mr_worker_heartbeat_age_s", ages)
                     log_event("requeue", kind=kind, task=task_id,
-                              timeout_s=self.config.task_timeout_s)
+                              timeout_s=self.config.task_timeout_s,
+                              worker=wid or None, heartbeat_age_s=hb_age,
+                              reason="in-progress past task_timeout_s")
+                    print(f"coordinator: requeue {kind} task {task_id}: "
+                          f"in-progress past "
+                          f"{self.config.task_timeout_s}s (worker="
+                          f"{wid or '?'} heartbeat_age="
+                          f"{'%.3fs' % hb_age if hb_age is not None else 'n/a'})",
+                          file=sys.stderr)
 
     # ---- lifecycle (mr/coordinator.go:121-160) ----
 
@@ -249,6 +299,16 @@ class Coordinator:
         """Job-completion poll (mr/coordinator.go:138-142)."""
         with self.mu:
             return self.c_reduce == self.n_reduce
+
+    def worker_heartbeat_ages(self) -> Dict[str, float]:
+        """Seconds since each known worker's last RPC — the per-worker
+        heartbeat-age gauge (also published to the obs registry at
+        requeue time).  The straggler signal the speculative-execution
+        item will dispatch backup tasks on."""
+        now = time.monotonic()
+        with self.mu:
+            return {w: round(now - t, 3)
+                    for w, t in self._worker_seen.items()}
 
     def close(self) -> None:
         with self._deadline_cv:

@@ -189,13 +189,19 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
     cfg = config or JobConfig()
     sock = cfg.sock()
     tasks_done = 0
+    # Stable per-process identity, sent with every RPC: the coordinator
+    # keys its per-worker heartbeat-age gauge on it (a requeue can then
+    # say WHOSE heartbeat went stale — and the speculative-execution
+    # hook reads the same gauge).  Old coordinators ignore the extra key.
+    worker_id = f"w{os.getpid()}"
 
     def report_complete(method: str, task_number: int) -> bool:
         """Completion RPC; False means the loop must exit.  An auth
         rejection is always LOUD — a misconfigured worker must not look
         like a clean end-of-job exit."""
         try:
-            rpc.call(sock, method, {"TaskNumber": task_number})
+            rpc.call(sock, method, {"TaskNumber": task_number,
+                                    "WorkerId": worker_id})
             return True
         except rpc.AuthError as e:
             print(f"mrworker: {e}", file=sys.stderr)
@@ -205,7 +211,8 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
 
     while True:
         try:
-            ok, reply = rpc.call(sock, "Coordinator.RequestTask", {"TaskNumber": 0})
+            ok, reply = rpc.call(sock, "Coordinator.RequestTask",
+                                 {"TaskNumber": 0, "WorkerId": worker_id})
         except rpc.CoordinatorGone as e:
             # Coordinator exited; the reference worker dies here
             # (worker.go:176-178).  Normal at end-of-job; noteworthy if this

@@ -1,0 +1,82 @@
+"""dsi_tpu.obs — unified tracing + metrics across every runtime layer.
+
+Two halves, one subsystem:
+
+* :mod:`~dsi_tpu.obs.trace` — the :class:`Tracer`: nested spans,
+  instant events, counters, buffered in memory and flushed durably as a
+  JSONL event log plus a Chrome/Perfetto ``trace.json`` (one lane per
+  pipeline stage, plus device-service and control-plane lanes).
+  Enabled by ``DSI_TRACE_DIR`` or the CLIs' ``--trace-dir``; ~free
+  when disabled (``DSI_TRACE=1`` stays the stderr event stream's knob).
+* :mod:`~dsi_tpu.obs.registry` — the :class:`MetricsRegistry` every
+  engine's phase dict registers into, with the single documented key
+  schema that subsumes ``pipeline_stats``/``stream_phases``/
+  ``wave_phases``/``grep_phases``.
+
+Render a trace with ``scripts/tracecat.py``; open the ``trace.json`` at
+https://ui.perfetto.dev.  DESIGN.md "Observability" documents the span
+taxonomy and lane map.
+"""
+
+import sys
+
+from dsi_tpu.obs.registry import (
+    ENGINES,
+    LEGACY_ALIASES,
+    PHASE_KEYS,
+    MetricsRegistry,
+    MetricsScope,
+    get_registry,
+    metrics_scope,
+)
+from dsi_tpu.obs.trace import (
+    LANES,
+    Tracer,
+    configure,
+    count,
+    event,
+    flush,
+    get_tracer,
+    span,
+)
+
+#: CLI-facing aliases (the engine modules import ``span``/``event``
+#: directly; the CLIs read better with the explicit names).
+configure_tracing = configure
+flush_tracing = flush
+trace_event = event
+
+
+def flush_tracing_report(trace_dir: str, prog: str = "") -> None:
+    """Flush the global tracer and print the canonical
+    where-is-my-trace line — the one exit block every single-process
+    ``--trace-dir`` entry point (wcstream/grepstream/the soaks) shares,
+    so the wording cannot drift per CLI."""
+    paths = flush()
+    if paths:
+        tag = f"{prog}: " if prog else ""
+        print(f"{tag}trace written to {paths[1]} "
+              f"(render: python scripts/tracecat.py {trace_dir})",
+              file=sys.stderr)
+
+__all__ = [
+    "ENGINES",
+    "LANES",
+    "LEGACY_ALIASES",
+    "PHASE_KEYS",
+    "MetricsRegistry",
+    "MetricsScope",
+    "Tracer",
+    "configure",
+    "configure_tracing",
+    "count",
+    "event",
+    "flush",
+    "flush_tracing",
+    "flush_tracing_report",
+    "get_registry",
+    "get_tracer",
+    "metrics_scope",
+    "span",
+    "trace_event",
+]

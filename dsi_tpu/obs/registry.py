@@ -1,0 +1,151 @@
+"""The one metrics registry behind every engine's phase dict.
+
+Before this module each engine grew its own stats dict with its own
+spellings — ``pipeline_stats``/``stream_phases`` (word count),
+``wave_phases`` (TF-IDF), the grep variants — and bench.py, the CLIs,
+and ``scripts/summarize_onchip.py`` each re-learned every shape.  Now an
+engine's stats dict IS a :class:`MetricsScope` registered here under the
+engine's name, and every consumer reads one documented schema.
+
+## The unified key schema
+
+Phase wall-seconds (suffix ``_s``; a key is present when the engine has
+that phase):
+
+* ``materialize_s``      — building host-side step items (batch slicing,
+  wave chunk assembly); in the producer thread at depth > 1
+* ``materialize_wait_s`` — consumer starvation on the producer queue
+* ``upload_s``           — H2D puts of step inputs
+* ``kernel_s``           — time blocked on a step's deferred scalar/flag
+  check (the device-compute wall the window failed to hide)
+* ``pull_s``             — D2H result pulls
+* ``merge_s``            — host-side accumulation of pulled results
+* ``replay_s``           — exactness-ladder replays of overflowed steps
+* ``fold_s`` / ``append_s`` / ``hist_s`` — device-service folds
+* ``sync_s`` / ``drain_s``               — device-service pulls/drains
+* ``widen_s``            — drain→realloc→re-fold recoveries
+* ``ckpt_s``             — checkpoint snapshot + durable write
+
+Counters / gauges: ``steps`` (or ``waves``), ``depth``, ``replays``,
+``step_pulls``, ``sync_pulls``, ``widens``, ``folds``,
+``fold_overflows``, ``appends``, ``append_overflows``,
+``postings_widens``, ``topk_snapshots``, ``hist_folds``, ``hist_pulls``,
+``table_cap``, ``l_cap``, ``sync_every``, ``max_inflight``,
+``buffer_allocs``, ``ckpt_saves``, ``ckpt_every``, ``resume_gap_s``,
+``resume_cursor``/``resume_wave``, ``device_accumulate``.
+
+Engines keep their historical spellings inside the scope (external
+consumers — tests, soaks, BENCH artifacts — read those keys today);
+:meth:`MetricsScope.unified` maps the legacy spellings onto the schema
+above, which is the view new consumers (``scripts/tracecat.py``, the
+trace-file registry snapshot, the schema contract test) use.  The
+aliases below are the complete drift list — adding an engine key that
+needs a NEW alias is a schema change and belongs in this table.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+#: Legacy engine-specific spellings → unified schema names.  The
+#: streaming word-count/grep engines predate the schema ("batch" for the
+#: materialize phase, per-engine inflight names); everything else
+#: already matches.
+LEGACY_ALIASES = {
+    "batch_s": "materialize_s",
+    "batch_wait_s": "materialize_wait_s",
+    "max_inflight_chunks": "max_inflight",
+    "max_inflight_waves": "max_inflight",
+    "batch_allocs": "buffer_allocs",
+}
+
+#: The canonical phase keys (module docstring) — what the schema
+#: contract test pins.
+PHASE_KEYS = (
+    "materialize_s", "materialize_wait_s", "upload_s", "kernel_s",
+    "pull_s", "merge_s", "replay_s", "fold_s", "append_s", "hist_s",
+    "sync_s", "drain_s", "widen_s", "ckpt_s",
+)
+
+#: The engine names the four streaming engines register under.
+ENGINES = ("stream", "tfidf", "grep", "indexer")
+
+
+class MetricsScope(dict):
+    """One engine's stats dict, registered in the registry at creation.
+    Behaves exactly like the plain dict it replaces (engines mutate it
+    with ``+=``/``setdefault``/``update``); :meth:`unified` is the
+    schema-normalized read view."""
+
+    def __init__(self, engine: str):
+        super().__init__()
+        self.engine = engine
+
+    def unified(self) -> Dict:
+        """The scope under the documented schema: legacy spellings
+        renamed, everything else passed through."""
+        return {LEGACY_ALIASES.get(k, k): v for k, v in self.items()}
+
+
+class MetricsRegistry:
+    """Process-global map of live engine scopes + named gauges.  An
+    engine re-registers its scope per run (latest wins) — the registry
+    answers "what did the most recent <engine> run report", which is
+    what bench rows, the CLIs' ``--stats``, and the trace-file snapshot
+    all want."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._scopes: Dict[str, MetricsScope] = {}
+        self._gauges: Dict[str, object] = {}
+
+    def scope(self, engine: str) -> MetricsScope:
+        """A fresh scope for one engine run, registered as the engine's
+        current phase dict."""
+        sc = MetricsScope(engine)
+        with self._lock:
+            self._scopes[engine] = sc
+        return sc
+
+    def phases(self, engine: str) -> Optional[MetricsScope]:
+        """The engine's current phase dict (None before its first run)."""
+        with self._lock:
+            return self._scopes.get(engine)
+
+    def engines(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self._scopes))
+
+    def set_gauge(self, name: str, value) -> None:
+        """Publish a named gauge (e.g. the coordinator's per-worker
+        heartbeat ages) — read back via :meth:`gauge`/:meth:`snapshot`;
+        the speculative-execution hook consumes these."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge(self, name: str, default=None):
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def snapshot(self) -> Dict:
+        """JSON-ready dump: every engine's unified view + the gauges —
+        embedded in trace files by ``obs/trace.py`` at flush."""
+        with self._lock:
+            scopes = dict(self._scopes)
+            gauges = dict(self._gauges)
+        return {"engines": {e: sc.unified() for e, sc in scopes.items()},
+                "gauges": gauges}
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def metrics_scope(engine: str) -> MetricsScope:
+    """Shorthand: a fresh registered scope on the global registry — the
+    one-liner every engine calls where it used to build ``stats = {}``."""
+    return _REGISTRY.scope(engine)

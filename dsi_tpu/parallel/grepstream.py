@@ -77,6 +77,7 @@ from dsi_tpu.ckpt import (
 from dsi_tpu.device.policy import SyncPolicy
 from dsi_tpu.device.table import _pow2, _quiet_unusable_donation
 from dsi_tpu.device.topk import DeviceHistogram, DeviceTopK, KeyCounts
+from dsi_tpu.obs import metrics_scope, span as _span
 from dsi_tpu.ops.grepk import is_literal_pattern, line_cap_rungs
 from dsi_tpu.ops.wordcount import (
     _PAD_KEY64,
@@ -472,11 +473,15 @@ def grep_streaming(
     m = len(pattern)
     rungs = line_cap_rungs(chunk_bytes)
     state = {"l_cap": rungs[0]}
-    stats = {"depth": depth, "steps": 0, "replays": 0, "step_pulls": 0,
-             "sync_pulls": 0, "device_accumulate": device_accumulate,
-             "l_cap": rungs[0], "batch_s": 0.0, "batch_wait_s": 0.0,
-             "upload_s": 0.0, "kernel_s": 0.0, "pull_s": 0.0,
-             "merge_s": 0.0, "replay_s": 0.0}
+    # Registry scope (dsi_tpu/obs): grep_phases is a view over the one
+    # schema, not its own dialect.
+    stats = metrics_scope("grep")
+    stats.update({"depth": depth, "steps": 0, "replays": 0,
+                  "step_pulls": 0, "sync_pulls": 0,
+                  "device_accumulate": device_accumulate,
+                  "l_cap": rungs[0], "batch_s": 0.0, "batch_wait_s": 0.0,
+                  "upload_s": 0.0, "kernel_s": 0.0, "pull_s": 0.0,
+                  "merge_s": 0.0, "replay_s": 0.0})
     sh2 = NamedSharding(mesh, P(AXIS, None))
     sh1 = NamedSharding(mesh, P(AXIS))
     pat_np = np.tile(np.frombuffer(pattern.encode("ascii"), np.uint8),
@@ -558,36 +563,36 @@ def grep_streaming(
         """Consistent snapshot at a confirmed-step boundary — device
         images first (flushing the top-k lag can widen, whose drain
         lands in the KeyCounts accumulator), host residue second."""
-        t0 = time.perf_counter()
-        arrays: dict = {}
-        meta = {"cursor": ck_cursor["offset"], "lines": ck_cursor["lines"],
-                "l_cap": state["l_cap"]}
-        if device_accumulate:
-            for k, v in topk_svc.checkpoint_state().items():
-                arrays["table_" + k] = v
-            meta["table_cap"] = topk_svc.cap
-            meta["table_kk"] = topk_svc.kk
-            arrays["hist"] = hist_svc.checkpoint_state()["hist"]
-            for k, v in acc.snapshot().items():
-                arrays["kc_" + k] = v
-            meta["sync_since"] = policy.snapshot()
-        else:
-            arrays["gs_hist"] = hist_h.copy()
-            arrays["gs_totals"] = totals.copy()
-            if cand_h:
-                arrays["gs_cands"] = np.array(cand_h, dtype=np.int64)
-        ck_store.save(arrays, meta)
-        stats["ckpt_saves"] += 1
-        stats["ckpt_s"] += time.perf_counter() - t0
+        with _span("ckpt", stats=stats, key="ckpt_s",
+                   lines=ck_cursor["lines"]):
+            arrays: dict = {}
+            meta = {"cursor": ck_cursor["offset"],
+                    "lines": ck_cursor["lines"], "l_cap": state["l_cap"]}
+            if device_accumulate:
+                for k, v in topk_svc.checkpoint_state().items():
+                    arrays["table_" + k] = v
+                meta["table_cap"] = topk_svc.cap
+                meta["table_kk"] = topk_svc.kk
+                arrays["hist"] = hist_svc.checkpoint_state()["hist"]
+                for k, v in acc.snapshot().items():
+                    arrays["kc_" + k] = v
+                meta["sync_since"] = policy.snapshot()
+            else:
+                arrays["gs_hist"] = hist_h.copy()
+                arrays["gs_totals"] = totals.copy()
+                if cand_h:
+                    arrays["gs_cands"] = np.array(cand_h, dtype=np.int64)
+            ck_store.save(arrays, meta)
+            stats["ckpt_saves"] += 1
         fault_point("post-ckpt")
 
     def step_call(buf, lens_np, bases_np, l_cap):
-        t0 = time.perf_counter()
-        chunks = jax.device_put(buf, sh2)
-        lens = jax.device_put(lens_np, sh1)
-        with enable_x64(True):  # keep the u64 bases u64 through the put
-            bases = jax.device_put(bases_np.astype(np.uint64), sh1)
-        stats["upload_s"] += time.perf_counter() - t0
+        with _span("upload", stats=stats, key="upload_s",
+                   step=stats["steps"]):
+            chunks = jax.device_put(buf, sh2)
+            lens = jax.device_put(lens_np, sh1)
+            with enable_x64(True):  # keep the u64 bases u64 through it
+                bases = jax.device_put(bases_np.astype(np.uint64), sh1)
         fn = _grep_fn((chunks, pat_dev, lens, bases), n_dev=n_dev,
                       chunk_bytes=chunk_bytes, m=m, l_cap=l_cap, bins=bins,
                       k=topk, mesh=mesh)
@@ -617,8 +622,7 @@ def grep_streaming(
         at the wider sticky rung.  Exactly-once — the optimistic
         attempt's tensors are dropped unmerged."""
         stats["replays"] += 1
-        t0 = time.perf_counter()
-        try:
+        with _span("replay", stats=stats, key="replay_s"):
             for l_cap in rungs:
                 if l_cap <= used_l_cap:
                     continue
@@ -629,16 +633,13 @@ def grep_streaming(
                     state["l_cap"] = max(state["l_cap"], l_cap)
                     stats["l_cap"] = state["l_cap"]
                     return hist_d, cand_d, scal, scal_np
-        finally:
-            stats["replay_s"] += time.perf_counter() - t0
         raise RuntimeError("grep l_cap ladder exhausted (n+1 must fit)")
 
     def finish_one(record) -> None:
         buf, lens_np, row_lines, bases_np, l_cap_used, hist_d, cand_d, \
             scal, rec_offset, rec_lines = record
-        t0 = time.perf_counter()
-        scal_np = np.asarray(scal)  # blocks until this step's kernel lands
-        stats["kernel_s"] += time.perf_counter() - t0
+        with _span("kernel", stats=stats, key="kernel_s"):
+            scal_np = np.asarray(scal)  # blocks until the kernel lands
         if scal_np[:, 2].any():  # l_cap overflow: replay wider, sticky
             hist_d, cand_d, scal, scal_np = replay_step(
                 buf, lens_np, bases_np, l_cap_used)
@@ -662,21 +663,19 @@ def grep_streaming(
                 stats["sync_pulls"] += 1
                 policy.reset()
         else:
-            t0 = time.perf_counter()
-            hist_np = np.asarray(hist_d)
-            cand_np = np.asarray(cand_d)
-            stats["step_pulls"] += 1
-            stats["pull_s"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            hist_h[:] += hist_np[:, :bins].astype(np.int64).sum(axis=0)
-            totals[:] += hist_np[:, bins:].astype(np.int64).sum(axis=0)
-            for d in range(n_dev):
-                nc = int(scal_np[d, 0])
-                for i in range(nc):
-                    line = (int(cand_np[d, i, 0]) << 32) | int(
-                        cand_np[d, i, 1])
-                    cand_h.append((line, int(cand_np[d, i, 3])))
-            stats["merge_s"] += time.perf_counter() - t0
+            with _span("pull", stats=stats, key="pull_s"):
+                hist_np = np.asarray(hist_d)
+                cand_np = np.asarray(cand_d)
+                stats["step_pulls"] += 1
+            with _span("merge", stats=stats, key="merge_s"):
+                hist_h[:] += hist_np[:, :bins].astype(np.int64).sum(axis=0)
+                totals[:] += hist_np[:, bins:].astype(np.int64).sum(axis=0)
+                for d in range(n_dev):
+                    nc = int(scal_np[d, 0])
+                    for i in range(nc):
+                        line = (int(cand_np[d, i, 0]) << 32) | int(
+                            cand_np[d, i, 1])
+                        cand_h.append((line, int(cand_np[d, i, 3])))
         # Confirmed: merged/folded, nothing later is.  Fault before the
         # cursor advances — the torn-update instant.
         fault_point("mid-fold")
@@ -693,7 +692,7 @@ def grep_streaming(
                         stats=stats, produce_key="batch_s",
                         wait_key="batch_wait_s",
                         inflight_key="max_inflight_chunks",
-                        thread_name="dsi-grep-batcher")
+                        thread_name="dsi-grep-batcher", engine="grep")
 
     feed = skip_stream(blocks, start_offset) if start_offset else blocks
     result: Optional[GrepStreamResult]
@@ -944,7 +943,9 @@ def indexer_streaming(
     longest = max(doc_lens, default=1)
     size_max = 1 << max(8, int(longest).bit_length())
     n_real = len(docs)
-    st = stats if stats is not None else {}
+    # Internal registry scope (dsi_tpu/obs); copied out to the caller's
+    # ``stats`` dict when the walk ends, like pipeline_stats everywhere.
+    st = metrics_scope("indexer")
     st.update({"waves": len(waves), "step_pulls": 0, "depth": depth,
                "replays": 0, "device_accumulate": device_accumulate,
                "upload_s": 0.0, "kernel_s": 0.0, "pull_s": 0.0,
@@ -1057,28 +1058,28 @@ def indexer_streaming(
             flushing the df top-k's lag can widen into ``df_acc`` —
             host residue second, so both sides of any such move land
             in the same image."""
-            t0 = time.perf_counter()
-            arrays: dict = {}
-            meta = {"mwl": mwl, "wave": ck_wave[0], "cap": state["cap"],
-                    "grouper": state["grouper"], "frac": state["frac"]}
-            if buf_dev is not None:
-                pb = buf_dev.checkpoint_state()
-                arrays["pb_buf"] = pb["buf"]
-                arrays["pb_nrows"] = pb["nrows"]
-                meta["pb_cap"] = int(pb["cap"])
-                if topk_svc is not None:
-                    for k, v in topk_svc.checkpoint_state().items():
-                        arrays["table_" + k] = v
-                    meta["table_cap"] = topk_svc.cap
-                    meta["table_kk"] = topk_svc.kk
-                for k, v in df_acc.snapshot().items():
-                    arrays["df_" + k] = v
-                meta["sync_since"] = policy.snapshot()
-            for k, v in table.snapshot().items():
-                arrays["pt_" + k] = v
-            ck_store.save(arrays, meta)
-            st["ckpt_saves"] += 1
-            st["ckpt_s"] += time.perf_counter() - t0
+            with _span("ckpt", stats=st, key="ckpt_s", wave=ck_wave[0]):
+                arrays: dict = {}
+                meta = {"mwl": mwl, "wave": ck_wave[0],
+                        "cap": state["cap"], "grouper": state["grouper"],
+                        "frac": state["frac"]}
+                if buf_dev is not None:
+                    pb = buf_dev.checkpoint_state()
+                    arrays["pb_buf"] = pb["buf"]
+                    arrays["pb_nrows"] = pb["nrows"]
+                    meta["pb_cap"] = int(pb["cap"])
+                    if topk_svc is not None:
+                        for k, v in topk_svc.checkpoint_state().items():
+                            arrays["table_" + k] = v
+                        meta["table_cap"] = topk_svc.cap
+                        meta["table_kk"] = topk_svc.kk
+                    for k, v in df_acc.snapshot().items():
+                        arrays["df_" + k] = v
+                    meta["sync_since"] = policy.snapshot()
+                for k, v in table.snapshot().items():
+                    arrays["pt_" + k] = v
+                ck_store.save(arrays, meta)
+                st["ckpt_saves"] += 1
             fault_point("post-ckpt")
 
         def materialize():
@@ -1090,10 +1091,9 @@ def indexer_streaming(
                 yield (size, chunk_np, ids_np)
 
         def wave_call(chunk_np, ids_np, size, cap, frac, g):
-            t0 = time.perf_counter()
-            chunk = jax.device_put(chunk_np, sh_chunk)
-            ids = jax.device_put(ids_np, sh_ids)
-            st["upload_s"] += time.perf_counter() - t0
+            with _span("upload", stats=st, key="upload_s"):
+                chunk = jax.device_put(chunk_np, sh_chunk)
+                ids = jax.device_put(ids_np, sh_ids)
             fn = _idx_fn((chunk, ids), n_dev=n_dev, n_reduce=n_reduce,
                          max_word_len=mwl, u_cap=cap, size=size, mesh=mesh,
                          t_cap_frac=frac, grouper=g)
@@ -1110,9 +1110,8 @@ def indexer_streaming(
 
         def replay_wave(size, chunk_np, ids_np):
             st["replays"] += 1
-            t0 = time.perf_counter()
             cap = state["cap"]
-            try:
+            with _span("replay", stats=st, key="replay_s"):
                 while True:
                     for g in groupers:
                         for frac in (4, 2):
@@ -1133,8 +1132,6 @@ def indexer_streaming(
                         cap *= 4  # uniques <= tokens <= size/2: terminates
                         continue
                     break
-            finally:
-                st["replay_s"] += time.perf_counter() - t0
             state["cap"], state["grouper"], state["frac"] = cap, g, frac
             return rows, df, scal, scal_np
 
@@ -1168,23 +1165,20 @@ def indexer_streaming(
                     topk_svc.sync()
                     policy.reset()
                 return
-            t0 = time.perf_counter()
-            mp = occupied_prefix(m, rows.shape[1])
-            rows_np = np.asarray(rows[:, :mp])
-            st["step_pulls"] += 1
-            st["pull_s"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            for d in range(n_dev):
-                nr = int(scal_np[d, 0])
-                if nr:
-                    buffer_rows(rows_np[d, :nr])
-            st["merge_s"] += time.perf_counter() - t0
+            with _span("pull", stats=st, key="pull_s"):
+                mp = occupied_prefix(m, rows.shape[1])
+                rows_np = np.asarray(rows[:, :mp])
+                st["step_pulls"] += 1
+            with _span("merge", stats=st, key="merge_s"):
+                for d in range(n_dev):
+                    nr = int(scal_np[d, 0])
+                    if nr:
+                        buffer_rows(rows_np[d, :nr])
 
         def finish(rec):
             size, chunk_np, ids_np, rows, df, scal, cap = rec
-            t0 = time.perf_counter()
-            scal_np = np.asarray(scal)  # blocks until the kernel lands
-            st["kernel_s"] += time.perf_counter() - t0
+            with _span("kernel", stats=st, key="kernel_s"):
+                scal_np = np.asarray(scal)  # blocks until the kernel lands
             if bool(scal_np[:, 3].any()):
                 outcome["high"] = True
                 raise _AbortRung
@@ -1210,7 +1204,8 @@ def indexer_streaming(
                             stats=st, produce_key="materialize_s",
                             wait_key="materialize_wait_s",
                             inflight_key="max_inflight_waves",
-                            thread_name="dsi-idx-materializer")
+                            thread_name="dsi-idx-materializer",
+                            engine="indexer")
         try:
             pipe.run(materialize)
         except _AbortRung:
@@ -1241,14 +1236,18 @@ def indexer_streaming(
         # provably aborted before the checkpointed one began).
         rungs = tuple(m for m in rungs
                       if m >= int(resume_meta["mwl"])) or rungs
-    for mwl in rungs:
-        status, payload = run(mwl)
-        if status == "high":
-            return None
-        if status == "widen":
-            continue
-        return payload()
-    return None  # a word wider than 64 bytes: the job is the host path's
+    try:
+        for mwl in rungs:
+            status, payload = run(mwl)
+            if status == "high":
+                return None
+            if status == "widen":
+                continue
+            return payload()
+        return None  # a word wider than 64 bytes: the host path's job
+    finally:
+        if stats is not None:
+            stats.update(st)
 
 
 def write_indexer_output(result, doc_names: Sequence[str], n_reduce: int,

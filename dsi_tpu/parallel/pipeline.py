@@ -41,6 +41,8 @@ from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
 
+from dsi_tpu.obs import span as _span
+
 
 def pipeline_depth(depth: Optional[int] = None) -> int:
     """Resolve an engine's in-flight window: an explicit ``depth`` wins,
@@ -102,6 +104,16 @@ class StepPipeline:
     building items — in the producer thread at depth > 1, inline at
     depth 1), ``wait_key`` (consumer starvation on the queue) and
     ``inflight_key`` (peak window occupancy, bounded by ``depth``).
+
+    Tracing (``dsi_tpu/obs``) is instrumented HERE once for all four
+    engines: every produced item, dispatch, and finish is a span —
+    ``materialize``/``dispatch``/``finish`` carrying the step ordinal
+    and the ``engine`` label — so a traced run gets its per-step
+    timeline from the core, and the engines only add their
+    phase-specific child spans (upload/kernel/pull/merge/replay) inside
+    ``finish``.  The spans double as the stats accumulators (the
+    ``stats``/``key`` sink), so the trace totals and the phase dict are
+    the same measurement.
     """
 
     def __init__(self, *, depth: int,
@@ -110,7 +122,8 @@ class StepPipeline:
                  produce_key: str = "batch_s",
                  wait_key: str = "batch_wait_s",
                  inflight_key: str = "max_inflight_chunks",
-                 thread_name: str = "dsi-pipeline-producer"):
+                 thread_name: str = "dsi-pipeline-producer",
+                 engine: str = ""):
         self.depth = max(1, int(depth))
         self._dispatch = dispatch
         self._finish = finish
@@ -119,6 +132,7 @@ class StepPipeline:
         self._wait_key = wait_key
         self._inflight_key = inflight_key
         self._thread_name = thread_name
+        self._engine = engine or getattr(stats, "engine", "")
         stats.setdefault(produce_key, 0.0)
         stats.setdefault(wait_key, 0.0)
         stats.setdefault(inflight_key, 0)
@@ -128,14 +142,17 @@ class StepPipeline:
     def _producer(self, make_items: Callable[[], Iterator],
                   out_q: queue.Queue, stop: threading.Event) -> None:
         gen = make_items()
+        i = 0
         try:
             while True:
-                t0 = time.perf_counter()
-                try:
-                    item = next(gen)
-                except StopIteration:
-                    break
-                self._stats[self._produce_key] += time.perf_counter() - t0
+                with _span("materialize", stats=self._stats,
+                           key=self._produce_key, step=i,
+                           engine=self._engine):
+                    try:
+                        item = next(gen)
+                    except StopIteration:
+                        break
+                i += 1
                 while not stop.is_set():
                     try:
                         out_q.put(("item", item), timeout=0.2)
@@ -161,13 +178,16 @@ class StepPipeline:
               started: list) -> Iterator:
         if self.depth == 1:
             gen = make_items()
+            i = 0
             while True:
-                t0 = time.perf_counter()
-                try:
-                    item = next(gen)
-                except StopIteration:
-                    return
-                self._stats[self._produce_key] += time.perf_counter() - t0
+                with _span("materialize", stats=self._stats,
+                           key=self._produce_key, step=i,
+                           engine=self._engine):
+                    try:
+                        item = next(gen)
+                    except StopIteration:
+                        return
+                i += 1
                 yield item
             return
         thread = threading.Thread(
@@ -176,9 +196,9 @@ class StepPipeline:
         started.append(thread)
         thread.start()
         while True:
-            t0 = time.perf_counter()
-            kind, item = out_q.get()
-            self._stats[self._wait_key] += time.perf_counter() - t0
+            with _span("wait", lane="materialize", stats=self._stats,
+                       key=self._wait_key, engine=self._engine):
+                kind, item = out_q.get()
             if kind == "done":
                 return
             if kind == "err":
@@ -194,21 +214,35 @@ class StepPipeline:
         exception (producer or consumer) unwinds with the producer thread
         stopped and its queue drained."""
         pending: collections.deque = collections.deque()
+        steps: collections.deque = collections.deque()  # dispatch ordinals
         stop = threading.Event()
         out_q: queue.Queue = queue.Queue(maxsize=self.depth + 1)
         started: list = []
+        idx = 0
+
+        def finish_oldest() -> None:
+            # The per-step trace span: its wall IS the step's retire cost
+            # (deferred flag wait + merge or replay) — the unit the
+            # straggler table in scripts/tracecat.py ranks.
+            with _span("finish", lane="dispatch", step=steps.popleft(),
+                       engine=self._engine):
+                self._finish(pending.popleft())
+
         try:
             for item in self._feed(make_items, out_q, stop, started):
-                rec = self._dispatch(item)
+                with _span("dispatch", step=idx, engine=self._engine):
+                    rec = self._dispatch(item)
+                idx += 1
                 if rec is None:
                     continue
                 pending.append(rec)
+                steps.append(idx - 1)
                 if len(pending) > self._stats[self._inflight_key]:
                     self._stats[self._inflight_key] = len(pending)
                 if len(pending) >= self.depth:
-                    self._finish(pending.popleft())
+                    finish_oldest()
             while pending:
-                self._finish(pending.popleft())
+                finish_oldest()
         finally:
             if started:
                 stop.set()

@@ -111,6 +111,7 @@ from dsi_tpu.ckpt import (
 )
 from dsi_tpu.device.policy import SyncPolicy
 from dsi_tpu.device.table import DeviceTable, _quiet_unusable_donation
+from dsi_tpu.obs import metrics_scope, span as _span
 from dsi_tpu.ops.wordcount import (
     exactness_retry,
     grouper_ladder,
@@ -630,12 +631,16 @@ def wordcount_streaming(
     state = {"cap": rung0_cap(chunk_bytes, u_cap), "mwl": max_word_len,
              "grouper": groupers[0], "frac": 4}
     sharding = NamedSharding(mesh, PartitionSpec(AXIS, None))
-    stats = {"depth": depth, "steps": 0, "replays": 0,
-             "max_inflight_chunks": 0, "donate_chunks": True,
-             "step_pulls": 0, "device_accumulate": device_accumulate,
-             "batch_s": 0.0, "batch_wait_s": 0.0, "upload_s": 0.0,
-             "kernel_s": 0.0, "pull_s": 0.0, "merge_s": 0.0,
-             "replay_s": 0.0}
+    # The engine's stats dict IS a registry scope (dsi_tpu/obs): the
+    # same keys as ever, readable by any consumer as the one documented
+    # schema — stream_phases is a view over this, not a fifth dialect.
+    stats = metrics_scope("stream")
+    stats.update({"depth": depth, "steps": 0, "replays": 0,
+                  "max_inflight_chunks": 0, "donate_chunks": True,
+                  "step_pulls": 0, "device_accumulate": device_accumulate,
+                  "batch_s": 0.0, "batch_wait_s": 0.0, "upload_s": 0.0,
+                  "kernel_s": 0.0, "pull_s": 0.0, "merge_s": 0.0,
+                  "replay_s": 0.0})
     # Device-resident accumulation: confirmed steps fold on-device, the
     # host pulls every K folds.  The table allocates lazily at the first
     # fold (its key width and capacity come from that step's shapes); the
@@ -741,22 +746,23 @@ def wordcount_streaming(
         move.  Everything in the in-flight window is deliberately
         absent — those steps were never merged, and resume re-processes
         them from the cursor."""
-        t0 = time.perf_counter()
-        arrays: dict = {}
-        meta = {"cursor": ck_cursor["offset"], "steps": ck_cursor["steps"],
-                "cap": state["cap"], "mwl": state["mwl"],
-                "grouper": state["grouper"], "frac": state["frac"]}
-        if table_svc is not None:
-            for k, v in table_svc.checkpoint_state().items():
-                arrays["table_" + k] = v
-            meta["table_cap"] = table_svc.cap
-            meta["table_kk"] = table_svc.kk
-            meta["sync_since"] = policy.snapshot()
-        for k, v in acc.snapshot().items():
-            arrays["acc_" + k] = v
-        ck_store.save(arrays, meta)
-        stats["ckpt_saves"] += 1
-        stats["ckpt_s"] += time.perf_counter() - t0
+        with _span("ckpt", stats=stats, key="ckpt_s",
+                   step=ck_cursor["steps"]):
+            arrays: dict = {}
+            meta = {"cursor": ck_cursor["offset"],
+                    "steps": ck_cursor["steps"],
+                    "cap": state["cap"], "mwl": state["mwl"],
+                    "grouper": state["grouper"], "frac": state["frac"]}
+            if table_svc is not None:
+                for k, v in table_svc.checkpoint_state().items():
+                    arrays["table_" + k] = v
+                meta["table_cap"] = table_svc.cap
+                meta["table_kk"] = table_svc.kk
+                meta["sync_since"] = policy.snapshot()
+            for k, v in acc.snapshot().items():
+                arrays["acc_" + k] = v
+            ck_store.save(arrays, meta)
+            stats["ckpt_saves"] += 1
         fault_point("post-ckpt")
     # Live host buffers = out queue (≤ depth+1) + in-flight window
     # (≤ depth) + one being filled + one being finished.
@@ -840,9 +846,9 @@ def wordcount_streaming(
         mwl, cap = state["mwl"], state["cap"]
         if on_attempt is not None:
             on_attempt(mwl, cap)
-        t0 = time.perf_counter()
-        chunks = jax.device_put(buf, sharding)
-        stats["upload_s"] += time.perf_counter() - t0
+        with _span("upload", stats=stats, key="upload_s",
+                   step=stats["steps"]):
+            chunks = jax.device_put(buf, sharding)
         keys, lens, cnts, parts, scal = step_call(
             chunks, mwl, cap, state["frac"], state["grouper"])
         if aot or device_accumulate:
@@ -874,9 +880,8 @@ def wordcount_streaming(
         """Retire the oldest in-flight step: deferred exactness check,
         then merge (clean) or replay-at-wider-shape (overflow)."""
         buf, mwl, cap, rec_offset, (scal, packed_dev, kk, tables) = record
-        t0 = time.perf_counter()
-        scal_np = np.asarray(scal)   # blocks until this step's kernel lands
-        stats["kernel_s"] += time.perf_counter() - t0
+        with _span("kernel", stats=stats, key="kernel_s"):
+            scal_np = np.asarray(scal)  # blocks until the kernel lands
         if scal_np[:, 3].any():      # non-ASCII: the whole stream is host's
             pool.give(buf)
             raise _NeedsHostPath
@@ -892,41 +897,38 @@ def wordcount_streaming(
                 # its step cleared.
                 fold_confirmed(packed_dev, scal, scal_np)
             else:
-                t0 = time.perf_counter()
-                if int(scal_np[:, 0].max()) == 0:
-                    packed, nus = None, None
-                elif packed_dev is not None:  # aot: pack already executed
-                    packed, nus = np.asarray(packed_dev), scal_np[:, 0]
-                else:
-                    packed, nus, kk = pull_packed(*tables, scal_np)
-                if packed is not None:
-                    stats["step_pulls"] += 1
-                stats["pull_s"] += time.perf_counter() - t0
-                t0 = time.perf_counter()
-                if packed is not None:
-                    acc.add_packed_step(packed, nus, kk)
-                stats["merge_s"] += time.perf_counter() - t0
+                with _span("pull", stats=stats, key="pull_s"):
+                    if int(scal_np[:, 0].max()) == 0:
+                        packed, nus = None, None
+                    elif packed_dev is not None:  # aot: pack already ran
+                        packed, nus = np.asarray(packed_dev), scal_np[:, 0]
+                    else:
+                        packed, nus, kk = pull_packed(*tables, scal_np)
+                    if packed is not None:
+                        stats["step_pulls"] += 1
+                with _span("merge", stats=stats, key="merge_s"):
+                    if packed is not None:
+                        acc.add_packed_step(packed, nus, kk)
         else:
             # Late-detected overflow: replay just this step through the
             # ladder.  Exactly-once by construction — the optimistic
             # attempt's tables are dropped unmerged, and the replay's
             # payload merges (or folds) here and nowhere else.
             stats["replays"] += 1
-            t0 = time.perf_counter()
-            payload = run_step_sync(buf, device_payload=device_accumulate)
-            if payload is None:
-                pool.give(buf)
-                stats["replay_s"] += time.perf_counter() - t0
-                raise _NeedsHostPath
-            if device_accumulate:
-                packed_dev, scal_dev, scal_np = payload()
-                fold_confirmed(packed_dev, scal_dev, scal_np)
-            else:
-                packed, nus, kk = payload()
-                if packed is not None:
-                    stats["step_pulls"] += 1
-                    acc.add_packed_step(packed, nus, kk)
-            stats["replay_s"] += time.perf_counter() - t0
+            with _span("replay", stats=stats, key="replay_s"):
+                payload = run_step_sync(buf,
+                                        device_payload=device_accumulate)
+                if payload is None:
+                    pool.give(buf)
+                    raise _NeedsHostPath
+                if device_accumulate:
+                    packed_dev, scal_dev, scal_np = payload()
+                    fold_confirmed(packed_dev, scal_dev, scal_np)
+                else:
+                    packed, nus, kk = payload()
+                    if packed is not None:
+                        stats["step_pulls"] += 1
+                        acc.add_packed_step(packed, nus, kk)
         # This step is now CONFIRMED: its output is merged/folded and
         # nothing after it is.  The fault point sits BEFORE the cursor
         # advances — the classic torn-update instant.
@@ -945,7 +947,7 @@ def wordcount_streaming(
                         stats=stats, produce_key="batch_s",
                         wait_key="batch_wait_s",
                         inflight_key="max_inflight_chunks",
-                        thread_name="dsi-stream-batcher")
+                        thread_name="dsi-stream-batcher", engine="stream")
 
     feed = skip_stream(blocks, start_offset) if start_offset else blocks
     result: Optional[Dict[str, Tuple[int, int]]]

@@ -53,6 +53,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dsi_tpu.ckpt import CheckpointPolicy, CheckpointStore, fault_point
+from dsi_tpu.obs import metrics_scope, span as _span
 from dsi_tpu.utils.jaxcompat import (enable_x64, x64_scoped,
                                      shard_map as _shard_map)
 
@@ -330,7 +331,10 @@ def tfidf_sharded(
     longest = max(doc_lens, default=1)
     size_max = 1 << max(8, int(longest).bit_length())  # capacity hard ref
     n_real = len(docs)
-    stats = wave_stats if wave_stats is not None else {}
+    # Internal registry scope (dsi_tpu/obs); copied out to the caller's
+    # ``wave_stats`` dict when the walk ends — wave_phases is a view
+    # over the one documented schema, not its own dialect.
+    stats = metrics_scope("tfidf")
     stats.update({"waves": len(waves), "step_pulls": 0, "depth": depth,
                   "replays": 0, "device_accumulate": device_accumulate,
                   "upload_s": 0.0, "kernel_s": 0.0, "pull_s": 0.0,
@@ -464,21 +468,22 @@ def tfidf_sharded(
             """Consistent snapshot at a confirmed-wave boundary: the
             device buffer's drain-free image FIRST (flushing its lag
             can drain into the host table), host residue second."""
-            t0 = time.perf_counter()
-            arrays: dict = {}
-            meta = {"mwl": mwl, "wave": ck_wave[0], "cap": state["cap"],
-                    "grouper": state["grouper"], "frac": state["frac"]}
-            if buf_dev is not None:
-                pb = buf_dev.checkpoint_state()
-                arrays["pb_buf"] = pb["buf"]
-                arrays["pb_nrows"] = pb["nrows"]
-                meta["pb_cap"] = int(pb["cap"])
-                meta["sync_since"] = policy.snapshot()
-            for k, v in table.snapshot().items():
-                arrays["pt_" + k] = v
-            ck_store.save(arrays, meta)
-            stats["ckpt_saves"] += 1
-            stats["ckpt_s"] += time.perf_counter() - t0
+            with _span("ckpt", stats=stats, key="ckpt_s",
+                       wave=ck_wave[0]):
+                arrays: dict = {}
+                meta = {"mwl": mwl, "wave": ck_wave[0],
+                        "cap": state["cap"], "grouper": state["grouper"],
+                        "frac": state["frac"]}
+                if buf_dev is not None:
+                    pb = buf_dev.checkpoint_state()
+                    arrays["pb_buf"] = pb["buf"]
+                    arrays["pb_nrows"] = pb["nrows"]
+                    meta["pb_cap"] = int(pb["cap"])
+                    meta["sync_since"] = policy.snapshot()
+                for k, v in table.snapshot().items():
+                    arrays["pt_" + k] = v
+                ck_store.save(arrays, meta)
+                stats["ckpt_saves"] += 1
             fault_point("post-ckpt")
 
         def materialize():
@@ -493,10 +498,9 @@ def tfidf_sharded(
         def wave_call(chunk_np, ids_np, size, cap, frac, g):
             """Upload + async wave dispatch at one rung.  Each attempt
             re-uploads: the compiled program donates its chunk."""
-            t0 = time.perf_counter()
-            chunk = jax.device_put(chunk_np, sh_chunk)
-            ids = jax.device_put(ids_np, sh_ids)
-            stats["upload_s"] += time.perf_counter() - t0
+            with _span("upload", stats=stats, key="upload_s"):
+                chunk = jax.device_put(chunk_np, sh_chunk)
+                ids = jax.device_put(ids_np, sh_ids)
             fn = _wave_fn((chunk, ids), n_dev=n_dev, n_reduce=n_reduce,
                           max_word_len=mwl, u_cap=cap, size=size,
                           mesh=mesh, t_cap_frac=frac, grouper=g)
@@ -517,9 +521,8 @@ def tfidf_sharded(
             of a deferred-check failure.  The cleared rung sticks for
             every later dispatch."""
             stats["replays"] += 1
-            t0 = time.perf_counter()
             cap = state["cap"]
-            try:
+            with _span("replay", stats=stats, key="replay_s"):
                 while True:
                     for g in groupers:
                         for frac in (4, 2):
@@ -540,8 +543,6 @@ def tfidf_sharded(
                         cap *= 4  # uniques <= tokens <= size/2: terminates
                         continue
                     break
-            finally:
-                stats["replay_s"] += time.perf_counter() - t0
             state["cap"], state["grouper"], state["frac"] = cap, g, frac
             return rows, scal, scal_np
 
@@ -565,25 +566,22 @@ def tfidf_sharded(
             # Pull only the occupied prefix (max per-device received
             # rows, pow2-rounded to bound the slice-program count): the
             # D2H bill tracks this wave's postings, not capacity.
-            t0 = time.perf_counter()
-            mp = occupied_prefix(m, rows.shape[1])
-            rows_np = np.asarray(rows[:, :mp])
-            stats["step_pulls"] += 1
-            stats["pull_s"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            for d in range(n_dev):
-                nr = int(scal_np[d, 0])
-                if nr:
-                    buffer_rows(rows_np[d, :nr])
-            stats["merge_s"] += time.perf_counter() - t0
+            with _span("pull", stats=stats, key="pull_s"):
+                mp = occupied_prefix(m, rows.shape[1])
+                rows_np = np.asarray(rows[:, :mp])
+                stats["step_pulls"] += 1
+            with _span("merge", stats=stats, key="merge_s"):
+                for d in range(n_dev):
+                    nr = int(scal_np[d, 0])
+                    if nr:
+                        buffer_rows(rows_np[d, :nr])
 
         def finish(rec):
             """Retire the oldest in-flight wave: deferred scalar check,
             then commit (clean) or replay-at-wider-shape (overflow)."""
             size, chunk_np, ids_np, rows, scal, cap = rec
-            t0 = time.perf_counter()
-            scal_np = np.asarray(scal)  # blocks until the kernel lands
-            stats["kernel_s"] += time.perf_counter() - t0
+            with _span("kernel", stats=stats, key="kernel_s"):
+                scal_np = np.asarray(scal)  # blocks until the kernel lands
             if bool(scal_np[:, 3].any()):
                 outcome["high"] = True
                 raise _AbortRung
@@ -611,7 +609,8 @@ def tfidf_sharded(
                             stats=stats, produce_key="materialize_s",
                             wait_key="materialize_wait_s",
                             inflight_key="max_inflight_waves",
-                            thread_name="dsi-wave-materializer")
+                            thread_name="dsi-wave-materializer",
+                            engine="tfidf")
         try:
             pipe.run(materialize)
         except _AbortRung:
@@ -631,14 +630,18 @@ def tfidf_sharded(
         # aborted before the checkpointed rung began its walk.
         rungs = tuple(m for m in rungs
                       if m >= int(resume_meta["mwl"])) or rungs
-    for mwl in rungs:
-        status, payload = run(mwl)
-        if status == "high":
-            return None
-        if status == "widen":
-            continue
-        return payload()
-    return None  # a word wider than 64 bytes: the job is the host path's
+    try:
+        for mwl in rungs:
+            status, payload = run(mwl)
+            if status == "high":
+                return None
+            if status == "widen":
+                continue
+            return payload()
+        return None  # a word wider than 64 bytes: the host path's job
+    finally:
+        if wave_stats is not None:
+            wave_stats.update(stats)
 
 
 class FileDocs:

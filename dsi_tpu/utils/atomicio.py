@@ -140,19 +140,22 @@ def read_bytes_verified(path: str) -> Optional[bytes]:
     return data
 
 
-def reap_tmp_files(directory: str) -> int:
+def reap_tmp_files(directory: str, prefix: str = ".tmp-") -> int:
     """Remove ``.tmp-*`` orphans left by writers killed mid-commit
     (``atomic_write``'s temp prefix).  Safe in a quiesced directory by
     construction: a live writer's temp file disappears at rename, so
     anything still named ``.tmp-*`` once the writers are dead is
-    garbage.  Returns the number removed."""
+    garbage.  In a directory SHARED by live writers (mrrun's trace dir),
+    pass a narrower ``prefix`` — ``.tmp-<target-name>.`` — so one
+    process only reaps its own orphans, never a committing sibling's
+    in-flight temp.  Returns the number removed."""
     n = 0
     try:
         names = os.listdir(directory)
     except OSError:
         return 0
     for name in names:
-        if name.startswith(".tmp-"):
+        if name.startswith(prefix):
             try:
                 os.remove(os.path.join(directory, name))
                 n += 1

@@ -40,7 +40,26 @@ class Span:
 
 
 def log_event(event: str, **fields) -> None:
-    """Structured one-line JSON event log (stderr), off unless DSI_TRACE=1."""
+    """Structured one-line JSON event log (stderr), off unless DSI_TRACE=1.
+
+    Every event is ALSO mirrored into the unified tracer's control-plane
+    lane (``dsi_tpu/obs``) when that is enabled — so a ``--trace-dir``
+    run captures the coordinator/worker timeline (assign/complete/
+    requeue, task spans) in its Perfetto trace without DSI_TRACE's
+    stderr stream.  Mirroring must never break the caller."""
+    try:
+        from dsi_tpu.obs.trace import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            if event == "span" and "seconds" in fields:
+                f = dict(fields)
+                name = str(f.pop("name", "span"))
+                tracer.record_span(name, float(f.pop("seconds")), **f)
+            else:
+                tracer.event(event, **fields)
+    except Exception:
+        pass
     if os.environ.get("DSI_TRACE") != "1":
         return
     rec = {"t": time.time(), "event": event}

@@ -140,6 +140,25 @@ timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
   > "$OUT/wcstream-dacc.log" 2>&1
 log "wcstream-dacc rc=$? $(tail -c 200 "$OUT/wcstream-dacc.log" | tr '\n' ' ')"
 
+log "wcstream traced run (--trace-dir: Perfetto trace + span rollups, dsi_tpu/obs)"
+# Same warmed shapes as the wcstream-dacc step, with the unified tracer
+# on: the trace.json answers the questions the on-chip sweep exists for
+# — per-step upload/pull wall over the tunnel, widen/replay causality,
+# fold/sync amortization — as a per-step timeline, not just totals.
+# tracecat.log is the text rendering (flame summary + slowest steps +
+# straggler table) summarize_onchip.py tails into the round report.
+rm -rf "$OUT/wcstream-trace" "$OUT/wcstream-trace-ck"
+mkdir -p "$OUT/wcstream-trace-wd"
+timeout -k 30s 3600s python -m dsi_tpu.cli.wcstream --check --devices 1 \
+  --aot --u-cap 16384 --device-accumulate --sync-every "${SYNC_EVERY:-8}" \
+  --checkpoint-dir "$OUT/wcstream-trace-ck" --checkpoint-every 8 \
+  --trace-dir "$OUT/wcstream-trace" --stats \
+  --workdir "$OUT/wcstream-trace-wd" "$OUT"/corpus/pg-*.txt \
+  > "$OUT/wcstream-trace.log" 2>&1
+log "wcstream-trace rc=$? $(tail -c 200 "$OUT/wcstream-trace.log" | tr '\n' ' ')"
+python scripts/tracecat.py "$OUT/wcstream-trace" > "$OUT/tracecat.log" 2>&1
+log "tracecat rc=$? $(head -c 160 "$OUT/tracecat.log" | tr '\n' ' ')"
+
 log "grepstream --check on the chip (streaming grep engine + on-device top-k/histogram)"
 # Same corpus as the wcstream steps; the CLI's default --chunk-bytes
 # (1 MiB) and pattern length 3 MUST stay in lockstep with the shapes

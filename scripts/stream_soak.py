@@ -43,9 +43,18 @@ def main() -> int:
                     help="resume from the newest valid checkpoint in "
                          "--checkpoint-dir (kill the soak with "
                          "DSI_FAULT_POINT/DSI_FAULT_STEP to exercise it)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write the soak's unified trace (dsi_tpu/obs): "
+                         "Perfetto trace.json + trace.jsonl; render "
+                         "with scripts/tracecat.py")
     args = ap.parse_args()
     if args.resume and not args.checkpoint_dir:
         ap.error("--resume requires --checkpoint-dir")
+
+    if args.trace_dir:
+        from dsi_tpu.obs import configure_tracing
+
+        configure_tracing(trace_dir=args.trace_dir)
 
     import jax
 
@@ -87,6 +96,10 @@ def main() -> int:
                               pipeline_stats=pstats)
     dt = time.perf_counter() - t0
     assert acc is not None
+    if args.trace_dir:
+        from dsi_tpu.obs import flush_tracing_report
+
+        flush_tracing_report(args.trace_dir)
     ok = all(acc.get(w, (0, 0))[0] == n_lines for w in words)
     peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     print(json.dumps({

@@ -76,7 +76,11 @@ def _bench_line(path: str) -> str:
     phases = d.get("phases")
     if phases:
         parts.append("phases=" + json.dumps(phases))
-    for k in ("stream_phases", "tfidf_phases", "grep_phases"):
+    for k in ("stream_phases", "tfidf_phases", "grep_phases",
+              # The per-phase SPAN rollups (dsi_tpu/obs): present when
+              # the bench ran traced (DSI_BENCH_TRACE=1/DSI_TRACE_DIR) —
+              # same measurements as the phases plus per-span counts/max.
+              "stream_spans", "tfidf_spans", "grep_spans"):
         if k in d:
             parts.append(f"{k}=" + json.dumps(d[k]))
     return "  " + "  ".join(parts)
@@ -243,6 +247,13 @@ def main() -> None:
     if os.path.exists(f"{out}/grepstream.log"):
         print("grepstream --check (streaming grep + on-device top-k/histogram):")
         print(_tail(f"{out}/grepstream.log", 5))
+    if os.path.exists(f"{out}/wcstream-trace.log"):
+        print("wcstream --trace-dir (unified obs trace, warmed dacc "
+              "shapes):")
+        print(_tail(f"{out}/wcstream-trace.log", 3))
+    if os.path.exists(f"{out}/tracecat.log"):
+        print("tracecat (flame summary + slowest steps + stragglers):")
+        print(_tail(f"{out}/tracecat.log", 16))
     if os.path.exists(f"{out}/ckptstream.log"):
         print("wcstream crash-resume (DSI_FAULT_POINT kill + --resume "
               "--check):")

@@ -52,7 +52,16 @@ def main() -> int:
                     help="waves between host pulls with "
                          "--device-accumulate (default: "
                          "DSI_STREAM_SYNC_EVERY or 8)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write the soak's unified trace (dsi_tpu/obs): "
+                         "Perfetto trace.json + trace.jsonl; render "
+                         "with scripts/tracecat.py")
     args = ap.parse_args()
+
+    if args.trace_dir:
+        from dsi_tpu.obs import configure_tracing
+
+        configure_tracing(trace_dir=args.trace_dir)
 
     import jax
 
@@ -90,6 +99,10 @@ def main() -> int:
                         sync_every=args.sync_every, wave_stats=wave_stats)
     wall = time.perf_counter() - t0
     assert res is not None, "tfidf fell back to host"
+    if args.trace_dir:
+        from dsi_tpu.obs import flush_tracing_report
+
+        flush_tracing_report(args.trace_dir)
 
     # Structural invariants over the whole result (vectorized on the
     # packed tables).

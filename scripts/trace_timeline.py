@@ -11,6 +11,11 @@ Usage:
     DSI_TRACE=1 python -m dsi_tpu.cli.mrrun --check wc inputs/pg-*.txt \
         2> trace.log
     python scripts/trace_timeline.py trace.log
+
+For the unified subsystem (Perfetto trace.json, per-step engine spans,
+flame/straggler rendering) use ``mrrun --trace-dir DIR`` +
+``scripts/tracecat.py DIR`` instead — this script stays for quick
+stderr-stream triage where no trace dir was configured.
 """
 
 from __future__ import annotations

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Render a dsi_tpu/obs trace as text: flame summary, slowest steps,
+straggler table, control-plane digest.
+
+Input is whatever a traced run left behind — a ``trace.jsonl`` (or
+``.json``) file, or a directory of them (``mrrun --trace-dir`` leaves
+one ``trace-<pid>.*`` pair per coordinator/worker process; all are
+merged).  No jax, no repo imports: this reads the artifacts alone, so
+it runs anywhere the trace files land (including a laptop far from the
+chip that produced them).
+
+Sections:
+
+* header      — event counts, wall span, dropped events, counters, and
+                the metrics-registry snapshot (per-engine unified phase
+                dicts) embedded at flush time;
+* flame       — per span-name totals (total seconds, count, mean, max)
+                with text bars, sorted by total: WHERE the wall went;
+* top steps   — the N slowest per-step ``finish`` spans (the pipeline
+                core's per-step retire wall: deferred flag wait + merge
+                or replay), with engine and step ordinal;
+* stragglers  — finish spans beyond max(2x median, mean + 3 sigma): the
+                outliers a speculative-execution pass would back up;
+* control     — requeue/fault/assign/complete event digest and the
+                per-worker heartbeat-age gauge, when present.
+
+Usage: python scripts/tracecat.py TRACE_OR_DIR [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+
+def _load_jsonl(path: str):
+    meta, events = {}, []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line of a killed writer
+            if rec.get("type") == "meta":
+                meta = rec
+            else:
+                events.append(rec)
+    return meta, events
+
+
+def _load_chrome(path: str):
+    """Fallback reader for the Perfetto ``.json`` when no ``.jsonl`` is
+    around (e.g. only the Chrome file was copied off the box)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    meta = doc.get("otherData", {})
+    events = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") not in ("X", "i", "C"):
+            continue
+        rec = {"ph": "I" if ev["ph"] == "i" else ev["ph"],
+               "name": ev.get("name", "?"), "lane": ev.get("cat", "?"),
+               "ts": ev.get("ts", 0) / 1e6, "dur": ev.get("dur", 0) / 1e6,
+               "depth": 0}
+        rec.update(ev.get("args") or {})
+        events.append(rec)
+    return meta, events
+
+
+def load(path: str):
+    """(metas, events) from a file or a directory of trace artifacts."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*.jsonl")))
+        if not files:
+            files = sorted(glob.glob(os.path.join(path, "*.json")))
+            files = [f for f in files if not f.endswith(".crc32")]
+        if not files:
+            sys.exit(f"tracecat: no trace artifacts under {path}")
+    else:
+        files = [path]
+    metas, events = [], []
+    for f in files:
+        meta, evs = (_load_jsonl(f) if f.endswith(".jsonl")
+                     else _load_chrome(f))
+        if meta:
+            meta["_file"] = os.path.basename(f)
+            metas.append(meta)
+        for e in evs:
+            e["_file"] = os.path.basename(f)
+        events.extend(evs)
+    return metas, events
+
+
+def _bar(frac: float, width: int = 28) -> str:
+    n = int(round(max(0.0, min(1.0, frac)) * width))
+    return "#" * n + "." * (width - n)
+
+
+def flame(events, out) -> None:
+    rows = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        r = rows.setdefault((e.get("lane", "?"), e["name"]),
+                            [0.0, 0, 0.0])
+        r[0] += e.get("dur", 0.0)
+        r[1] += 1
+        r[2] = max(r[2], e.get("dur", 0.0))
+    if not rows:
+        print("  (no spans)", file=out)
+        return
+    top = max(r[0] for r in rows.values()) or 1.0
+    print(f"  {'lane/span':<24} {'total_s':>9} {'count':>7} "
+          f"{'mean_ms':>9} {'max_ms':>9}", file=out)
+    for (lane, name), (tot, cnt, mx) in sorted(
+            rows.items(), key=lambda kv: -kv[1][0]):
+        label = f"{lane}/{name}" if lane != name else name
+        print(f"  {label:<24} {tot:>9.3f} {cnt:>7} "
+              f"{1e3 * tot / cnt:>9.2f} {1e3 * mx:>9.2f}  "
+              f"{_bar(tot / top)}", file=out)
+
+
+def _finish_spans(events):
+    return [e for e in events
+            if e.get("ph") == "X" and e.get("name") == "finish"]
+
+
+def top_steps(events, n: int, out) -> None:
+    fin = sorted(_finish_spans(events), key=lambda e: -e.get("dur", 0.0))
+    if not fin:
+        print("  (no per-step finish spans — not a pipeline trace?)",
+              file=out)
+        return
+    print(f"  {'engine':<10} {'step':>6} {'dur_ms':>10}  file", file=out)
+    for e in fin[:n]:
+        print(f"  {e.get('engine') or '?':<10} {e.get('step', '?'):>6} "
+              f"{1e3 * e.get('dur', 0.0):>10.2f}  {e.get('_file', '')}",
+              file=out)
+
+
+def stragglers(events, out) -> None:
+    fin = _finish_spans(events)
+    if len(fin) < 4:
+        print("  (too few steps for outlier statistics)", file=out)
+        return
+    durs = sorted(e.get("dur", 0.0) for e in fin)
+    n = len(durs)
+    median = durs[n // 2]
+    mean = sum(durs) / n
+    sigma = math.sqrt(sum((d - mean) ** 2 for d in durs) / n)
+    cut = max(2 * median, mean + 3 * sigma)
+    bad = [e for e in fin if e.get("dur", 0.0) > cut]
+    print(f"  steps={n} median={1e3 * median:.2f}ms mean={1e3 * mean:.2f}ms"
+          f" sigma={1e3 * sigma:.2f}ms cutoff={1e3 * cut:.2f}ms", file=out)
+    if not bad:
+        print("  no stragglers past the cutoff", file=out)
+        return
+    for e in sorted(bad, key=lambda e: -e.get("dur", 0.0)):
+        print(f"  STRAGGLER {e.get('engine') or '?'} step "
+              f"{e.get('step', '?')}: {1e3 * e.get('dur', 0.0):.2f}ms "
+              f"({e.get('dur', 0.0) / median:.1f}x median)", file=out)
+
+
+def control(events, metas, out) -> None:
+    interesting = ("requeue", "fault", "assign", "complete",
+                   "duplicate_completion", "ckpt_save", "ckpt_restore",
+                   "table_widen")
+    counts: dict = {}
+    for e in events:
+        if e.get("ph") == "I" and e.get("name") in interesting:
+            counts[e["name"]] = counts.get(e["name"], 0) + 1
+    if counts:
+        print("  events: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(counts.items())), file=out)
+    for e in events:
+        if e.get("ph") == "I" and e.get("name") in ("requeue", "fault"):
+            extras = {k: v for k, v in e.items()
+                      if k not in ("ph", "name", "lane", "ts", "dur",
+                                   "depth", "_file")}
+            print(f"  {e['name']} @ {e.get('ts', 0):.3f}s: {extras}",
+                  file=out)
+    for meta in metas:
+        gauges = (meta.get("registry") or {}).get("gauges") or {}
+        hb = gauges.get("mr_worker_heartbeat_age_s")
+        if hb:
+            print(f"  heartbeat ages [{meta.get('_file', '?')}]: "
+                  + "  ".join(f"{w}={a}s" for w, a in sorted(hb.items())),
+                  file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trace.jsonl / trace.json, or a "
+                                  "--trace-dir directory")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest steps to list (default 10)")
+    args = ap.parse_args(argv)
+    metas, events = load(args.trace)
+    out = sys.stdout
+
+    spans = sum(1 for e in events if e.get("ph") == "X")
+    wall = max((e.get("ts", 0) + e.get("dur", 0) for e in events),
+               default=0.0)
+    dropped = sum(m.get("dropped_events", 0) for m in metas)
+    print(f"== tracecat: {args.trace} ==", file=out)
+    print(f"  files={len(metas) or 1} events={len(events)} spans={spans} "
+          f"wall={wall:.3f}s dropped={dropped}", file=out)
+    for meta in metas:
+        if meta.get("counters"):
+            print(f"  counters [{meta.get('_file', '?')}]: "
+                  f"{meta['counters']}", file=out)
+        engines = (meta.get("registry") or {}).get("engines") or {}
+        for eng, phases in sorted(engines.items()):
+            ph = {k: v for k, v in phases.items()
+                  if k.endswith("_s") and isinstance(v, (int, float))
+                  and v > 0}
+            if ph:
+                print(f"  {eng} phases [{meta.get('_file', '?')}]: "
+                      + " ".join(f"{k}={round(v, 3)}"
+                                 for k, v in sorted(ph.items())),
+                      file=out)
+    print("\n-- flame (per span name) --", file=out)
+    flame(events, out)
+    print(f"\n-- top {args.top} slowest steps --", file=out)
+    top_steps(events, args.top, out)
+    print("\n-- stragglers --", file=out)
+    stragglers(events, out)
+    print("\n-- control plane --", file=out)
+    control(events, metas, out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -115,6 +115,56 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["grep_oracle_mbps"] > 0
 
 
+def test_engine_phase_dicts_come_from_the_registry(tmp_path):
+    """Schema contract (dsi_tpu/obs/registry.py): every engine's phase
+    dict IS a registered MetricsScope, and its unified view carries the
+    one documented key set — killing the stream/wave/grep key drift.
+    The alias table is closed: a legacy spelling surviving into the
+    unified view, or a brand-new drift key, fails here."""
+    jax = pytest.importorskip("jax")
+    from dsi_tpu.obs.registry import (LEGACY_ALIASES, MetricsScope,
+                                      get_registry)
+    from dsi_tpu.parallel.grepstream import (grep_streaming,
+                                             indexer_streaming)
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.streaming import wordcount_streaming
+    from dsi_tpu.parallel.tfidf import tfidf_sharded
+
+    mesh = default_mesh(8)
+    text = ("alpha beta gamma delta the fox " * 400).encode()
+    assert wordcount_streaming([text], mesh=mesh, n_reduce=4,
+                               chunk_bytes=1 << 11,
+                               u_cap=1 << 9) is not None
+    assert grep_streaming([b"the fox\nno match here\nthe the\n" * 100],
+                          "the", mesh=mesh,
+                          chunk_bytes=1 << 11) is not None
+    docs = [b"alpha beta alpha", b"beta gamma", b"delta the fox"]
+    assert tfidf_sharded(docs, mesh=mesh, n_reduce=4,
+                         u_cap=1 << 8) is not None
+    assert indexer_streaming(docs, mesh=mesh, n_reduce=4,
+                             u_cap=1 << 8) is not None
+
+    reg = get_registry()
+    for engine in ("stream", "grep", "tfidf", "indexer"):
+        sc = reg.phases(engine)
+        assert isinstance(sc, MetricsScope), \
+            f"{engine} phase dict is not a registry scope"
+        assert sc.engine == engine
+        u = sc.unified()
+        # The unified phase keys every engine must report.
+        for key in ("materialize_s", "upload_s", "kernel_s", "pull_s",
+                    "merge_s", "replay_s"):
+            assert key in u, (engine, key)
+        for key in ("depth", "replays", "step_pulls"):
+            assert key in u, (engine, key)
+        # No legacy spelling leaks through the unified view.
+        assert not (set(LEGACY_ALIASES) & set(u)), (engine, u)
+    # The registry snapshot (embedded in trace artifacts) carries all
+    # four engines under the same shape.
+    snap = reg.snapshot()["engines"]
+    assert {"stream", "grep", "tfidf", "indexer"} <= set(snap)
+
+
 @pytest.mark.slow
 def test_stream_row_disabled_leaves_no_stream_keys(tmp_path):
     rc, v = run_bench(tmp_path, {"DSI_BENCH_TPU_TIMEOUTS": "0",
