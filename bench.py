@@ -1133,6 +1133,154 @@ def run_framework_row(bench_oracle_mbps: float) -> dict:
     return row
 
 
+def mesh_child(args_json: str) -> int:
+    """Child entry for the mesh A/B row: one ``wordcount_streaming``
+    pass over the given corpus on the (env-forced) 8-device virtual
+    mesh, mesh-sharded or host-merge per config, printing one JSON line
+    — result CRC (the parity bar), throughput, and the pull/widen/
+    imbalance counters the parent compares."""
+    import zlib
+
+    cfg = json.loads(args_json)
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.streaming import (stream_files,
+                                            wordcount_streaming)
+
+    mesh = default_mesh(int(cfg["n_dev"]))
+
+    def blocks():
+        for c in range(int(cfg["cycles"])):
+            if c:
+                yield b"\n"
+            yield from stream_files(cfg["files"])
+
+    pstats: dict = {}
+    t0 = time.perf_counter()
+    # depth=1 pins BOTH passes to the lockstep path: the row measures
+    # the pull SHAPE (pre-merged vs N partials), not pipelining — and on
+    # the forced-8-vdev CPU mesh this jaxlib's collectives are flaky
+    # when two in-flight programs both carry an all_to_all (observed
+    # glibc heap corruption / misrouted rows at MB-scale shapes; real
+    # chips execute in order and are unaffected).
+    acc = wordcount_streaming(
+        blocks(), mesh=mesh, n_reduce=N_REDUCE,
+        chunk_bytes=int(cfg["chunk_bytes"]), u_cap=int(cfg["u_cap"]),
+        depth=1, device_accumulate=True,
+        mesh_shards=int(cfg["mesh_shards"]), pipeline_stats=pstats)
+    dt = time.perf_counter() - t0
+    if acc is None:
+        print(json.dumps({"error": "stream needed the host path"}))
+        return 1
+    crc = zlib.crc32(repr(sorted(acc.items())).encode())
+    out = {"crc": crc, "mbps": round(cfg["mb"] / dt, 2),
+           "uniques": len(acc)}
+    for k in ("pull_bytes", "sync_pulls", "widens", "shard_widens",
+              "shard_imbalance", "folds", "steps"):
+        if k in pstats:
+            out[k] = pstats[k]
+    print(json.dumps(out))
+    return 0
+
+
+def run_mesh_row() -> dict:
+    """Mesh-vs-host-merge A/B on the 8-device virtual CPU mesh (ISSUE 7
+    satellite): the same stream run twice in subprocesses — device
+    services mesh-sharded (``mesh_shards=8``: ihash-routed shuffle-fold,
+    per-shard widens, pre-merged occupied-prefix pulls) versus the
+    host-merge device-accumulate path — reporting ``mesh_shuffle_mbps``
+    A/B throughput, host bytes pulled per sync both ways, and the
+    per-shard widen/imbalance counters.  Chip-independent structural
+    evidence (the multichip dryrun's bench twin): subprocesses because
+    the virtual 8-device mesh needs ``XLA_FLAGS`` set before jax
+    imports.  Parity bar: both children's result CRCs must match (each
+    child is the engine whose own parity grid is pinned by tier-1).
+    Measured keys XOR ``mesh_skipped`` — the bench-contract discipline.
+    ``DSI_BENCH_MESH_SHARDS=0`` disables; other values set the degree."""
+    try:
+        shards = int(os.environ.get("DSI_BENCH_MESH_SHARDS", "8"))
+    except ValueError:
+        shards = 8
+    if shards <= 0:
+        return {"mesh_skipped": "disabled (DSI_BENCH_MESH_SHARDS=0)"}
+    mb = env_float("DSI_BENCH_MESH_MB", 4.0)
+    # Controlled-vocabulary corpus (the multichip dryrun's discipline):
+    # the row isolates the pull-SHAPE effect — with ~6k uniques the
+    # hash-balanced shards' occupied prefix rounds to half the
+    # partition-placed (n_reduce % n_dev) tables' — and an uncontrolled
+    # corpus whose window vocabulary saturates the table capacity would
+    # show both paths pulling full-capacity blocks, i.e. nothing.
+    import numpy as np
+
+    mesh_dir = os.path.join(WORKDIR, "mesh-corpus")
+    os.makedirs(mesh_dir, exist_ok=True)
+    path = os.path.join(mesh_dir, "corpus.txt")
+    if not os.path.exists(path):
+        rng = np.random.default_rng(7)
+        vocab = ["".join(chr(97 + (i // 26 ** j) % 26) for j in range(4))
+                 for i in range(6000)]
+        toks = rng.integers(0, len(vocab), size=200_000)
+        with open(path, "w") as f:
+            f.write(" ".join(vocab[int(i)] for i in toks))
+    files = [path]
+    corpus_bytes = os.path.getsize(path)
+    cycles = max(1, round(mb * 1e6 / corpus_bytes))
+    cfg = {"files": files, "cycles": cycles,
+           "mb": corpus_bytes * cycles / 1e6, "n_dev": shards,
+           "chunk_bytes": 1 << 17, "u_cap": 1 << 10}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flag = f"--xla_force_host_platform_device_count={shards}"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+    budget = env_float("DSI_BENCH_MESH_TIMEOUT", 240.0)
+
+    def child(mesh_shards: int) -> dict:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-child",
+             json.dumps({**cfg, "mesh_shards": mesh_shards})],
+            capture_output=True, text=True, timeout=budget, env=env)
+        if p.returncode != 0:
+            raise RuntimeError(f"mesh child rc={p.returncode}: "
+                               f"{p.stderr[-400:]}")
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    # One retry absorbs the virtual mesh's residual collective flake
+    # (a crashed child or a torn exchange fails the CRC gate — the gate
+    # never lets a wrong pass publish throughput).
+    host = meshed = None
+    for attempt in (1, 2):
+        try:
+            host = child(0)
+            meshed = child(shards)
+        except Exception as e:
+            if attempt == 2:
+                return {"mesh_skipped": f"mesh row failed: "
+                                        f"{type(e).__name__}: {e}"}
+            continue
+        if host["crc"] == meshed["crc"]:
+            break
+        if attempt == 2:
+            return {"mesh_skipped": "mesh/host-merge parity mismatch "
+                                    "(throughput suppressed)",
+                    "mesh_parity": False}
+    row = {"mesh_shards": shards, "mesh_parity": True,
+           "mesh_mb": round(cfg["mb"], 1),
+           "mesh_shuffle_mbps": meshed["mbps"],
+           "mesh_host_mbps": host["mbps"],
+           "mesh_pull_bytes_per_sync": round(
+               meshed["pull_bytes"] / max(1, meshed["sync_pulls"])),
+           "mesh_host_pull_bytes_per_sync": round(
+               host["pull_bytes"] / max(1, host["sync_pulls"])),
+           "mesh_shard_widens": meshed.get("shard_widens", []),
+           "mesh_shard_imbalance": meshed.get("shard_imbalance", 0.0)}
+    log(f"mesh row: {row['mesh_mb']} MB x2 on {shards} virtual devices — "
+        f"shuffle {row['mesh_shuffle_mbps']} MB/s vs host-merge "
+        f"{row['mesh_host_mbps']} MB/s, pull bytes/sync "
+        f"{row['mesh_pull_bytes_per_sync']} vs "
+        f"{row['mesh_host_pull_bytes_per_sync']}, imbalance "
+        f"{row['mesh_shard_imbalance']}")
+    return row
+
+
 def run_native_oracle_row(files, oracle_out, total_mb, native_ok,
                           fw_oracle_mbps) -> dict:
     """Sequential run of the SAME C++ task bodies the native-backend
@@ -1471,6 +1619,17 @@ def main() -> None:
         except Exception as e:  # never trade the verdict for the row
             fw = {"framework_skipped":
                   f"framework row failed: {type(e).__name__}: {e}"}
+    # The mesh-sharded A/B row is chip-independent too (virtual 8-device
+    # CPU mesh in subprocesses) and rides every verdict branch.
+    if budget_s >= 60 or "DSI_BENCH_MESH_SHARDS" in os.environ:
+        try:
+            fw.update(run_mesh_row())
+        except Exception as e:
+            fw["mesh_skipped"] = (f"mesh row failed: "
+                                  f"{type(e).__name__}: {e}")
+    else:
+        # Measured-XOR-skipped holds on the fast path too.
+        fw["mesh_skipped"] = f"budget {budget_s:.0f}s < 60s"
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,
@@ -1537,4 +1696,6 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--tpu-child":
         sys.exit(tpu_child(sys.argv[2]))
+    if len(sys.argv) >= 3 and sys.argv[1] == "--mesh-child":
+        sys.exit(mesh_child(sys.argv[2]))
     main()

@@ -60,6 +60,12 @@ def main(argv=None) -> int:
     p.add_argument("--sync-every", type=_positive_int, default=None,
                    help="folds between host pulls with --device-accumulate "
                         "(default: DSI_STREAM_SYNC_EVERY or 8)")
+    p.add_argument("--mesh-shards", type=int, default=None,
+                   help="mesh-shard the device services across N shards "
+                        "(ihash %% N routing inside the fold, per-shard "
+                        "widens, pre-merged histogram pulls; implies "
+                        "--device-accumulate; default: "
+                        "DSI_STREAM_MESH_SHARDS or 0 = off)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable crash-resume checkpoints (dsi_tpu/ckpt)")
     p.add_argument("--checkpoint-every", type=_positive_int, default=None,
@@ -119,7 +125,8 @@ def main(argv=None) -> int:
             stream_files(args.files), pattern, mesh=mesh,
             chunk_bytes=args.chunk_bytes, depth=args.pipeline_depth,
             aot=args.aot, device_accumulate=args.device_accumulate,
-            sync_every=args.sync_every, topk=args.topk,
+            sync_every=args.sync_every, mesh_shards=args.mesh_shards,
+            topk=args.topk,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every, resume=args.resume,
             pipeline_stats=pstats)

@@ -67,6 +67,13 @@ def main(argv=None) -> int:
                    help="folds between host pulls with "
                         "--device-accumulate (default: "
                         "DSI_STREAM_SYNC_EVERY or 8)")
+    p.add_argument("--mesh-shards", type=int, default=None,
+                   help="mesh-shard the device table across N shards "
+                        "(ihash(key) %% N routing inside the fold "
+                        "program, per-shard widens, pre-merged sync "
+                        "pulls; implies --device-accumulate; default: "
+                        "DSI_STREAM_MESH_SHARDS or 0 = off; results "
+                        "are bit-identical either way)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable crash-resume checkpoints (dsi_tpu/ckpt): "
                         "durable snapshots of the accumulators + device "
@@ -123,7 +130,7 @@ def main(argv=None) -> int:
             chunk_bytes=args.chunk_bytes, u_cap=args.u_cap, aot=args.aot,
             depth=args.pipeline_depth,
             device_accumulate=args.device_accumulate,
-            sync_every=args.sync_every,
+            sync_every=args.sync_every, mesh_shards=args.mesh_shards,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every, resume=args.resume,
             pipeline_stats=pstats)

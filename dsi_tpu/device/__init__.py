@@ -18,7 +18,8 @@ host visibility).
   the every-K-folds pull cadence.
 """
 
-from dsi_tpu.device.policy import SyncPolicy, sync_every_default
+from dsi_tpu.device.policy import (SyncPolicy, mesh_shards_default,
+                                   sync_every_default)
 from dsi_tpu.device.table import (
     DeviceTable,
     device_fold_persisted,
@@ -44,6 +45,7 @@ __all__ = [
     "SyncPolicy",
     "device_fold_persisted",
     "histogram_persisted",
+    "mesh_shards_default",
     "sync_every_default",
     "topk_service_persisted",
     "warm_device_fold",

@@ -38,6 +38,25 @@ def sync_every_default(sync_every: int | None = None) -> int:
     return max(1, sync_every)
 
 
+#: Environment default for the mesh-sharded service degree (0 = off =
+#: the host-merge path, bit-identical historical behavior).
+_MESH_SHARDS_ENV = "DSI_STREAM_MESH_SHARDS"
+
+
+def mesh_shards_default(mesh_shards: int | None = None) -> int:
+    """Resolve the mesh-sharding degree the engines hand their device
+    services: an explicit value wins, else ``DSI_STREAM_MESH_SHARDS``
+    (default 0 = off).  One resolver so the four engines, the CLIs and
+    the soaks cannot read the knob differently — the ``sync_every``
+    discipline."""
+    if mesh_shards is None:
+        try:
+            mesh_shards = int(os.environ.get(_MESH_SHARDS_ENV, "0"))
+        except ValueError:
+            mesh_shards = 0
+    return max(0, int(mesh_shards))
+
+
 class SyncPolicy:
     """Pull the device table to the host every ``sync_every`` confirmed
     folds (plus, by caller contract, once at stream end).

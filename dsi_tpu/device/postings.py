@@ -48,6 +48,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dsi_tpu.obs import span as _span
+from dsi_tpu.ops.meshroute import compact_received, exchange_rows, route_dest
+from dsi_tpu.ops.wordcount import _PAD_KEY
 from dsi_tpu.parallel.shuffle import AXIS, occupied_prefix
 from dsi_tpu.utils.jaxcompat import shard_map
 
@@ -99,6 +101,65 @@ _append_step = jax.jit(_append_impl, static_argnames=("mesh",),
                        donate_argnums=(0, 1, 2))
 
 
+def _mesh_append_device(buf, n, dirty, rows, scal, *, cap: int, width: int,
+                        kk: int, n_dev: int, n_shards: int):
+    """Mesh-sharded append body: the wave's rows are RE-ROUTED to their
+    owning shard (``ihash(word) % n_shards``, ``ops/meshroute.py``)
+    before the scatter, so a word's postings always buffer on one shard
+    regardless of how ``n_reduce % n_dev`` placed them.  Per-word order
+    survives: a word's rows arrive from exactly one source device (the
+    step's shuffle already grouped them) and the exchange concatenates
+    source blocks in device order.  Overflow stays GLOBAL (pmax +
+    sticky dirty) — a postings overflow is an early sync, not a
+    capacity ladder, so the per-shard machinery buys nothing here."""
+    buf = buf.reshape(cap, width)
+    n0 = n.reshape(())
+    d0 = dirty.reshape(())
+    r = rows.shape[-2]
+    rows = rows.reshape(r, width)
+    nr = scal.reshape(-1)[0]
+
+    valid = jnp.arange(r, dtype=jnp.int32) < nr
+    keys = jnp.where(valid[:, None], rows[:, :kk], jnp.uint32(_PAD_KEY))
+    lens = jnp.where(valid, rows[:, kk].astype(jnp.int32), 0)
+    dest = route_dest(keys, lens, valid, n_shards=n_shards, park=n_dev)
+    recv = exchange_rows(rows, dest, n_dev=n_dev, kk=kk)
+    crows, n_recv = compact_received(recv)
+
+    idx = jnp.where(jnp.arange(n_dev * r, dtype=jnp.int32) < n_recv,
+                    n0 + jnp.arange(n_dev * r, dtype=jnp.int32), cap)
+    target = jnp.concatenate([buf, jnp.zeros((1, width), jnp.uint32)],
+                             axis=0)
+    new_buf = target.at[idx].set(crows)[:cap]
+    new_n = n0 + n_recv
+    ov = lax.pmax((new_n > cap).astype(jnp.int32), AXIS)
+    no_op = jnp.maximum(ov, d0)
+    keep_old = no_op > 0
+    out_buf = jnp.where(keep_old, buf, new_buf)
+    out_n = jnp.where(keep_old, n0, new_n)
+    flags = jnp.stack([no_op, out_n])
+    return out_buf[None], out_n[None], no_op[None], flags[None]
+
+
+def _mesh_append_impl(buf, n, dirty, rows, scal, *, mesh: Mesh, kk: int,
+                      n_shards: int):
+    cap, width = buf.shape[1], buf.shape[2]
+    body = functools.partial(_mesh_append_device, cap=cap, width=width,
+                             kk=kk, n_dev=int(mesh.devices.size),
+                             n_shards=n_shards)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(AXIS), P(AXIS),
+                  P(AXIS, None, None), P(AXIS, None)),
+        out_specs=(P(AXIS, None, None), P(AXIS), P(AXIS), P(AXIS, None)),
+    )(buf, n, dirty, rows, scal)
+
+
+_mesh_append_step = jax.jit(_mesh_append_impl,
+                            static_argnames=("mesh", "kk", "n_shards"),
+                            donate_argnums=(0, 1, 2))
+
+
 @functools.partial(jax.jit, static_argnames=("mp",))
 def _buf_prefix(buf, *, mp: int):
     return buf[:, :mp]
@@ -114,20 +175,35 @@ class DevicePostings:
 
     ``stats``, if given, receives ``appends``, ``append_overflows``,
     ``sync_pulls``, ``postings_widens``, ``append_s``, ``drain_s``.
+
+    ``mesh_shards`` > 0 re-routes every appended row to shard
+    ``ihash(word) % n_shards`` inside the compiled append (the
+    shuffle-fold treatment; ``kk`` names the key-lane count, default
+    ``width - 4`` — the (keys, len, payload...) row layout both wave
+    walks use).  Buffered postings then shard by KEY rather than by the
+    step's partition placement; the drain contract and the sticky
+    global overflow protocol are unchanged.
     """
 
     def __init__(self, mesh: Mesh, *, width: int, cap: int,
                  sink: Callable[[np.ndarray], None],
-                 lag: int = 0, stats: Optional[dict] = None):
+                 lag: int = 0, stats: Optional[dict] = None,
+                 mesh_shards: int = 0, kk: Optional[int] = None):
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
         self.width = int(width)
         self.cap = 1 << max(0, int(cap) - 1).bit_length()
         self.sink = sink
         self.lag = max(0, int(lag))
+        self.mesh_shards = max(0, int(mesh_shards))
+        self.kk = int(kk) if kk is not None else self.width - 4
+        if self.mesh_shards > self.n_dev:
+            raise ValueError(
+                f"mesh_shards={self.mesh_shards} exceeds the mesh size "
+                f"({self.n_dev} devices)")
         self.stats = stats if stats is not None else {}
         for key in ("appends", "append_overflows", "sync_pulls",
-                    "postings_widens"):
+                    "postings_widens", "pull_bytes"):
             self.stats.setdefault(key, 0)
         for key in ("append_s", "drain_s"):
             self.stats.setdefault(key, 0.0)
@@ -149,9 +225,14 @@ class DevicePostings:
     # ── the append path ──
 
     def _dispatch(self, rows_dev, scal_dev):
-        self._buf, self._n, self._dirty, flags = _append_step(
-            self._buf, self._n, self._dirty, rows_dev, scal_dev,
-            mesh=self.mesh)
+        if self.mesh_shards:
+            self._buf, self._n, self._dirty, flags = _mesh_append_step(
+                self._buf, self._n, self._dirty, rows_dev, scal_dev,
+                mesh=self.mesh, kk=self.kk, n_shards=self.mesh_shards)
+        else:
+            self._buf, self._n, self._dirty, flags = _append_step(
+                self._buf, self._n, self._dirty, rows_dev, scal_dev,
+                mesh=self.mesh)
         return flags
 
     def append(self, rows_dev, scal_dev) -> None:
@@ -214,7 +295,11 @@ class DevicePostings:
                 # widening mid-walk): grow the buffer to hold it —
                 # overflow widens, it never drops.  _alloc resets the
                 # sticky dirty bit along with the rest of the state.
-                new_cap = max(4 * self.cap, int(rows_dev.shape[-2]))
+                # Mesh routing can deliver every device's rows of one
+                # wave to a single shard, so its bound is n_dev * rows.
+                wave_rows = int(rows_dev.shape[-2]) * (
+                    self.n_dev if self.mesh_shards else 1)
+                new_cap = max(4 * self.cap, wave_rows)
                 self.cap = 1 << max(0, new_cap - 1).bit_length()
                 self._alloc(self.cap)
                 self._nrows[:] = 0
@@ -252,6 +337,23 @@ class DevicePostings:
         return {"buf": buf, "nrows": self._nrows.copy(),
                 "cap": np.array(self.cap, dtype=np.int64)}
 
+    @staticmethod
+    def drain_image(sink, img: dict) -> None:
+        """Feed a :meth:`checkpoint_state` image's committed rows to
+        ``sink`` (one ``[n, width]`` block per device, device order)
+        WITHOUT re-uploading it — the resume path when the checkpoint's
+        sharding degree differs from the live buffer's (``mesh_shards``
+        in the manifest): the rows re-enter through the host table and
+        the buffer starts empty at the new routing.  Device order is
+        per-word order for rows that predate every resumed wave, so the
+        append-order invariant survives re-routing."""
+        buf = np.asarray(img["buf"])
+        nrows = np.asarray(img["nrows"])
+        for d in range(buf.shape[0]):
+            n = int(nrows[d])
+            if n:
+                sink(buf[d, :n])
+
     def restore_state(self, img: dict) -> None:
         """Re-upload a :meth:`checkpoint_state` image (resume):
         reallocate at the image's capacity (a pre-crash widen sticks),
@@ -283,6 +385,7 @@ class DevicePostings:
             if m:
                 mp = occupied_prefix(m, self.cap)
                 pulled = np.asarray(_buf_prefix(self._buf, mp=mp))
+                self.stats["pull_bytes"] += pulled.nbytes
                 for d in range(self.n_dev):
                     nr = int(self._nrows[d])
                     if nr:

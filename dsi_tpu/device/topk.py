@@ -154,13 +154,20 @@ class DeviceTopK(DeviceTable):
     and ``widens`` the recovery drains — so an engine's host pulls are
     ``topk_snapshots + widens + 1`` against ``steps`` on the per-step
     path.
+
+    ``mesh_shards`` is inherited whole from :class:`DeviceTable`: folds
+    become the shuffle-fold (keys — opaque line identities or word
+    spellings alike — route to ``ihash(key bytes) % n_shards``), widens
+    go per-shard.  The snapshot stays per-shard top-k + host merge of
+    ``n_dev * k`` rows: a global winner is necessarily in its OWNING
+    shard's top-k under the same order, so the pruning stays exact.
     """
 
     def __init__(self, mesh: Mesh, *, kk: int, cap: int, k: int, acc,
                  aot: bool = False, lag: int = 1,
-                 stats: Optional[dict] = None):
+                 stats: Optional[dict] = None, mesh_shards: int = 0):
         super().__init__(mesh, kk=kk, cap=cap, acc=acc, aot=aot, lag=lag,
-                         stats=stats)
+                         stats=stats, mesh_shards=mesh_shards)
         self.k = int(k)
         self.stats.setdefault("topk_snapshots", 0)
         #: Last snapshot: ((count, key_lanes_tuple, len), ...) count
@@ -215,33 +222,40 @@ class DeviceTopK(DeviceTable):
 
 
 def warm_topk_service(mesh: Mesh, *, kk: int, rows: int, cap: int, k: int,
-                      table_rungs: int = 2) -> None:
+                      table_rungs: int = 2, mesh_shards: int = 0) -> None:
     """Compile + persist the fold/clear/pack/snapshot shapes a
     :class:`DeviceTopK` reaches at this per-fold ``rows`` shape: the
     given capacity rung plus ``table_rungs - 1`` ×4 widenings, from
-    shape structs alone — same discipline as
-    ``table.warm_device_fold``."""
+    shape structs alone — same discipline as ``table.warm_device_fold``
+    (which also owns the ``mesh_fold_*``/``mesh_grow_*`` variants the
+    ``mesh_shards`` flag switches to)."""
     from dsi_tpu.backends import aotcache
+    from dsi_tpu.device.table import (_warm_mesh_fold_rung,
+                                      _warm_pack_shapes)
 
     n_dev = mesh.devices.size
     cap = _pow2(cap)
-    for _ in range(max(1, table_rungs)):
+    for rung in range(max(1, table_rungs)):
         table = _table_structs(n_dev, cap, kk)
         step = _step_structs(n_dev, rows, kk)
-        name, fn = _fold_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk,
-                                 rows=rows)
-        with _quiet_unusable_donation():
-            aotcache.cached_compile(name, fn, table + step,
-                                    donate_argnums=(0, 1, 2, 3, 4),
-                                    x64=True)
+        if mesh_shards:
+            _warm_mesh_fold_rung(mesh, n_dev=n_dev, n_shards=mesh_shards,
+                                 cap=cap, kk=kk, rows=rows,
+                                 grow=rung + 1 < max(1, table_rungs))
+        else:
+            name, fn = _fold_program(mesh=mesh, n_dev=n_dev, cap=cap,
+                                     kk=kk, rows=rows)
+            with _quiet_unusable_donation():
+                aotcache.cached_compile(name, fn, table + step,
+                                        donate_argnums=(0, 1, 2, 3, 4),
+                                        x64=True)
         name, fn = _clear_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk)
         with _quiet_unusable_donation():
             aotcache.cached_compile(name, fn, table,
                                     donate_argnums=(0, 1, 2, 3, 4),
                                     x64=True)
-        name, fn = _pack_program(n_dev=n_dev, cap=cap, kk=kk, mp=cap)
-        aotcache.cached_compile(
-            name, fn, (table[0], table[1], table[3], table[2]), x64=True)
+        _warm_pack_shapes(n_dev=n_dev, cap=cap, kk=kk,
+                          mesh_shards=mesh_shards)
         name, fn = _topk_program(n_dev=n_dev, cap=cap, kk=kk, k=k)
         aotcache.cached_compile(name, fn, (table[0], table[1], table[2]),
                                 x64=True)
@@ -249,21 +263,34 @@ def warm_topk_service(mesh: Mesh, *, kk: int, rows: int, cap: int, k: int,
 
 
 def topk_service_persisted(mesh: Mesh, *, kk: int, rows: int, cap: int,
-                           k: int) -> bool:
+                           k: int, mesh_shards: int = 0) -> bool:
     """True when the rung-0 programs a :class:`DeviceTopK` executes at
-    this shape are already in the persistent AOT cache."""
+    this shape are already in the persistent AOT cache.  With
+    ``mesh_shards`` the probe keys on the ``mesh_fold_*`` shuffle-fold
+    (the program a mesh run compiles first), mirroring
+    ``table.device_fold_persisted``."""
     from dsi_tpu.backends.aotcache import is_persisted
-    from dsi_tpu.device.table import _TABLE_DONATE
+    from dsi_tpu.device.table import (_TABLE_DONATE, _apply_struct,
+                                      _mesh_fold_program)
 
     n_dev = mesh.devices.size
     cap = _pow2(cap)
     table = _table_structs(n_dev, cap, kk)
     step = _step_structs(n_dev, rows, kk)
-    name, fn = _fold_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk,
-                             rows=rows)
-    if not is_persisted(name, fn, table + step,
-                        donate_argnums=_TABLE_DONATE):
-        return False
+    if mesh_shards:
+        name, fn = _mesh_fold_program(mesh=mesh, n_dev=n_dev,
+                                      n_shards=mesh_shards, cap=cap,
+                                      kk=kk, rows=rows)
+        if not is_persisted(name, fn,
+                            table + step + (_apply_struct(n_dev),),
+                            donate_argnums=_TABLE_DONATE):
+            return False
+    else:
+        name, fn = _fold_program(mesh=mesh, n_dev=n_dev, cap=cap, kk=kk,
+                                 rows=rows)
+        if not is_persisted(name, fn, table + step,
+                            donate_argnums=_TABLE_DONATE):
+            return False
     name, fn = _pack_program(n_dev=n_dev, cap=cap, kk=kk, mp=cap)
     if not is_persisted(name, fn, (table[0], table[1], table[3], table[2])):
         return False
@@ -289,6 +316,25 @@ def _hist_program(*, n_dev: int, slots: int):
     return f"topk_hist_fold_d{n_dev}_s{slots}", fn
 
 
+def _hist_premerge_impl(state):
+    """Cross-shard reduction ON DEVICE: the mesh-sharded pull sums the
+    per-device slot vectors over the mesh (one all-reduce) so the host
+    pulls ONE pre-merged ``[slots]`` vector instead of N partials —
+    1/n_dev the bytes, zero host merge."""
+    with enable_x64(True):
+        return jnp.sum(state, axis=0, dtype=jnp.uint64)
+
+
+_hist_premerge_jit = x64_scoped(jax.jit(_hist_premerge_impl))
+
+
+def _hist_premerge_program(*, n_dev: int, slots: int):
+    def fn(state):
+        return _hist_premerge_impl(state)
+
+    return f"mesh_hist_pull_d{n_dev}_s{slots}", fn
+
+
 def _hist_structs(n_dev: int, slots: int):
     sds = jax.ShapeDtypeStruct
     return (sds((n_dev, slots), jnp.uint64), sds((n_dev, slots), jnp.uint32))
@@ -307,19 +353,28 @@ class DeviceHistogram:
 
     ``pull()`` returns the running totals summed over devices without
     clearing; ``close()`` is the final pull.  ``stats`` receives
-    ``hist_folds``/``hist_pulls``/``hist_s``.
+    ``hist_folds``/``hist_pulls``/``hist_s``/``pull_bytes``.
+
+    ``mesh_shards`` > 0 pre-merges the pull ON DEVICE (one all-reduce
+    over the mesh): the host receives a single ``[slots]`` vector
+    instead of the ``[n_dev, slots]`` partials it used to sum itself —
+    the literal N-partial-tables → one-pre-merged-table reduction,
+    visible in ``pull_bytes``.
     """
 
     def __init__(self, mesh: Mesh, *, slots: int, aot: bool = False,
-                 stats: Optional[dict] = None):
+                 stats: Optional[dict] = None, mesh_shards: int = 0):
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
         self.slots = int(slots)
         self.aot = bool(aot)
+        self.mesh_shards = max(0, int(mesh_shards))
         self.stats = stats if stats is not None else {}
-        for key in ("hist_folds", "hist_pulls"):
+        for key in ("hist_folds", "hist_pulls", "pull_bytes"):
             self.stats.setdefault(key, 0)
         self.stats.setdefault("hist_s", 0.0)
+        if self.mesh_shards:
+            self.stats.setdefault("mesh_shards", self.mesh_shards)
         sh = NamedSharding(mesh, P(AXIS, None))
         with enable_x64(True):
             self._state = jax.device_put(
@@ -345,12 +400,32 @@ class DeviceHistogram:
                 self._state = self._fold_fn()(self._state, step_dev)
             self.stats["hist_folds"] += 1
 
+    def _premerge_fn(self):
+        if not self.aot:
+            return _hist_premerge_jit
+        from dsi_tpu.backends import aotcache
+
+        name, fn = _hist_premerge_program(n_dev=self.n_dev,
+                                          slots=self.slots)
+        return aotcache.cached_compile(
+            name, fn, (_hist_structs(self.n_dev, self.slots)[0],),
+            x64=True)
+
     def pull(self) -> np.ndarray:
         """Running totals summed over devices — ``[slots]`` int64.  No
-        clear: the vector keeps accumulating on device."""
+        clear: the vector keeps accumulating on device.  Mesh-sharded
+        mode sums on device first and pulls one pre-merged vector
+        (lane: the shuffle is the merge)."""
         with _span("hist_pull", lane="sync", stats=self.stats,
                    key="hist_s"):
-            out = np.asarray(self._state).astype(np.int64).sum(axis=0)
+            if self.mesh_shards:
+                merged = np.asarray(self._premerge_fn()(self._state))
+                self.stats["pull_bytes"] += merged.nbytes
+                out = merged.astype(np.int64)
+            else:
+                full = np.asarray(self._state)
+                self.stats["pull_bytes"] += full.nbytes
+                out = full.astype(np.int64).sum(axis=0)
             self.stats["hist_pulls"] += 1
         return out
 
@@ -374,8 +449,9 @@ class DeviceHistogram:
                 np.asarray(img["hist"], np.uint64), sh)
 
 
-def warm_histogram(mesh: Mesh, *, slots: int) -> None:
-    """Compile + persist the histogram fold at this slot count."""
+def warm_histogram(mesh: Mesh, *, slots: int, mesh_shards: int = 0) -> None:
+    """Compile + persist the histogram fold at this slot count (plus,
+    with ``mesh_shards``, the pre-merged ``mesh_hist_pull_*`` pull)."""
     from dsi_tpu.backends import aotcache
 
     name, fn = _hist_program(n_dev=mesh.devices.size, slots=slots)
@@ -383,11 +459,26 @@ def warm_histogram(mesh: Mesh, *, slots: int) -> None:
         aotcache.cached_compile(name, fn,
                                 _hist_structs(mesh.devices.size, slots),
                                 donate_argnums=(0,), x64=True)
+    if mesh_shards:
+        name, fn = _hist_premerge_program(n_dev=mesh.devices.size,
+                                          slots=slots)
+        aotcache.cached_compile(
+            name, fn, (_hist_structs(mesh.devices.size, slots)[0],),
+            x64=True)
 
 
-def histogram_persisted(mesh: Mesh, *, slots: int) -> bool:
+def histogram_persisted(mesh: Mesh, *, slots: int,
+                        mesh_shards: int = 0) -> bool:
     from dsi_tpu.backends.aotcache import is_persisted
 
     name, fn = _hist_program(n_dev=mesh.devices.size, slots=slots)
-    return is_persisted(name, fn, _hist_structs(mesh.devices.size, slots),
-                        donate_argnums=(0,))
+    if not is_persisted(name, fn,
+                        _hist_structs(mesh.devices.size, slots),
+                        donate_argnums=(0,)):
+        return False
+    if mesh_shards:
+        name, fn = _hist_premerge_program(n_dev=mesh.devices.size,
+                                          slots=slots)
+        return is_persisted(
+            name, fn, (_hist_structs(mesh.devices.size, slots)[0],))
+    return True

@@ -34,6 +34,20 @@ Counters / gauges: ``steps`` (or ``waves``), ``depth``, ``replays``,
 ``buffer_allocs``, ``ckpt_saves``, ``ckpt_every``, ``resume_gap_s``,
 ``resume_cursor``/``resume_wave``, ``device_accumulate``.
 
+Mesh-sharded service keys (``mesh_shards`` > 0, the shuffle-fold path
+— ``device/table.py``): ``mesh_shards`` (the sharding degree),
+``pull_bytes`` (total D2H drain payload, counted in BOTH modes — the
+bench A/B's evidence), ``shard_widens`` (per-shard widen counts, a
+length-``n_dev`` list whose sum tracks the per-shard drain→realloc→
+re-fold recoveries), ``shard_imbalance`` (max/mean shard occupancy
+after the last confirmed fold; ~1.0 under FNV routing), and
+``resharded_resume`` (set when a resume crossed sharding degrees via
+the drain path; its value is the checkpoint's OLD degree, which is
+legitimately 0 resuming a host-merge image into a mesh run — key
+presence, not truthiness, is the signal).  Fold spans land in the tracer's ``shuffle`` lane in
+mesh mode; span totals still reconcile with ``fold_s`` — the span IS
+the stats accumulator.
+
 Engines keep their historical spellings inside the scope (external
 consumers — tests, soaks, BENCH artifacts — read those keys today);
 :meth:`MetricsScope.unified` maps the legacy spellings onto the schema

@@ -58,7 +58,8 @@ from typing import Dict, List, Optional, Tuple
 #: display order, then the device-service lanes, then the control plane.
 LANES = (
     "materialize", "upload", "dispatch", "kernel", "pull", "merge",
-    "replay", "fold", "sync", "widen", "ckpt", "control", "counters",
+    "replay", "shuffle", "fold", "sync", "widen", "ckpt", "control",
+    "counters",
 )
 
 _BUFFER_ENV = "DSI_TRACE_BUFFER_EVENTS"

@@ -1,0 +1,121 @@
+"""On-device key routing for mesh-sharded device services.
+
+The paper's shuffle is a partition function: a key belongs to reduce
+task ``ihash(key) % NReduce`` (Dean & Ghemawat §3.1; ``mr/worker.go:76``
+— bit-exact here as ``fnv32a(key) & 0x7fffffff``).  The SPMD job step
+already runs that rule on device for its *per-step* exchange
+(``parallel/shuffle.py``), but the persistent device services
+(``dsi_tpu/device/``) historically accepted whatever placement the step
+handed them: per-device state islands whose key ownership depended on
+``n_reduce % n_dev`` accidents (grep's top-k candidates were not routed
+at all — a line's counts lived wherever its chunk happened to land).
+
+This module is the routing half of the mesh-sharded fold programs: one
+place that computes, ON DEVICE, the owning shard of every packed row —
+``ihash(key) % n_shards`` over the row's actual key bytes — and
+exchanges rows over the mesh so each shard folds exactly the keys it
+owns.  The fold programs (``device/table.py`` ``mesh_fold_*``,
+``device/postings.py`` ``mesh_app_*``) call these helpers inside their
+``shard_map`` bodies; the hash is ``ops.wordcount.fnv1a32_packed``, so
+the device route agrees byte-for-byte with the host oracle
+``mr.worker.ihash`` (the shard-routing property test pins this).
+
+Routing contract, stated exactly:
+
+* a row's key bytes are its ``kk`` big-endian uint32 lanes, hashed over
+  the first ``len`` bytes (the lanes' packing rule,
+  ``ops/wordcount.py``) — for word keys that IS the word's spelling;
+  for opaque keys (grep's global line numbers: kk=2, len=8) it is the
+  8-byte big-endian identity, which balances equally well;
+* the owning shard is ``(fnv1a32(key) & 0x7fffffff) % n_shards``;
+* rows flagged invalid are parked on the exchange's dump row and never
+  leave their source device;
+* the exchange preserves per-source row order within a destination (the
+  all_to_all concatenates source blocks in device order), which is what
+  keeps the postings buffer's per-word append order an invariant under
+  re-routing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dsi_tpu.ops.wordcount import _PAD_KEY, fnv1a32_packed
+
+
+def route_dest(keys: jax.Array, lens: jax.Array, valid: jax.Array, *,
+               n_shards: int, park: int) -> jax.Array:
+    """Owning shard per row: ``ihash(key) % n_shards`` for valid rows,
+    ``park`` (the exchange's dump destination, = n_dev) otherwise.
+
+    ``keys`` [rows, kk] uint32 big-endian lanes, ``lens`` [rows] int32
+    key byte lengths, ``valid`` [rows] bool.  The hash is the
+    reference-exact FNV-1a over the first ``len`` key bytes — the same
+    ``ihash`` the host partitioner uses (``mr/worker.py``), so host and
+    device can never disagree about ownership.
+    """
+    kk = keys.shape[1]
+    h = fnv1a32_packed(keys, lens, 4 * kk)
+    part = h & jnp.uint32(0x7FFFFFFF)
+    dest = (part % jnp.uint32(n_shards)).astype(jnp.int32)
+    return jnp.where(valid, dest, jnp.int32(park))
+
+
+def exchange_rows(rows: jax.Array, dest: jax.Array, *, n_dev: int,
+                  kk: int) -> jax.Array:
+    """All-to-all one device's packed rows to their owning shards.
+
+    ``rows`` [r, kk+p] uint32 (key lanes + payload), ``dest`` [r] int32
+    with ``n_dev`` parking invalid rows.  Returns [n_dev*r, kk+p]: the
+    rows this shard received, source blocks concatenated in device
+    order, each block valid-prefix-then-pad (pad rows carry ``_PAD_KEY``
+    key lanes and zero payload, so they sort last and fold as empty).
+    Runs inside a ``shard_map`` body over the shared mesh axis.
+    """
+    from dsi_tpu.parallel.shuffle import shuffle_rows
+
+    return shuffle_rows(rows, dest, n_dev=n_dev,
+                        u_cap=int(rows.shape[0]), k=kk)
+
+
+def compact_received(recv: jax.Array) -> tuple:
+    """Compact an :func:`exchange_rows` result: real rows to the front,
+    order preserved (stable sort on the pad bit), pad rows after.
+    Returns ``(rows, n_valid)`` — the order-preserving prefix the
+    postings buffer's append scatter consumes.
+    """
+    r = recv.shape[0]
+    is_pad = (recv[:, 0] == jnp.uint32(_PAD_KEY)).astype(jnp.int32)
+    order = jnp.argsort(is_pad, stable=True)
+    n_valid = (r - jnp.sum(is_pad)).astype(jnp.int32)
+    return recv[order], n_valid
+
+
+def host_shard_of(word_bytes: bytes, n_shards: int) -> int:
+    """The host oracle for :func:`route_dest` — ``mr.worker`` ihash over
+    the key bytes, mod the shard count.  Tests pin device == host."""
+    from dsi_tpu.mr.worker import fnv32a
+
+    return (fnv32a(word_bytes) & 0x7FFFFFFF) % n_shards
+
+
+def pack_host_rows(words, n_shards: int, kk: int):
+    """Host-side packing of byte-string keys into the routed-row layout
+    (big-endian uint32 lanes + length) plus the oracle shard of each —
+    the property test's bridge between Python byte strings and the
+    device routing program's inputs.  Returns (keys [n, kk] uint32,
+    lens [n] int32, shards [n] int32)."""
+    import numpy as np
+
+    n = len(words)
+    keys = np.zeros((n, kk), dtype=np.uint32)
+    lens = np.zeros(n, dtype=np.int32)
+    shards = np.zeros(n, dtype=np.int32)
+    for i, w in enumerate(words):
+        b = w.ljust(4 * kk, b"\x00")[:4 * kk]
+        keys[i] = np.frombuffer(b, dtype=">u4").astype(np.uint32)
+        lens[i] = len(w)
+        shards[i] = host_shard_of(w, n_shards)
+    return keys, lens, shards

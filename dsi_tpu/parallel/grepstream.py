@@ -74,8 +74,9 @@ from dsi_tpu.ckpt import (
     fault_point,
     skip_stream,
 )
-from dsi_tpu.device.policy import SyncPolicy
-from dsi_tpu.device.table import _pow2, _quiet_unusable_donation
+from dsi_tpu.device.policy import SyncPolicy, mesh_shards_default
+from dsi_tpu.device.table import (DeviceTable, _pow2,
+                                  _quiet_unusable_donation)
 from dsi_tpu.device.topk import DeviceHistogram, DeviceTopK, KeyCounts
 from dsi_tpu.obs import metrics_scope, span as _span
 from dsi_tpu.ops.grepk import is_literal_pattern, line_cap_rungs
@@ -420,7 +421,8 @@ def grep_streaming(
         blocks: Iterable[bytes], pattern: str, mesh: Mesh | None = None,
         chunk_bytes: int = 1 << 20, depth: Optional[int] = None,
         aot: bool = False, device_accumulate: bool = False,
-        sync_every: Optional[int] = None, topk: int = DEFAULT_TOPK,
+        sync_every: Optional[int] = None,
+        mesh_shards: Optional[int] = None, topk: int = DEFAULT_TOPK,
         bins: int = GREP_BINS, pipeline_stats: Optional[dict] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: Optional[int] = None, resume: bool = False,
@@ -451,6 +453,13 @@ def grep_streaming(
     exact uint64 adds, candidate keys (global line numbers) are unique,
     and the close drain hands the host the complete multiset the
     per-step path would have pulled.
+
+    ``mesh_shards`` (default ``DSI_STREAM_MESH_SHARDS``, 0 = off;
+    implies ``device_accumulate``) mesh-shards both services: candidate
+    folds route line keys by ``ihash % n_shards`` with an in-program
+    all-to-all (per-shard widens, ``shard_widens``/``shard_imbalance``)
+    and histogram pulls pre-merge on device to one ``[slots]`` vector.
+    Results stay bit-identical.
 
     ``pipeline_stats`` mirrors ``wordcount_streaming``'s dict
     (``batch_s``/``batch_wait_s``/``upload_s``/``kernel_s``/``pull_s``/
@@ -495,7 +504,15 @@ def grep_streaming(
     totals = np.zeros(3, dtype=np.int64)  # lines, matched, occurrences
     cand_h: List[Tuple[int, int]] = []
 
-    # Device services.
+    # Device services.  ``mesh_shards`` makes them mesh-sharded
+    # (device/table.py module docs): candidate keys — global line
+    # numbers — route to ``ihash % n_shards`` inside the fold, the
+    # top-k widen goes per-shard, and the histogram pull pre-merges on
+    # device (one [slots] vector instead of n_dev partials).
+    mesh_shards = mesh_shards_default(mesh_shards)
+    if mesh_shards:
+        device_accumulate = True
+        stats["device_accumulate"] = True
     acc = KeyCounts()
     hist_svc: Optional[DeviceHistogram] = None
     topk_svc: Optional[DeviceTopK] = None
@@ -503,11 +520,13 @@ def grep_streaming(
     if device_accumulate:
         policy = SyncPolicy(sync_every)
         stats["sync_every"] = policy.sync_every
+        stats["mesh_shards"] = mesh_shards
         hist_svc = DeviceHistogram(mesh, slots=bins + 3, aot=aot,
-                                   stats=stats)
+                                   stats=stats, mesh_shards=mesh_shards)
         topk_svc = DeviceTopK(mesh, kk=2, cap=_default_topk_cap(n_dev, topk),
                               k=topk, acc=acc, aot=aot,
-                              lag=max(0, depth - 1), stats=stats)
+                              lag=max(0, depth - 1), stats=stats,
+                              mesh_shards=mesh_shards)
 
     # ── checkpoint/restore (dsi_tpu/ckpt) ──
     ck_store: Optional[CheckpointStore] = None
@@ -542,9 +561,17 @@ def grep_streaming(
                     if "hist" in arrays:
                         hist_svc.restore_state({"hist": arrays["hist"]})
                     if meta.get("table_cap"):
-                        topk_svc.restore_state(
-                            {k[6:]: v for k, v in arrays.items()
-                             if k.startswith("table_")})
+                        img = {k[6:]: v for k, v in arrays.items()
+                               if k.startswith("table_")}
+                        if int(meta.get("mesh_shards", 0)) == mesh_shards:
+                            topk_svc.restore_state(img)
+                        else:
+                            # Sharding degree changed since the
+                            # checkpoint: re-enter via the drain path
+                            # (manifest `mesh_shards` contract).
+                            DeviceTable.drain_image(acc, img)
+                            stats["resharded_resume"] = int(
+                                meta.get("mesh_shards", 0))
                     policy.restore(meta.get("sync_since", 0))
                 else:
                     if "gs_hist" in arrays:
@@ -573,6 +600,7 @@ def grep_streaming(
                     arrays["table_" + k] = v
                 meta["table_cap"] = topk_svc.cap
                 meta["table_kk"] = topk_svc.kk
+                meta["mesh_shards"] = topk_svc.mesh_shards
                 arrays["hist"] = hist_svc.checkpoint_state()["hist"]
                 for k, v in acc.snapshot().items():
                     arrays["kc_" + k] = v
@@ -727,12 +755,14 @@ def grep_streaming(
 def warm_grepstream_aot(mesh: Mesh | None = None,
                         chunk_bytes: int = 1 << 20, pattern_len: int = 3,
                         bins: int = GREP_BINS, topk: int = DEFAULT_TOPK,
-                        device_accumulate: bool = False) -> None:
+                        device_accumulate: bool = False,
+                        mesh_shards: int = 0) -> None:
     """Compile + persist the grep step programs at BOTH ``l_cap`` rungs
     (the optimistic and the ``n + 1`` replay shape — an ungated
     escalation must load, never cold-compile) plus, with
     ``device_accumulate``, the top-k fold/snapshot and histogram fold
-    shapes.  From shape structs alone; mirror of ``warm_stream_aot``."""
+    shapes (the ``mesh_*`` shuffle-fold variants under ``mesh_shards``).
+    From shape structs alone; mirror of ``warm_stream_aot``."""
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -745,18 +775,20 @@ def warm_grepstream_aot(mesh: Mesh | None = None,
 
         warm_topk_service(mesh, kk=2, rows=topk,
                           cap=_default_topk_cap(n_dev, topk), k=topk,
-                          table_rungs=2)
-        warm_histogram(mesh, slots=bins + 3)
+                          table_rungs=2, mesh_shards=mesh_shards)
+        warm_histogram(mesh, slots=bins + 3, mesh_shards=mesh_shards)
 
 
 def grepstream_persisted(mesh: Mesh | None = None,
                          chunk_bytes: int = 1 << 20, pattern_len: int = 3,
                          bins: int = GREP_BINS, topk: int = DEFAULT_TOPK,
-                         device_accumulate: bool = False) -> bool:
+                         device_accumulate: bool = False,
+                         mesh_shards: int = 0) -> bool:
     """True when every program a ``grep_streaming`` run at these shapes
-    can reach (both ``l_cap`` rungs; plus the device services') is in
-    the persistent AOT cache — the bench grep row's cold-compile gate,
-    same discipline as ``stream_programs_persisted``."""
+    can reach (both ``l_cap`` rungs; plus the device services', keyed on
+    the ``mesh_*`` variants under ``mesh_shards``) is in the persistent
+    AOT cache — the bench grep row's cold-compile gate, same discipline
+    as ``stream_programs_persisted``."""
     from dsi_tpu.backends.aotcache import is_persisted
 
     if mesh is None:
@@ -776,9 +808,10 @@ def grepstream_persisted(mesh: Mesh | None = None,
 
         if not topk_service_persisted(mesh, kk=2, rows=topk,
                                       cap=_default_topk_cap(n_dev, topk),
-                                      k=topk):
+                                      k=topk, mesh_shards=mesh_shards):
             return False
-        if not histogram_persisted(mesh, slots=bins + 3):
+        if not histogram_persisted(mesh, slots=bins + 3,
+                                   mesh_shards=mesh_shards):
             return False
     return True
 
@@ -890,7 +923,8 @@ def indexer_streaming(
         docs: Sequence[bytes], mesh: Mesh | None = None, n_reduce: int = 10,
         max_word_len: int = 16, u_cap: int = 1 << 15,
         depth: Optional[int] = None, device_accumulate: bool = False,
-        sync_every: Optional[int] = None, topk: int = DEFAULT_TOPK,
+        sync_every: Optional[int] = None,
+        mesh_shards: Optional[int] = None, topk: int = DEFAULT_TOPK,
         stats: Optional[dict] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: Optional[int] = None, resume: bool = False,
@@ -917,7 +951,11 @@ def indexer_streaming(
     ``sync_every`` waves and the df leaders as k-row snapshots, with
     the close drain completing the exact result.  Both the postings
     (including per-word posting order) and the top-k are bit-identical
-    to the per-wave pull path.
+    to the per-wave pull path.  ``mesh_shards`` (default
+    ``DSI_STREAM_MESH_SHARDS``; implies ``device_accumulate``)
+    re-routes both services by ``ihash(word) % n_shards`` inside their
+    compiled programs — the mesh-sharded treatment, bit-identical
+    output included.
 
     ``checkpoint_dir``/``checkpoint_every``/``resume`` follow the
     streaming engines' crash-resume contract (``dsi_tpu/ckpt``): the
@@ -934,6 +972,12 @@ def indexer_streaming(
         mesh = default_mesh()
     n_dev = mesh.devices.size
     depth = pipeline_depth(depth)
+    # ``mesh_shards`` re-routes the postings buffer AND the df top-k by
+    # ``ihash(word) % n_shards`` inside their compiled programs — word
+    # state shards by key, not by ``n_reduce % n_dev`` placement.
+    mesh_shards = mesh_shards_default(mesh_shards)
+    if mesh_shards:
+        device_accumulate = True
     from dsi_tpu.parallel.tfidf import _wave_chunk, plan_waves
 
     doc_lens = getattr(docs, "lengths", None)
@@ -1005,9 +1049,11 @@ def indexer_streaming(
             buf_dev = DevicePostings(
                 mesh, width=kk + 4,
                 cap=pcap if pcap > 0 else n_dev * state["cap"],
-                sink=buffer_rows, lag=max(0, depth - 1), stats=st)
+                sink=buffer_rows, lag=max(0, depth - 1), stats=st,
+                mesh_shards=mesh_shards, kk=kk)
             policy = SyncPolicy(sync_every)
             st["sync_every"] = policy.sync_every
+            st["mesh_shards"] = mesh_shards
 
         # A checkpoint belongs to ONE word-window rung (a widen re-keys
         # every row and restarts the walk, discarding rung state): apply
@@ -1030,23 +1076,36 @@ def indexer_streaming(
                 table.restore({k[3:]: v for k, v in resume_arrays.items()
                                if k.startswith("pt_")})
                 if device_accumulate:
+                    saved_shards = int(resume_meta.get("mesh_shards", 0))
                     if resume_meta.get("pb_cap"):
-                        buf_dev.restore_state(
-                            {"buf": resume_arrays["pb_buf"],
-                             "nrows": resume_arrays["pb_nrows"],
-                             "cap": resume_meta["pb_cap"]})
+                        pb_img = {"buf": resume_arrays["pb_buf"],
+                                  "nrows": resume_arrays["pb_nrows"],
+                                  "cap": resume_meta["pb_cap"]}
+                        if saved_shards == mesh_shards:
+                            buf_dev.restore_state(pb_img)
+                        else:
+                            # Degree changed: the buffered rows re-enter
+                            # through the drain path — host table first,
+                            # buffer starts empty at the new routing.
+                            DevicePostings.drain_image(buffer_rows, pb_img)
+                            st["resharded_resume"] = saved_shards
                     df_acc.restore(
                         {k[3:]: v for k, v in resume_arrays.items()
                          if k.startswith("df_")})
                     if resume_meta.get("table_cap"):
-                        topk_svc = DeviceTopK(
-                            mesh, kk=int(resume_meta["table_kk"]),
-                            cap=int(resume_meta["table_cap"]), k=topk,
-                            acc=df_acc, aot=False,
-                            lag=max(0, depth - 1), stats=st)
-                        topk_svc.restore_state(
-                            {k[6:]: v for k, v in resume_arrays.items()
-                             if k.startswith("table_")})
+                        img = {k[6:]: v for k, v in resume_arrays.items()
+                               if k.startswith("table_")}
+                        if saved_shards == mesh_shards:
+                            topk_svc = DeviceTopK(
+                                mesh, kk=int(resume_meta["table_kk"]),
+                                cap=int(resume_meta["table_cap"]), k=topk,
+                                acc=df_acc, aot=False,
+                                lag=max(0, depth - 1), stats=st,
+                                mesh_shards=mesh_shards)
+                            topk_svc.restore_state(img)
+                        else:
+                            DeviceTable.drain_image(df_acc, img)
+                            st["resharded_resume"] = saved_shards
                     policy.restore(resume_meta.get("sync_since", 0))
                 st["resume_gap_s"] = round(time.perf_counter() - t_res, 4)
                 st["resume_wave"] = start_wave
@@ -1068,6 +1127,7 @@ def indexer_streaming(
                     arrays["pb_buf"] = pb["buf"]
                     arrays["pb_nrows"] = pb["nrows"]
                     meta["pb_cap"] = int(pb["cap"])
+                    meta["mesh_shards"] = buf_dev.mesh_shards
                     if topk_svc is not None:
                         for k, v in topk_svc.checkpoint_state().items():
                             arrays["table_" + k] = v
@@ -1151,7 +1211,8 @@ def indexer_streaming(
                         mesh, kk=kk,
                         cap=_topk_cap_env() or int(df.shape[1]),
                         k=topk, acc=df_acc, aot=False,
-                        lag=max(0, depth - 1), stats=st)
+                        lag=max(0, depth - 1), stats=st,
+                        mesh_shards=mesh_shards)
                 pulls_before = st["sync_pulls"]
                 buf_dev.append(rows, scal)
                 topk_svc.fold(df, scal, scal_np)
@@ -1268,7 +1329,8 @@ def write_indexer_output(result, doc_names: Sequence[str], n_reduce: int,
 def warm_indexer_aot(mesh: Mesh | None = None, sizes: Sequence[int] = (
         1 << 18,), n_reduce: int = 10, word_lens: Sequence[int] = (16,),
         caps: Sequence[int] = (1 << 14,), fracs: Sequence[int] = (4, 2),
-        topk: int = DEFAULT_TOPK, device_accumulate: bool = False) -> None:
+        topk: int = DEFAULT_TOPK, device_accumulate: bool = False,
+        mesh_shards: int = 0) -> None:
     """Compile + persist the ``idx_wave_*`` shapes an
     ``indexer_streaming`` run reaches at these wave sizes/capacities
     (both grouper variants), plus — with ``device_accumulate`` — the
@@ -1291,4 +1353,5 @@ def warm_indexer_aot(mesh: Mesh | None = None, sizes: Sequence[int] = (
                 from dsi_tpu.device.topk import warm_topk_service
 
                 warm_topk_service(mesh, kk=mwl // 4, rows=n_dev * cap,
-                                  cap=n_dev * cap, k=topk, table_rungs=2)
+                                  cap=n_dev * cap, k=topk, table_rungs=2,
+                                  mesh_shards=mesh_shards)

@@ -109,7 +109,7 @@ from dsi_tpu.ckpt import (
     fault_point,
     skip_stream,
 )
-from dsi_tpu.device.policy import SyncPolicy
+from dsi_tpu.device.policy import SyncPolicy, mesh_shards_default
 from dsi_tpu.device.table import DeviceTable, _quiet_unusable_donation
 from dsi_tpu.obs import metrics_scope, span as _span
 from dsi_tpu.ops.wordcount import (
@@ -355,7 +355,8 @@ def stream_programs_persisted(mesh: Mesh | None = None,
                               n_reduce: int = 10, max_word_len: int = 16,
                               u_cap: int = 1 << 12,
                               fracs: Sequence[int] = (4, 2),
-                              device_accumulate: bool = False) -> bool:
+                              device_accumulate: bool = False,
+                              mesh_shards: int = 0) -> bool:
     """True when every starting-rung program
     ``wordcount_streaming(..., aot=True)`` would reach first (step at
     each token-capacity frac, plus the pack program) is already in the
@@ -394,7 +395,8 @@ def stream_programs_persisted(mesh: Mesh | None = None,
         from dsi_tpu.device.table import device_fold_persisted
 
         if not device_fold_persisted(mesh, u_cap=u_cap,
-                                     kk=max_word_len // 4):
+                                     kk=max_word_len // 4,
+                                     mesh_shards=mesh_shards):
             return False
     return True
 
@@ -409,7 +411,8 @@ def warm_stream_aot(mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
                     word_lens: Sequence[int] = (16,),
                     caps: Sequence[int] = (1 << 12, 1 << 14, 1 << 16),
                     fracs: Sequence[int] = (4, 2),
-                    device_accumulate: bool = False) -> None:
+                    device_accumulate: bool = False,
+                    mesh_shards: int = 0) -> None:
     """Compile + persist the program shapes
     ``wordcount_streaming(..., aot=True)`` reaches at these parameters,
     from shape structs alone (no data, nothing executed) — so a later
@@ -448,7 +451,7 @@ def warm_stream_aot(mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
                 from dsi_tpu.device.table import warm_device_fold
 
                 warm_device_fold(mesh, u_cap=cap, kk=mwl // 4,
-                                 table_rungs=2)
+                                 table_rungs=2, mesh_shards=mesh_shards)
 
 
 def warm_kernel_row(mesh: Mesh | None = None, chunk_bytes: int = 1 << 21,
@@ -547,6 +550,7 @@ def wordcount_streaming(
         pipeline_stats: Optional[dict] = None,
         device_accumulate: bool = False,
         sync_every: Optional[int] = None,
+        mesh_shards: Optional[int] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: Optional[int] = None,
         resume: bool = False,
@@ -604,6 +608,17 @@ def wordcount_streaming(
     result pulls in BOTH modes, so a bench can show the amortization
     (steps vs ``ceil(steps/K) + widens``) directly.
 
+    ``mesh_shards`` (default ``DSI_STREAM_MESH_SHARDS``, 0 = off) makes
+    the device table MESH-SHARDED (``device/table.py`` module docs): the
+    fold program routes every key to shard ``ihash(key) % mesh_shards``
+    with an in-program all-to-all before the merge, so each shard holds
+    the complete pre-merged state of its hash range, the widen protocol
+    goes per-shard (``shard_widens`` — a hot shard drains, reallocs and
+    re-folds alone), and sync pulls one hash-balanced pre-merged table
+    (``pull_bytes``/``shard_imbalance`` counters).  Implies
+    ``device_accumulate``; results stay bit-identical to the
+    host-merge path.
+
     ``checkpoint_dir`` enables crash-resume (``dsi_tpu/ckpt``): every
     ``checkpoint_every`` CONFIRMED steps (``DSI_STREAM_CKPT_EVERY``
     default) the engine writes a durable snapshot — host accumulator,
@@ -646,11 +661,16 @@ def wordcount_streaming(
     # fold (its key width and capacity come from that step's shapes); the
     # fold-flag lag is the pipeline window, so confirming a fold never
     # blocks on kernels the window still wants in flight.
+    mesh_shards = mesh_shards_default(mesh_shards)
+    if mesh_shards:
+        device_accumulate = True  # the services ARE the sharded state
+        stats["device_accumulate"] = True
     table_svc: Optional[DeviceTable] = None
     policy: Optional[SyncPolicy] = None
     if device_accumulate:
         policy = SyncPolicy(sync_every)
         stats["sync_every"] = policy.sync_every
+        stats["mesh_shards"] = mesh_shards
 
     # ── checkpoint/restore (dsi_tpu/ckpt) ──
     ck_store: Optional[CheckpointStore] = None
@@ -683,15 +703,28 @@ def wordcount_streaming(
                 acc.restore({k[4:]: v for k, v in arrays.items()
                              if k.startswith("acc_")})
                 if device_accumulate and meta.get("table_cap"):
-                    # Re-enter device_accumulate mid-table: the image's
-                    # capacity/width win (a pre-crash widen sticks).
-                    table_svc = DeviceTable(
-                        mesh, kk=int(meta["table_kk"]),
-                        cap=int(meta["table_cap"]), acc=acc, aot=aot,
-                        lag=max(0, depth - 1), stats=stats)
-                    table_svc.restore_state(
-                        {k[6:]: v for k, v in arrays.items()
-                         if k.startswith("table_")})
+                    img = {k[6:]: v for k, v in arrays.items()
+                           if k.startswith("table_")}
+                    if int(meta.get("mesh_shards", 0)) == mesh_shards:
+                        # Re-enter device_accumulate mid-table: the
+                        # image's capacity/width win (a pre-crash widen
+                        # sticks).
+                        table_svc = DeviceTable(
+                            mesh, kk=int(meta["table_kk"]),
+                            cap=int(meta["table_cap"]), acc=acc, aot=aot,
+                            lag=max(0, depth - 1), stats=stats,
+                            mesh_shards=mesh_shards)
+                        table_svc.restore_state(img)
+                    else:
+                        # The checkpoint's sharding degree differs from
+                        # this run's (manifest `mesh_shards`): re-enter
+                        # through the DRAIN path — the image's merged
+                        # rows flow into the host accumulator, the
+                        # table starts empty at the new degree, and the
+                        # resumed folds re-shuffle key ownership.
+                        DeviceTable.drain_image(acc, img)
+                        stats["resharded_resume"] = int(
+                            meta.get("mesh_shards", 0))
                     policy.restore(meta.get("sync_since", 0))
                 if aot:
                     # Re-warm the sticky-rung executables now (persistent
@@ -730,7 +763,8 @@ def wordcount_streaming(
             table_svc = DeviceTable(
                 mesh, kk=int(packed_dev.shape[2]) - 3,
                 cap=cap if cap > 0 else int(packed_dev.shape[1]),
-                acc=acc, aot=aot, lag=max(0, depth - 1), stats=stats)
+                acc=acc, aot=aot, lag=max(0, depth - 1), stats=stats,
+                mesh_shards=mesh_shards)
         table_svc.fold(packed_dev, scal_dev, scal_np)
         policy.note_fold()
         if policy.due():
@@ -758,6 +792,10 @@ def wordcount_streaming(
                     arrays["table_" + k] = v
                 meta["table_cap"] = table_svc.cap
                 meta["table_kk"] = table_svc.kk
+                # The manifest records the image's sharding degree so a
+                # resume onto a different mesh degree re-shuffles via
+                # the drain path instead of misreading shard ownership.
+                meta["mesh_shards"] = table_svc.mesh_shards
                 meta["sync_since"] = policy.snapshot()
             for k, v in acc.snapshot().items():
                 arrays["acc_" + k] = v

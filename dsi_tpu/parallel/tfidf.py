@@ -246,6 +246,7 @@ def tfidf_sharded(
         max_word_len: int = 16, u_cap: int = 1 << 15,
         partitions: Optional[set] = None, packed: bool = False,
         device_accumulate: bool = False, sync_every: Optional[int] = None,
+        mesh_shards: Optional[int] = None,
         wave_stats: Optional[dict] = None, depth: Optional[int] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: Optional[int] = None, resume: bool = False,
@@ -301,6 +302,11 @@ def tfidf_sharded(
     reach the same ``PostingsTable`` in the same per-device order (the
     buffer's sticky-overflow protocol preserves wave order through
     recovery), and the padding-doc/partition filters run at drain time.
+    ``mesh_shards`` (default ``DSI_STREAM_MESH_SHARDS``; implies
+    ``device_accumulate``) re-routes the buffered rows by
+    ``ihash(word) % n_shards`` inside the compiled append — the
+    mesh-sharded service treatment (``device/table.py`` module docs),
+    bit-identical results included.
 
     ``wave_stats``, if given, is populated with the per-phase wall
     seconds ``wave_phases`` mirrors of ``stream_phases``:
@@ -324,6 +330,11 @@ def tfidf_sharded(
         mesh = default_mesh()
     n_dev = mesh.devices.size
     depth = pipeline_depth(depth)
+    from dsi_tpu.device.policy import mesh_shards_default
+
+    mesh_shards = mesh_shards_default(mesh_shards)
+    if mesh_shards:
+        device_accumulate = True
     doc_lens = getattr(docs, "lengths", None)
     if doc_lens is None:
         doc_lens = [len(d) for d in docs]
@@ -429,9 +440,11 @@ def tfidf_sharded(
             buf_dev = DevicePostings(
                 mesh, width=kk + 4,
                 cap=pcap if pcap > 0 else n_dev * state["cap"],
-                sink=buffer_rows, lag=max(0, depth - 1), stats=stats)
+                sink=buffer_rows, lag=max(0, depth - 1), stats=stats,
+                mesh_shards=mesh_shards, kk=kk)
             policy = SyncPolicy(sync_every)
             stats["sync_every"] = policy.sync_every
+            stats["mesh_shards"] = mesh_shards
 
         # A checkpoint belongs to ONE word-window rung (the widen
         # restart discards rung state): apply the loaded image only at
@@ -454,10 +467,23 @@ def tfidf_sharded(
                 table.restore({k[3:]: v for k, v in resume_arrays.items()
                                if k.startswith("pt_")})
                 if buf_dev is not None and resume_meta.get("pb_cap"):
-                    buf_dev.restore_state(
-                        {"buf": resume_arrays["pb_buf"],
-                         "nrows": resume_arrays["pb_nrows"],
-                         "cap": resume_meta["pb_cap"]})
+                    if int(resume_meta.get("mesh_shards",
+                                           0)) == mesh_shards:
+                        buf_dev.restore_state(
+                            {"buf": resume_arrays["pb_buf"],
+                             "nrows": resume_arrays["pb_nrows"],
+                             "cap": resume_meta["pb_cap"]})
+                    else:
+                        # Sharding degree changed (manifest
+                        # `mesh_shards`): buffered rows re-enter via
+                        # the drain path — host table first, buffer
+                        # empty at the new routing.
+                        DevicePostings.drain_image(
+                            buffer_rows,
+                            {"buf": resume_arrays["pb_buf"],
+                             "nrows": resume_arrays["pb_nrows"]})
+                        stats["resharded_resume"] = int(
+                            resume_meta.get("mesh_shards", 0))
                 if policy is not None:
                     policy.restore(resume_meta.get("sync_since", 0))
                 stats["resume_gap_s"] = round(
@@ -479,6 +505,7 @@ def tfidf_sharded(
                     arrays["pb_buf"] = pb["buf"]
                     arrays["pb_nrows"] = pb["nrows"]
                     meta["pb_cap"] = int(pb["cap"])
+                    meta["mesh_shards"] = buf_dev.mesh_shards
                     meta["sync_since"] = policy.snapshot()
                 for k, v in table.snapshot().items():
                     arrays["pt_" + k] = v

@@ -34,6 +34,10 @@ def main() -> int:
                     help="folds between host pulls with "
                          "--device-accumulate (default: "
                          "DSI_STREAM_SYNC_EVERY or 8)")
+    ap.add_argument("--mesh-shards", type=int, default=None,
+                    help="mesh-shard the device table across N shards "
+                         "(implies --device-accumulate; default: "
+                         "DSI_STREAM_MESH_SHARDS or 0 = off)")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="enable crash-resume checkpoints (dsi_tpu/ckpt)")
     ap.add_argument("--checkpoint-every", type=int, default=None,
@@ -90,6 +94,7 @@ def main() -> int:
                               depth=args.pipeline_depth,
                               device_accumulate=args.device_accumulate,
                               sync_every=args.sync_every,
+                              mesh_shards=args.mesh_shards,
                               checkpoint_dir=args.checkpoint_dir,
                               checkpoint_every=args.checkpoint_every,
                               resume=args.resume,

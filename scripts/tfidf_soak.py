@@ -48,6 +48,11 @@ def main() -> int:
                     help="batch the wave walk's D2H through the "
                          "device-resident postings buffer (dsi_tpu/"
                          "device/postings.py)")
+    ap.add_argument("--mesh-shards", type=int, default=None,
+                    help="mesh-shard the postings buffer across N shards "
+                         "(ihash %% N word routing inside the append; "
+                         "implies --device-accumulate; default: "
+                         "DSI_STREAM_MESH_SHARDS or 0 = off)")
     ap.add_argument("--sync-every", type=int, default=None,
                     help="waves between host pulls with "
                          "--device-accumulate (default: "
@@ -96,7 +101,9 @@ def main() -> int:
                         u_cap=1 << 15, partitions=partitions, packed=True,
                         depth=args.pipeline_depth,
                         device_accumulate=args.device_accumulate,
-                        sync_every=args.sync_every, wave_stats=wave_stats)
+                        sync_every=args.sync_every,
+                        mesh_shards=args.mesh_shards,
+                        wave_stats=wave_stats)
     wall = time.perf_counter() - t0
     assert res is not None, "tfidf fell back to host"
     if args.trace_dir:

@@ -31,17 +31,21 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--file-size", type=int, default=300000,
                     help="harness split size (test_mr.sh ensure_corpus)")
-    ap.add_argument("--phase", choices=("harness", "stream", "grep", "all"),
+    ap.add_argument("--phase", choices=("harness", "stream", "grep",
+                                        "mesh", "all"),
                     default="all",
                     help="which program group to warm: 'harness' = the "
                          "per-task worker kernels test_mr.sh runs touch; "
                          "'stream' = the streaming step/pack programs; "
                          "'grep' = the grep/indexer stream engines + the "
-                         "on-device top-k/histogram service; 'all' = "
-                         "everything.  Remote compiles cost tens of "
-                         "minutes EACH on the axon tunnel, so the ladder "
-                         "(warm_loop.sh) warms the group it is about to "
-                         "collect evidence with, not everything up front.")
+                         "on-device top-k/histogram service; 'mesh' = the "
+                         "mesh-sharded shuffle-fold programs (mesh_fold_*/"
+                         "mesh_grow_*/mesh_hist_pull_*) for --mesh-shards "
+                         "runs; 'all' = everything.  Remote compiles cost "
+                         "tens of minutes EACH on the axon tunnel, so the "
+                         "ladder (warm_loop.sh) warms the group it is "
+                         "about to collect evidence with, not everything "
+                         "up front.")
     args = ap.parse_args()
 
     from dsi_tpu.utils.corpus import ensure_corpus
@@ -242,6 +246,32 @@ def main() -> int:
                          device_accumulate=True)
         print(f"grep/indexer programs: {time.perf_counter() - t0:.1f}s",
               flush=True)
+
+    if args.phase in ("mesh", "all"):
+        # Mesh-sharded device services (ISSUE 7): the shuffle-fold
+        # programs a --mesh-shards run reaches — the mesh_fold_* fold
+        # with the in-program all-to-all at the stream/CLI step shapes
+        # (rung 0 + one ×4 widening, with the mesh_grow_* per-shard
+        # reallocation between them), the grep candidate fold + the
+        # pre-merged mesh_hist_pull_*, and the step/pack programs they
+        # ride (warmed by the stream/grep phases; re-warmed here so
+        # --phase mesh alone is sufficient before a mesh soak).  The
+        # shard degree warms at the full local mesh width — the only
+        # degree a run on this machine can use end to end.
+        from dsi_tpu.parallel.grepstream import warm_grepstream_aot
+        from dsi_tpu.parallel.shuffle import default_mesh
+        from dsi_tpu.parallel.streaming import warm_stream_aot
+
+        t0 = time.perf_counter()
+        mesh = default_mesh()
+        shards = mesh.devices.size
+        warm_stream_aot(mesh=mesh, chunk_bytes=1 << 20,
+                        caps=(1 << 14, 1 << 16), device_accumulate=True,
+                        mesh_shards=shards)
+        warm_grepstream_aot(mesh=mesh, chunk_bytes=1 << 20,
+                            device_accumulate=True, mesh_shards=shards)
+        print(f"mesh-sharded programs (shards={shards}): "
+              f"{time.perf_counter() - t0:.1f}s", flush=True)
 
     print(f"aot stats: {aotcache.stats}", flush=True)
     return 0

@@ -37,6 +37,9 @@ def run_bench(tmp_path, extra_env, timeout=300):
         "DSI_BENCH_TFIDF_MB": "2",      # engine rows at contract-test
         "DSI_BENCH_GREP_MB": "2",       # scale: the verdict plumbing is
                                         # under test, not throughput
+        "DSI_BENCH_MESH_MB": "1",       # mesh A/B row: two 8-vdev
+                                        # subprocess passes ride every
+                                        # verdict — keep them short here
         # Isolated workdir + compile cache: must NOT touch the repo's
         # canonical .bench corpus/oracle (the warm loop's parity checks
         # read them) or write CPU-platform entries into the persistent
@@ -113,6 +116,16 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
     if "grep_mbps" in v:
         assert v["grep_parity"] is True
         assert v["grep_oracle_mbps"] > 0
+    # The mesh-vs-host-merge A/B row (ISSUE 7): measured XOR skipped,
+    # and a measured row carries the parity gate, the per-sync pull
+    # bytes BOTH ways, and the per-shard widen counters.
+    assert ("mesh_skipped" in v) != ("mesh_shuffle_mbps" in v)
+    if "mesh_shuffle_mbps" in v:
+        assert v["mesh_parity"] is True
+        assert v["mesh_shards"] >= 2
+        assert v["mesh_pull_bytes_per_sync"] > 0
+        assert v["mesh_host_pull_bytes_per_sync"] > 0
+        assert len(v["mesh_shard_widens"]) == v["mesh_shards"]
 
 
 def test_engine_phase_dicts_come_from_the_registry(tmp_path):
@@ -163,6 +176,51 @@ def test_engine_phase_dicts_come_from_the_registry(tmp_path):
     # four engines under the same shape.
     snap = reg.snapshot()["engines"]
     assert {"stream", "grep", "tfidf", "indexer"} <= set(snap)
+
+
+def test_mesh_shard_keys_reconcile_with_span_totals(tmp_path):
+    """Schema contract for the mesh-sharded service keys (ISSUE 7):
+    a mesh run's phase dict carries the documented counters
+    (``mesh_shards``/``pull_bytes``/``shard_widens``/
+    ``shard_imbalance``), fold spans land in the tracer's ``shuffle``
+    lane, and the span totals reconcile with ``fold_s`` — the span IS
+    the stats accumulator, so the two cannot drift."""
+    pytest.importorskip("jax")
+    from dsi_tpu.obs import get_tracer
+    from dsi_tpu.obs.registry import get_registry
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.parallel.streaming import wordcount_streaming
+
+    mesh = default_mesh(8)
+    tr = get_tracer()
+    was_enabled = tr.enabled
+    tr.enabled = True
+    mark = tr.mark()
+    try:
+        text = ("alpha beta gamma delta the fox jumps " * 600).encode()
+        pstats: dict = {}
+        assert wordcount_streaming(
+            [text], mesh=mesh, n_reduce=10, chunk_bytes=1 << 11,
+            u_cap=1 << 9, mesh_shards=8,
+            pipeline_stats=pstats) is not None
+        with tr._lock:
+            evs = tr._events[mark:]
+    finally:
+        tr.enabled = was_enabled
+    for key in ("mesh_shards", "pull_bytes", "shard_widens",
+                "shard_imbalance", "folds", "fold_s"):
+        assert key in pstats, key
+    assert pstats["mesh_shards"] == 8
+    assert pstats["pull_bytes"] > 0
+    assert len(pstats["shard_widens"]) == 8
+    # The registry scope mirrors the same dict.
+    sc = get_registry().phases("stream")
+    assert sc is not None and sc.get("mesh_shards") == 8
+    # Fold spans in the shuffle lane, totals == fold_s (same clock).
+    fold_spans = [e for e in evs if e[0] == "X" and e[1] == "fold"]
+    assert fold_spans and all(e[2] == "shuffle" for e in fold_spans)
+    assert sum(e[4] for e in fold_spans) == pytest.approx(
+        pstats["fold_s"], rel=0.05, abs=0.05)
 
 
 @pytest.mark.slow

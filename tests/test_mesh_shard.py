@@ -1,0 +1,237 @@
+"""Mesh-sharded device services (ISSUE 7): the paper's shuffle as an
+on-device all-to-all.
+
+The parity bar is the tentpole's contract: with ``mesh_shards`` the fold
+programs route every key to ``ihash(key) % n_shards`` over the mesh
+before merging, and the results must stay BIT-IDENTICAL to the host-
+merge path across engine × depth × forced per-shard widen × crash-
+resume.  The grid here pins that, plus the per-shard widen protocol's
+central claim — a hot shard (skewed key distribution) drains, reallocs
+and re-folds ALONE — and the cross-degree resume drain path recorded in
+the checkpoint manifest (``mesh_shards`` field).
+
+The shard-routing device-vs-host ihash property lives with the other
+hypothesis properties in tests/test_property_fuzz.py.
+"""
+
+import itertools
+import string
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dsi_tpu.ckpt import FaultInjected, reset_faults
+from dsi_tpu.ops.meshroute import host_shard_of
+from dsi_tpu.parallel.grepstream import (grep_host_oracle, grep_streaming,
+                                         indexer_streaming)
+from dsi_tpu.parallel.shuffle import default_mesh
+from dsi_tpu.parallel.streaming import wordcount_streaming
+from dsi_tpu.parallel.tfidf import tfidf_sharded
+
+N_SHARDS = 8
+
+
+def _mesh():
+    return default_mesh(N_SHARDS)
+
+
+WC_TEXT = ("alpha beta gamma delta the fox jumps over lazy dogs "
+           "epsilon zeta eta theta iota kappa " * 2500).encode()  # ~7 steps
+WC_CHUNK = 1 << 12
+
+_GREP_LINES = [b"ab " * (i % 5) + b"line" + str(i).encode()
+               for i in range(2500)]
+GREP_TEXT = b"\n".join(_GREP_LINES) + b"\n"
+
+IDX_DOCS = [("doc%d alpha beta w%d w%d common" % (i, i % 7, i % 3)).encode()
+            for i in range(20)]
+
+
+def _run_wc(mesh_shards=0, depth=2, stats=None, ckpt=None, resume=False,
+            text=WC_TEXT, **kw):
+    reset_faults()
+    return wordcount_streaming(
+        [text], mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK,
+        u_cap=256, depth=depth, mesh_shards=mesh_shards, sync_every=2,
+        checkpoint_dir=ckpt, checkpoint_every=2, resume=resume,
+        pipeline_stats=stats, **kw)
+
+
+# ── the parity grid: engine × depth × mesh ─────────────────────────────
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_wordcount_mesh_bit_identical(depth):
+    base = _run_wc(depth=1)  # the depth=1 host-merge parity anchor
+    assert base is not None
+    st = {}
+    got = _run_wc(mesh_shards=N_SHARDS, depth=depth, stats=st)
+    assert got == base
+    assert st["mesh_shards"] == N_SHARDS
+    assert st["folds"] > 0 and st["pull_bytes"] > 0
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_grep_mesh_bit_identical_and_premerged_hist(depth):
+    want = grep_host_oracle([GREP_TEXT], "ab", topk=8)
+    st, st0 = {}, {}
+    base = grep_streaming([GREP_TEXT], "ab", mesh=_mesh(),
+                          chunk_bytes=1 << 11, depth=1,
+                          device_accumulate=True, sync_every=2, topk=8,
+                          pipeline_stats=st0)
+    got = grep_streaming([GREP_TEXT], "ab", mesh=_mesh(),
+                         chunk_bytes=1 << 11, depth=depth,
+                         mesh_shards=N_SHARDS, sync_every=2, topk=8,
+                         pipeline_stats=st)
+    assert base == want and got == want
+    # The histogram pull pre-merges on device: one [slots] vector per
+    # pull instead of n_dev partials — 1/n_dev the bytes per hist pull.
+    assert st["hist_pulls"] == st0["hist_pulls"] > 0
+    assert 0 < st["pull_bytes"] < st0["pull_bytes"]
+    assert st["mesh_shards"] == N_SHARDS
+
+
+def test_indexer_mesh_bit_identical():
+    base = indexer_streaming(IDX_DOCS, mesh=_mesh(), n_reduce=10,
+                             u_cap=1 << 8, depth=1, topk=8)
+    st = {}
+    got = indexer_streaming(IDX_DOCS, mesh=_mesh(), n_reduce=10,
+                            u_cap=1 << 8, depth=2,
+                            mesh_shards=N_SHARDS, topk=8, stats=st)
+    # Postings (incl. per-word order) AND df top-k, bit-for-bit.
+    assert got == base
+    assert st["mesh_shards"] == N_SHARDS and st["appends"] > 0
+
+
+def test_tfidf_mesh_bit_identical():
+    base = tfidf_sharded(IDX_DOCS, mesh=_mesh(), n_reduce=10,
+                         u_cap=1 << 8, depth=1)
+    st = {}
+    got = tfidf_sharded(IDX_DOCS, mesh=_mesh(), n_reduce=10,
+                        u_cap=1 << 8, depth=2, mesh_shards=N_SHARDS,
+                        wave_stats=st)
+    assert got == base
+    assert st["mesh_shards"] == N_SHARDS and st["appends"] > 0
+
+
+# ── the per-shard widen protocol ───────────────────────────────────────
+
+
+def _skewed_text(hot_shard: int, n_hot: int = 300, n_cold: int = 8):
+    """A corpus whose vocabulary concentrates on ONE shard's hash range
+    — the adversarial key distribution of the tentpole's acceptance
+    criterion."""
+    hot, cold = [], []
+    for t in itertools.product(string.ascii_lowercase, repeat=4):
+        w = "".join(t).encode()
+        (hot if host_shard_of(w, N_SHARDS) == hot_shard else cold).append(w)
+        if len(hot) >= n_hot and len(cold) >= n_cold:
+            break
+    line = b" ".join(hot[:n_hot] + cold[:n_cold]) + b"\n"
+    return line * 24, hot[:n_hot], cold[:n_cold]
+
+
+def test_hot_shard_widens_alone(monkeypatch):
+    """Skewed keys + a forced-tiny table rung: ONLY the hot shard pays
+    the drain→realloc×4→re-fold — its counter advances, every cold
+    shard's stays zero — and the result is still bit-identical."""
+    hot_shard = 3
+    text, hot, cold = _skewed_text(hot_shard)
+    base = _run_wc(depth=1, text=text)
+    assert base is not None
+    monkeypatch.setenv("DSI_DEVICE_TABLE_CAP", "64")
+    st = {}
+    got = _run_wc(mesh_shards=N_SHARDS, depth=2, stats=st, text=text)
+    monkeypatch.delenv("DSI_DEVICE_TABLE_CAP")
+    assert got == base
+    widens = st["shard_widens"]
+    assert widens[hot_shard] >= 1, widens
+    assert sum(widens) == widens[hot_shard], \
+        f"cold shards widened too: {widens}"
+    assert st["shard_imbalance"] > 2.0  # the skew is visible
+
+
+def test_grep_topk_mesh_forced_widen(monkeypatch):
+    """The grep candidate table under a forced-tiny rung: per-shard
+    widens fire (line keys hash-spread, so several shards may be hot)
+    and the exact top-k survives."""
+    want = grep_host_oracle([GREP_TEXT], "ab", topk=8)
+    monkeypatch.setenv("DSI_DEVICE_TOPK_CAP", "16")
+    st = {}
+    got = grep_streaming([GREP_TEXT], "ab", mesh=_mesh(),
+                         chunk_bytes=1 << 11, depth=2,
+                         mesh_shards=N_SHARDS, sync_every=2, topk=8,
+                         pipeline_stats=st)
+    monkeypatch.delenv("DSI_DEVICE_TOPK_CAP")
+    assert got == want
+    assert st["widens"] >= 1
+    assert sum(st["shard_widens"]) >= st["widens"]
+
+
+# ── crash-resume × mesh ────────────────────────────────────────────────
+
+
+def _fault(monkeypatch, point, step):
+    monkeypatch.setenv("DSI_FAULT_MODE", "raise")
+    monkeypatch.setenv("DSI_FAULT_POINT", point)
+    monkeypatch.setenv("DSI_FAULT_STEP", str(step))
+
+
+def _clear_fault(monkeypatch):
+    for k in ("DSI_FAULT_MODE", "DSI_FAULT_POINT", "DSI_FAULT_STEP"):
+        monkeypatch.delenv(k, raising=False)
+
+
+@pytest.mark.parametrize("point,at", [("mid-fold", 4), ("pre-sync", 2)])
+def test_mesh_crash_resume_bit_identical(monkeypatch, tmp_path, point, at):
+    base = _run_wc(depth=1)
+    ck = str(tmp_path / "ck")
+    _fault(monkeypatch, point, at)
+    with pytest.raises(FaultInjected):
+        _run_wc(mesh_shards=N_SHARDS, ckpt=ck)
+    _clear_fault(monkeypatch)
+    st = {}
+    got = _run_wc(mesh_shards=N_SHARDS, ckpt=ck, resume=True, stats=st)
+    assert got == base
+    # Resume must actually have engaged: the fault fires after the
+    # checkpoint at confirmed step 2 (checkpoint_every=2), so a restored
+    # cursor is guaranteed, not merely possible.
+    assert st.get("resume_cursor", 0) > 0
+
+
+def test_resume_across_sharding_degrees(monkeypatch, tmp_path):
+    """The manifest records the image's sharding degree; resuming onto a
+    DIFFERENT degree re-enters through the drain path (the image's
+    merged rows flow to the host accumulator) and stays bit-identical
+    — both directions."""
+    base = _run_wc(depth=1)
+    for crash_shards, resume_shards in ((0, N_SHARDS), (N_SHARDS, 0)):
+        ck = str(tmp_path / f"ck{crash_shards}")
+        _fault(monkeypatch, "mid-fold", 4)
+        with pytest.raises(FaultInjected):
+            _run_wc(mesh_shards=crash_shards, ckpt=ck,
+                    device_accumulate=True)
+        _clear_fault(monkeypatch)
+        st = {}
+        got = _run_wc(mesh_shards=resume_shards, ckpt=ck, resume=True,
+                      device_accumulate=True, stats=st)
+        assert got == base, (crash_shards, resume_shards)
+        # The mid-fold fault at step 4 fires after the checkpoint at
+        # confirmed step 2 (checkpoint_every=2), so resume MUST engage
+        # and MUST take the cross-degree drain path.  Direct indexing:
+        # `resharded_resume`'s value is the checkpoint's old degree —
+        # legitimately 0 in the host-merge→mesh direction — so key
+        # PRESENCE, not truthiness, is the "a reshard ran" signal.
+        assert st.get("resume_cursor", 0) > 0
+        assert st["resharded_resume"] == crash_shards
+
+
+def test_mesh_shards_exceeding_mesh_refuses():
+    from dsi_tpu.device.table import DeviceTable
+    from dsi_tpu.parallel.merge import PackedCounts
+
+    with pytest.raises(ValueError):
+        DeviceTable(_mesh(), kk=4, cap=64, acc=PackedCounts(),
+                    mesh_shards=N_SHARDS + 1)

@@ -432,3 +432,44 @@ def test_fuzz_keycounts_snapshot_roundtrip(pairs):
     kc2 = KeyCounts()
     kc2.restore(kc.snapshot())
     assert kc2.finalize() == kc.finalize()
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.text(alphabet="abcdefghijklmnopqrstuvwxyzABC",
+                        min_size=1, max_size=16),
+                min_size=1, max_size=80),
+       st.sampled_from([2, 3, 8]))
+def test_fuzz_mesh_routing_partitions_exactly(words, n_shards):
+    """Shard-routing invariant (ISSUE 7): for ANY key multiset, the
+    on-device ``ihash(key) % n_shards`` route (ops/meshroute.py — the
+    prologue of every mesh_fold_* program) partitions exactly — every
+    key lands on exactly one in-range shard, duplicates agree, the
+    union is the input — and matches the host ihash oracle from
+    mr/worker.py byte-for-byte."""
+    import functools
+
+    import numpy as np
+
+    from dsi_tpu.ops.meshroute import pack_host_rows, route_dest
+
+    kk = 4  # the 16-byte word window; max_size above stays within it
+    bwords = [w.encode("ascii") for w in words]
+    keys, lens, oracle = pack_host_rows(bwords, n_shards, kk)
+    valid = np.ones(len(bwords), dtype=bool)
+    route = jax.jit(functools.partial(route_dest, n_shards=n_shards,
+                                      park=n_shards))
+    dest = np.asarray(route(keys, lens, valid))
+    # Exact partition: every key on one in-range shard...
+    assert ((dest >= 0) & (dest < n_shards)).all()
+    # ...duplicates agree (ownership is a pure function of the key)...
+    seen = {}
+    for w, d in zip(bwords, dest.tolist()):
+        assert seen.setdefault(w, d) == d
+    # ...and device == host oracle (mr.worker ihash), byte-for-byte.
+    assert dest.tolist() == oracle.tolist()
+    for w, d in zip(words, dest.tolist()):
+        assert d == ihash(w) % n_shards
+    # Invalid rows park on the dump destination, never on a shard.
+    none_valid = np.zeros(len(bwords), dtype=bool)
+    parked = np.asarray(route(keys, lens, none_valid))
+    assert (parked == n_shards).all()
