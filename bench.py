@@ -89,12 +89,16 @@ Environment knobs:
                           folds the match histogram + top-k candidates
                           on device (dsi_tpu/device/topk.py).
   DSI_BENCH_CKPT          the stream row's checkpoint/restore cost keys
-                          (ckpt_overhead_pct / resume_gap_s, dsi_tpu/
-                          ckpt): a checkpointed pass vs the plain pass
-                          plus a resumed pass, both parity-gated.  CPU
-                          boxes run it whenever the stream row measured;
-                          accelerators opt in with 1 (two more stream
-                          passes on a time-boxed window); 0 disables.
+                          (dsi_tpu/ckpt), a cadence-1 sync-vs-async A/B:
+                          ckpt_overhead_pct (sync-full, the PR-5 path)
+                          vs ckpt_async_overhead_pct (overlapped commits
+                          + incremental saves), ckpt_full_bytes_per_save
+                          vs ckpt_delta_bytes_per_save, and resume_gap_s
+                          from the delta CHAIN — every pass parity-
+                          gated.  CPU boxes run it whenever the stream
+                          row measured; accelerators opt in with 1 (four
+                          more stream passes on a time-boxed window);
+                          0 disables.
   DSI_BENCH_FRAMEWORK_MB  corpus size for the distributed N-worker row
                           (default 48; 0 disables it; auto-shrunk so its
                           oracle pass costs ~100 s on a slow box, skipped
@@ -646,24 +650,35 @@ def run_stream_row(files, corpus_compile_s: float, stream_mb: float) -> dict:
 def run_stream_ckpt_row(files, mesh, device_acc, oracle,
                         corpus_bytes, stream_mb) -> dict:
     """The checkpoint/restore cost row riding the stream row
-    (``dsi_tpu/ckpt``): three passes over a bounded slice of the stream
-    — a plain WARM pass (its own baseline: the stream row's pass may
-    have paid one-time compiles, which would make a naive comparison
-    report negative overhead), a checkpointed pass
-    (``ckpt_overhead_pct``, acceptance target <=5% at the row's
-    cadence on the CPU box), and a resumed pass from the final retained
-    checkpoint (``resume_gap_s`` = the engine's restore wall: load +
-    re-upload + re-warm + seek), each parity-gated against the oracle
-    counts.
+    (``dsi_tpu/ckpt``), now a CADENCE-1 sync-vs-async A/B (ISSUE 8):
+    four passes over a bounded slice of the stream — a plain WARM pass
+    (its own baseline: the stream row's pass may have paid one-time
+    compiles, which would make a naive comparison report negative
+    overhead), a sync-full checkpointed pass at ``checkpoint_every=1``
+    (``ckpt_overhead_pct`` — the PR-5 path, every save a stall-and-
+    write full image), an async+incremental pass at the same cadence
+    (``ckpt_async_overhead_pct`` — captures overlap the pipeline
+    window, saves ship deltas with a periodic full re-base;
+    ``ckpt_delta_bytes_per_save`` vs ``ckpt_full_bytes_per_save`` is
+    the payload A/B), and a resumed pass from the async pass's delta
+    CHAIN (``resume_gap_s`` = load + re-apply deltas + re-upload +
+    re-warm + seek), each parity-gated against the oracle counts.
+
+    Cadence 1 is the deliberate, hostile setting: it is the ROADMAP's
+    serving-daemon eviction target and the cadence where snapshot cost
+    decides whether checkpointing is on by default.
 
     The slice is capped at ~16 MB (overhead is a ratio; it does not
-    need the full row size, and three extra 64 MB passes would threaten
+    need the full row size, and four extra 64 MB passes would threaten
     the CPU-fallback wall budget).  CPU boxes run it whenever the
     stream row measured; accelerators opt in via ``DSI_BENCH_CKPT=1``
-    (three more stream passes on a time-boxed tunnel window must be a
+    (four more stream passes on a time-boxed tunnel window must be a
     choice, not a default), and ``DSI_BENCH_CKPT=0`` disables
     everywhere.  Always returns measured keys XOR ``ckpt_skipped`` —
-    the bench-contract discipline.
+    the bench-contract discipline; the per-save delta-bytes key rides
+    only when the pass produced at least one delta
+    (``ckpt_deltas`` >= 1 — a one-step slice has nothing to
+    increment).
     """
     explicit = os.environ.get("DSI_BENCH_CKPT")
     if explicit == "0":
@@ -675,13 +690,14 @@ def run_stream_ckpt_row(files, mesh, device_acc, oracle,
                                 "(set DSI_BENCH_CKPT=1)"}
     import shutil
 
-    from dsi_tpu.ckpt import checkpoint_every_default
     from dsi_tpu.parallel.streaming import (stream_files,
                                             wordcount_streaming)
     from dsi_tpu.utils.tracing import Span
 
     ckpt_dir = os.path.join(WORKDIR, "ckpt-row")
+    async_dir = os.path.join(WORKDIR, "ckpt-row-async")
     shutil.rmtree(ckpt_dir, ignore_errors=True)
+    shutil.rmtree(async_dir, ignore_errors=True)
     cycles = max(1, round(min(stream_mb, 16.0) * 1e6 / corpus_bytes))
 
     def blocks():
@@ -703,14 +719,7 @@ def run_stream_ckpt_row(files, mesh, device_acc, oracle,
                       for w, c in oracle.items()))
         return ok, pt.elapsed_s, pstats
 
-    # Cadence: the env default, shrunk so even a small contract-test row
-    # writes a few checkpoints (a row that never checkpoints measures
-    # nothing).  The big-row default stays the documented cadence.
-    n_dev = mesh.devices.size
-    est_steps = max(1, int(corpus_bytes * cycles
-                           // (n_dev * STREAM_CHUNK_BYTES)))
-    every = max(1, min(checkpoint_every_default(),
-                       max(1, est_steps // 4)))
+    every = 1  # the A/B's whole point: snapshot EVERY confirmed step
     try:
         base_ok, base_s, _ = run()  # warm plain baseline
         if not base_ok:
@@ -725,24 +734,52 @@ def run_stream_ckpt_row(files, mesh, device_acc, oracle,
             return {"ckpt_skipped": f"stream too short to checkpoint "
                                     f"(0 saves at every={every})"}
         overhead = 100.0 * (ck_s - base_s) / base_s
-        resume_ok, _, rstats = run(checkpoint_dir=ckpt_dir,
-                                   checkpoint_every=every, resume=True)
+        full_per_save = pstats.get("ckpt_full_bytes", 0) / saves
+        as_ok, as_s, astats = run(checkpoint_dir=async_dir,
+                                  checkpoint_every=every,
+                                  checkpoint_async=True,
+                                  checkpoint_delta=True)
+        if not as_ok:
+            return {"ckpt_skipped": "async+delta pass parity mismatch "
+                                    "(A/B suppressed)"}
+        as_overhead = 100.0 * (as_s - base_s) / base_s
+        deltas = astats.get("ckpt_deltas", 0)
+        # Resume from the async pass's chain — the stronger restore:
+        # base image + ordered deltas re-applied, not one flat load.
+        resume_ok, _, rstats = run(checkpoint_dir=async_dir,
+                                   checkpoint_every=every,
+                                   checkpoint_async=True,
+                                   checkpoint_delta=True, resume=True)
     finally:
         # Every exit path — skip returns and exceptions included — must
         # drop the row's snapshot files, or stale state-*.npz piles up
         # in the bench workdir across runs.
         shutil.rmtree(ckpt_dir, ignore_errors=True)
-    log(f"ckpt row: overhead {overhead:.1f}% ({ck_s:.2f}s vs {base_s:.2f}s"
-        f" warm, {saves} saves at every={every}), resume gap "
-        f"{rstats.get('resume_gap_s', 0)}s from cursor "
+        shutil.rmtree(async_dir, ignore_errors=True)
+    log(f"ckpt row (cadence 1): sync-full {overhead:.1f}% "
+        f"({ck_s:.2f}s) vs async+delta {as_overhead:.1f}% ({as_s:.2f}s) "
+        f"over {base_s:.2f}s warm; {saves} saves "
+        f"({full_per_save:.0f} B/full) vs {astats.get('ckpt_saves', 0)} "
+        f"saves / {deltas} deltas "
+        f"({astats.get('ckpt_delta_bytes', 0) / max(1, deltas):.0f} "
+        f"B/delta, barrier {astats.get('ckpt_barrier_s', 0)}s); resume "
+        f"gap {rstats.get('resume_gap_s', 0)}s from cursor "
         f"{rstats.get('resume_cursor', 0)} (parity={resume_ok})")
     if not resume_ok:
         return {"ckpt_skipped": "resume parity mismatch (gap suppressed)",
                 "resume_parity": False}
-    return {"ckpt_overhead_pct": round(overhead, 1), "ckpt_every": every,
-            "ckpt_saves": saves,
-            "resume_gap_s": rstats.get("resume_gap_s", 0.0),
-            "resume_parity": True}
+    row = {"ckpt_overhead_pct": round(overhead, 1),
+           "ckpt_async_overhead_pct": round(as_overhead, 1),
+           "ckpt_every": every, "ckpt_saves": saves,
+           "ckpt_deltas": deltas,
+           "ckpt_full_bytes_per_save": round(full_per_save),
+           "ckpt_barrier_s": round(astats.get("ckpt_barrier_s", 0.0), 4),
+           "resume_gap_s": rstats.get("resume_gap_s", 0.0),
+           "resume_parity": True}
+    if deltas:
+        row["ckpt_delta_bytes_per_save"] = round(
+            astats.get("ckpt_delta_bytes", 0) / deltas)
+    return row
 
 
 def run_kernel_row(files) -> dict:

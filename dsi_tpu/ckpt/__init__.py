@@ -17,7 +17,14 @@ full replay.  This package closes that gap:
   fsync, newest-valid-wins loading and last-two retention;
 * :mod:`~dsi_tpu.ckpt.fault` — :func:`fault_point`, the named
   kill-points (``DSI_FAULT_POINT``/``DSI_FAULT_STEP``) that let tests
-  and ``onchip_evidence.sh`` prove resume against REAL crashes.
+  and ``onchip_evidence.sh`` prove resume against REAL crashes;
+* :mod:`~dsi_tpu.ckpt.writer` — :class:`CheckpointWriter`, the
+  capture/commit split (``--ckpt-async``: snapshot pulls overlap the
+  next pipeline window, a background writer runs the durable path);
+* :mod:`~dsi_tpu.ckpt.delta` — the incremental payload format
+  (``--ckpt-delta``: a save ships only the confirmed step payloads
+  appended since the previous one; the store chains ``delta-<seq>``
+  manifests onto their base, restore = base + ordered deltas).
 
 The consistency contract, owned here and honored by every engine
 (``parallel/streaming.py``, ``parallel/grepstream.py``,
@@ -43,9 +50,20 @@ from dsi_tpu.ckpt.fault import (
     fault_point,
     reset_faults,
 )
+from dsi_tpu.ckpt.delta import (
+    Deferred,
+    DeltaSteps,
+    HostDeltaLog,
+    drain_packed_steps,
+    drain_posting_steps,
+    iter_delta_steps,
+)
 from dsi_tpu.ckpt.policy import (
     CheckpointPolicy,
+    checkpoint_async_default,
+    checkpoint_delta_default,
     checkpoint_every_default,
+    checkpoint_rebase_default,
     checkpoint_secs_default,
 )
 from dsi_tpu.ckpt.store import (
@@ -54,18 +72,29 @@ from dsi_tpu.ckpt.store import (
     CheckpointStore,
     skip_stream,
 )
+from dsi_tpu.ckpt.writer import CheckpointWriter
 
 __all__ = [
     "CKPT_VERSION",
     "CheckpointMismatch",
     "CheckpointPolicy",
     "CheckpointStore",
+    "CheckpointWriter",
+    "Deferred",
+    "DeltaSteps",
+    "HostDeltaLog",
     "FAULT_EXIT",
     "FAULT_POINTS",
     "FaultInjected",
+    "checkpoint_async_default",
+    "checkpoint_delta_default",
     "checkpoint_every_default",
+    "checkpoint_rebase_default",
     "checkpoint_secs_default",
+    "drain_packed_steps",
+    "drain_posting_steps",
     "fault_point",
+    "iter_delta_steps",
     "reset_faults",
     "skip_stream",
 ]

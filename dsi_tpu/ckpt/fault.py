@@ -16,7 +16,14 @@ those bugs would hide:
   pull (host and device state maximally divergent);
 * ``post-ckpt``    — right after a checkpoint manifest commits (resume
   must pick THIS checkpoint, and replay exactly the uncheckpointed
-  tail).
+  tail);
+* ``mid-capture``  — inside an async save, after the snapshot's device
+  pulls are dispatched but before the capture is handed to the commit
+  writer (nothing of this save may be visible to a resume);
+* ``mid-commit``   — in the commit writer, after the capture
+  materialized but before the payload/manifest pair lands (a
+  half-written delta or image must LOSE to the previous complete
+  chain — the newest-valid-wins walk's async edge).
 
 Knobs (all read per call, so a subprocess inherits them from its env):
 
@@ -43,7 +50,8 @@ from typing import Dict
 #: fired" rather than "something died".
 FAULT_EXIT = 87
 
-FAULT_POINTS = ("post-dispatch", "mid-fold", "pre-sync", "post-ckpt")
+FAULT_POINTS = ("post-dispatch", "mid-fold", "pre-sync", "post-ckpt",
+                "mid-capture", "mid-commit")
 
 _counters: Dict[str, int] = {}
 

@@ -27,6 +27,13 @@ import time
 
 _CKPT_EVERY_ENV = "DSI_STREAM_CKPT_EVERY"
 _CKPT_SECS_ENV = "DSI_STREAM_CKPT_SECS"
+_CKPT_ASYNC_ENV = "DSI_STREAM_CKPT_ASYNC"
+_CKPT_DELTA_ENV = "DSI_STREAM_CKPT_DELTA"
+_CKPT_REBASE_ENV = "DSI_STREAM_CKPT_REBASE"
+#: Delta saves between full rebases: long chains cost restore work
+#: (base + every delta re-applied) and pin every chain member against
+#: GC, so the store periodically compacts by writing a fresh full image.
+_CKPT_REBASE_DEFAULT = 8
 #: 32 confirmed steps at the bench's 2 MiB chunks is ~64 MB of replay
 #: exposure — small against a GB-scale stream, large enough that the
 #: snapshot pulls (capacity-sized D2H per live service) stay well under
@@ -56,6 +63,43 @@ def checkpoint_secs_default(secs: float | None = None) -> float:
         except ValueError:
             secs = 0.0
     return max(0.0, secs)
+
+
+def _bool_env(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "on",
+                                                        "yes")
+
+
+def checkpoint_async_default(flag: bool | None = None) -> bool:
+    """Resolve the async-commit switch: explicit wins, else
+    ``DSI_STREAM_CKPT_ASYNC`` (default off — off is bit-identical PR-5
+    behavior: capture + commit inline at the confirmed-step boundary)."""
+    if flag is None:
+        return _bool_env(_CKPT_ASYNC_ENV)
+    return bool(flag)
+
+
+def checkpoint_delta_default(flag: bool | None = None) -> bool:
+    """Resolve the incremental-snapshot switch: explicit wins, else
+    ``DSI_STREAM_CKPT_DELTA`` (default off — every save a full image,
+    the PR-5 shape)."""
+    if flag is None:
+        return _bool_env(_CKPT_DELTA_ENV)
+    return bool(flag)
+
+
+def checkpoint_rebase_default(every: int | None = None) -> int:
+    """Resolve the rebase cadence — every Nth save is a full image,
+    i.e. up to ``N - 1`` deltas chain between fulls: explicit wins,
+    else ``DSI_STREAM_CKPT_REBASE`` (default 8), floored at 1
+    (= every save full, deltas effectively disabled)."""
+    if every is None:
+        try:
+            every = int(os.environ.get(_CKPT_REBASE_ENV,
+                                       str(_CKPT_REBASE_DEFAULT)))
+        except ValueError:
+            every = _CKPT_REBASE_DEFAULT
+    return max(1, every)
 
 
 class CheckpointPolicy:

@@ -22,6 +22,24 @@ valid checkpoint or recognisable garbage, never a half-truth:
 The manifest carries the job identity (engine name + the shape knobs
 that change byte layout); resuming against a different job is refused
 rather than silently corrupting state — the journal-header rule.
+
+## Delta chains (incremental snapshots)
+
+A checkpoint may be INCREMENTAL: ``save_delta`` writes a
+``delta-<seq>.npz`` payload whose manifest carries ``kind: "delta"`` and
+``prev: <seq>`` — the checkpoint it extends.  A chain is one full image
+(``state-<seq>.npz``, the base) plus the ordered deltas chained onto it;
+restoring a chain = restore the base, then re-apply each delta's
+increment oldest-first (the engines re-ingest the delta rows through
+their host drain path, which is order-insensitive for count merges and
+order-preserving for postings — the same argument the cross-degree
+resume already rests on).  Newest-valid-wins generalizes to chains: the
+loader walks manifests newest→oldest and returns the first seq whose
+ENTIRE chain back to a base verifies; a torn middle delta invalidates
+every seq above it and the walk falls back to the last complete chain
+(ultimately the bare base).  GC is chain-aware: the last two restore
+points are retained *with every chain member they reference*, so a
+live delta chain can never lose its base to retention.
 """
 
 from __future__ import annotations
@@ -81,6 +99,9 @@ class CheckpointStore:
         #: mesh width, reduce count, pattern, ...).  JSON-normalised so
         #: tuple-vs-list spelling differences can't refuse a real match.
         self.job = json.loads(json.dumps(job))
+        #: Serialized payload size of the most recent save — the bench's
+        #: delta-vs-full bytes evidence rides this through the writer.
+        self.last_payload_bytes = 0
         os.makedirs(self.dir, exist_ok=True)
 
     # ── paths ──
@@ -90,6 +111,9 @@ class CheckpointStore:
 
     def _payload_path(self, seq: int) -> str:
         return os.path.join(self.dir, f"state-{seq:06d}.npz")
+
+    def _delta_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"delta-{seq:06d}.npz")
 
     def _seqs(self) -> list[int]:
         try:
@@ -111,7 +135,7 @@ class CheckpointStore:
         except OSError:
             return
         for n in names:
-            if (n.startswith(("manifest-", "state-", ".tmp-"))
+            if (n.startswith(("manifest-", "state-", "delta-", ".tmp-"))
                     and not os.path.isdir(os.path.join(self.dir, n))):
                 try:
                     os.remove(os.path.join(self.dir, n))
@@ -126,41 +150,96 @@ class CheckpointStore:
         fsync_dir(self.dir)
 
     def save(self, arrays: Dict[str, np.ndarray], meta: Dict) -> int:
-        """Commit one checkpoint; returns its sequence number.  The
-        payload lands durably BEFORE the manifest that names it, so the
-        manifest's existence implies a complete payload."""
+        """Commit one FULL checkpoint (a chain base); returns its
+        sequence number.  The payload lands durably BEFORE the manifest
+        that names it, so the manifest's existence implies a complete
+        payload."""
+        return self._commit(arrays, meta, kind="full")
+
+    def save_delta(self, arrays: Dict[str, np.ndarray], meta: Dict) -> int:
+        """Commit one INCREMENTAL checkpoint chained onto the newest
+        existing one (full or delta).  Refuses when the store is empty —
+        a delta with nothing under it could never restore; the engines
+        write a full base first (and re-base every
+        ``DSI_STREAM_CKPT_REBASE`` saves)."""
+        if not self._seqs():
+            raise RuntimeError("delta checkpoint with no base: the first "
+                               "save of a lineage must be full")
+        return self._commit(arrays, meta, kind="delta")
+
+    def _commit(self, arrays: Dict[str, np.ndarray], meta: Dict,
+                kind: str) -> int:
         seqs = self._seqs()
         seq = (seqs[-1] + 1) if seqs else 1
         buf = io.BytesIO()
         np.savez(buf, **arrays)
         payload = buf.getvalue()
-        crc = write_bytes_durable(self._payload_path(seq), payload)
+        path = (self._delta_path(seq) if kind == "delta"
+                else self._payload_path(seq))
+        crc = write_bytes_durable(path, payload)
         manifest = {
             "version": CKPT_VERSION,
             "engine": self.engine,
             "job": self.job,
             "seq": seq,
-            "payload": os.path.basename(self._payload_path(seq)),
+            "payload": os.path.basename(path),
             "payload_crc32": crc,
             "meta": meta,
         }
+        if kind == "delta":
+            manifest["kind"] = "delta"
+            manifest["prev"] = seqs[-1]
         write_bytes_durable(
             self._manifest_path(seq),
             json.dumps(manifest, sort_keys=True).encode("utf-8"))
-        self._gc(keep_from=seq - 1)
+        self.last_payload_bytes = len(payload)
+        self._gc()
         reap_tmp_files(self.dir)
         _trace_event("ckpt_save", lane="ckpt", engine=self.engine,
-                     seq=seq, bytes=len(payload))
+                     seq=seq, bytes=len(payload), kind=kind)
         return seq
 
-    def _gc(self, keep_from: int) -> None:
-        """Remove checkpoints older than ``keep_from`` (last-two
-        retention: the newest may be the one a concurrent crash tore,
-        the one before it is the fallback)."""
-        for seq in self._seqs():
-            if seq >= keep_from:
+    def _chain_members(self, seq: int) -> Tuple[set, bool]:
+        """The seqs a restore at ``seq`` needs — ``seq`` itself plus,
+        for a delta, everything down its ``prev`` links to the base —
+        and whether the walk reached a full image.  Reads manifests
+        WITHOUT CRC verification; an unreadable link ends the walk
+        INCOMPLETE, and GC must then err toward retention: everything
+        below the hole might be the complete chain the loader falls
+        back to."""
+        members = set()
+        while seq not in members:
+            members.add(seq)
+            try:
+                with open(self._manifest_path(seq), "rb") as f:
+                    m = json.loads(f.read())
+            except (OSError, ValueError):
+                return members, False
+            if m.get("kind") != "delta":
+                return members, True
+            seq = int(m.get("prev", seq))
+        return members, False  # prev-link cycle: same retention rule
+
+    def _gc(self) -> None:
+        """Chain-aware last-two retention: keep the newest two restore
+        points AND every chain member they reference (a live delta
+        chain must never lose its base `state-<seq>.npz` to
+        retention); remove everything else.  A chain walk that cannot
+        reach its base (unreadable mid-chain manifest) protects every
+        OLDER seq too — the loader's fallback could need any of them,
+        and GC never reaps what the loader might still read."""
+        seqs = self._seqs()
+        protect: set = set()
+        for seq in seqs[-2:]:
+            members, complete = self._chain_members(seq)
+            protect |= members
+            if not complete:
+                protect |= {s for s in seqs if s <= min(members)}
+        for seq in seqs:
+            if seq in protect:
                 continue
-            for path in (self._manifest_path(seq), self._payload_path(seq)):
+            for path in (self._manifest_path(seq), self._payload_path(seq),
+                         self._delta_path(seq)):
                 for p in (path, path + ".crc32"):
                     try:
                         os.remove(p)
@@ -169,38 +248,96 @@ class CheckpointStore:
 
     # ── reading ──
 
+    def _load_one(self, seq: int) -> Optional[Tuple[Dict,
+                                                    Dict[str, np.ndarray]]]:
+        """One verified (manifest, arrays) pair, or None when any check
+        fails — manifest CRC, version, payload CRC.  A VALID manifest
+        for a different job refuses loudly instead (silently starting
+        fresh would overwrite a good lineage)."""
+        raw = read_bytes_verified(self._manifest_path(seq))
+        if raw is None:
+            return None  # torn manifest
+        try:
+            manifest = json.loads(raw)
+        except ValueError:
+            return None
+        if manifest.get("version") != CKPT_VERSION:
+            return None
+        if (manifest.get("engine") != self.engine
+                or manifest.get("job") != self.job):
+            raise CheckpointMismatch(
+                f"checkpoint {self._manifest_path(seq)} belongs to a "
+                f"different job (engine/job mismatch); refusing to "
+                f"resume")
+        payload = read_bytes_verified(
+            os.path.join(self.dir, manifest["payload"]))
+        if payload is None:
+            return None
+        if zlib.crc32(payload) != manifest["payload_crc32"]:
+            return None
+        with np.load(io.BytesIO(payload)) as z:
+            arrays = {k: z[k] for k in z.files}
+        return manifest, arrays
+
     def load_latest(self) -> Optional[Tuple[Dict, Dict[str, np.ndarray]]]:
-        """Newest checkpoint that passes every check — manifest CRC,
-        version, job identity, payload CRC — or None when no usable
-        checkpoint exists.  A corrupt newest falls back to its
-        predecessor (that is what last-two retention buys); a VALID
-        manifest for a different job refuses loudly instead, because
-        silently starting fresh would overwrite a good lineage."""
+        """Newest FULL checkpoint that passes every check, or None when
+        no usable one exists.  A corrupt newest falls back to its
+        predecessor (that is what last-two retention buys).  Delta
+        manifests are skipped — a delta alone is not restorable; chain
+        consumers use :meth:`load_latest_chain`."""
         for seq in reversed(self._seqs()):
             raw = read_bytes_verified(self._manifest_path(seq))
             if raw is None:
-                continue  # torn manifest: fall back to the previous
+                continue
             try:
-                manifest = json.loads(raw)
+                if json.loads(raw).get("kind") == "delta":
+                    continue  # manifest-only skip: no payload read for
+                    # a delta this view can never return
             except ValueError:
                 continue
-            if manifest.get("version") != CKPT_VERSION:
+            loaded = self._load_one(seq)
+            if loaded is None:
                 continue
-            if (manifest.get("engine") != self.engine
-                    or manifest.get("job") != self.job):
-                raise CheckpointMismatch(
-                    f"checkpoint {self._manifest_path(seq)} belongs to a "
-                    f"different job (engine/job mismatch); refusing to "
-                    f"resume")
-            payload = read_bytes_verified(
-                os.path.join(self.dir, manifest["payload"]))
-            if payload is None:
-                continue
-            if zlib.crc32(payload) != manifest["payload_crc32"]:
-                continue
-            with np.load(io.BytesIO(payload)) as z:
-                arrays = {k: z[k] for k in z.files}
+            manifest, arrays = loaded
             _trace_event("ckpt_restore", lane="ckpt",
                          engine=self.engine, seq=seq)
             return manifest["meta"], arrays
+        return None
+
+    def load_latest_chain(self) -> Optional[Tuple[
+            Dict, Dict[str, np.ndarray], list]]:
+        """Newest restore point whose ENTIRE chain verifies, as
+        ``(base_meta, base_arrays, deltas)`` with ``deltas`` the ordered
+        ``[(delta_meta, delta_arrays), ...]`` oldest-first (empty for a
+        bare full checkpoint — then this is exactly
+        :meth:`load_latest`).  A chain torn anywhere — missing middle
+        delta, corrupt base — invalidates every seq above the tear and
+        the walk falls back to the last complete chain."""
+        for seq in reversed(self._seqs()):
+            chain = []
+            seen = set()
+            s = seq
+            ok = True
+            while True:
+                if s in seen:  # corrupt prev link: never walk a cycle
+                    ok = False
+                    break
+                seen.add(s)
+                loaded = self._load_one(s)
+                if loaded is None:
+                    ok = False
+                    break
+                manifest, arrays = loaded
+                chain.append((manifest, arrays))
+                if manifest.get("kind") != "delta":
+                    break
+                s = int(manifest["prev"])
+            if not ok:
+                continue
+            chain.reverse()
+            base_manifest, base_arrays = chain[0]
+            deltas = [(m["meta"], a) for m, a in chain[1:]]
+            _trace_event("ckpt_restore", lane="ckpt", engine=self.engine,
+                         seq=seq, deltas=len(deltas))
+            return base_manifest["meta"], base_arrays, deltas
         return None

@@ -20,6 +20,7 @@ Usage:
     python -m dsi_tpu.cli.grepstream --pattern PAT [--chunk-bytes B]
         [--devices D] [--pipeline-depth D] [--device-accumulate]
         [--sync-every K] [--checkpoint-dir DIR] [--checkpoint-every K]
+        [--ckpt-async] [--ckpt-delta]
         [--resume] [--topk K] [--aot] [--stats] [--check]
         inputfiles...
 """
@@ -68,6 +69,14 @@ def main(argv=None) -> int:
                         "DSI_STREAM_MESH_SHARDS or 0 = off)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable crash-resume checkpoints (dsi_tpu/ckpt)")
+    p.add_argument("--ckpt-async", action="store_true", default=None,
+                   dest="ckpt_async",
+                   help="overlap checkpoint commits with the pipeline "
+                        "(env DSI_STREAM_CKPT_ASYNC)")
+    p.add_argument("--ckpt-delta", action="store_true", default=None,
+                   dest="ckpt_delta",
+                   help="incremental checkpoints (env "
+                        "DSI_STREAM_CKPT_DELTA)")
     p.add_argument("--checkpoint-every", type=_positive_int, default=None,
                    help="confirmed steps between checkpoints (default: "
                         "DSI_STREAM_CKPT_EVERY or 32)")
@@ -128,7 +137,9 @@ def main(argv=None) -> int:
             sync_every=args.sync_every, mesh_shards=args.mesh_shards,
             topk=args.topk,
             checkpoint_dir=args.checkpoint_dir,
-            checkpoint_every=args.checkpoint_every, resume=args.resume,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_async=args.ckpt_async,
+            checkpoint_delta=args.ckpt_delta, resume=args.resume,
             pipeline_stats=pstats)
     except CheckpointMismatch as e:
         # A valid checkpoint for a DIFFERENT job (other pattern/shape):

@@ -15,6 +15,7 @@ Usage:
         [--devices D] [--workdir DIR] [--check] [--aot] [--u-cap U]
         [--pipeline-depth D] [--device-accumulate] [--sync-every K]
         [--checkpoint-dir DIR] [--checkpoint-every K] [--resume]
+        [--ckpt-async] [--ckpt-delta]
         [--grouper sort|hash] [--stats] inputfiles...
 """
 
@@ -78,6 +79,17 @@ def main(argv=None) -> int:
                    help="enable crash-resume checkpoints (dsi_tpu/ckpt): "
                         "durable snapshots of the accumulators + device "
                         "table + input cursor land here; see --resume")
+    p.add_argument("--ckpt-async", action="store_true", default=None,
+                   dest="ckpt_async",
+                   help="overlap checkpoint commits with the pipeline "
+                        "(capture at the boundary, durable write in a "
+                        "background writer; env DSI_STREAM_CKPT_ASYNC)")
+    p.add_argument("--ckpt-delta", action="store_true", default=None,
+                   dest="ckpt_delta",
+                   help="incremental checkpoints: ship only the step "
+                        "payloads appended since the previous save, "
+                        "full re-base every DSI_STREAM_CKPT_REBASE "
+                        "saves (env DSI_STREAM_CKPT_DELTA)")
     p.add_argument("--checkpoint-every", type=_positive_int, default=None,
                    help="confirmed steps between checkpoints (default: "
                         "DSI_STREAM_CKPT_EVERY or 32)")
@@ -132,7 +144,9 @@ def main(argv=None) -> int:
             device_accumulate=args.device_accumulate,
             sync_every=args.sync_every, mesh_shards=args.mesh_shards,
             checkpoint_dir=args.checkpoint_dir,
-            checkpoint_every=args.checkpoint_every, resume=args.resume,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_async=args.ckpt_async,
+            checkpoint_delta=args.ckpt_delta, resume=args.resume,
             pipeline_stats=pstats)
     except CheckpointMismatch as e:
         # A valid checkpoint for a DIFFERENT job (other corpus shape /

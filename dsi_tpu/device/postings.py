@@ -160,9 +160,9 @@ _mesh_append_step = jax.jit(_mesh_append_impl,
                             donate_argnums=(0, 1, 2))
 
 
-@functools.partial(jax.jit, static_argnames=("mp",))
-def _buf_prefix(buf, *, mp: int):
-    return buf[:, :mp]
+# Fresh-buffer prefix slice shared with the table service (one jitted
+# program for both consumers).
+from dsi_tpu.device.table import _rows_prefix as _buf_prefix  # noqa: E402
 
 
 class DevicePostings:
@@ -213,6 +213,13 @@ class DevicePostings:
         # tensors stay referenced until their append is proven committed,
         # so a no-op'd append can be replayed after the drain.
         self._pending: Deque[Tuple] = collections.deque()
+        # Delta-checkpoint log (enable_delta): wave payloads appended
+        # since the last capture — wave tensors are never donated, so
+        # retaining the handles is safe (same discipline as
+        # ``DeviceTable``'s step log).
+        self._delta_log: list = []
+        self._delta_max = 0
+        self._delta_invalid = False
 
     def _alloc(self, cap: int) -> None:
         sh3 = NamedSharding(self.mesh, P(AXIS, None, None))
@@ -235,12 +242,24 @@ class DevicePostings:
                 mesh=self.mesh)
         return flags
 
-    def append(self, rows_dev, scal_dev) -> None:
+    def append(self, rows_dev, scal_dev, nvalid=None) -> None:
         """Append one wave's valid rows (async) and lazily confirm
         appends older than ``lag``.  ``rows_dev`` is the wave's sorted
         received-row tensor ``[n_dev, r, width]``; ``scal_dev`` the
         per-device scalar block whose column 0 is the valid row count
-        (already host-confirmed exact by the caller)."""
+        (already host-confirmed exact by the caller).  ``nvalid`` is
+        that column as host ints — required only when the delta log is
+        armed (it is the trim vector an incremental save ships with the
+        wave's rows)."""
+        if self._delta_max and not self._delta_invalid:
+            # An already-invalid window retains nothing — take_delta
+            # would discard it anyway; don't pin dead HBM.
+            if nvalid is None or len(self._delta_log) >= self._delta_max:
+                self._delta_invalid = True
+                self._delta_log.clear()
+            else:
+                self._delta_log.append(
+                    (rows_dev, np.asarray(nvalid, np.int64).copy()))
         with _span("append", lane="fold", stats=self.stats,
                    key="append_s"):
             flags = self._dispatch(rows_dev, scal_dev)
@@ -318,24 +337,75 @@ class DevicePostings:
 
     # ── checkpoint image (dsi_tpu/ckpt) ──
 
-    def checkpoint_state(self) -> dict:
-        """Drain-free snapshot: flush the lagged append flags (an
-        overflow recovery drains into the sink, so callers snapshot
-        this buffer BEFORE the host table), then pull the committed
-        prefix WITHOUT resetting.  After the flush the sticky dirty bit
-        is provably clear — a dirty buffer is resolved by recovery
-        before this returns — so the image needs only rows + counts."""
+    def checkpoint_capture(self):
+        """Drain-free snapshot, capture half: flush the lagged append
+        flags (an overflow recovery drains into the sink, so callers
+        capture this buffer BEFORE the host table), then DISPATCH the
+        committed-prefix slice (a fresh buffer — later appends donate
+        the live buffer, never this) and kick its D2H; ``materialize``
+        in the commit writer finds the transfer draining.  After the
+        flush the sticky dirty bit is provably clear — a dirty buffer
+        is resolved by recovery before this returns — so the image
+        needs only rows + counts."""
+        from dsi_tpu.ckpt.delta import Deferred
+
         orphans = self._flush_pending()
         if orphans:
             self._recover(orphans)
-        m = int(self._nrows.max())
+        n_dev, width, cap = self.n_dev, self.width, self.cap
+        nrows = self._nrows.copy()
+        m = int(nrows.max())
         if m:
-            mp = occupied_prefix(m, self.cap)
-            buf = np.asarray(_buf_prefix(self._buf, mp=mp))
+            buf_dev = _buf_prefix(self._buf, mp=occupied_prefix(m, cap))
+            from dsi_tpu.device.table import _copy_to_host_async
+
+            _copy_to_host_async(buf_dev)
         else:
-            buf = np.zeros((self.n_dev, 0, self.width), dtype=np.uint32)
-        return {"buf": buf, "nrows": self._nrows.copy(),
-                "cap": np.array(self.cap, dtype=np.int64)}
+            buf_dev = None
+
+        def _image() -> dict:
+            buf = (np.asarray(buf_dev) if buf_dev is not None
+                   else np.zeros((n_dev, 0, width), dtype=np.uint32))
+            return {"buf": buf, "nrows": nrows.copy(),
+                    "cap": np.array(cap, dtype=np.int64)}
+
+        return Deferred(_image)
+
+    def checkpoint_state(self) -> dict:
+        """The synchronous spelling: capture + immediate materialize."""
+        return self.checkpoint_capture().materialize()
+
+    # ── incremental (delta) checkpoints ──
+
+    def enable_delta(self, max_steps: int = 64) -> None:
+        """Arm the delta log (``DeviceTable.enable_delta`` contract):
+        every appended wave retains its payload handle until the next
+        ``take_delta``; a window past ``max_steps`` falls back to a
+        full save."""
+        self._delta_max = max(1, int(max_steps))
+        self._delta_log.clear()
+        self._delta_invalid = False
+
+    def take_delta(self):
+        """The waves appended since the last capture, as ordered
+        ``(sliced_rows_handle, nvalid)`` entries with their D2H kicked —
+        or None when the window cannot be a delta (log overflow, or an
+        append without ``nvalid``); always re-arms the log."""
+        from dsi_tpu.device.table import _copy_to_host_async
+
+        if self._delta_invalid:
+            self._delta_invalid = False
+            self._delta_log.clear()
+            return None
+        entries = []
+        for rows_dev, nus in self._delta_log:
+            mp = occupied_prefix(max(1, int(nus.max())),
+                                 int(rows_dev.shape[1]))
+            sliced = _buf_prefix(rows_dev, mp=mp)
+            _copy_to_host_async(sliced)
+            entries.append((sliced, nus))
+        self._delta_log.clear()
+        return entries
 
     @staticmethod
     def drain_image(sink, img: dict) -> None:

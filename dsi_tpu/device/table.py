@@ -351,6 +351,28 @@ grow_table = x64_scoped(
             donate_argnums=_TABLE_DONATE))
 
 
+@functools.partial(jax.jit, static_argnames=("mp",))
+def _rows_prefix(rows, *, mp: int):
+    """Fresh-buffer prefix slice of a ``[n_dev, rows, ...]`` tensor
+    (shared with ``device/postings.py``): the output aliases nothing
+    (no donation), so a retained slice — a delta capture, a snapshot
+    pull — survives every later fold/clear/grow that donates the live
+    state, and its D2H can drain under the next pipeline window."""
+    return rows[:, :mp]
+
+
+def _copy_to_host_async(arr) -> None:
+    """Kick an async D2H on a jax array if the runtime supports it (the
+    capture half of the overlapped snapshot); materialization later in
+    the commit writer then finds the transfer already draining."""
+    fn = getattr(arr, "copy_to_host_async", None)
+    if fn is not None:
+        try:
+            fn()
+        except Exception:
+            pass  # overlap is an optimization; np.asarray still works
+
+
 def _pull_shard(arr, d: int) -> np.ndarray:
     """D2H of ONE mesh shard: the per-shard widen's drain pulls only the
     hot shard's slice via its addressable shard — cold shards never
@@ -655,6 +677,14 @@ class DeviceTable:
             self.stats.setdefault("shard_widens", [0] * self.n_dev)
             self.stats.setdefault("shard_imbalance", 0.0)
         self._apply_dev = None  # cached all-shards apply mask (mesh mode)
+        # Delta-checkpoint log (enable_delta): confirmed step payloads
+        # retained since the last capture — the rows APPENDED to the
+        # table, which is what an incremental save ships instead of the
+        # whole image.  Step tensors are never donated (they are the
+        # widen-recovery payload), so retaining the handles is safe.
+        self._delta_log: list = []
+        self._delta_max = 0
+        self._delta_invalid = False
         self._state = self._alloc(self.cap, self.kk)
         # Occupancy per device after the last CONFIRMED fold (a no-op'd
         # fold reports the old occupancy, so this stays exact either way).
@@ -787,6 +817,22 @@ class DeviceTable:
             # words.  Re-key via the widen protocol: drain what we have,
             # reallocate at the new width, resume folding.
             self._rekey(step_kk, int(packed_dev.shape[1]))
+        if self._delta_max:
+            # Record the step's appended rows for the next delta save —
+            # exactly once per confirmed step (recovery re-folds go
+            # through _dispatch_fold and never re-enter here).  A log
+            # outgrowing its cap invalidates THIS window only: the next
+            # save falls back to a full image and re-arms the log —
+            # and an already-invalid window retains nothing (take_delta
+            # would discard it anyway; don't pin dead HBM).
+            if self._delta_invalid:
+                pass
+            elif len(self._delta_log) >= self._delta_max:
+                self._delta_invalid = True
+                self._delta_log.clear()
+            else:
+                self._delta_log.append(
+                    (packed_dev, scal_np[:, 0].astype(np.int64).copy()))
         with _span("fold", lane="shuffle" if self.mesh_shards else "fold",
                    stats=self.stats, key="fold_s",
                    fold=self.stats["folds"]):
@@ -946,21 +992,95 @@ class DeviceTable:
 
     # ── checkpoint image (dsi_tpu/ckpt) ──
 
-    def checkpoint_state(self) -> dict:
-        """Drain-free snapshot image: flush the lagged flags first (so
-        the image reflects exactly the CONFIRMED folds — recovery of a
-        late-detected overflow may widen, whose drain lands in ``acc``,
-        which is why callers snapshot the device services BEFORE the
-        host accumulator), then pull the five table arrays WITHOUT
-        clearing.  The stream continues with the table resident; the
-        image is pure numpy, ready for ``np.savez``."""
+    def checkpoint_capture(self):
+        """Drain-free snapshot image, capture half: flush the lagged
+        flags first (so the image reflects exactly the CONFIRMED folds
+        — recovery of a late-detected overflow may widen, whose drain
+        lands in ``acc``, which is why callers capture the device
+        services BEFORE the host accumulator), then DISPATCH the
+        occupied-prefix pack (a fresh buffer: later folds donate the
+        live table arrays, never this) and kick its D2H — returning a
+        deferred whose ``materialize()`` (in the commit writer, or
+        inline for a sync save) reconstructs the five-array image the
+        restore path has always consumed.  Rows beyond each device's
+        occupancy are pad by the fold invariant, so prefix + pad
+        reconstruction is the live image."""
+        from dsi_tpu.ckpt.delta import Deferred
+
         orphans = self._flush_pending()
         if orphans:
             self._recover(orphans)
-        tkeys, tlens, tcnts, tparts, tn = self._state
-        return {"keys": np.asarray(tkeys), "lens": np.asarray(tlens),
-                "cnts": np.asarray(tcnts), "parts": np.asarray(tparts),
-                "tn": np.asarray(tn), "nrows": self._nrows.copy()}
+        n_dev, cap, kk = self.n_dev, self.cap, self.kk
+        nrows = self._nrows.copy()
+        m = int(nrows.max())
+        if m:
+            mp = cap if (self.aot and not self.mesh_shards) \
+                else occupied_prefix(m, cap)
+            tkeys, tlens, tcnts, tparts, _ = self._state
+            packed_dev, cnts_dev = self._pack_fn(mp)(tkeys, tlens, tparts,
+                                                     tcnts)
+            _copy_to_host_async(packed_dev)
+            _copy_to_host_async(cnts_dev)
+        else:
+            packed_dev = cnts_dev = None
+
+        def _image() -> dict:
+            keys = np.full((n_dev, cap, kk), _PAD_KEY, np.uint32)
+            lens = np.zeros((n_dev, cap), np.int32)
+            cnts = np.zeros((n_dev, cap), np.uint64)
+            parts = np.zeros((n_dev, cap), np.int32)
+            if packed_dev is not None:
+                p = np.asarray(packed_dev)
+                c = np.asarray(cnts_dev)
+                for d in range(n_dev):
+                    n = int(nrows[d])
+                    if n:
+                        keys[d, :n] = p[d, :n, :kk]
+                        lens[d, :n] = p[d, :n, kk].astype(np.int32)
+                        parts[d, :n] = p[d, :n, kk + 1].astype(np.int32)
+                        cnts[d, :n] = c[d, :n]
+            return {"keys": keys, "lens": lens, "cnts": cnts,
+                    "parts": parts, "tn": nrows.astype(np.int32),
+                    "nrows": nrows.copy()}
+
+        return Deferred(_image)
+
+    def checkpoint_state(self) -> dict:
+        """The synchronous spelling: capture + immediate materialize —
+        what every PR-5 call site (and the sync save path) still
+        gets."""
+        return self.checkpoint_capture().materialize()
+
+    # ── incremental (delta) checkpoints ──
+
+    def enable_delta(self, max_steps: int = 64) -> None:
+        """Arm the delta log: every confirmed fold retains its step
+        payload handle until the next ``take_delta``.  ``max_steps``
+        bounds the retained HBM (a window past it falls back to a full
+        save)."""
+        self._delta_max = max(1, int(max_steps))
+        self._delta_log.clear()
+        self._delta_invalid = False
+
+    def take_delta(self):
+        """The rows appended since the last capture, as ordered
+        ``(sliced_rows_handle, nus)`` entries with their D2H already
+        kicked — or None when this window cannot be expressed as a
+        delta (log overflow), which tells the engine to write a full
+        image instead.  Always re-arms the log for the next window."""
+        if self._delta_invalid:
+            self._delta_invalid = False
+            self._delta_log.clear()
+            return None
+        entries = []
+        for packed_dev, nus in self._delta_log:
+            mp = occupied_prefix(int(nus.max()),
+                                 int(packed_dev.shape[1]))
+            sliced = _rows_prefix(packed_dev, mp=mp)
+            _copy_to_host_async(sliced)
+            entries.append((sliced, nus))
+        self._delta_log.clear()
+        return entries
 
     def restore_state(self, img: dict) -> None:
         """Re-upload a :meth:`checkpoint_state` image — re-entering

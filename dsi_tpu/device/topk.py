@@ -439,8 +439,19 @@ class DeviceHistogram:
     def checkpoint_state(self) -> dict:
         """Drain-free image of the running totals.  A histogram fold is
         a donated add with no flags, so the last dispatched fold IS
-        confirmed the moment the pull lands — no lag to flush."""
+        confirmed the moment the pull lands — no lag to flush.  The
+        pull is synchronous even under an async capture: the vector is
+        KBs, and the live state is donated to the very next fold, so a
+        deferred read could find the buffer gone."""
         return {"hist": np.asarray(self._state)}
+
+    def checkpoint_capture(self):
+        """Capture-API spelling (``ckpt/writer.py`` parts): the tiny
+        vector is pulled eagerly, so the deferred is already ready."""
+        from dsi_tpu.ckpt.delta import Deferred
+
+        img = self.checkpoint_state()
+        return Deferred(lambda: img)
 
     def restore_state(self, img: dict) -> None:
         sh = NamedSharding(self.mesh, P(AXIS, None))

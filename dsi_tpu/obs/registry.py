@@ -24,7 +24,15 @@ that phase):
 * ``fold_s`` / ``append_s`` / ``hist_s`` — device-service folds
 * ``sync_s`` / ``drain_s``               — device-service pulls/drains
 * ``widen_s``            — drain→realloc→re-fold recoveries
-* ``ckpt_s``             — checkpoint snapshot + durable write
+* ``ckpt_s``             — checkpoint snapshot + durable write (with
+  async commits, only the boundary-side work: capture + any barrier)
+* ``ckpt_capture_s``     — the capture half of a save: flag flushes,
+  snapshot-pull dispatches, host snapshot-by-reference (engine thread)
+* ``ckpt_commit_s``      — the commit half: materialize the in-flight
+  pulls, serialize, durable write (the background writer thread under
+  ``--ckpt-async``, inline otherwise)
+* ``ckpt_barrier_s``     — engine-thread stalls on the commit writer
+  (the NEXT save or the end-of-stream drain found a commit in flight)
 
 Counters / gauges: ``steps`` (or ``waves``), ``depth``, ``replays``,
 ``step_pulls``, ``sync_pulls``, ``widens``, ``folds``,
@@ -33,6 +41,12 @@ Counters / gauges: ``steps`` (or ``waves``), ``depth``, ``replays``,
 ``table_cap``, ``l_cap``, ``sync_every``, ``max_inflight``,
 ``buffer_allocs``, ``ckpt_saves``, ``ckpt_every``, ``resume_gap_s``,
 ``resume_cursor``/``resume_wave``, ``device_accumulate``.
+
+Async/incremental checkpoint keys (``dsi_tpu/ckpt`` writer/delta —
+present when checkpointing is on): ``ckpt_async``/``ckpt_delta`` (the
+mode flags), ``ckpt_deltas`` (incremental saves among ``ckpt_saves``),
+``ckpt_full_bytes``/``ckpt_delta_bytes`` (serialized payload totals by
+kind — the bench's delta-vs-full evidence).
 
 Mesh-sharded service keys (``mesh_shards`` > 0, the shuffle-fold path
 — ``device/table.py``): ``mesh_shards`` (the sharding degree),
@@ -79,7 +93,8 @@ LEGACY_ALIASES = {
 PHASE_KEYS = (
     "materialize_s", "materialize_wait_s", "upload_s", "kernel_s",
     "pull_s", "merge_s", "replay_s", "fold_s", "append_s", "hist_s",
-    "sync_s", "drain_s", "widen_s", "ckpt_s",
+    "sync_s", "drain_s", "widen_s", "ckpt_s", "ckpt_capture_s",
+    "ckpt_commit_s", "ckpt_barrier_s",
 )
 
 #: The engine names the four streaming engines register under.

@@ -71,6 +71,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dsi_tpu.ckpt import (
     CheckpointPolicy,
     CheckpointStore,
+    CheckpointWriter,
+    DeltaSteps,
+    HostDeltaLog,
+    checkpoint_async_default,
+    checkpoint_delta_default,
+    drain_packed_steps,
+    drain_posting_steps,
     fault_point,
     skip_stream,
 )
@@ -425,7 +432,9 @@ def grep_streaming(
         mesh_shards: Optional[int] = None, topk: int = DEFAULT_TOPK,
         bins: int = GREP_BINS, pipeline_stats: Optional[dict] = None,
         checkpoint_dir: Optional[str] = None,
-        checkpoint_every: Optional[int] = None, resume: bool = False,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_async: Optional[bool] = None,
+        checkpoint_delta: Optional[bool] = None, resume: bool = False,
 ) -> Optional[GrepStreamResult]:
     """Whole-stream literal grep with bounded memory, pipelined.
 
@@ -471,7 +480,14 @@ def grep_streaming(
     snapshots at confirmed-step boundaries carry the host accumulators
     (or the device histogram/top-k images), the global line counter,
     the sticky ``l_cap`` rung, and the byte cursor; resumed output is
-    bit-identical to an uninterrupted run.
+    bit-identical to an uninterrupted run.  ``checkpoint_async`` /
+    ``checkpoint_delta`` (env twins ``DSI_STREAM_CKPT_ASYNC`` /
+    ``DSI_STREAM_CKPT_DELTA``, both default off = bit-identical PR-5
+    behavior) follow the ``wordcount_streaming`` capture/commit and
+    incremental-save contracts: an async save captures at the boundary
+    and commits in the background writer; a delta save ships only the
+    candidate rows appended since the previous save (the histogram is
+    cumulative KBs and rides every delta whole, newest-wins).
     """
     if not is_literal_pattern(pattern):
         return None
@@ -531,10 +547,14 @@ def grep_streaming(
     # ── checkpoint/restore (dsi_tpu/ckpt) ──
     ck_store: Optional[CheckpointStore] = None
     ck_policy: Optional[CheckpointPolicy] = None
+    ck_writer: Optional[CheckpointWriter] = None
     ck_cursor = {"offset": 0, "lines": 0}
     offsets: Optional[list] = None
     dispatch_idx = [0]
     start_offset = 0
+    ck_async = checkpoint_async_default(checkpoint_async)
+    ck_delta = checkpoint_delta_default(checkpoint_delta)
+    cand_mark = [0]  # non-dacc delta watermark into the cand_h append log
     if checkpoint_dir:
         ck_store = CheckpointStore(checkpoint_dir, "grep", {
             "n_dev": n_dev, "chunk_bytes": chunk_bytes,
@@ -543,36 +563,65 @@ def grep_streaming(
         ck_policy = CheckpointPolicy(checkpoint_every)
         offsets = []
         stats.update({"ckpt_saves": 0, "ckpt_s": 0.0,
-                      "ckpt_every": ck_policy.every})
+                      "ckpt_every": ck_policy.every,
+                      "ckpt_capture_s": 0.0,
+                      "ckpt_async": ck_async, "ckpt_delta": ck_delta})
+        ck_writer = CheckpointWriter(ck_store, stats, async_=ck_async,
+                                     delta=ck_delta)
+        if ck_delta and topk_svc is not None:
+            topk_svc.enable_delta()
         if resume:
             t_res = time.perf_counter()
-            loaded = ck_store.load_latest()
+            loaded = ck_store.load_latest_chain()
             if loaded is not None:
-                meta, arrays = loaded
-                start_offset = int(meta["cursor"])
+                meta, arrays, deltas = loaded
+                # Cursor/rung state is newest-wins: the final delta's
+                # meta IS the restore point; the base meta only names
+                # the image shapes.
+                eff = deltas[-1][0] if deltas else meta
+                start_offset = int(eff["cursor"])
                 ck_cursor.update(offset=start_offset,
-                                 lines=int(meta["lines"]))
-                next_line[0] = int(meta["lines"])
-                state["l_cap"] = int(meta["l_cap"])
+                                 lines=int(eff["lines"]))
+                next_line[0] = int(eff["lines"])
+                state["l_cap"] = int(eff["l_cap"])
                 stats["l_cap"] = state["l_cap"]
                 if device_accumulate:
                     acc.restore({k[3:]: v for k, v in arrays.items()
                                  if k.startswith("kc_")})
-                    if "hist" in arrays:
-                        hist_svc.restore_state({"hist": arrays["hist"]})
+                    # The histogram vector is cumulative and rides
+                    # every delta whole: the newest copy wins.
+                    hist_img = arrays.get("hist")
+                    for _, darr in deltas:
+                        if "hist" in darr:
+                            hist_img = darr["hist"]
+                    if hist_img is not None:
+                        hist_svc.restore_state({"hist": hist_img})
                     if meta.get("table_cap"):
                         img = {k[6:]: v for k, v in arrays.items()
                                if k.startswith("table_")}
-                        if int(meta.get("mesh_shards", 0)) == mesh_shards:
-                            topk_svc.restore_state(img)
-                        else:
-                            # Sharding degree changed since the
-                            # checkpoint: re-enter via the drain path
-                            # (manifest `mesh_shards` contract).
+                        same_degree = (int(meta.get("mesh_shards", 0))
+                                       == mesh_shards)
+                        if deltas or not same_degree:
+                            # Chain restore (and the sharding-degree
+                            # change) re-enters via the drain path:
+                            # the image's merged rows flow into the
+                            # KeyCounts accumulator, the candidate
+                            # table starts empty, and the resumed
+                            # folds rebuild device state.
                             DeviceTable.drain_image(acc, img)
-                            stats["resharded_resume"] = int(
-                                meta.get("mesh_shards", 0))
-                    policy.restore(meta.get("sync_since", 0))
+                            if not same_degree:
+                                stats["resharded_resume"] = int(
+                                    meta.get("mesh_shards", 0))
+                        else:
+                            topk_svc.restore_state(img)
+                            if ck_delta:
+                                topk_svc.enable_delta()
+                    policy.restore(eff.get("sync_since", 0))
+                    for _, darr in deltas:
+                        # Each delta's retained candidate steps re-enter
+                        # the accumulator in save order — the drain-path
+                        # argument, same as the cross-degree resume.
+                        drain_packed_steps(acc, darr)
                 else:
                     if "gs_hist" in arrays:
                         hist_h[:] = arrays["gs_hist"]
@@ -581,38 +630,85 @@ def grep_streaming(
                         cand_h.extend(
                             (int(a), int(b))
                             for a, b in arrays["gs_cands"].tolist())
+                    for _, darr in deltas:
+                        # Cumulative counters newest-wins; candidate
+                        # rows are the append-only log's increments.
+                        hist_h[:] = darr["gs_hist"]
+                        totals[:] = darr["gs_totals"]
+                        if "gs_cands" in darr:
+                            cand_h.extend(
+                                (int(a), int(b))
+                                for a, b in darr["gs_cands"].tolist())
+                    cand_mark[0] = len(cand_h)
             stats["resume_gap_s"] = round(time.perf_counter() - t_res, 4)
             stats["resume_cursor"] = start_offset
         else:
             ck_store.reset()
 
     def save_ckpt() -> None:
-        """Consistent snapshot at a confirmed-step boundary — device
-        images first (flushing the top-k lag can widen, whose drain
-        lands in the KeyCounts accumulator), host residue second."""
+        """Consistent snapshot at a confirmed-step boundary — capture
+        here (device images first: flushing the top-k lag can widen,
+        whose drain lands in the KeyCounts accumulator; host residue
+        second), commit inline or in the background writer
+        (``ckpt/writer.py``).  A delta save ships the candidate rows
+        appended since the previous save plus the cumulative histogram
+        vector (KBs — newest copy wins on restore); every
+        ``DSI_STREAM_CKPT_REBASE``-th save is a full re-base (an
+        invalid delta window forces one)."""
         with _span("ckpt", stats=stats, key="ckpt_s",
                    lines=ck_cursor["lines"]):
-            arrays: dict = {}
             meta = {"cursor": ck_cursor["offset"],
                     "lines": ck_cursor["lines"], "l_cap": state["l_cap"]}
-            if device_accumulate:
-                for k, v in topk_svc.checkpoint_state().items():
-                    arrays["table_" + k] = v
-                meta["table_cap"] = topk_svc.cap
-                meta["table_kk"] = topk_svc.kk
-                meta["mesh_shards"] = topk_svc.mesh_shards
-                arrays["hist"] = hist_svc.checkpoint_state()["hist"]
-                for k, v in acc.snapshot().items():
-                    arrays["kc_" + k] = v
-                meta["sync_since"] = policy.snapshot()
-            else:
-                arrays["gs_hist"] = hist_h.copy()
-                arrays["gs_totals"] = totals.copy()
-                if cand_h:
-                    arrays["gs_cands"] = np.array(cand_h, dtype=np.int64)
-            ck_store.save(arrays, meta)
-            stats["ckpt_saves"] += 1
-        fault_point("post-ckpt")
+            kind = "full"
+            parts = None
+            with _span("ckpt_capture", lane="ckpt", stats=stats,
+                       key="ckpt_capture_s"):
+                if ck_writer.want_delta():
+                    if device_accumulate:
+                        entries = topk_svc.take_delta()
+                        if entries is not None:
+                            parts = [("", DeltaSteps(entries)),
+                                     ("", {"hist": hist_svc
+                                           .checkpoint_state()["hist"]})]
+                            meta["sync_since"] = policy.snapshot()
+                            kind = "delta"
+                    else:
+                        new_cands = cand_h[cand_mark[0]:]
+                        cand_mark[0] = len(cand_h)
+                        d_arrays = {"gs_hist": hist_h.copy(),
+                                    "gs_totals": totals.copy()}
+                        if new_cands:
+                            d_arrays["gs_cands"] = np.array(new_cands,
+                                                            dtype=np.int64)
+                        parts = [("", d_arrays)]
+                        kind = "delta"
+                if parts is None:
+                    # Full image — the PR-5 arrays (device pulls
+                    # dispatched, not awaited), and a fresh delta
+                    # window: payloads recorded before this base are in
+                    # the image, so the logs reset here.
+                    parts = []
+                    if device_accumulate:
+                        parts.append(("table_",
+                                      topk_svc.checkpoint_capture()))
+                        meta["table_cap"] = topk_svc.cap
+                        meta["table_kk"] = topk_svc.kk
+                        meta["mesh_shards"] = topk_svc.mesh_shards
+                        parts.append(("", hist_svc.checkpoint_capture()))
+                        parts.append(("kc_", acc.snapshot()))
+                        meta["sync_since"] = policy.snapshot()
+                        if ck_delta:
+                            topk_svc.take_delta()
+                    else:
+                        arrays = {"gs_hist": hist_h.copy(),
+                                  "gs_totals": totals.copy()}
+                        if cand_h:
+                            arrays["gs_cands"] = np.array(cand_h,
+                                                          dtype=np.int64)
+                        parts.append(("", arrays))
+                    cand_mark[0] = len(cand_h)
+            fault_point("mid-capture")
+            ck_writer.commit(parts, meta, kind=kind)
 
     def step_call(buf, lens_np, bases_np, l_cap):
         with _span("upload", stats=stats, key="upload_s",
@@ -734,6 +830,9 @@ def grep_streaming(
             hist_h = final[:bins]
             totals = final[bins:]
             cand_h = [(line, occ) for line, occ in acc.finalize().items()]
+        if ck_writer is not None:
+            ck_writer.drain()  # surface async commit errors; counters
+            # settle before the caller reads them
         top = tuple(sorted(cand_h, key=lambda r: (-r[1], r[0]))[:topk])
         result = GrepStreamResult(int(totals[0]), int(totals[1]),
                                   int(totals[2]),
@@ -741,11 +840,14 @@ def grep_streaming(
     except _LineTooLong:
         result = None  # caller routes the job to the host path
     finally:
+        if ck_writer is not None:
+            ck_writer.shutdown()
         if pipeline_stats is not None:
             stats["batch_allocs"] = pool.allocs
             for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",
                       "pull_s", "merge_s", "replay_s", "fold_s", "sync_s",
-                      "widen_s", "hist_s", "ckpt_s"):
+                      "widen_s", "hist_s", "ckpt_s", "ckpt_capture_s",
+                      "ckpt_commit_s", "ckpt_barrier_s"):
                 if k in stats:
                     stats[k] = round(stats[k], 4)
             pipeline_stats.update(stats)
@@ -927,7 +1029,9 @@ def indexer_streaming(
         mesh_shards: Optional[int] = None, topk: int = DEFAULT_TOPK,
         stats: Optional[dict] = None,
         checkpoint_dir: Optional[str] = None,
-        checkpoint_every: Optional[int] = None, resume: bool = False,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_async: Optional[bool] = None,
+        checkpoint_delta: Optional[bool] = None, resume: bool = False,
 ):
     """Whole-corpus inverted index over the mesh, waves of ``n_dev``
     documents, pipelined ``depth`` waves deep.
@@ -1002,6 +1106,9 @@ def indexer_streaming(
     ck_store: Optional[CheckpointStore] = None
     resume_meta = None
     resume_arrays = None
+    resume_deltas: list = []
+    ck_async = checkpoint_async_default(checkpoint_async)
+    ck_delta = checkpoint_delta_default(checkpoint_delta)
     if checkpoint_dir:
         import zlib
 
@@ -1015,9 +1122,9 @@ def indexer_streaming(
             "n_docs": n_real, "doc_lens_crc32": lens_crc,
             "topk": topk, "device_accumulate": bool(device_accumulate)})
         if resume:
-            loaded = ck_store.load_latest()
+            loaded = ck_store.load_latest_chain()
             if loaded is not None:
-                resume_meta, resume_arrays = loaded
+                resume_meta, resume_arrays, resume_deltas = loaded
         else:
             ck_store.reset()
 
@@ -1059,20 +1166,33 @@ def indexer_streaming(
         # every row and restarts the walk, discarding rung state): apply
         # the loaded image only when this run() is at its rung.
         ck_policy: Optional[CheckpointPolicy] = None
+        ck_writer: Optional[CheckpointWriter] = None
         ck_wave = [0]  # confirmed-wave cursor (absolute ordinal)
+        host_delta = HostDeltaLog()  # non-dacc delta log: trimmed copies
+        # of the pulled (rows, nrows) waves, bounded like device logs
         start_wave = 0
         if ck_store is not None:
             ck_policy = CheckpointPolicy(checkpoint_every)
             st.setdefault("ckpt_saves", 0)
             st.setdefault("ckpt_s", 0.0)
+            st.setdefault("ckpt_capture_s", 0.0)
             st["ckpt_every"] = ck_policy.every
-            if resume_meta is not None and int(resume_meta["mwl"]) == mwl:
+            st["ckpt_async"] = ck_async
+            st["ckpt_delta"] = ck_delta
+            # A fresh writer per rung: a rung restart discards rung
+            # state, so its first save is a full base again.
+            ck_writer = CheckpointWriter(ck_store, st, async_=ck_async,
+                                         delta=ck_delta)
+            if ck_delta and buf_dev is not None:
+                buf_dev.enable_delta()
+            eff = resume_deltas[-1][0] if resume_deltas else resume_meta
+            if eff is not None and int(eff["mwl"]) == mwl:
                 t_res = time.perf_counter()
-                start_wave = int(resume_meta["wave"])
+                start_wave = int(eff["wave"])
                 ck_wave[0] = start_wave
-                state.update({"cap": int(resume_meta["cap"]),
-                              "grouper": resume_meta["grouper"],
-                              "frac": int(resume_meta["frac"])})
+                state.update({"cap": int(eff["cap"]),
+                              "grouper": eff["grouper"],
+                              "frac": int(eff["frac"])})
                 table.restore({k[3:]: v for k, v in resume_arrays.items()
                                if k.startswith("pt_")})
                 if device_accumulate:
@@ -1081,21 +1201,26 @@ def indexer_streaming(
                         pb_img = {"buf": resume_arrays["pb_buf"],
                                   "nrows": resume_arrays["pb_nrows"],
                                   "cap": resume_meta["pb_cap"]}
-                        if saved_shards == mesh_shards:
-                            buf_dev.restore_state(pb_img)
-                        else:
-                            # Degree changed: the buffered rows re-enter
-                            # through the drain path — host table first,
-                            # buffer starts empty at the new routing.
+                        if resume_deltas or saved_shards != mesh_shards:
+                            # Chain restore (and the sharding-degree
+                            # change) re-enters through the drain path:
+                            # buffered rows into the host table, buffer
+                            # empty; resumed waves rebuild device state.
                             DevicePostings.drain_image(buffer_rows, pb_img)
-                            st["resharded_resume"] = saved_shards
+                            if saved_shards != mesh_shards:
+                                st["resharded_resume"] = saved_shards
+                        else:
+                            buf_dev.restore_state(pb_img)
+                            if ck_delta:
+                                buf_dev.enable_delta()
                     df_acc.restore(
                         {k[3:]: v for k, v in resume_arrays.items()
                          if k.startswith("df_")})
                     if resume_meta.get("table_cap"):
                         img = {k[6:]: v for k, v in resume_arrays.items()
                                if k.startswith("table_")}
-                        if saved_shards == mesh_shards:
+                        if (not resume_deltas
+                                and saved_shards == mesh_shards):
                             topk_svc = DeviceTopK(
                                 mesh, kk=int(resume_meta["table_kk"]),
                                 cap=int(resume_meta["table_cap"]), k=topk,
@@ -1103,44 +1228,84 @@ def indexer_streaming(
                                 lag=max(0, depth - 1), stats=st,
                                 mesh_shards=mesh_shards)
                             topk_svc.restore_state(img)
+                            if ck_delta:
+                                topk_svc.enable_delta()
                         else:
                             DeviceTable.drain_image(df_acc, img)
-                            st["resharded_resume"] = saved_shards
-                    policy.restore(resume_meta.get("sync_since", 0))
+                            if saved_shards != mesh_shards:
+                                st["resharded_resume"] = saved_shards
+                    policy.restore(eff.get("sync_since", 0))
+                for _, darr in resume_deltas:
+                    # Each delta's retained wave payloads re-enter the
+                    # host side in save order — postings through the
+                    # sink (per-word order preserved: the drain-path
+                    # argument), df rows through the accumulator.
+                    drain_posting_steps(buffer_rows, darr, "pb_")
+                    drain_packed_steps(df_acc, darr, "tk_")
                 st["resume_gap_s"] = round(time.perf_counter() - t_res, 4)
                 st["resume_wave"] = start_wave
 
         def save_ckpt() -> None:
-            """Consistent snapshot at a confirmed-wave boundary.
-            Device images first — flushing the postings buffer's lag
-            drains into the host table on overflow recovery, and
-            flushing the df top-k's lag can widen into ``df_acc`` —
-            host residue second, so both sides of any such move land
-            in the same image."""
+            """Consistent snapshot at a confirmed-wave boundary —
+            capture here, commit inline or in the background writer
+            (``ckpt/writer.py``).  Device captures first — flushing the
+            postings buffer's lag drains into the host table on
+            overflow recovery, and flushing the df top-k's lag can
+            widen into ``df_acc`` — host residue second, so both sides
+            of any such move land in the same image.  A delta save
+            ships only the wave payloads retained since the previous
+            save (device logs in dacc mode, the already-pulled host
+            rows otherwise); every ``DSI_STREAM_CKPT_REBASE``-th save
+            is a full re-base (an invalid delta window forces one)."""
             with _span("ckpt", stats=st, key="ckpt_s", wave=ck_wave[0]):
-                arrays: dict = {}
                 meta = {"mwl": mwl, "wave": ck_wave[0],
                         "cap": state["cap"], "grouper": state["grouper"],
                         "frac": state["frac"]}
-                if buf_dev is not None:
-                    pb = buf_dev.checkpoint_state()
-                    arrays["pb_buf"] = pb["buf"]
-                    arrays["pb_nrows"] = pb["nrows"]
-                    meta["pb_cap"] = int(pb["cap"])
-                    meta["mesh_shards"] = buf_dev.mesh_shards
-                    if topk_svc is not None:
-                        for k, v in topk_svc.checkpoint_state().items():
-                            arrays["table_" + k] = v
-                        meta["table_cap"] = topk_svc.cap
-                        meta["table_kk"] = topk_svc.kk
-                    for k, v in df_acc.snapshot().items():
-                        arrays["df_" + k] = v
-                    meta["sync_since"] = policy.snapshot()
-                for k, v in table.snapshot().items():
-                    arrays["pt_" + k] = v
-                ck_store.save(arrays, meta)
-                st["ckpt_saves"] += 1
-            fault_point("post-ckpt")
+                kind = "full"
+                parts = None
+                with _span("ckpt_capture", lane="ckpt", stats=st,
+                           key="ckpt_capture_s"):
+                    if ck_writer.want_delta():
+                        if device_accumulate:
+                            pb_entries = buf_dev.take_delta()
+                            tk_entries = (topk_svc.take_delta()
+                                          if topk_svc is not None else [])
+                        else:
+                            pb_entries = host_delta.take()
+                            tk_entries = []
+                        if pb_entries is not None and tk_entries is not None:
+                            parts = [("pb_", DeltaSteps(pb_entries)),
+                                     ("tk_", DeltaSteps(tk_entries))]
+                            if device_accumulate:
+                                meta["sync_since"] = policy.snapshot()
+                            kind = "delta"
+                    if parts is None:
+                        # Full image — the PR-5 arrays (device pulls
+                        # dispatched, not awaited); the delta logs
+                        # reset here: payloads recorded before this
+                        # base are inside the image.
+                        parts = []
+                        if buf_dev is not None:
+                            parts.append(("pb_",
+                                          buf_dev.checkpoint_capture()))
+                            meta["pb_cap"] = buf_dev.cap
+                            meta["mesh_shards"] = buf_dev.mesh_shards
+                            if topk_svc is not None:
+                                parts.append(
+                                    ("table_",
+                                     topk_svc.checkpoint_capture()))
+                                meta["table_cap"] = topk_svc.cap
+                                meta["table_kk"] = topk_svc.kk
+                            parts.append(("df_", df_acc.snapshot()))
+                            meta["sync_since"] = policy.snapshot()
+                            if ck_delta:
+                                buf_dev.take_delta()
+                                if topk_svc is not None:
+                                    topk_svc.take_delta()
+                        host_delta.reset()
+                        parts.append(("pt_", table.snapshot()))
+                fault_point("mid-capture")
+                ck_writer.commit(parts, meta, kind=kind)
 
         def materialize():
             for idxs, size in waves[start_wave:]:
@@ -1213,8 +1378,11 @@ def indexer_streaming(
                         k=topk, acc=df_acc, aot=False,
                         lag=max(0, depth - 1), stats=st,
                         mesh_shards=mesh_shards)
+                    if ck_store is not None and ck_delta:
+                        topk_svc.enable_delta()
                 pulls_before = st["sync_pulls"]
-                buf_dev.append(rows, scal)
+                buf_dev.append(rows, scal,
+                               nvalid=scal_np[:, 0].astype(np.int64))
                 topk_svc.fold(df, scal, scal_np)
                 policy.note_fold()
                 if st["sync_pulls"] != pulls_before:
@@ -1235,6 +1403,10 @@ def indexer_streaming(
                     nr = int(scal_np[d, 0])
                     if nr:
                         buffer_rows(rows_np[d, :nr])
+                if ck_store is not None and ck_delta:
+                    # Host-merge delta log: the wave's payload, window-
+                    # bounded like the device logs.
+                    host_delta.append(rows_np, scal_np[:, 0])
 
         def finish(rec):
             size, chunk_np, ids_np, rows, df, scal, cap = rec
@@ -1268,14 +1440,21 @@ def indexer_streaming(
                             thread_name="dsi-idx-materializer",
                             engine="indexer")
         try:
-            pipe.run(materialize)
-        except _AbortRung:
-            return ("high" if outcome["high"] else "widen", None)
-        if buf_dev is not None:
-            fault_point("pre-sync")
-            buf_dev.close()
-            if topk_svc is not None:
-                topk_svc.close()
+            try:
+                pipe.run(materialize)
+            except _AbortRung:
+                return ("high" if outcome["high"] else "widen", None)
+            if buf_dev is not None:
+                fault_point("pre-sync")
+                buf_dev.close()
+                if topk_svc is not None:
+                    topk_svc.close()
+            if ck_writer is not None:
+                ck_writer.drain()  # surface async commit errors before
+                # the payload (and the save counters) are read
+        finally:
+            if ck_writer is not None:
+                ck_writer.shutdown()
 
         def payload():
             postings = {

@@ -106,6 +106,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from dsi_tpu.ckpt import (
     CheckpointPolicy,
     CheckpointStore,
+    CheckpointWriter,
+    DeltaSteps,
+    HostDeltaLog,
+    checkpoint_async_default,
+    checkpoint_delta_default,
+    drain_packed_steps,
     fault_point,
     skip_stream,
 )
@@ -553,6 +559,8 @@ def wordcount_streaming(
         mesh_shards: Optional[int] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: Optional[int] = None,
+        checkpoint_async: Optional[bool] = None,
+        checkpoint_delta: Optional[bool] = None,
         resume: bool = False,
 ) -> Optional[Dict[str, Tuple[int, int]]]:
     """Exact whole-stream word counts with bounded memory, pipelined.
@@ -630,6 +638,24 @@ def wordcount_streaming(
     the final result is bit-identical to an uninterrupted run.
     ``pipeline_stats`` gains ``ckpt_saves``/``ckpt_s`` and, on resume,
     ``resume_gap_s``/``resume_cursor``.
+
+    ``checkpoint_async`` (default ``DSI_STREAM_CKPT_ASYNC``, off) splits
+    each save into capture (at the boundary: dispatch the image pulls,
+    snapshot the host accumulators by reference) and commit (a
+    background writer waits on the in-flight pulls, serializes, and
+    runs the durable-write path) so steps keep flowing while the
+    snapshot drains — the engine blocks only when the NEXT save finds
+    the previous commit still draining (``ckpt_barrier_s``).
+    ``checkpoint_delta`` (default ``DSI_STREAM_CKPT_DELTA``, off) makes
+    saves INCREMENTAL: a delta ships only the confirmed step payloads
+    appended since the previous save (the store chains ``delta-<seq>``
+    manifests; restore = base + ordered deltas re-ingested through the
+    host drain path) with a full re-base every
+    ``DSI_STREAM_CKPT_REBASE`` saves.  Both default off = bit-identical
+    PR-5 behavior; resume parity is unchanged either way.
+    ``pipeline_stats`` gains ``ckpt_capture_s``/``ckpt_commit_s``/
+    ``ckpt_barrier_s`` and ``ckpt_deltas``/``ckpt_full_bytes``/
+    ``ckpt_delta_bytes``.
     """
     if mesh is None:
         mesh = default_mesh()
@@ -675,10 +701,15 @@ def wordcount_streaming(
     # ── checkpoint/restore (dsi_tpu/ckpt) ──
     ck_store: Optional[CheckpointStore] = None
     ck_policy: Optional[CheckpointPolicy] = None
+    ck_writer: Optional[CheckpointWriter] = None
     ck_cursor = {"offset": 0, "steps": 0}  # last CONFIRMED step's end
     offsets: Optional[list] = None
     dispatch_idx = [0]
     start_offset = 0
+    ck_async = checkpoint_async_default(checkpoint_async)
+    ck_delta = checkpoint_delta_default(checkpoint_delta)
+    host_delta = HostDeltaLog()  # non-dacc delta log: trimmed copies of
+    # the pulled (packed, nus) steps, bounded like the device logs
     if checkpoint_dir:
         ck_store = CheckpointStore(checkpoint_dir, "wordcount", {
             "n_dev": n_dev, "n_reduce": n_reduce,
@@ -687,25 +718,47 @@ def wordcount_streaming(
         ck_policy = CheckpointPolicy(checkpoint_every)
         offsets = []
         stats.update({"ckpt_saves": 0, "ckpt_s": 0.0,
-                      "ckpt_every": ck_policy.every})
+                      "ckpt_every": ck_policy.every,
+                      "ckpt_capture_s": 0.0,
+                      "ckpt_async": ck_async, "ckpt_delta": ck_delta})
+        ck_writer = CheckpointWriter(ck_store, stats, async_=ck_async,
+                                     delta=ck_delta)
         if resume:
             t_res = time.perf_counter()
-            loaded = ck_store.load_latest()
+            loaded = ck_store.load_latest_chain()
             if loaded is not None:
-                meta, arrays = loaded
-                start_offset = int(meta["cursor"])
+                meta, arrays, deltas = loaded
+                # Cursor/rung state is newest-wins: the final delta's
+                # meta IS the restore point; the base meta only names
+                # the image shape.
+                eff = deltas[-1][0] if deltas else meta
+                start_offset = int(eff["cursor"])
                 ck_cursor.update(offset=start_offset,
-                                 steps=int(meta["steps"]))
-                state.update({"cap": int(meta["cap"]),
-                              "mwl": int(meta["mwl"]),
-                              "grouper": meta["grouper"],
-                              "frac": int(meta["frac"])})
+                                 steps=int(eff["steps"]))
+                state.update({"cap": int(eff["cap"]),
+                              "mwl": int(eff["mwl"]),
+                              "grouper": eff["grouper"],
+                              "frac": int(eff["frac"])})
                 acc.restore({k[4:]: v for k, v in arrays.items()
                              if k.startswith("acc_")})
                 if device_accumulate and meta.get("table_cap"):
                     img = {k[6:]: v for k, v in arrays.items()
                            if k.startswith("table_")}
-                    if int(meta.get("mesh_shards", 0)) == mesh_shards:
+                    same_degree = (int(meta.get("mesh_shards", 0))
+                                   == mesh_shards)
+                    if deltas or not same_degree:
+                        # Chain restore (and the sharding-degree change)
+                        # re-enters through the DRAIN path: the image's
+                        # merged rows flow into the host accumulator,
+                        # the table starts empty, and the resumed folds
+                        # rebuild device state.  base + ordered deltas
+                        # is content-exact, so the final output stays
+                        # bit-identical.
+                        DeviceTable.drain_image(acc, img)
+                        if not same_degree:
+                            stats["resharded_resume"] = int(
+                                meta.get("mesh_shards", 0))
+                    else:
                         # Re-enter device_accumulate mid-table: the
                         # image's capacity/width win (a pre-crash widen
                         # sticks).
@@ -715,17 +768,14 @@ def wordcount_streaming(
                             lag=max(0, depth - 1), stats=stats,
                             mesh_shards=mesh_shards)
                         table_svc.restore_state(img)
-                    else:
-                        # The checkpoint's sharding degree differs from
-                        # this run's (manifest `mesh_shards`): re-enter
-                        # through the DRAIN path — the image's merged
-                        # rows flow into the host accumulator, the
-                        # table starts empty at the new degree, and the
-                        # resumed folds re-shuffle key ownership.
-                        DeviceTable.drain_image(acc, img)
-                        stats["resharded_resume"] = int(
-                            meta.get("mesh_shards", 0))
-                    policy.restore(meta.get("sync_since", 0))
+                        if ck_delta:
+                            table_svc.enable_delta()
+                    policy.restore(eff.get("sync_since", 0))
+                for _, darr in deltas:
+                    # Each delta's retained step payloads re-enter the
+                    # host accumulator in save order — the same
+                    # drain-path argument as the cross-degree resume.
+                    drain_packed_steps(acc, darr)
                 if aot:
                     # Re-warm the sticky-rung executables now (persistent
                     # cache loads), so the first resumed step dispatches
@@ -765,6 +815,8 @@ def wordcount_streaming(
                 cap=cap if cap > 0 else int(packed_dev.shape[1]),
                 acc=acc, aot=aot, lag=max(0, depth - 1), stats=stats,
                 mesh_shards=mesh_shards)
+            if ck_delta and ck_store is not None:
+                table_svc.enable_delta()
         table_svc.fold(packed_dev, scal_dev, scal_np)
         policy.note_fold()
         if policy.due():
@@ -773,35 +825,62 @@ def wordcount_streaming(
             policy.reset()
 
     def save_ckpt() -> None:
-        """One consistent snapshot at a confirmed-step boundary.  The
-        device table's image is captured FIRST: flushing its lagged
-        flags can trigger a widen whose drain lands in the host
-        accumulator, and the snapshot must hold both sides of that
-        move.  Everything in the in-flight window is deliberately
-        absent — those steps were never merged, and resume re-processes
-        them from the cursor."""
+        """One consistent snapshot at a confirmed-step boundary —
+        capture here, commit inline (sync) or in the background writer
+        (async; ``ckpt/writer.py``).  The device table is captured
+        FIRST: flushing its lagged flags can trigger a widen whose
+        drain lands in the host accumulator, and the snapshot must hold
+        both sides of that move.  Everything in the in-flight window is
+        deliberately absent — those steps were never merged, and resume
+        re-processes them from the cursor.  A delta save ships only the
+        step payloads retained since the previous save (device log in
+        dacc mode, the already-pulled host payloads otherwise); every
+        ``DSI_STREAM_CKPT_REBASE``-th save is a full re-base (an
+        invalid delta window forces one)."""
         with _span("ckpt", stats=stats, key="ckpt_s",
                    step=ck_cursor["steps"]):
-            arrays: dict = {}
             meta = {"cursor": ck_cursor["offset"],
                     "steps": ck_cursor["steps"],
                     "cap": state["cap"], "mwl": state["mwl"],
                     "grouper": state["grouper"], "frac": state["frac"]}
-            if table_svc is not None:
-                for k, v in table_svc.checkpoint_state().items():
-                    arrays["table_" + k] = v
-                meta["table_cap"] = table_svc.cap
-                meta["table_kk"] = table_svc.kk
-                # The manifest records the image's sharding degree so a
-                # resume onto a different mesh degree re-shuffles via
-                # the drain path instead of misreading shard ownership.
-                meta["mesh_shards"] = table_svc.mesh_shards
-                meta["sync_since"] = policy.snapshot()
-            for k, v in acc.snapshot().items():
-                arrays["acc_" + k] = v
-            ck_store.save(arrays, meta)
-            stats["ckpt_saves"] += 1
-        fault_point("post-ckpt")
+            kind = "full"
+            parts = None
+            with _span("ckpt_capture", lane="ckpt", stats=stats,
+                       key="ckpt_capture_s"):
+                if ck_writer.want_delta():
+                    if device_accumulate:
+                        entries = (table_svc.take_delta()
+                                   if table_svc is not None else [])
+                    else:
+                        entries = host_delta.take()
+                    if entries is not None:
+                        parts = [("", DeltaSteps(entries))]
+                        kind = "delta"
+                        if device_accumulate:
+                            meta["mesh_shards"] = mesh_shards
+                            meta["sync_since"] = policy.snapshot()
+                if parts is None:
+                    # Full image — the PR-5 arrays, and a fresh delta
+                    # window: payloads recorded before this base are in
+                    # the image, so both logs reset here.
+                    parts = []
+                    if table_svc is not None:
+                        parts.append(("table_",
+                                      table_svc.checkpoint_capture()))
+                        meta["table_cap"] = table_svc.cap
+                        meta["table_kk"] = table_svc.kk
+                        # The manifest records the image's sharding
+                        # degree so a resume onto a different mesh
+                        # degree re-shuffles via the drain path instead
+                        # of misreading shard ownership.
+                        meta["mesh_shards"] = table_svc.mesh_shards
+                        meta["sync_since"] = policy.snapshot()
+                        if ck_delta:
+                            table_svc.take_delta()
+                    host_delta.reset()
+                    parts.append(("acc_", acc.snapshot()))
+            fault_point("mid-capture")
+            ck_writer.commit(parts, meta, kind=kind)
     # Live host buffers = out queue (≤ depth+1) + in-flight window
     # (≤ depth) + one being filled + one being finished.
     pool = BufferPool((n_dev, chunk_bytes), retain=2 * depth + 3)
@@ -947,6 +1026,11 @@ def wordcount_streaming(
                 with _span("merge", stats=stats, key="merge_s"):
                     if packed is not None:
                         acc.add_packed_step(packed, nus, kk)
+                        if ck_delta and ck_store is not None:
+                            # Host-merge delta log: the step's payload,
+                            # trimmed+copied (an AOT pull is capacity-
+                            # shaped) and window-bounded.
+                            host_delta.append(packed, nus)
         else:
             # Late-detected overflow: replay just this step through the
             # ladder.  Exactly-once by construction — the optimistic
@@ -967,6 +1051,8 @@ def wordcount_streaming(
                     if packed is not None:
                         stats["step_pulls"] += 1
                         acc.add_packed_step(packed, nus, kk)
+                        if ck_delta and ck_store is not None:
+                            host_delta.append(packed, nus)
         # This step is now CONFIRMED: its output is merged/folded and
         # nothing after it is.  The fault point sits BEFORE the cursor
         # advances — the classic torn-update instant.
@@ -995,15 +1081,21 @@ def wordcount_streaming(
         if table_svc is not None:
             fault_point("pre-sync")
             table_svc.close()  # the "or at stream end" pull
+        if ck_writer is not None:
+            ck_writer.drain()  # surface async commit errors; counters
+            # settle before the caller reads them
         result = acc.finalize()
     except (_TokenTooLong, _NeedsHostPath):
         result = None  # caller routes the job to the host path
     finally:
+        if ck_writer is not None:
+            ck_writer.shutdown()
         if pipeline_stats is not None:
             stats["batch_allocs"] = pool.allocs
             for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",
                       "pull_s", "merge_s", "replay_s", "fold_s", "sync_s",
-                      "widen_s", "ckpt_s"):
+                      "widen_s", "ckpt_s", "ckpt_capture_s",
+                      "ckpt_commit_s", "ckpt_barrier_s"):
                 if k in stats:
                     stats[k] = round(stats[k], 4)
             pipeline_stats.update(stats)

@@ -52,7 +52,17 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dsi_tpu.ckpt import CheckpointPolicy, CheckpointStore, fault_point
+from dsi_tpu.ckpt import (
+    CheckpointPolicy,
+    CheckpointStore,
+    CheckpointWriter,
+    DeltaSteps,
+    HostDeltaLog,
+    checkpoint_async_default,
+    checkpoint_delta_default,
+    drain_posting_steps,
+    fault_point,
+)
 from dsi_tpu.obs import metrics_scope, span as _span
 from dsi_tpu.utils.jaxcompat import (enable_x64, x64_scoped,
                                      shard_map as _shard_map)
@@ -249,7 +259,9 @@ def tfidf_sharded(
         mesh_shards: Optional[int] = None,
         wave_stats: Optional[dict] = None, depth: Optional[int] = None,
         checkpoint_dir: Optional[str] = None,
-        checkpoint_every: Optional[int] = None, resume: bool = False,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_async: Optional[bool] = None,
+        checkpoint_delta: Optional[bool] = None, resume: bool = False,
 ):
     """Whole-corpus TF-IDF over the mesh, waves of n_dev documents,
     pipelined ``depth`` waves deep.
@@ -358,6 +370,9 @@ def tfidf_sharded(
     ck_store: Optional[CheckpointStore] = None
     resume_meta = None
     resume_arrays = None
+    resume_deltas: list = []
+    ck_async = checkpoint_async_default(checkpoint_async)
+    ck_delta = checkpoint_delta_default(checkpoint_delta)
     if checkpoint_dir:
         import zlib
 
@@ -373,9 +388,9 @@ def tfidf_sharded(
                            if partitions is not None else None),
             "device_accumulate": bool(device_accumulate)})
         if resume:
-            loaded = ck_store.load_latest()
+            loaded = ck_store.load_latest_chain()
             if loaded is not None:
-                resume_meta, resume_arrays = loaded
+                resume_meta, resume_arrays, resume_deltas = loaded
         else:
             ck_store.reset()
 
@@ -450,68 +465,112 @@ def tfidf_sharded(
         # restart discards rung state): apply the loaded image only at
         # its own rung.
         ck_policy: Optional[CheckpointPolicy] = None
+        ck_writer: Optional[CheckpointWriter] = None
         ck_wave = [0]
+        host_delta = HostDeltaLog()  # non-dacc delta log: trimmed copies
+        # of the pulled (rows, nrows) waves, bounded like device logs
         start_wave = 0
         if ck_store is not None:
             ck_policy = CheckpointPolicy(checkpoint_every)
             stats.setdefault("ckpt_saves", 0)
             stats.setdefault("ckpt_s", 0.0)
+            stats.setdefault("ckpt_capture_s", 0.0)
             stats["ckpt_every"] = ck_policy.every
-            if resume_meta is not None and int(resume_meta["mwl"]) == mwl:
+            stats["ckpt_async"] = ck_async
+            stats["ckpt_delta"] = ck_delta
+            # A fresh writer per rung: a rung restart discards rung
+            # state, so its first save is a full base again.
+            ck_writer = CheckpointWriter(ck_store, stats, async_=ck_async,
+                                         delta=ck_delta)
+            if ck_delta and buf_dev is not None:
+                buf_dev.enable_delta()
+            # Cursor/rung state is newest-wins: the final delta's meta
+            # IS the restore point; the base meta names image shapes.
+            eff = resume_deltas[-1][0] if resume_deltas else resume_meta
+            if eff is not None and int(eff["mwl"]) == mwl:
                 t_res = time.perf_counter()
-                start_wave = int(resume_meta["wave"])
+                start_wave = int(eff["wave"])
                 ck_wave[0] = start_wave
-                state.update({"cap": int(resume_meta["cap"]),
-                              "grouper": resume_meta["grouper"],
-                              "frac": int(resume_meta["frac"])})
+                state.update({"cap": int(eff["cap"]),
+                              "grouper": eff["grouper"],
+                              "frac": int(eff["frac"])})
                 table.restore({k[3:]: v for k, v in resume_arrays.items()
                                if k.startswith("pt_")})
                 if buf_dev is not None and resume_meta.get("pb_cap"):
-                    if int(resume_meta.get("mesh_shards",
-                                           0)) == mesh_shards:
-                        buf_dev.restore_state(
-                            {"buf": resume_arrays["pb_buf"],
-                             "nrows": resume_arrays["pb_nrows"],
-                             "cap": resume_meta["pb_cap"]})
+                    pb_img = {"buf": resume_arrays["pb_buf"],
+                              "nrows": resume_arrays["pb_nrows"],
+                              "cap": resume_meta["pb_cap"]}
+                    saved_shards = int(resume_meta.get("mesh_shards", 0))
+                    if resume_deltas or saved_shards != mesh_shards:
+                        # Chain restore (and the sharding-degree
+                        # change) re-enters via the drain path — the
+                        # buffered rows into the host table, buffer
+                        # empty; resumed waves rebuild device state.
+                        DevicePostings.drain_image(buffer_rows, pb_img)
+                        if saved_shards != mesh_shards:
+                            stats["resharded_resume"] = saved_shards
                     else:
-                        # Sharding degree changed (manifest
-                        # `mesh_shards`): buffered rows re-enter via
-                        # the drain path — host table first, buffer
-                        # empty at the new routing.
-                        DevicePostings.drain_image(
-                            buffer_rows,
-                            {"buf": resume_arrays["pb_buf"],
-                             "nrows": resume_arrays["pb_nrows"]})
-                        stats["resharded_resume"] = int(
-                            resume_meta.get("mesh_shards", 0))
+                        buf_dev.restore_state(pb_img)
+                        if ck_delta:
+                            buf_dev.enable_delta()
                 if policy is not None:
-                    policy.restore(resume_meta.get("sync_since", 0))
+                    policy.restore(eff.get("sync_since", 0))
+                for _, darr in resume_deltas:
+                    # Each delta's retained wave payloads re-enter the
+                    # host table through the sink in save order —
+                    # per-word posting order preserved, the drain-path
+                    # argument the cross-degree resume rests on.
+                    drain_posting_steps(buffer_rows, darr, "pb_")
                 stats["resume_gap_s"] = round(
                     time.perf_counter() - t_res, 4)
                 stats["resume_wave"] = start_wave
 
         def save_ckpt() -> None:
-            """Consistent snapshot at a confirmed-wave boundary: the
-            device buffer's drain-free image FIRST (flushing its lag
-            can drain into the host table), host residue second."""
+            """Consistent snapshot at a confirmed-wave boundary —
+            capture here, commit inline or in the background writer
+            (``ckpt/writer.py``): the device buffer's capture FIRST
+            (flushing its lag can drain into the host table), host
+            residue second.  A delta save ships only the wave payloads
+            retained since the previous save; every
+            ``DSI_STREAM_CKPT_REBASE``-th save is a full re-base (an
+            invalid delta window forces one)."""
             with _span("ckpt", stats=stats, key="ckpt_s",
                        wave=ck_wave[0]):
-                arrays: dict = {}
                 meta = {"mwl": mwl, "wave": ck_wave[0],
                         "cap": state["cap"], "grouper": state["grouper"],
                         "frac": state["frac"]}
-                if buf_dev is not None:
-                    pb = buf_dev.checkpoint_state()
-                    arrays["pb_buf"] = pb["buf"]
-                    arrays["pb_nrows"] = pb["nrows"]
-                    meta["pb_cap"] = int(pb["cap"])
-                    meta["mesh_shards"] = buf_dev.mesh_shards
-                    meta["sync_since"] = policy.snapshot()
-                for k, v in table.snapshot().items():
-                    arrays["pt_" + k] = v
-                ck_store.save(arrays, meta)
-                stats["ckpt_saves"] += 1
-            fault_point("post-ckpt")
+                kind = "full"
+                parts = None
+                with _span("ckpt_capture", lane="ckpt", stats=stats,
+                           key="ckpt_capture_s"):
+                    if ck_writer.want_delta():
+                        if buf_dev is not None:
+                            entries = buf_dev.take_delta()
+                        else:
+                            entries = host_delta.take()
+                        if entries is not None:
+                            parts = [("pb_", DeltaSteps(entries))]
+                            if policy is not None:
+                                meta["sync_since"] = policy.snapshot()
+                            kind = "delta"
+                    if parts is None:
+                        # Full image — the PR-5 arrays (device pull
+                        # dispatched, not awaited); the delta logs
+                        # reset here: payloads recorded before this
+                        # base are inside the image.
+                        parts = []
+                        if buf_dev is not None:
+                            parts.append(("pb_",
+                                          buf_dev.checkpoint_capture()))
+                            meta["pb_cap"] = buf_dev.cap
+                            meta["mesh_shards"] = buf_dev.mesh_shards
+                            meta["sync_since"] = policy.snapshot()
+                            if ck_delta:
+                                buf_dev.take_delta()
+                        host_delta.reset()
+                        parts.append(("pt_", table.snapshot()))
+                fault_point("mid-capture")
+                ck_writer.commit(parts, meta, kind=kind)
 
         def materialize():
             for idxs, size in waves[start_wave:]:
@@ -579,7 +638,8 @@ def tfidf_sharded(
                 return
             if buf_dev is not None:
                 pulls_before = stats["sync_pulls"]
-                buf_dev.append(rows, scal)
+                buf_dev.append(rows, scal,
+                               nvalid=scal_np[:, 0].astype(np.int64))
                 policy.note_fold()
                 if stats["sync_pulls"] != pulls_before:
                     policy.reset()  # an overflow recovery just drained:
@@ -602,6 +662,10 @@ def tfidf_sharded(
                     nr = int(scal_np[d, 0])
                     if nr:
                         buffer_rows(rows_np[d, :nr])
+                if ck_store is not None and ck_delta:
+                    # Host-merge delta log: the wave's payload, window-
+                    # bounded like the device logs.
+                    host_delta.append(rows_np, scal_np[:, 0])
 
         def finish(rec):
             """Retire the oldest in-flight wave: deferred scalar check,
@@ -639,12 +703,19 @@ def tfidf_sharded(
                             thread_name="dsi-wave-materializer",
                             engine="tfidf")
         try:
-            pipe.run(materialize)
-        except _AbortRung:
-            return ("high" if outcome["high"] else "widen", None)
-        if buf_dev is not None:
-            fault_point("pre-sync")
-            buf_dev.close()  # end-of-walk sync
+            try:
+                pipe.run(materialize)
+            except _AbortRung:
+                return ("high" if outcome["high"] else "widen", None)
+            if buf_dev is not None:
+                fault_point("pre-sync")
+                buf_dev.close()  # end-of-walk sync
+            if ck_writer is not None:
+                ck_writer.drain()  # surface async commit errors before
+                # the payload (and the save counters) are read
+        finally:
+            if ck_writer is not None:
+                ck_writer.shutdown()
         return ("ok", table.finalize_packed if packed else table.finalize)
 
     # The word-window ladder (exactness_retry's outer rung, hand-rolled

@@ -47,6 +47,15 @@ def main() -> int:
                     help="resume from the newest valid checkpoint in "
                          "--checkpoint-dir (kill the soak with "
                          "DSI_FAULT_POINT/DSI_FAULT_STEP to exercise it)")
+    ap.add_argument("--ckpt-async", action="store_true", default=None,
+                    dest="ckpt_async",
+                    help="overlap checkpoint commits with the pipeline "
+                         "(env DSI_STREAM_CKPT_ASYNC)")
+    ap.add_argument("--ckpt-delta", action="store_true", default=None,
+                    dest="ckpt_delta",
+                    help="incremental checkpoints, full re-base every "
+                         "DSI_STREAM_CKPT_REBASE saves (env "
+                         "DSI_STREAM_CKPT_DELTA)")
     ap.add_argument("--trace-dir", default=None,
                     help="write the soak's unified trace (dsi_tpu/obs): "
                          "Perfetto trace.json + trace.jsonl; render "
@@ -97,6 +106,8 @@ def main() -> int:
                               mesh_shards=args.mesh_shards,
                               checkpoint_dir=args.checkpoint_dir,
                               checkpoint_every=args.checkpoint_every,
+                              checkpoint_async=args.ckpt_async,
+                              checkpoint_delta=args.ckpt_delta,
                               resume=args.resume,
                               pipeline_stats=pstats)
     dt = time.perf_counter() - t0

@@ -67,10 +67,13 @@ def _bench_line(path: str) -> str:
             "grep_mbps", "grep_mb", "grep_matched", "grep_oracle_mbps",
             "grep_vs_oracle", "grep_parity",
             # Checkpoint/restore cost keys riding the stream row
-            # (dsi_tpu/ckpt): checkpointed-pass overhead vs the plain
-            # pass, and the resumed pass's restore wall.
-            "ckpt_overhead_pct", "ckpt_every", "ckpt_saves",
-            "resume_gap_s", "resume_parity",
+            # (dsi_tpu/ckpt), the cadence-1 sync-vs-async A/B:
+            # sync-full overhead vs overlapped+incremental, the
+            # full-vs-delta payload bytes, and the chain restore wall.
+            "ckpt_overhead_pct", "ckpt_async_overhead_pct",
+            "ckpt_every", "ckpt_saves", "ckpt_deltas",
+            "ckpt_full_bytes_per_save", "ckpt_delta_bytes_per_save",
+            "ckpt_barrier_s", "resume_gap_s", "resume_parity",
             "tpu_error")
     parts = [f"{k}={d[k]}" for k in keys if k in d]
     phases = d.get("phases")

@@ -57,11 +57,30 @@ def main() -> int:
                     help="waves between host pulls with "
                          "--device-accumulate (default: "
                          "DSI_STREAM_SYNC_EVERY or 8)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="enable crash-resume checkpoints (dsi_tpu/ckpt)")
+    ap.add_argument("--checkpoint-every", type=int, default=None,
+                    help="confirmed waves between checkpoints (default: "
+                         "DSI_STREAM_CKPT_EVERY or 32)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the newest valid checkpoint in "
+                         "--checkpoint-dir")
+    ap.add_argument("--ckpt-async", action="store_true", default=None,
+                    dest="ckpt_async",
+                    help="overlap checkpoint commits with the wave walk "
+                         "(env DSI_STREAM_CKPT_ASYNC)")
+    ap.add_argument("--ckpt-delta", action="store_true", default=None,
+                    dest="ckpt_delta",
+                    help="incremental checkpoints, full re-base every "
+                         "DSI_STREAM_CKPT_REBASE saves (env "
+                         "DSI_STREAM_CKPT_DELTA)")
     ap.add_argument("--trace-dir", default=None,
                     help="write the soak's unified trace (dsi_tpu/obs): "
                          "Perfetto trace.json + trace.jsonl; render "
                          "with scripts/tracecat.py")
     args = ap.parse_args()
+    if args.resume and not args.checkpoint_dir:
+        ap.error("--resume requires --checkpoint-dir")
 
     if args.trace_dir:
         from dsi_tpu.obs import configure_tracing
@@ -103,6 +122,11 @@ def main() -> int:
                         device_accumulate=args.device_accumulate,
                         sync_every=args.sync_every,
                         mesh_shards=args.mesh_shards,
+                        checkpoint_dir=args.checkpoint_dir,
+                        checkpoint_every=args.checkpoint_every,
+                        checkpoint_async=args.ckpt_async,
+                        checkpoint_delta=args.ckpt_delta,
+                        resume=args.resume,
                         wave_stats=wave_stats)
     wall = time.perf_counter() - t0
     assert res is not None, "tfidf fell back to host"

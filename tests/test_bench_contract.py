@@ -95,12 +95,24 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         # The checkpoint/restore cost keys ride the measured stream row
         # under the same measured-XOR-skipped contract (dsi_tpu/ckpt):
         # either both cost numbers with their parity gate, or a reason.
+        # ISSUE 8 made the row a cadence-1 sync-vs-async A/B: the async
+        # overhead + full-bytes keys always accompany the sync one, and
+        # the per-delta bytes key rides exactly when the async pass
+        # produced at least one incremental save.
         assert ("ckpt_skipped" in v) != ("ckpt_overhead_pct" in v)
         if "ckpt_overhead_pct" in v:
             assert v["resume_parity"] is True
             assert v["ckpt_saves"] >= 1
             assert v["resume_gap_s"] >= 0
             assert isinstance(v["ckpt_overhead_pct"], (int, float))
+            assert isinstance(v["ckpt_async_overhead_pct"], (int, float))
+            assert v["ckpt_every"] == 1
+            assert v["ckpt_full_bytes_per_save"] > 0
+            assert v["ckpt_barrier_s"] >= 0
+            assert (("ckpt_delta_bytes_per_save" in v)
+                    == (v["ckpt_deltas"] >= 1))
+            if "ckpt_delta_bytes_per_save" in v:
+                assert v["ckpt_delta_bytes_per_save"] > 0
     # The distributed N-worker row (the reference's own headline shape,
     # test-mr.sh:36-53) rides the same verdict: measured or skipped.
     assert ("framework_skipped" in v) != ("framework_mbps" in v)

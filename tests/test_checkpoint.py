@@ -71,7 +71,7 @@ IDX_DOCS = [(" ".join(WC_WORDS[(3 * i) % 90:(3 * i) % 90 + 14])
 #: BEFORE the crash for every point (every=2): resume must restore real
 #: state, not just start over.
 _FAULT_AT = {"post-dispatch": 4, "mid-fold": 4, "pre-sync": 2,
-             "post-ckpt": 2}
+             "post-ckpt": 2, "mid-capture": 2, "mid-commit": 2}
 
 _BASE = {}
 
@@ -87,31 +87,34 @@ def _clear_fault(monkeypatch):
         monkeypatch.delenv(k, raising=False)
 
 
-def _run_wc(ckpt=None, resume=False, dacc=False, depth=2, stats=None):
+def _run_wc(ckpt=None, resume=False, dacc=False, depth=2, stats=None,
+            async_=None, delta=None):
     reset_faults()
     return wordcount_streaming(
         [WC_TEXT], mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK,
         u_cap=256, depth=depth, device_accumulate=dacc, sync_every=2,
-        checkpoint_dir=ckpt, checkpoint_every=2, resume=resume,
-        pipeline_stats=stats)
+        checkpoint_dir=ckpt, checkpoint_every=2, checkpoint_async=async_,
+        checkpoint_delta=delta, resume=resume, pipeline_stats=stats)
 
 
-def _run_grep(ckpt=None, resume=False, dacc=False, depth=2, stats=None):
+def _run_grep(ckpt=None, resume=False, dacc=False, depth=2, stats=None,
+              async_=None, delta=None):
     reset_faults()
     return grep_streaming(
         [GREP_TEXT], "ab", mesh=_mesh(), chunk_bytes=GREP_CHUNK,
         depth=depth, device_accumulate=dacc, sync_every=2, topk=8,
-        checkpoint_dir=ckpt, checkpoint_every=2, resume=resume,
-        pipeline_stats=stats)
+        checkpoint_dir=ckpt, checkpoint_every=2, checkpoint_async=async_,
+        checkpoint_delta=delta, resume=resume, pipeline_stats=stats)
 
 
-def _run_idx(ckpt=None, resume=False, dacc=False, depth=2, stats=None):
+def _run_idx(ckpt=None, resume=False, dacc=False, depth=2, stats=None,
+             async_=None, delta=None):
     reset_faults()
     return indexer_streaming(
         IDX_DOCS, mesh=_mesh(), n_reduce=10, u_cap=1 << 9, depth=depth,
         device_accumulate=dacc, sync_every=2, topk=8,
-        checkpoint_dir=ckpt, checkpoint_every=2, resume=resume,
-        stats=stats)
+        checkpoint_dir=ckpt, checkpoint_every=2, checkpoint_async=async_,
+        checkpoint_delta=delta, resume=resume, stats=stats)
 
 
 _RUNNERS = {"wc": _run_wc, "grep": _run_grep, "idx": _run_idx}
@@ -125,17 +128,19 @@ def _baseline(engine, dacc):
     return _BASE[key]
 
 
-def _crash_resume(engine, monkeypatch, tmp_path, point, dacc, depth=2):
+def _crash_resume(engine, monkeypatch, tmp_path, point, dacc, depth=2,
+                  async_=None, delta=None):
     """Run with a fault armed (expect it to fire), then resume and
     return the resumed result."""
     run = _RUNNERS[engine]
     ck = str(tmp_path / "ck")
     _fault_env(monkeypatch, point, _FAULT_AT[point])
     with pytest.raises(FaultInjected):
-        run(ckpt=ck, dacc=dacc, depth=depth)
+        run(ckpt=ck, dacc=dacc, depth=depth, async_=async_, delta=delta)
     _clear_fault(monkeypatch)
     stats = {}
-    res = run(ckpt=ck, resume=True, dacc=dacc, depth=depth, stats=stats)
+    res = run(ckpt=ck, resume=True, dacc=dacc, depth=depth, stats=stats,
+              async_=async_, delta=delta)
     return res, stats
 
 
@@ -167,8 +172,7 @@ def test_grep_crash_resume_parity(monkeypatch, tmp_path, point, dacc):
 
 
 @pytest.mark.parametrize("dacc", [False, True])
-@pytest.mark.parametrize("point", ("post-dispatch", "mid-fold",
-                                   "pre-sync", "post-ckpt"))
+@pytest.mark.parametrize("point", FAULT_POINTS)
 def test_indexer_crash_resume_parity(monkeypatch, tmp_path, point, dacc):
     if point == "pre-sync" and not dacc:
         pytest.skip("pre-sync exists only on the device-accumulate path")
@@ -177,6 +181,205 @@ def test_indexer_crash_resume_parity(monkeypatch, tmp_path, point, dacc):
     # Postings equality includes per-word doc order; topk includes df
     # count ties broken by word.
     assert res == base
+
+
+# ── async + incremental (ISSUE 8): the capture/commit split under fire ──
+
+
+@pytest.mark.parametrize("engine", ["wc", "grep", "idx"])
+@pytest.mark.parametrize("point", ("mid-capture", "mid-commit",
+                                   "mid-fold"))
+def test_async_delta_crash_resume_parity(monkeypatch, tmp_path, engine,
+                                         point):
+    """The async overlapped + incremental mode under the same bar as
+    PR 5's sync path: kill during a capture, during a background
+    commit, or at the torn-update instant, resume from whatever chain
+    survived, and the final output is bit-identical.  A death
+    mid-commit means the in-flight delta/image never produced a
+    manifest — the previous complete chain must win."""
+    res, stats = _crash_resume(engine, monkeypatch, tmp_path, point,
+                               dacc=True, async_=True, delta=True)
+    assert res == _baseline(engine, True)
+
+
+@pytest.mark.parametrize("dacc", [False, True])
+def test_wc_async_delta_host_and_device_paths(monkeypatch, tmp_path,
+                                              dacc):
+    res, stats = _crash_resume("wc", monkeypatch, tmp_path, "post-ckpt",
+                               dacc=dacc, async_=True, delta=True)
+    assert res == _baseline("wc", dacc)
+    assert stats["resume_cursor"] > 0
+
+
+@pytest.mark.parametrize("engine", ["grep", "idx"])
+def test_async_delta_host_path_crash_resume(monkeypatch, tmp_path,
+                                            engine):
+    """The non-dacc delta spellings (grep's cand_mark watermark +
+    newest-wins hist/totals, the indexer's HostDeltaLog wave rows)
+    under a crash mid-chain — the device-path grid above never touches
+    them."""
+    res, stats = _crash_resume(engine, monkeypatch, tmp_path,
+                               "mid-fold", dacc=False, async_=True,
+                               delta=True)
+    assert res == _baseline(engine, False)
+
+
+def test_tfidf_async_delta_crash_resume_parity(monkeypatch, tmp_path):
+    """The TF-IDF wave walk's async+delta chain (DevicePostings
+    take_delta in dacc mode) across a mid-fold crash."""
+    docs = IDX_DOCS
+    base = tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9)
+    ck = str(tmp_path / "ck")
+    _fault_env(monkeypatch, "mid-fold", 4)
+    reset_faults()
+    with pytest.raises(FaultInjected):
+        tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9,
+                      device_accumulate=True, sync_every=2,
+                      checkpoint_dir=ck, checkpoint_every=1,
+                      checkpoint_async=True, checkpoint_delta=True)
+    _clear_fault(monkeypatch)
+    reset_faults()
+    stats = {}
+    res = tfidf_sharded(docs, mesh=_mesh(), n_reduce=10, u_cap=1 << 9,
+                        device_accumulate=True, sync_every=2,
+                        checkpoint_dir=ck, checkpoint_every=1,
+                        checkpoint_async=True, checkpoint_delta=True,
+                        resume=True, wave_stats=stats)
+    assert res == base
+
+
+def test_wc_delta_rebase_cadence_and_counters(tmp_path, monkeypatch):
+    """Cadence-1 deltas with the default re-base window: the save
+    counters decompose exactly (first save full, a full re-base every
+    DSI_STREAM_CKPT_REBASE deltas), payload byte totals land in the
+    stats, and the chain restores bit-identically."""
+    monkeypatch.setenv("DSI_STREAM_CKPT_REBASE", "4")
+    ck = str(tmp_path / "ck")
+    stats = {}
+    res = wordcount_streaming(
+        [WC_TEXT], mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK,
+        u_cap=256, depth=2, device_accumulate=True, sync_every=2,
+        checkpoint_dir=ck, checkpoint_every=1, checkpoint_async=True,
+        checkpoint_delta=True, pipeline_stats=stats)
+    assert res == _baseline("wc", True)
+    saves, deltas = stats["ckpt_saves"], stats["ckpt_deltas"]
+    assert saves >= 5 and 0 < deltas < saves
+    # First save full, then <=4 deltas per full (the rebase window).
+    fulls = saves - deltas
+    assert fulls >= (saves + 4) // 5
+    assert stats["ckpt_full_bytes"] > 0 and stats["ckpt_delta_bytes"] > 0
+    # Append-heavy dacc stream: a delta is strictly smaller per save
+    # than a full image.
+    assert (stats["ckpt_delta_bytes"] / deltas
+            < stats["ckpt_full_bytes"] / fulls)
+    res2 = wordcount_streaming(
+        [WC_TEXT], mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK,
+        u_cap=256, depth=2, device_accumulate=True, sync_every=2,
+        checkpoint_dir=ck, checkpoint_every=1, checkpoint_async=True,
+        checkpoint_delta=True, resume=True)
+    assert res2 == _baseline("wc", True)
+
+
+def test_rebase_one_means_every_save_full(tmp_path, monkeypatch):
+    """The documented knob edge: ``DSI_STREAM_CKPT_REBASE=1`` really is
+    every-save-full — zero deltas, flat restores — even with
+    ``--ckpt-delta`` on."""
+    monkeypatch.setenv("DSI_STREAM_CKPT_REBASE", "1")
+    ck = str(tmp_path / "ck")
+    stats = {}
+    res = wordcount_streaming(
+        [WC_TEXT], mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK,
+        u_cap=256, depth=2, device_accumulate=True, sync_every=2,
+        checkpoint_dir=ck, checkpoint_every=1, checkpoint_delta=True,
+        pipeline_stats=stats)
+    assert res == _baseline("wc", True)
+    assert stats["ckpt_saves"] >= 5 and stats["ckpt_deltas"] == 0
+    assert not any(n.startswith("delta-") for n in os.listdir(ck))
+
+
+def test_commit_worker_single_in_flight_barrier():
+    """The writer's documented barrier: with ``max_pending=1`` a second
+    submit must BLOCK while the first thunk is still RUNNING (a bounded
+    queue alone would admit one running + one queued)."""
+    import threading
+    import time as _time
+
+    from dsi_tpu.parallel.pipeline import CommitWorker
+
+    w = CommitWorker(name="t-cw")
+    release = threading.Event()
+    running = threading.Event()
+
+    def slow():
+        running.set()
+        release.wait(5.0)
+
+    assert w.submit(slow) == 0.0
+    running.wait(5.0)
+    t0 = _time.perf_counter()
+    done2 = []
+
+    def second():
+        done2.append(_time.perf_counter())
+
+    def unblock():
+        _time.sleep(0.15)
+        release.set()
+
+    threading.Thread(target=unblock, daemon=True).start()
+    waited = w.submit(second)  # must block until slow() finishes
+    assert waited >= 0.1, waited
+    assert w.drain() >= 0.0
+    assert done2
+    w.shutdown()
+
+
+def test_wc_delta_resume_across_forced_widen(monkeypatch, tmp_path):
+    """A device-table widen straddling a delta chain: the forced tiny
+    rung widens mid-stream (drain into the host accumulator + realloc),
+    delta saves land around it, the crash loses the tail, and the chain
+    restore (base drained + deltas re-applied) must still reproduce the
+    uninterrupted output bit-identically."""
+    monkeypatch.setenv("DSI_DEVICE_TABLE_CAP", "16")
+    ck = str(tmp_path / "ck")
+    _fault_env(monkeypatch, "mid-fold", 6)
+    stats = {}
+    with pytest.raises(FaultInjected):
+        _run_wc(ckpt=ck, dacc=True, stats=stats, async_=True, delta=True)
+    assert stats.get("widens", 0) >= 1
+    _clear_fault(monkeypatch)
+    res = _run_wc(ckpt=ck, resume=True, dacc=True, async_=True,
+                  delta=True)
+    assert res == _baseline("wc", True)
+
+
+def test_wc_delta_chain_resume_across_mesh_degrees(monkeypatch,
+                                                   tmp_path):
+    """A ``--mesh-shards`` degree change straddling a delta chain: the
+    chain was saved by a mesh-sharded run, the resume runs host-merge
+    (degree 0).  The chain restore already re-enters through the drain
+    path, so the degree change rides the same machinery — output stays
+    bit-identical."""
+    ck = str(tmp_path / "ck")
+    _fault_env(monkeypatch, "mid-fold", 6)
+    with pytest.raises(FaultInjected):
+        reset_faults()
+        wordcount_streaming(
+            [WC_TEXT], mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK,
+            u_cap=256, depth=2, device_accumulate=True, sync_every=2,
+            mesh_shards=2, checkpoint_dir=ck, checkpoint_every=1,
+            checkpoint_async=True, checkpoint_delta=True)
+    _clear_fault(monkeypatch)
+    reset_faults()
+    stats = {}
+    res = wordcount_streaming(
+        [WC_TEXT], mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK,
+        u_cap=256, depth=2, device_accumulate=True, sync_every=2,
+        mesh_shards=0, checkpoint_dir=ck, checkpoint_every=1,
+        checkpoint_async=True, checkpoint_delta=True, resume=True,
+        pipeline_stats=stats)
+    assert res == _baseline("wc", True)
+    assert "resharded_resume" in stats and stats["resharded_resume"] == 2
 
 
 @pytest.mark.parametrize("depth", [1, 3])
@@ -300,6 +503,118 @@ def test_store_roundtrip_gc_and_fallback(tmp_path):
     meta, arrays = st.load_latest()
     assert meta["cursor"] == 10 and np.array_equal(arrays["a"],
                                                    np.arange(2))
+
+
+def test_store_chain_gc_protects_live_base(tmp_path):
+    """Chain-aware GC (ISSUE 8): last-two retention must never reap a
+    base ``state-<seq>.npz`` that a live delta chain still references —
+    with three deltas chained on one base, both retained restore points
+    are deltas, and naive last-two would have deleted the base they
+    both need."""
+    st = CheckpointStore(str(tmp_path), "wc", {})
+    st.save({"a": np.arange(3)}, {"cursor": 0})                   # seq 1
+    for i in range(3):                                            # 2..4
+        st.save_delta({"d": np.arange(i + 1)}, {"cursor": 10 * (i + 1)})
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "state-000001.npz" in names          # the live chain's base
+    assert "manifest-000001.json" in names
+    meta, arrays, deltas = st.load_latest_chain()
+    assert meta["cursor"] == 0 and len(deltas) == 3
+    assert [m["cursor"] for m, _ in deltas] == [10, 20, 30]
+    # A NEW full save starts a fresh chain; once two newer restore
+    # points exist without references into the old chain, it goes.
+    st.save({"a": np.arange(9)}, {"cursor": 99})                  # seq 5
+    st.save({"a": np.arange(9)}, {"cursor": 100})                 # seq 6
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "state-000001.npz" not in names
+    assert not any(n.startswith("delta-") for n in names)
+
+
+def test_store_torn_chain_falls_back_to_complete_chain(tmp_path):
+    """A torn middle delta invalidates every seq above it: the walk
+    falls back to the last COMPLETE chain (ultimately the bare base),
+    never restores around a hole."""
+    st = CheckpointStore(str(tmp_path), "wc", {})
+    st.save({"a": np.arange(2)}, {"cursor": 0})                   # seq 1
+    st.save_delta({"d": np.arange(1)}, {"cursor": 10})            # seq 2
+    st.save_delta({"d": np.arange(2)}, {"cursor": 20})            # seq 3
+    st.save_delta({"d": np.arange(3)}, {"cursor": 30})            # seq 4
+    # Corrupt the MIDDLE delta's payload: seqs 3 and 4 now both sit on
+    # a hole; the loader must fall back to base+delta2.
+    p = str(tmp_path / "delta-000003.npz")
+    with open(p, "r+b") as f:
+        f.seek(5)
+        b = f.read(1)
+        f.seek(5)
+        f.write(bytes([b[0] ^ 0xFF]))
+    meta, arrays, deltas = st.load_latest_chain()
+    assert len(deltas) == 1 and deltas[0][0]["cursor"] == 10
+    # Remove that delta entirely (missing middle): same fallback.
+    os.remove(p)
+    meta, arrays, deltas = st.load_latest_chain()
+    assert len(deltas) == 1 and deltas[0][0]["cursor"] == 10
+    # Now tear delta 2 as well: only the bare base survives.
+    os.remove(str(tmp_path / "delta-000002.npz"))
+    meta, arrays, deltas = st.load_latest_chain()
+    assert deltas == [] and meta["cursor"] == 0
+    # load_latest (full-only view) agrees with the chain walk's base.
+    m2, _ = st.load_latest()
+    assert m2["cursor"] == 0
+
+
+def test_store_gc_retains_fallback_below_unreadable_link(tmp_path):
+    """GC must err toward retention when a chain walk cannot reach its
+    base: with a mid-chain manifest gone, later saves keep chaining
+    above the hole — everything at or below it must survive GC, because
+    the loader's fallback is exactly the complete chain down there."""
+    st = CheckpointStore(str(tmp_path), "wc", {})
+    st.save({"a": np.arange(2)}, {"cursor": 0})           # seq 1
+    st.save_delta({"d": np.arange(1)}, {"cursor": 10})    # seq 2
+    st.save_delta({"d": np.arange(2)}, {"cursor": 20})    # seq 3
+    st.save_delta({"d": np.arange(3)}, {"cursor": 30})    # seq 4
+    os.remove(str(tmp_path / "manifest-000003.json"))     # the hole
+    st.save_delta({"d": np.arange(4)}, {"cursor": 40})    # seq 5
+    st.save_delta({"d": np.arange(5)}, {"cursor": 50})    # seq 6
+    names = os.listdir(str(tmp_path))
+    assert "state-000001.npz" in names
+    assert "delta-000002.npz" in names
+    meta, arrays, deltas = st.load_latest_chain()
+    assert meta["cursor"] == 0
+    assert len(deltas) == 1 and deltas[0][0]["cursor"] == 10
+
+
+def test_host_delta_log_trims_and_bounds_like_device_logs():
+    """The host-merge delta log mirrors the device rule: entries are
+    trimmed to the occupied prefix AND copied (an AOT-shaped pull is
+    full capacity; a view would pin it), and a window past
+    ``max_steps`` invalidates THIS window only — ``take()`` returns
+    None (the full-save fallback) and the next window is clean."""
+    from dsi_tpu.ckpt import HostDeltaLog
+
+    log = HostDeltaLog(max_steps=2)
+    big = np.arange(2 * 100 * 5, dtype=np.uint32).reshape(2, 100, 5)
+    log.append(big, np.array([3, 7]))
+    entries = log.take()
+    assert len(entries) == 1
+    rows, nus = entries[0]
+    assert rows.shape == (2, 7, 5)  # trimmed to max(nus), not capacity
+    assert rows.base is None        # a copy, not a view pinning `big`
+    assert np.array_equal(rows, big[:, :7])
+    assert log.take() == []         # re-armed, empty window
+    for _ in range(3):              # overflow the 2-step window
+        log.append(big, np.array([1, 1]))
+    assert log.take() is None       # invalid -> full-save fallback
+    log.append(big, np.array([2, 2]))
+    assert len(log.take()) == 1     # next window valid again
+    log.append(big, np.array([1, 1]))
+    log.reset()                     # a full save landed
+    assert log.take() == []
+
+
+def test_store_delta_refuses_empty_lineage(tmp_path):
+    st = CheckpointStore(str(tmp_path), "wc", {})
+    with pytest.raises(RuntimeError):
+        st.save_delta({"d": np.arange(1)}, {"cursor": 1})
 
 
 def test_store_refuses_other_job_and_resets(tmp_path):
@@ -478,6 +793,39 @@ def test_cli_wcstream_real_crash_resume(tmp_path):
                        timeout=300)
     assert p.returncode == FAULT_EXIT, p.stderr[-2000:]
     assert any(n.startswith("manifest-") for n in os.listdir(ck))
+    p = subprocess.run(cmd + ["--resume", "--check"], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "parity OK" in p.stderr
+
+
+def test_cli_wcstream_async_delta_real_crash_resume(tmp_path):
+    """REAL ``os._exit`` during an in-flight ASYNC snapshot
+    (``mid-commit`` fires on the background writer thread after the
+    capture materialized, before the store write): the half-captured
+    save must be invisible — no torn manifest — and the fresh-process
+    resume walks the surviving delta chain to bit-identical output."""
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(WC_TEXT * 3)
+    env = _cli_env(tmp_path)
+    ck = str(tmp_path / "ck")
+    wd = str(tmp_path / "wd")
+    cmd = [sys.executable, "-m", "dsi_tpu.cli.wcstream", "--devices", "2",
+           "--chunk-bytes", "8192", "--device-accumulate",
+           "--sync-every", "2", "--checkpoint-dir", ck,
+           "--checkpoint-every", "1", "--ckpt-async", "--ckpt-delta",
+           "--workdir", wd, str(corpus)]
+    env_crash = dict(env)
+    env_crash.update({"DSI_FAULT_POINT": "mid-commit",
+                      "DSI_FAULT_STEP": "3"})
+    p = subprocess.run(cmd, env=env_crash, capture_output=True, text=True,
+                       timeout=300)
+    assert p.returncode == FAULT_EXIT, p.stderr[-2000:]
+    names = os.listdir(ck)
+    # Two commits landed before the third died mid-write: a base and a
+    # delta chained on it survive, and nothing half-written is visible.
+    assert any(n.startswith("state-") for n in names), names
+    assert any(n.startswith("delta-") for n in names), names
     p = subprocess.run(cmd + ["--resume", "--check"], env=env,
                        capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, p.stderr[-2000:]

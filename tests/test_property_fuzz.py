@@ -434,6 +434,102 @@ def test_fuzz_keycounts_snapshot_roundtrip(pairs):
     assert kc2.finalize() == kc.finalize()
 
 
+# ── delta-chain restore properties (ISSUE 8) ───────────────────────────
+
+
+def _chain_run(words, dacc, save_shards, resume_shards, table_cap,
+               tmpdir):
+    """Random fold sequence → interleaved full/delta saves (cadence 1,
+    small re-base window so fulls and deltas interleave) → restore at
+    EVERY seq → byte-equal final output.  GC is disabled for the run so
+    every restore point stays walkable; each seq is restored from a
+    pruned copy of the store (manifests above it deleted — exactly the
+    on-disk state a crash right after that save leaves, modulo
+    retention)."""
+    import shutil
+
+    from dsi_tpu.parallel.streaming import wordcount_streaming
+
+    mesh = default_mesh(4)
+    line = (" ".join(words) + "\n").encode()
+    # >= 4 steps at 1 KB/device chunks on the 4-dev mesh, whatever the
+    # drawn vocabulary's line width — a chain needs several links.
+    text = line * max(4, (16 << 10) // len(line) + 1)
+
+    def run(ck=None, resume=False, shards=0):
+        return wordcount_streaming(
+            [text], mesh=mesh, n_reduce=10, chunk_bytes=1 << 10,
+            u_cap=256, depth=2, device_accumulate=dacc, sync_every=2,
+            mesh_shards=shards if dacc else 0, checkpoint_dir=ck,
+            checkpoint_every=1, checkpoint_delta=True,
+            checkpoint_async=True, resume=resume)
+
+    base = run()
+    assert base is not None
+    ck = os.path.join(str(tmpdir), "ck")
+    gc_orig = CheckpointStore._gc
+    old_env = {k: os.environ.get(k) for k in
+               ("DSI_STREAM_CKPT_REBASE", "DSI_DEVICE_TABLE_CAP")}
+    os.environ["DSI_STREAM_CKPT_REBASE"] = "3"
+    if table_cap:
+        os.environ["DSI_DEVICE_TABLE_CAP"] = str(table_cap)
+    try:
+        CheckpointStore._gc = lambda self: None  # keep every seq
+        assert run(ck=ck, shards=save_shards) == base
+        seqs = sorted(
+            int(m.group(1)) for n in os.listdir(ck)
+            if (m := re.match(r"^manifest-(\d{6})\.json$", n)))
+        assert len(seqs) >= 3
+        kinds = set()
+        for n in os.listdir(ck):
+            kinds.add("delta" if n.startswith("delta-") else
+                      "full" if n.startswith("state-") else None)
+        assert {"full", "delta"} <= kinds  # saves really interleaved
+        for s in seqs:
+            trunc = os.path.join(str(tmpdir), f"at{s}")
+            shutil.copytree(ck, trunc)
+            for n in os.listdir(trunc):
+                m = re.match(r"^(?:manifest|state|delta)-(\d{6})", n)
+                if m and int(m.group(1)) > s:
+                    os.remove(os.path.join(trunc, n))
+            assert run(ck=trunc, resume=True,
+                       shards=resume_shards) == base, \
+                f"restore at seq {s} diverged"
+    finally:
+        CheckpointStore._gc = gc_orig
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@settings(max_examples=2, deadline=None)
+@given(st.data())
+def test_fuzz_delta_chain_restores_at_every_seq(tmp_path_factory, data):
+    words = data.draw(st.lists(
+        st.text(alphabet="abcdefghijklmnopqrstuvwxyz", min_size=1,
+                max_size=8), min_size=3, max_size=40, unique=True))
+    dacc = data.draw(st.booleans())
+    _chain_run(words, dacc=dacc, save_shards=0, resume_shards=0,
+               table_cap=0, tmpdir=tmp_path_factory.mktemp("chain"))
+
+
+@settings(max_examples=1, deadline=None)
+@given(st.data())
+def test_fuzz_delta_chain_forced_widen_and_mesh_straddle(
+        tmp_path_factory, data):
+    """The hostile pair the ISSUE names: a forced device-table widen
+    inside the chain window (tiny capacity rung), and a
+    ``--mesh-shards`` degree change straddling the deltas (saved at
+    degree 2, every restore at degree 0 — the drain-path re-entry)."""
+    words = data.draw(st.lists(
+        st.text(alphabet="abcdefghijklmnopqrstuvwxyz", min_size=1,
+                max_size=8), min_size=20, max_size=60, unique=True))
+    _chain_run(words, dacc=True, save_shards=2, resume_shards=0,
+               table_cap=16, tmpdir=tmp_path_factory.mktemp("straddle"))
+
+
 @settings(max_examples=40, deadline=None)
 @given(st.lists(st.text(alphabet="abcdefghijklmnopqrstuvwxyzABC",
                         min_size=1, max_size=16),
