@@ -502,6 +502,43 @@ def tpu_child(result_path: str) -> int:
 
 
 
+def run_provenance() -> dict:
+    """Attribution keys stamped into EVERY verdict (success, parity
+    failure, tunnel-down error alike), so a ``scripts/bench_diff.py``
+    comparison across BENCH_r*.json rounds can say WHAT produced each
+    number — a throughput delta between two different jax versions or
+    hosts is an environment change, not a code regression.  Every key
+    degrades to "unknown" rather than failing the bench, and
+    bench_diff treats missing/unknown as non-comparable, so old
+    artifacts without the block stay diffable (backfill-tolerant)."""
+    prov = {}
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        prov["git_sha"] = r.stdout.strip() or "unknown"
+    except Exception:
+        prov["git_sha"] = "unknown"
+    try:  # version without importing jax into the parent process
+        from importlib import metadata
+
+        prov["jax_version"] = metadata.version("jax")
+    except Exception:
+        prov["jax_version"] = "unknown"
+    import platform as _platform
+    import socket
+
+    prov["platform"] = f"{_platform.system()}-{_platform.machine()}"
+    prov["hostname"] = socket.gethostname()
+    prov["python"] = _platform.python_version()
+    # The repo runs x64 SCOPED (utils/jaxcompat.x64_scoped) unless the
+    # env pins it globally — record which, it changes kernel numerics.
+    prov["x64"] = os.environ.get("JAX_ENABLE_X64", "scoped")
+    prov["utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    return prov
+
+
 def bench_tracer():
     """The bench's handle on the unified tracer (dsi_tpu/obs):
     DSI_BENCH_TRACE=1 turns on in-memory span buffering so the engine
@@ -1629,6 +1666,8 @@ def main() -> None:
     files = ensure_corpus(WORKDIR, n_files=N_FILES, file_size=FILE_SIZE)
     total_mb = sum(os.path.getsize(p) for p in files) / 1e6
     log(f"corpus: {len(files)} files, {total_mb:.1f} MB")
+    prov = run_provenance()
+    log(f"provenance: {prov}")
 
     oracle_s, oracle_mbps = run_oracle(files)
     log(f"oracle (mrsequential semantics): {oracle_s:.2f}s = "
@@ -1676,6 +1715,7 @@ def main() -> None:
         if tpu_error:
             out["tpu_error"] = tpu_error
         out.update(fw)
+        out["provenance"] = prov
         print(json.dumps(out))
         sys.exit(1)
     log(f"tpu path: {res['tpu_s']:.3f}s = {res['tpu_mbps']:.2f} MB/s  "
@@ -1691,6 +1731,7 @@ def main() -> None:
             out["tpu_error"] = tpu_error
             out["diagnosis"] = diagnose_tunnel()
         out.update(fw)
+        out["provenance"] = prov
         print(json.dumps(out))
         sys.exit(1)
 
@@ -1719,6 +1760,7 @@ def main() -> None:
                          "ckpt_", "resume_")):
             out[k] = res[k]
     out.update(fw)
+    out["provenance"] = prov
     if tpu_error:
         # The number above was measured on the CPU FALLBACK backend: the
         # TPU half failed (tunnel outage etc.) and this run proves the

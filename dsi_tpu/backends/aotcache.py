@@ -198,7 +198,15 @@ def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
     # memoized, still counted in stats).
     persist = persist and len(jax.devices()) == 1
 
-    loaded = _try_load(path) if persist else None
+    # DSI_AOT_FRESH=1 skips persisted LOADS (compiles fresh, still
+    # saves): the mitigation for the known 1-device widen-shape
+    # heap-corruption flake where a deserialized executable
+    # intermittently corrupts the heap or the counts (CHANGES.md PR 8;
+    # OPERATIONS.md runbook).  Loads stay attributable either way —
+    # every load logs basename+digest+shapes and lands in the trace's
+    # control lane as an ``aot_load`` event.
+    fresh = os.environ.get("DSI_AOT_FRESH") == "1"
+    loaded = _try_load(path) if (persist and not fresh) else None
     if loaded is None:
         compiled = _compile_with_retry(jitted, example_args, static, name,
                                        x64=x64)
@@ -207,7 +215,24 @@ def cached_compile(name: str, fn: Callable, example_args: Tuple[Any, ...],
         loaded = compiled
     else:
         stats["loads"] += 1
-        _log(f"{name}: loaded from {os.path.basename(path)}")
+        # Flake attribution (ISSUE 10): WHICH persisted entry, at WHICH
+        # digest and shapes, was deserialized — so a later heap
+        # corruption or silent count mismatch names its suspect instead
+        # of "some aot entry".  Mirrored into the tracer's control lane
+        # when tracing is on.
+        shapes = ",".join(str(tuple(getattr(a, "shape", ())))
+                          for a in example_args)
+        _log(f"{name}: loaded from {os.path.basename(path)} "
+             f"(digest={key} shapes={shapes})")
+        try:
+            from dsi_tpu.obs import get_tracer
+
+            get_tracer().event(
+                "aot_load", lane="control", name=name,
+                file=os.path.basename(path), digest=key, shapes=shapes,
+                bytes=os.path.getsize(path))
+        except Exception:
+            pass  # attribution must never break a load
         loaded = _verify_first_call(loaded, path, name, jitted,
                                     example_args, static, x64=x64,
                                     donate_argnums=donate_argnums)

@@ -101,6 +101,11 @@ def main(argv=None) -> int:
                    help="write this run's unified trace (dsi_tpu/obs): "
                         "Perfetto trace.json + trace.jsonl event log; "
                         "render with scripts/tracecat.py")
+    p.add_argument("--statusz-port", type=int, default=None,
+                   help="serve live telemetry on 127.0.0.1:PORT — "
+                        "/statusz + /metrics (0 = pick a free port; "
+                        "default off, env DSI_STATUSZ_PORT); arms the "
+                        "stall watchdog and the live.jsonl ring")
     args = p.parse_args(argv)
 
     if args.resume and not args.checkpoint_dir:
@@ -110,6 +115,11 @@ def main(argv=None) -> int:
         from dsi_tpu.obs import configure_tracing
 
         configure_tracing(trace_dir=args.trace_dir)
+
+    if args.statusz_port is not None or os.environ.get("DSI_STATUSZ_PORT"):
+        from dsi_tpu.obs.live import start_from_args
+
+        start_from_args(args.statusz_port, live_dir=args.trace_dir)
 
     pattern = args.pattern or os.environ.get("DSI_GREP_PATTERN")
     if not pattern:

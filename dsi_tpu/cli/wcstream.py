@@ -112,6 +112,15 @@ def main(argv=None) -> int:
                         "there: trace.json (Perfetto-loadable, one lane "
                         "per pipeline stage) + trace.jsonl (event log); "
                         "render with scripts/tracecat.py")
+    p.add_argument("--statusz-port", type=int, default=None,
+                   help="serve live telemetry on 127.0.0.1:PORT — "
+                        "/statusz (plain text: current step, stage "
+                        "p50/p99, in-flight window) + /metrics "
+                        "(Prometheus); 0 picks a free port (printed to "
+                        "stderr); default off (env DSI_STATUSZ_PORT) = "
+                        "zero threads; also arms the stall watchdog "
+                        "and, with --trace-dir, a bounded live.jsonl "
+                        "sample ring there")
     args = p.parse_args(argv)
 
     if args.resume and not args.checkpoint_dir:
@@ -124,6 +133,13 @@ def main(argv=None) -> int:
         from dsi_tpu.obs import configure_tracing
 
         configure_tracing(trace_dir=args.trace_dir)
+
+    # Live telemetry BEFORE the jax import below: /statusz answers
+    # during device init, the slowest silent phase of a tunnel run.
+    if args.statusz_port is not None or os.environ.get("DSI_STATUSZ_PORT"):
+        from dsi_tpu.obs.live import start_from_args
+
+        start_from_args(args.statusz_port, live_dir=args.trace_dir)
 
     from dsi_tpu.utils.platformpin import pin_platform_from_env
 

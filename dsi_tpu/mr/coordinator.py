@@ -33,7 +33,7 @@ import time
 from typing import Dict, List, Optional
 
 from dsi_tpu.config import JobConfig
-from dsi_tpu.obs import get_registry
+from dsi_tpu.obs import LatencyHistogram, get_registry
 from dsi_tpu.mr import rpc
 from dsi_tpu.mr.journal import Journal
 from dsi_tpu.mr.types import (LOG_COMPLETED, LOG_IN_PROGRESS, LOG_UNTOUCHED,
@@ -69,6 +69,14 @@ class Coordinator:
         # coordinator.go:70-77).
         self._worker_seen: Dict[str, float] = {}
         self._task_worker: Dict[tuple, str] = {}
+        # Per-worker contact-GAP histograms (obs/hist.py): every RPC
+        # records the gap since the worker's previous contact, so a
+        # requeue can compare the stale worker's current silence to its
+        # own p99 gap — "presumed dead" (silence way past anything it
+        # ever did) vs "slow task" (still phoning home, the task is
+        # just long).  The percentile-aware signal the speculative-
+        # execution item dispatches backup tasks on.
+        self._hb_hist: Dict[str, LatencyHistogram] = {}
         # Straggler watchdog: ONE monitor thread over a deadline heap
         # replaces the reference's goroutine-per-assignment
         # (mr/coordinator.go:70-77,99-106) — a per-task Timer thread melts
@@ -133,7 +141,7 @@ class Coordinator:
         wid = str(args.get("WorkerId") or "")
         with self.mu:
             if wid:
-                self._worker_seen[wid] = time.monotonic()
+                self._touch(wid)
             if self.c_map < self.n_map:
                 tba = self._pop_untouched(self._map_ready, self.map_log)
                 if tba is None:
@@ -172,7 +180,7 @@ class Coordinator:
         wid = str(args.get("WorkerId") or "")
         with self.mu:
             if wid:
-                self._worker_seen[wid] = time.monotonic()
+                self._touch(wid)
             self._task_worker.pop(("map", t), None)
             if self.map_log[t] != LOG_COMPLETED:  # fix: count first completion only
                 self.map_log[t] = LOG_COMPLETED
@@ -191,7 +199,7 @@ class Coordinator:
         wid = str(args.get("WorkerId") or "")
         with self.mu:
             if wid:
-                self._worker_seen[wid] = time.monotonic()
+                self._touch(wid)
             self._task_worker.pop(("reduce", t), None)
             if self.reduce_log[t] != LOG_COMPLETED:
                 self.reduce_log[t] = LOG_COMPLETED
@@ -205,6 +213,16 @@ class Coordinator:
         return {}
 
     # ---- internals ----
+
+    def _touch(self, wid: str) -> None:
+        """Refresh a worker's heartbeat and record the contact gap into
+        its histogram.  Caller holds ``self.mu``."""
+        now = time.monotonic()
+        prev = self._worker_seen.get(wid)
+        if prev is not None:
+            self._hb_hist.setdefault(
+                wid, LatencyHistogram()).record(now - prev)
+        self._worker_seen[wid] = now
 
     @staticmethod
     def _pop_untouched(ready: list[int], log: list[int]) -> Optional[int]:
@@ -266,15 +284,37 @@ class Coordinator:
                             for w, t in self._worker_seen.items()}
                     get_registry().set_gauge(
                         "mr_worker_heartbeat_age_s", ages)
+                    # Percentile-aware classification: silence beyond
+                    # 2× the worker's own p99 contact gap reads as a
+                    # dead worker (its cadence stopped, not just this
+                    # task); silence still within cadence norms reads
+                    # as a slow task — the case a backup dispatcher
+                    # should prefer to split rather than abandon.  No
+                    # gap data yet → unknown, never a guess.
+                    h = self._hb_hist.get(wid)
+                    hb_p99 = (round(h.percentile(0.99), 3)
+                              if h is not None and h.count else None)
+                    presumed = "unknown"
+                    if hb_age is not None and hb_p99 is not None:
+                        presumed = ("dead" if hb_age > 2 * hb_p99
+                                    else "slow-task")
+                    get_registry().set_gauge(
+                        "mr_worker_heartbeat_hist",
+                        {w: hh.snapshot()
+                         for w, hh in self._hb_hist.items()})
                     log_event("requeue", kind=kind, task=task_id,
                               timeout_s=self.config.task_timeout_s,
                               worker=wid or None, heartbeat_age_s=hb_age,
+                              heartbeat_p99_s=hb_p99, presumed=presumed,
                               reason="in-progress past task_timeout_s")
                     print(f"coordinator: requeue {kind} task {task_id}: "
                           f"in-progress past "
                           f"{self.config.task_timeout_s}s (worker="
                           f"{wid or '?'} heartbeat_age="
-                          f"{'%.3fs' % hb_age if hb_age is not None else 'n/a'})",
+                          f"{'%.3fs' % hb_age if hb_age is not None else 'n/a'}"
+                          f" p99="
+                          f"{'%.3fs' % hb_p99 if hb_p99 is not None else 'n/a'}"
+                          f" presumed={presumed})",
                           file=sys.stderr)
 
     # ---- lifecycle (mr/coordinator.go:121-160) ----
@@ -309,6 +349,32 @@ class Coordinator:
         with self.mu:
             return {w: round(now - t, 3)
                     for w, t in self._worker_seen.items()}
+
+    def worker_heartbeat_hists(self) -> Dict[str, Dict]:
+        """Per-worker contact-gap histogram snapshots (pinned
+        ``obs.HIST_SNAPSHOT_KEYS``) — the distribution behind
+        :meth:`straggler_suspects`."""
+        with self.mu:
+            return {w: h.snapshot() for w, h in self._hb_hist.items()}
+
+    def straggler_suspects(self, k: float = 2.0) -> Dict[str, float]:
+        """Workers whose current silence exceeds ``max(k · p99(their
+        own contact gaps), task_timeout_s)`` — {worker: age_s}.  THE
+        armed hook for speculative execution: a backup dispatcher polls
+        this instead of re-deriving staleness from raw ages, so its
+        decision is percentile-aware per worker (a chatty worker going
+        quiet trips far sooner than one that always polled slowly)."""
+        now = time.monotonic()
+        out: Dict[str, float] = {}
+        with self.mu:
+            for w, t in self._worker_seen.items():
+                age = now - t
+                h = self._hb_hist.get(w)
+                p99 = h.percentile(0.99) if h is not None and h.count \
+                    else 0.0
+                if age > max(k * p99, self.config.task_timeout_s):
+                    out[w] = round(age, 3)
+        return out
 
     def close(self) -> None:
         with self._deadline_cv:

@@ -189,6 +189,19 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
     cfg = config or JobConfig()
     sock = cfg.sock()
     tasks_done = 0
+    # Task-latency histogram (obs/hist.py), published as a registry
+    # gauge after every task: lands in this process's trace-meta
+    # snapshot and any ``/statusz`` peephole, and gives the
+    # speculative-execution hook the worker-side view (how long do MY
+    # tasks take) to pair with the coordinator's heartbeat percentiles.
+    from dsi_tpu.obs import LatencyHistogram, get_registry
+
+    task_hist = LatencyHistogram()
+
+    def note_task(seconds: float) -> None:
+        task_hist.record(seconds)
+        get_registry().set_gauge("mr_worker_task_hist",
+                                 task_hist.snapshot())
     # Stable per-process identity, sent with every RPC: the coordinator
     # keys its per-worker heartbeat-age gauge on it (a requeue can then
     # say WHOSE heartbeat went stale — and the speculative-execution
@@ -228,25 +241,27 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
             # Span → DSI_TRACE=1 yields a per-task timeline (the tracing
             # layer the reference lacks entirely, SURVEY.md §5).
             with Span("worker.map", task=reply["CMap"],
-                      file=reply["Filename"]):
+                      file=reply["Filename"]) as sp:
                 if task_runner is not None:
                     task_runner.run_map(mapf, reply["Filename"], reply["CMap"],
                                         reply["NReduce"], cfg.workdir)
                 else:
                     run_map_task(mapf, reply["Filename"], reply["CMap"],
                                  reply["NReduce"], cfg.workdir)
+            note_task(sp.elapsed_s)
             tasks_done += 1
             if not report_complete("Coordinator.RecieveMapComplete",
                                    reply["CMap"]):
                 break
         elif status == int(TaskStatus.REDUCE):
-            with Span("worker.reduce", task=reply["CReduce"]):
+            with Span("worker.reduce", task=reply["CReduce"]) as sp:
                 if task_runner is not None:
                     task_runner.run_reduce(reducef, reply["CReduce"],
                                            reply["NMap"], cfg.workdir)
                 else:
                     run_reduce_task(reducef, reply["CReduce"], reply["NMap"],
                                     cfg.workdir)
+            note_task(sp.elapsed_s)
             tasks_done += 1
             if not report_complete("Coordinator.RecieveReduceComplete",
                                    reply["CReduce"]):

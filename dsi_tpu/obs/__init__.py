@@ -1,6 +1,6 @@
 """dsi_tpu.obs — unified tracing + metrics across every runtime layer.
 
-Two halves, one subsystem:
+Four parts, one subsystem:
 
 * :mod:`~dsi_tpu.obs.trace` — the :class:`Tracer`: nested spans,
   instant events, counters, buffered in memory and flushed durably as a
@@ -13,13 +13,29 @@ Two halves, one subsystem:
   schema that subsumes ``pipeline_stats``/``stream_phases``/
   ``wave_phases``/``grep_phases``.
 
+* :mod:`~dsi_tpu.obs.hist` — log-bucketed stage latency histograms
+  (p50/p90/p99/max, HDR-style constant memory), recorded at span close
+  for the pinned hot stages whenever the plane is active; plus the
+  live-pipeline registry the sampler and stall watchdog read.
+* :mod:`~dsi_tpu.obs.live` — the live telemetry plane: a sampler
+  thread with a bounded ``live.jsonl`` ring and localhost ``/statusz``
+  + ``/metrics`` endpoints (``--statusz-port`` / ``DSI_STATUSZ_PORT``;
+  default off = zero threads).
+
 Render a trace with ``scripts/tracecat.py``; open the ``trace.json`` at
-https://ui.perfetto.dev.  DESIGN.md "Observability" documents the span
-taxonomy and lane map.
+https://ui.perfetto.dev.  DESIGN.md "Observability" and "Live
+telemetry" document the span taxonomy, lane map, and sampler design.
 """
 
 import sys
 
+from dsi_tpu.obs.hist import (
+    HIST_SNAPSHOT_KEYS,
+    HIST_STAGES,
+    LatencyHistogram,
+    StageHistograms,
+    active_histograms,
+)
 from dsi_tpu.obs.registry import (
     ENGINES,
     LEGACY_ALIASES,
@@ -61,7 +77,12 @@ def flush_tracing_report(trace_dir: str, prog: str = "") -> None:
 
 __all__ = [
     "ENGINES",
+    "HIST_SNAPSHOT_KEYS",
+    "HIST_STAGES",
     "LANES",
+    "LatencyHistogram",
+    "StageHistograms",
+    "active_histograms",
     "LEGACY_ALIASES",
     "PHASE_KEYS",
     "MetricsRegistry",

@@ -62,6 +62,22 @@ presence, not truthiness, is the signal).  Fold spans land in the tracer's ``shu
 mesh mode; span totals still reconcile with ``fold_s`` — the span IS
 the stats accumulator.
 
+## Live telemetry keys (``obs/hist.py`` + ``obs/live.py``)
+
+When the telemetry plane is active (tracing enabled, or a
+``--statusz-port`` live sampler running), :meth:`MetricsRegistry.
+snapshot` additionally carries ``histograms`` — one log-bucketed
+latency distribution per hot stage (the pinned ``hist.HIST_STAGES``:
+kernel/upload/pull/finish/fold/sync/ckpt_commit), each under the
+pinned ``hist.HIST_SNAPSHOT_KEYS`` (``count``/``total_s``/``p50_ms``/
+``p90_ms``/``p99_ms``/``max_ms``).  The stall watchdog
+(``parallel/pipeline.py``) adds the ``stalls`` counter to an engine's
+scope and publishes the ``pipeline_stall`` gauge (engine, step, age,
+threshold of the most recent stall); the coordinator publishes
+``mr_worker_heartbeat_age_s`` (current ages) and
+``mr_worker_heartbeat_hist`` (per-worker contact-gap histogram
+snapshots — the percentile-aware requeue signal) gauges.
+
 Engines keep their historical spellings inside the scope (external
 consumers — tests, soaks, BENCH artifacts — read those keys today);
 :meth:`MetricsScope.unified` maps the legacy spellings onto the schema
@@ -75,6 +91,8 @@ from __future__ import annotations
 
 import threading
 from typing import Dict, Optional
+
+from dsi_tpu.obs.hist import active_histograms as _active_histograms
 
 #: Legacy engine-specific spellings → unified schema names.  The
 #: streaming word-count/grep engines predate the schema ("batch" for the
@@ -159,12 +177,18 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict:
         """JSON-ready dump: every engine's unified view + the gauges —
-        embedded in trace files by ``obs/trace.py`` at flush."""
+        embedded in trace files by ``obs/trace.py`` at flush — plus the
+        stage latency histograms whenever the live telemetry plane is
+        active (``obs/hist.py``)."""
         with self._lock:
             scopes = dict(self._scopes)
             gauges = dict(self._gauges)
-        return {"engines": {e: sc.unified() for e, sc in scopes.items()},
-                "gauges": gauges}
+        out = {"engines": {e: sc.unified() for e, sc in scopes.items()},
+               "gauges": gauges}
+        hs = _active_histograms()
+        if hs is not None:
+            out["histograms"] = hs.snapshot()
+        return out
 
 
 _REGISTRY = MetricsRegistry()

@@ -53,6 +53,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+import dsi_tpu.obs.hist as _hist
+
+#: Span names recorded into the live stage histograms when the
+#: telemetry plane is active (obs/hist.py owns the pinned set).
+_HOT_STAGES = frozenset(_hist.HIST_STAGES)
+
 #: The lane taxonomy: every span/event lands in one of these Perfetto
 #: lanes (a span's lane defaults to its name).  Pipeline stages first in
 #: display order, then the device-service lanes, then the control plane.
@@ -114,6 +120,12 @@ class _Span:
         self.elapsed_s = dur
         if self._stats is not None:
             self._stats[self._key] = self._stats.get(self._key, 0.0) + dur
+        # Stage histogram recording at span close (the tentpole of the
+        # live telemetry plane): one module-attribute load when the
+        # plane is off, one dict lookup + O(1) bucket bump when on.
+        hs = _hist._active
+        if hs is not None:
+            hs.record(self.name, dur)
         tr = self._tr
         if tr is not None:
             tr._tls.depth = self._depth
@@ -137,7 +149,12 @@ class Tracer:
         self.counters: Dict[str, float] = {}
         self._t0 = time.perf_counter()
         self._wall0 = time.time()
-        self.enabled = bool(enabled)
+        # Construction never DEactivates the histogram plane (another
+        # tracer may be feeding it); only an explicit ``enabled=False``
+        # assignment does — see the property setter.
+        self._enabled = bool(enabled)
+        if self._enabled:
+            _hist.activate()
         self.trace_dir: Optional[str] = None
         self.basename = basename
         if buffer_cap is None:
@@ -151,6 +168,22 @@ class Tracer:
             self.set_trace_dir(trace_dir, basename)
 
     # ── configuration ──
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @enabled.setter
+    def enabled(self, v) -> None:
+        """Enabling tracing also activates the stage-histogram plane
+        (hot spans record their close latency); disabling deactivates
+        it UNLESS the live sampler holds it — statusz must keep its
+        percentiles when a bench toggles its in-memory tracer off."""
+        self._enabled = bool(v)
+        if self._enabled:
+            _hist.activate()
+        else:
+            _hist.deactivate()
 
     def set_trace_dir(self, trace_dir: str,
                       basename: Optional[str] = None) -> None:
@@ -176,11 +209,17 @@ class Tracer:
         """A context manager timing one region.  With ``stats``/``key``
         the elapsed seconds are ALSO added to ``stats[key]`` (the
         engines' phase dicts — one measurement, two consumers).
-        Disabled and sink-less returns the shared no-op singleton."""
+        Disabled and sink-less returns the shared no-op singleton —
+        unless the live histogram plane is active and the span is a hot
+        stage, which still needs its close latency recorded (statusz-
+        without-tracing mode)."""
         if not self.enabled:
-            if stats is None:
-                return _NOOP_SPAN
-            return _Span(None, name, "", stats, key or (name + "_s"), None)
+            if stats is not None:
+                return _Span(None, name, "", stats,
+                             key or (name + "_s"), None)
+            if _hist._active is not None and name in _HOT_STAGES:
+                return _Span(None, name, "", None, None, None)
+            return _NOOP_SPAN
         return _Span(self, name, lane or name, stats,
                      (key or (name + "_s")) if stats is not None else None,
                      fields or None)
@@ -203,6 +242,9 @@ class Tracer:
         and would otherwise export a negative timestamp."""
         if not self.enabled:
             return
+        hs = _hist._active
+        if hs is not None:
+            hs.record(name, dur_s)
         self._record("X", name, lane,
                      max(self._t0, time.perf_counter() - dur_s),
                      dur_s, 0, fields or None)
@@ -235,13 +277,25 @@ class Tracer:
         with self._lock:
             return len(self._events)
 
+    def counters_snapshot(self) -> Dict[str, float]:
+        """A consistent copy of the counters — readers on other
+        threads (the statusz endpoints, the live sampler) must not
+        iterate the live dict while :meth:`count` inserts into it."""
+        with self._lock:
+            return dict(self.counters)
+
     def rollup(self, since: int = 0) -> Dict[str, dict]:
         """Per-span-name totals over the buffered events:
-        ``{name: {"total_s", "count", "max_s"}}`` — the per-phase span
-        rollup the bench rows publish."""
+        ``{name: {"total_s", "count", "max_s", "p50_ms", "p99_ms"}}`` —
+        the per-phase span rollup the bench rows publish.  The
+        percentiles are EXACT over the buffered durations (the buffer
+        holds every one), scoped by ``since`` like the totals — so a
+        bench row's rollup carries its own latency distribution, not
+        the whole process's."""
         with self._lock:
             evs = self._events[since:]
         out: Dict[str, dict] = {}
+        durs: Dict[str, list] = {}
         for ph, name, lane, ts, dur, depth, fields in evs:
             if ph != "X":
                 continue
@@ -251,7 +305,16 @@ class Tracer:
             r["count"] += 1
             if dur > r["max_s"]:
                 r["max_s"] = dur
-        for r in out.values():
+            durs.setdefault(name, []).append(dur)
+        for name, r in out.items():
+            d = sorted(durs[name])
+            n = len(d)
+            # Nearest-rank percentiles, index ceil(q*n)-1 — the same
+            # rank rule as LatencyHistogram.percentile, so the rollup
+            # and the live histograms cannot disagree on definition
+            # (p99 of 100 samples is the 99th, NOT the max).
+            r["p50_ms"] = round(1e3 * d[(n + 1) // 2 - 1], 4)
+            r["p99_ms"] = round(1e3 * d[(99 * n + 99) // 100 - 1], 4)
             r["total_s"] = round(r["total_s"], 4)
             r["max_s"] = round(r["max_s"], 4)
         return out

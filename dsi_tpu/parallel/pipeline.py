@@ -41,6 +41,7 @@ from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
 
+from dsi_tpu.obs import hist as _hist
 from dsi_tpu.obs import span as _span
 
 
@@ -184,6 +185,100 @@ class CommitWorker:
         self._thread = None
 
 
+class _StallWatchdog(threading.Thread):
+    """Flags the head-of-line step when its RETIRE age — seconds since
+    it became the oldest in-flight record (i.e. since the previous
+    finish completed), not since its own dispatch — exceeds
+    ``max(k · p99(finish), floor)``.  The percentile-aware straggler
+    signal (Dean & Ghemawat §3.6 make backup dispatch a tail-latency
+    decision; a flat timeout can't tell "slow step" from "stuck
+    step").  Head-of-line age is the right clock: dispatch→finish age
+    includes ~``depth-1`` steps of NORMAL window residency, so at
+    depth > k it exceeds ``k·p99`` on perfectly healthy pipelines —
+    the retire age is depth-independent (steady state ≈ one step
+    wall).  One daemon thread per running pipeline, started ONLY when
+    the telemetry plane is active (``obs/hist.py``) — the default run
+    has zero watchdog threads.
+
+    The p99 comes from the live ``finish`` stage histogram once it has
+    ``DSI_STALL_MIN_SAMPLES`` (default 8) steps; before that only the
+    floor gates, so early-run compile stalls don't self-trigger.
+    Knobs: ``DSI_STALL_K`` (default 4), ``DSI_STALL_FLOOR_S`` (default
+    5 s), ``DSI_STALL_CHECK_S`` (default floor/4 capped at 1 s).
+
+    A stalled step is flagged EXACTLY ONCE: a loud stderr line, a
+    ``stall`` event in the trace's control lane (step, retire + since-
+    dispatch ages, threshold, p99), the ``pipeline_stall`` registry
+    gauge, and a ``stalls`` bump in the engine's stats scope.  The
+    step may still finish — the flag means "a backup dispatcher should
+    be looking", not "dead".
+    """
+
+    def __init__(self, pipe: "StepPipeline",
+                 hists: "_hist.StageHistograms"):
+        super().__init__(name="dsi-stall-watchdog", daemon=True)
+        self._pipe = pipe
+        self._hists = hists
+        self._halt = threading.Event()
+        self._flagged: set = set()
+        envf = _hist.env_float
+        self.k = envf("DSI_STALL_K", 4.0)
+        self.floor_s = envf("DSI_STALL_FLOOR_S", 5.0)
+        self.check_s = envf("DSI_STALL_CHECK_S",
+                            max(0.02, min(1.0, self.floor_s / 4)))
+        self.min_samples = int(envf("DSI_STALL_MIN_SAMPLES", 8))
+
+    def threshold_s(self) -> float:
+        # THIS pipeline's finish distribution, not the process-global
+        # stage histogram: in one bench process the stream row's ~ms
+        # finishes would otherwise calibrate the tfidf row's ~s waves
+        # (every healthy wave flagged) and vice versa.
+        h = self._pipe._finish_hist
+        p99 = (h.percentile(0.99)
+               if h is not None and h.count >= self.min_samples else 0.0)
+        return max(self.k * p99, self.floor_s)
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        import sys
+
+        from dsi_tpu.obs import event as _event, get_registry
+
+        while not self._halt.wait(self.check_s):
+            oldest = self._pipe.oldest_inflight()
+            if oldest is None:
+                continue
+            step, ts = oldest
+            if step in self._flagged:
+                continue
+            now = time.perf_counter()
+            # Retire age: since this record reached the head of the
+            # line (the later of its dispatch and the previous finish
+            # completing) — depth-independent, unlike now - ts.
+            age = now - max(ts, self._pipe._last_retire_t)
+            thr = self.threshold_s()
+            if age <= thr:
+                continue
+            self._flagged.add(step)
+            h = self._hists.get("finish")
+            p99_s = round(h.percentile(0.99), 4) if h is not None else 0.0
+            engine = self._pipe._engine or "?"
+            info = {"engine": engine, "step": step,
+                    "age_s": round(age, 3),
+                    "inflight_age_s": round(now - ts, 3),
+                    "threshold_s": round(thr, 3),
+                    "p99_s": p99_s}
+            self._pipe._stats["stalls"] = \
+                self._pipe._stats.get("stalls", 0) + 1
+            _event("stall", lane="control", **info)
+            get_registry().set_gauge("pipeline_stall", info)
+            print(f"obs: STALL {engine} step {step}: in flight "
+                  f"{age:.1f}s > max({self.k:g}*p99={self.k * p99_s:.1f}s,"
+                  f" floor={self.floor_s:g}s)", file=sys.stderr)
+
+
 class StepPipeline:
     """``depth``-deep dispatch/finish window over a produced item stream.
 
@@ -226,6 +321,48 @@ class StepPipeline:
         stats.setdefault(produce_key, 0.0)
         stats.setdefault(wait_key, 0.0)
         stats.setdefault(inflight_key, 0)
+        # Live telemetry state (obs/live.py statusz + the stall
+        # watchdog): (ordinal, dispatch-perf_counter) per in-flight
+        # record, plus monotonic dispatched/finished counters.  Plain
+        # attribute writes on the hot path — a deque append and two int
+        # bumps per step, read from other threads without locks (deque
+        # ops are atomic; readers tolerate a racy oldest).
+        self._inflight: collections.deque = collections.deque()
+        self.dispatched = 0
+        self.finished = 0
+        #: perf_counter of the most recent finish completing (run start
+        #: before any) — the watchdog's head-of-line age baseline.
+        self._last_retire_t = 0.0
+        #: THIS run's finish-wall histogram (fresh per run, telemetry-
+        #: active runs only) — the watchdog's p99 source; the process-
+        #: global stage histograms aggregate across engines/runs and
+        #: would cross-calibrate their thresholds.
+        self._finish_hist: Optional["_hist.LatencyHistogram"] = None
+
+    # ── live telemetry read side ──
+
+    def oldest_inflight(self) -> Optional[tuple]:
+        """(step ordinal, dispatch perf_counter) of the oldest record
+        still in flight, or None — the watchdog's probe."""
+        try:
+            return self._inflight[0]
+        except IndexError:
+            return None
+
+    def live_state(self) -> dict:
+        """One JSON-ready line of in-flight window state — what
+        ``/statusz`` reports per running pipeline."""
+        oldest = self.oldest_inflight()
+        now = time.perf_counter()
+        return {"engine": self._engine,
+                "dispatched": self.dispatched,
+                "finished": self.finished,
+                "inflight": len(self._inflight),
+                "depth": self.depth,
+                "step": max(0, self.dispatched - 1),
+                "oldest_step": oldest[0] if oldest else None,
+                "oldest_age_s": (round(now - oldest[1], 3)
+                                 if oldest else 0.0)}
 
     # ── item feed: inline at depth=1, background thread otherwise ──
 
@@ -304,29 +441,48 @@ class StepPipeline:
         exception (producer or consumer) unwinds with the producer thread
         stopped and its queue drained."""
         pending: collections.deque = collections.deque()
-        steps: collections.deque = collections.deque()  # dispatch ordinals
+        steps = self._inflight  # (ordinal, dispatch ts) — live-readable
+        steps.clear()
+        self._last_retire_t = time.perf_counter()
         stop = threading.Event()
         out_q: queue.Queue = queue.Queue(maxsize=self.depth + 1)
         started: list = []
         idx = 0
+        # The stall watchdog rides only telemetry-active runs: the
+        # default path starts zero extra threads.
+        watchdog: Optional[_StallWatchdog] = None
+        hists = _hist.active_histograms()
+        if hists is not None:
+            self._finish_hist = _hist.LatencyHistogram()
+            watchdog = _StallWatchdog(self, hists)
+            watchdog.start()
+        _hist.register_pipeline(self)
 
         def finish_oldest() -> None:
             # The per-step trace span: its wall IS the step's retire cost
             # (deferred flag wait + merge or replay) — the unit the
-            # straggler table in scripts/tracecat.py ranks.
-            with _span("finish", lane="dispatch", step=steps.popleft(),
-                       engine=self._engine):
+            # straggler table in scripts/tracecat.py ranks and the
+            # ``finish`` histogram the watchdog thresholds on.
+            step, _ts = steps[0]
+            with _span("finish", lane="dispatch", step=step,
+                       engine=self._engine) as sp:
                 self._finish(pending.popleft())
+            steps.popleft()
+            self.finished += 1
+            self._last_retire_t = time.perf_counter()
+            if self._finish_hist is not None:
+                self._finish_hist.record(sp.elapsed_s)
 
         try:
             for item in self._feed(make_items, out_q, stop, started):
                 with _span("dispatch", step=idx, engine=self._engine):
                     rec = self._dispatch(item)
                 idx += 1
+                self.dispatched = idx
                 if rec is None:
                     continue
                 pending.append(rec)
-                steps.append(idx - 1)
+                steps.append((idx - 1, time.perf_counter()))
                 if len(pending) > self._stats[self._inflight_key]:
                     self._stats[self._inflight_key] = len(pending)
                 if len(pending) >= self.depth:
@@ -334,6 +490,10 @@ class StepPipeline:
             while pending:
                 finish_oldest()
         finally:
+            _hist.unregister_pipeline(self)
+            if watchdog is not None:
+                watchdog.stop()
+                watchdog.join(timeout=5.0)  # fast: stop() wakes its wait
             if started:
                 stop.set()
                 thread = started[0]

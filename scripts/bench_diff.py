@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Diff two BENCH_r*.json verdicts against per-metric thresholds.
+
+Perf work on this repo has been eyeball-audited across the BENCH_r0*
+trajectory; this is the gate that makes a regression a table row
+instead of an archaeology project.  No jax, no repo imports — it reads
+the checked-in artifacts alone, so it runs in CI and on any laptop.
+
+Inputs: two artifact paths, or ``--dir`` to auto-pick the two newest
+``BENCH_r<NN>.json`` by round number (the matching ``MULTICHIP_r<NN>``
+twins are diffed too when both exist).  Artifacts may be the driver's
+wrapper (``{"parsed": {...}}``) or a raw bench verdict line.
+
+Each shared top-level numeric key is classified by the GATES table:
+
+* **higher-better** (throughputs, ratios): regress when the new value
+  drops more than the threshold fraction below the old;
+* **lower-better** (overheads, resume gap): regress when it RISES more
+  than the threshold fraction;
+* **bool** (parity, multichip ``ok``): regress on true→false;
+* everything else is an **info** row — shown, never gated.
+
+Missing keys compare as ``unknown``, never as a regression: rows are
+added over time and old artifacts legitimately lack them
+(backfill-tolerant by construction).  A zero/absent old value is also
+``unknown`` — no division by a failed round.  Provenance blocks
+(``bench.py`` stamps git sha / jax version / platform / hostname /
+x64) are printed as attribution, not compared.
+
+Exit: 0 when no gated metric regressed, 1 otherwise (CI runs this as
+an advisory, non-failing step; a release gate can take the rc as-is).
+
+Usage:
+  python scripts/bench_diff.py [--dir .] [OLD.json NEW.json]
+      [--threshold PATTERN=FRACTION ...] [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: (key pattern, kind, threshold-fraction).  First match wins — keep
+#: specific patterns above general ones.  kind: "higher" = bigger is
+#: better, "lower" = smaller is better, "bool" = regress on
+#: true→false.
+GATES: List[Tuple[str, str, float]] = [
+    ("*_parity", "bool", 0.0),
+    ("ok", "bool", 0.0),
+    ("counts_exact", "bool", 0.0),
+    ("value", "higher", 0.10),
+    ("vs_baseline", "higher", 0.10),
+    ("*_vs_oracle", "higher", 0.10),
+    ("*_vs_native", "higher", 0.10),
+    ("*_vs_python", "higher", 0.10),
+    ("framework_vs_native", "higher", 0.10),
+    ("*_mbps", "higher", 0.10),
+    ("*_overhead_pct", "lower", 0.50),
+    ("resume_gap_s", "lower", 1.00),
+]
+
+
+def classify(key: str,
+             overrides: List[Tuple[str, float]]) -> Tuple[str, float]:
+    """(kind, threshold) for one metric KEY.  The gate DIRECTION always
+    comes from the built-in table (matched against the key, never
+    against an override pattern — an override must not silently flip a
+    lower-better gate to higher-better); an override only replaces the
+    threshold, and promotes an otherwise-info metric to higher-better."""
+    kind, thr = "info", 0.0
+    for pat, k, t in GATES:
+        if fnmatch.fnmatch(key, pat):
+            kind, thr = k, t
+            break
+    for pat, frac in overrides:
+        if fnmatch.fnmatch(key, pat):
+            if kind == "info":
+                kind = "higher"
+            thr = frac
+            break
+    return kind, thr
+
+
+def load(path: str) -> Dict:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    return parsed if isinstance(parsed, dict) else doc
+
+
+def _round_no(path: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def discover(d: str) -> Tuple[str, str]:
+    files = sorted(glob.glob(os.path.join(d, "BENCH_r*.json")),
+                   key=_round_no)
+    files = [f for f in files if _round_no(f) >= 0]
+    if len(files) < 2:
+        sys.exit(f"bench_diff: need two BENCH_r*.json under {d}, "
+                 f"found {len(files)}")
+    return files[-2], files[-1]
+
+
+def fmt(v) -> str:
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+def diff_table(old: Dict, new: Dict, overrides, out) -> Tuple[int, int]:
+    """Print the per-metric table; returns (regressions, gated)."""
+    keys = [k for k in list(old) + [k for k in new if k not in old]
+            if k not in ("provenance",)]
+    rows = []
+    regressions = gated = 0
+    for k in keys:
+        ov, nv = old.get(k), new.get(k)
+        if not (isinstance(ov, (int, float, bool)) or
+                isinstance(nv, (int, float, bool))):
+            continue  # nested dicts (phases/spans), strings: not gated
+        kind, thr = classify(k, overrides)
+        if kind == "bool":
+            if ov is None or nv is None:
+                verdict, delta = "unknown", "?"
+            elif bool(ov) and not bool(nv):
+                verdict, delta = "REGRESS", "true->false"
+                regressions += 1
+                gated += 1
+            else:
+                verdict, delta = "ok", f"{ov}->{nv}"
+                gated += 1
+            rows.append((k, ov, nv, delta, "true", verdict))
+            continue
+        if not isinstance(ov, (int, float)) or \
+                not isinstance(nv, (int, float)) or \
+                isinstance(ov, bool) or isinstance(nv, bool):
+            rows.append((k, ov, nv, "?", "-", "unknown"))
+            continue
+        delta = f"{100.0 * (nv - ov) / ov:+.1f}%" if ov else "?"
+        if kind == "info":
+            rows.append((k, ov, nv, delta, "-", "info"))
+            continue
+        if ov <= 0:
+            # A zeroed old value is a failed round, not a baseline.
+            rows.append((k, ov, nv, delta, "-", "unknown"))
+            continue
+        gated += 1
+        if kind == "higher":
+            bad = nv < ov * (1.0 - thr)
+            gate = f">-{thr:.0%}"
+        else:
+            bad = nv > ov * (1.0 + thr)
+            gate = f"<+{thr:.0%}"
+        if bad:
+            regressions += 1
+        rows.append((k, ov, nv, delta, gate, "REGRESS" if bad else "ok"))
+    print(f"  {'metric':<28} {'old':>10} {'new':>10} {'delta':>12} "
+          f"{'gate':>8}  verdict", file=out)
+    order = {"REGRESS": 0, "ok": 1, "info": 2, "unknown": 3}
+    for k, ov, nv, delta, gate, verdict in sorted(
+            rows, key=lambda r: (order.get(r[5], 9), r[0])):
+        print(f"  {k:<28} {fmt(ov) if ov is not None else '?':>10} "
+              f"{fmt(nv) if nv is not None else '?':>10} {delta:>12} "
+              f"{gate:>8}  {verdict}", file=out)
+    return regressions, gated
+
+
+def _provenance_line(doc: Dict) -> Optional[str]:
+    p = doc.get("provenance")
+    if not isinstance(p, dict):
+        return None
+    return " ".join(f"{k}={p[k]}" for k in ("git_sha", "jax_version",
+                                            "platform", "hostname",
+                                            "x64", "utc") if k in p)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="OLD.json NEW.json (default: the two newest "
+                         "BENCH_r*.json in --dir)")
+    ap.add_argument("--dir", default=".",
+                    help="artifact directory for auto-discovery "
+                         "(default .)")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="PATTERN=FRACTION",
+                    help="override a gate, e.g. stream_mbps=0.25; "
+                         "repeatable; prepended to the built-in table")
+    args = ap.parse_args(argv)
+
+    overrides: List[Tuple[str, float]] = []
+    for spec in args.threshold:
+        pat, _, frac = spec.partition("=")
+        if not frac:
+            ap.error(f"--threshold wants PATTERN=FRACTION, got {spec!r}")
+        overrides.append((pat, float(frac)))
+
+    if len(args.paths) == 2:
+        old_path, new_path = args.paths
+    elif not args.paths:
+        old_path, new_path = discover(args.dir)
+    else:
+        ap.error("give exactly two paths, or none with --dir")
+
+    out = sys.stdout
+    total_regressions = 0
+    print(f"== bench_diff: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} ==", file=out)
+    old, new = load(old_path), load(new_path)
+    for tag, doc in (("old", old), ("new", new)):
+        line = _provenance_line(doc)
+        if line:
+            print(f"  {tag} provenance: {line}", file=out)
+    if old.get("metric") != new.get("metric"):
+        print(f"  NOTE: metric changed "
+              f"({old.get('metric')} -> {new.get('metric')}) — "
+              f"numbers may not be like-for-like", file=out)
+    r, g = diff_table(old, new, overrides, out)
+    total_regressions += r
+    print(f"  -> {'REGRESS' if r else 'PASS'} "
+          f"({r} regressions over {g} gated metrics)", file=out)
+
+    # The MULTICHIP twins of the same rounds, when both exist.
+    ro, rn = _round_no(old_path), _round_no(new_path)
+    d = os.path.dirname(os.path.abspath(old_path))
+    mco = os.path.join(d, f"MULTICHIP_r{ro:02d}.json")
+    mcn = os.path.join(d, f"MULTICHIP_r{rn:02d}.json")
+    if ro >= 0 and rn >= 0 and os.path.exists(mco) and os.path.exists(mcn):
+        print(f"\n== bench_diff: {os.path.basename(mco)} -> "
+              f"{os.path.basename(mcn)} ==", file=out)
+        r, g = diff_table(load(mco), load(mcn), overrides, out)
+        total_regressions += r
+        print(f"  -> {'REGRESS' if r else 'PASS'} "
+              f"({r} regressions over {g} gated metrics)", file=out)
+
+    return 1 if total_regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -78,6 +78,11 @@ def main() -> int:
                     help="write the soak's unified trace (dsi_tpu/obs): "
                          "Perfetto trace.json + trace.jsonl; render "
                          "with scripts/tracecat.py")
+    ap.add_argument("--statusz-port", type=int, default=None,
+                    help="serve live telemetry on 127.0.0.1:PORT — "
+                         "/statusz + /metrics (0 = pick a free port; "
+                         "default off, env DSI_STATUSZ_PORT); arms the "
+                         "stall watchdog and the live.jsonl ring")
     args = ap.parse_args()
     if args.resume and not args.checkpoint_dir:
         ap.error("--resume requires --checkpoint-dir")
@@ -86,6 +91,11 @@ def main() -> int:
         from dsi_tpu.obs import configure_tracing
 
         configure_tracing(trace_dir=args.trace_dir)
+
+    if args.statusz_port is not None or os.environ.get("DSI_STATUSZ_PORT"):
+        from dsi_tpu.obs.live import start_from_args
+
+        start_from_args(args.statusz_port, live_dir=args.trace_dir)
 
     import jax
 

@@ -21,8 +21,18 @@ Sections:
                 or replay), with engine and step ordinal;
 * stragglers  — finish spans beyond max(2x median, mean + 3 sigma): the
                 outliers a speculative-execution pass would back up;
-* control     — requeue/fault/assign/complete event digest and the
-                per-worker heartbeat-age gauge, when present.
+* control     — requeue/fault/assign/complete/stall/aot_load event
+                digest and the per-worker heartbeat-age gauge, when
+                present;
+* shuffle     — the mesh-sharded fold lane (PR 7): fold-span wall,
+                ``shard_widens``/``shard_imbalance``/``pull_bytes``
+                counters and per-event hot-shard details;
+* ckpt        — the capture/commit split (PR 8): per-half span wall
+                and the ``ckpt_barrier_s``/saves/deltas/bytes
+                counters;
+* histograms  — the live-telemetry stage latency percentile table
+                (count/p50/p90/p99/max per hot stage) embedded in the
+                registry snapshot at flush.
 
 Usage: python scripts/tracecat.py TRACE_OR_DIR [--top N]
 """
@@ -78,6 +88,11 @@ def load(path: str):
     """(metas, events) from a file or a directory of trace artifacts."""
     if os.path.isdir(path):
         files = sorted(glob.glob(os.path.join(path, "*.jsonl")))
+        # The live sampler's ring (obs/live.py) shares the trace dir
+        # but holds wall-clock snapshots, not span events — summarized
+        # separately in main(), never merged into the timeline.
+        files = [f for f in files
+                 if os.path.basename(f) != "live.jsonl"]
         if not files:
             files = sorted(glob.glob(os.path.join(path, "*.json")))
             files = [f for f in files if not f.endswith(".crc32")]
@@ -171,7 +186,7 @@ def stragglers(events, out) -> None:
 def control(events, metas, out) -> None:
     interesting = ("requeue", "fault", "assign", "complete",
                    "duplicate_completion", "ckpt_save", "ckpt_restore",
-                   "table_widen")
+                   "table_widen", "shard_widen", "stall", "aot_load")
     counts: dict = {}
     for e in events:
         if e.get("ph") == "I" and e.get("name") in interesting:
@@ -180,11 +195,13 @@ def control(events, metas, out) -> None:
         print("  events: " + "  ".join(
             f"{k}={v}" for k, v in sorted(counts.items())), file=out)
     for e in events:
-        if e.get("ph") == "I" and e.get("name") in ("requeue", "fault"):
+        if e.get("ph") == "I" and e.get("name") in ("requeue", "fault",
+                                                    "stall"):
             extras = {k: v for k, v in e.items()
                       if k not in ("ph", "name", "lane", "ts", "dur",
                                    "depth", "_file")}
-            print(f"  {e['name']} @ {e.get('ts', 0):.3f}s: {extras}",
+            tag = "STALL" if e["name"] == "stall" else e["name"]
+            print(f"  {tag} @ {e.get('ts', 0):.3f}s: {extras}",
                   file=out)
     for meta in metas:
         gauges = (meta.get("registry") or {}).get("gauges") or {}
@@ -193,6 +210,108 @@ def control(events, metas, out) -> None:
             print(f"  heartbeat ages [{meta.get('_file', '?')}]: "
                   + "  ".join(f"{w}={a}s" for w, a in sorted(hb.items())),
                   file=out)
+        hbh = gauges.get("mr_worker_heartbeat_hist")
+        if hbh:
+            for w, h in sorted(hbh.items()):
+                print(f"  heartbeat gaps {w}: count={h.get('count')} "
+                      f"p50={h.get('p50_ms')}ms p99={h.get('p99_ms')}ms "
+                      f"max={h.get('max_ms')}ms", file=out)
+
+
+def _span_totals(events, names) -> dict:
+    tot: dict = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") in names:
+            r = tot.setdefault(e["name"], [0.0, 0])
+            r[0] += e.get("dur", 0.0)
+            r[1] += 1
+    return tot
+
+
+def shuffle(events, metas, out) -> bool:
+    """The mesh-sharded fold lane (PR 7): invisible to the original
+    digest because the lane landed after it.  Returns True when there
+    was anything to show."""
+    folds = [e for e in events if e.get("ph") == "X"
+             and e.get("lane") == "shuffle"]
+    widens = [e for e in events if e.get("ph") == "I"
+              and e.get("name") == "shard_widen"]
+    rows = []
+    for meta in metas:
+        engines = (meta.get("registry") or {}).get("engines") or {}
+        for eng, ph in sorted(engines.items()):
+            if ph.get("mesh_shards"):
+                rows.append((meta.get("_file", "?"), eng, ph))
+    if not (folds or widens or rows):
+        return False
+    if folds:
+        tot = sum(e.get("dur", 0.0) for e in folds)
+        print(f"  fold spans in lane: {len(folds)}  wall={tot:.3f}s",
+              file=out)
+    for fname, eng, ph in rows:
+        sw = ph.get("shard_widens")
+        print(f"  {eng} [{fname}]: mesh_shards={ph.get('mesh_shards')} "
+              f"pull_bytes={ph.get('pull_bytes')} "
+              f"shard_widens={sw} (sum={sum(sw) if sw else 0}) "
+              f"shard_imbalance={ph.get('shard_imbalance')}", file=out)
+    for e in widens:
+        extras = {k: v for k, v in e.items()
+                  if k not in ("ph", "name", "lane", "ts", "dur",
+                               "depth", "_file")}
+        print(f"  shard_widen @ {e.get('ts', 0):.3f}s: {extras}",
+              file=out)
+    return True
+
+
+def ckpt(events, metas, out) -> bool:
+    """The async checkpoint capture/commit split (PR 8) — per-half
+    wall from the spans, barrier/saves/bytes from the phase dicts."""
+    tot = _span_totals(events, ("ckpt", "ckpt_capture", "ckpt_commit"))
+    keys = ("ckpt_saves", "ckpt_deltas", "ckpt_barrier_s",
+            "ckpt_capture_s", "ckpt_commit_s", "ckpt_full_bytes",
+            "ckpt_delta_bytes", "resume_gap_s")
+    rows = []
+    for meta in metas:
+        engines = (meta.get("registry") or {}).get("engines") or {}
+        for eng, ph in sorted(engines.items()):
+            kv = {k: ph[k] for k in keys if ph.get(k)}
+            if kv:
+                rows.append((meta.get("_file", "?"), eng, kv))
+    if not (tot or rows):
+        return False
+    for name in ("ckpt", "ckpt_capture", "ckpt_commit"):
+        if name in tot:
+            t, n = tot[name]
+            print(f"  {name:<14} total={t:.3f}s count={n} "
+                  f"mean={1e3 * t / n:.2f}ms", file=out)
+    for fname, eng, kv in rows:
+        print(f"  {eng} [{fname}]: " + " ".join(
+            f"{k}={round(v, 4) if isinstance(v, float) else v}"
+            for k, v in kv.items()), file=out)
+    return True
+
+
+def histograms(metas, out) -> bool:
+    """The stage latency percentile table (obs/hist.py) embedded in
+    each trace's registry snapshot."""
+    any_rows = False
+    for meta in metas:
+        hists = (meta.get("registry") or {}).get("histograms") or {}
+        if not hists:
+            continue
+        if not any_rows:
+            print(f"  {'stage':<14} {'count':>8} {'p50_ms':>10} "
+                  f"{'p90_ms':>10} {'p99_ms':>10} {'max_ms':>10}  file",
+                  file=out)
+        any_rows = True
+        for stage, h in sorted(hists.items()):
+            print(f"  {stage:<14} {h.get('count', 0):>8} "
+                  f"{h.get('p50_ms', 0):>10.3f} "
+                  f"{h.get('p90_ms', 0):>10.3f} "
+                  f"{h.get('p99_ms', 0):>10.3f} "
+                  f"{h.get('max_ms', 0):>10.3f}  "
+                  f"{meta.get('_file', '?')}", file=out)
+    return any_rows
 
 
 def main(argv=None) -> int:
@@ -212,6 +331,18 @@ def main(argv=None) -> int:
     print(f"== tracecat: {args.trace} ==", file=out)
     print(f"  files={len(metas) or 1} events={len(events)} spans={spans} "
           f"wall={wall:.3f}s dropped={dropped}", file=out)
+    ring = (os.path.join(args.trace, "live.jsonl")
+            if os.path.isdir(args.trace) else None)
+    if ring and os.path.exists(ring):
+        try:
+            with open(ring, encoding="utf-8") as f:
+                samples = [l for l in f if l.strip()]
+            last = json.loads(samples[-1]) if samples else {}
+            print(f"  live ring: {len(samples)} samples (live.jsonl), "
+                  f"last at uptime {last.get('uptime_s', '?')}s, "
+                  f"pipelines={last.get('pipelines')}", file=out)
+        except (OSError, ValueError):
+            pass
     for meta in metas:
         if meta.get("counters"):
             print(f"  counters [{meta.get('_file', '?')}]: "
@@ -232,6 +363,17 @@ def main(argv=None) -> int:
     top_steps(events, args.top, out)
     print("\n-- stragglers --", file=out)
     stragglers(events, out)
+    import io
+
+    for title, fn in (("shuffle lane", lambda o: shuffle(events, metas, o)),
+                      ("ckpt capture/commit", lambda o: ckpt(events, metas,
+                                                             o)),
+                      ("stage latency histograms",
+                       lambda o: histograms(metas, o))):
+        buf = io.StringIO()
+        if fn(buf):  # sections that landed after the original digest:
+            print(f"\n-- {title} --", file=out)  # shown only with data
+            out.write(buf.getvalue())
     print("\n-- control plane --", file=out)
     control(events, metas, out)
     return 0

@@ -235,6 +235,34 @@ def test_mesh_shard_keys_reconcile_with_span_totals(tmp_path):
         pstats["fold_s"], rel=0.05, abs=0.05)
 
 
+def test_histogram_keys_pinned_in_registry_schema():
+    """Schema contract for the live telemetry plane (ISSUE 10): the
+    hot-stage set and the per-stage snapshot keys are PINNED — every
+    consumer (/statusz, /metrics, trace meta, tracecat's percentile
+    table, bench rollups) keys on them, so changing either is a schema
+    change and must fail here first."""
+    from dsi_tpu.obs import hist
+    from dsi_tpu.obs.registry import get_registry
+
+    assert hist.HIST_STAGES == ("kernel", "upload", "pull", "finish",
+                                "fold", "sync", "ckpt_commit")
+    assert hist.HIST_SNAPSHOT_KEYS == ("count", "total_s", "p50_ms",
+                                       "p90_ms", "p99_ms", "max_ms")
+    hist.deactivate(force=True)
+    try:
+        # Off: the snapshot carries no histograms key at all.
+        assert "histograms" not in get_registry().snapshot()
+        hs = hist.activate()
+        hs.record("kernel", 0.004)
+        hs.record("not_a_stage", 1.0)  # non-hot names drop silently
+        snap = get_registry().snapshot()
+        assert set(snap["histograms"]) == {"kernel"}
+        assert tuple(snap["histograms"]["kernel"]) == \
+            hist.HIST_SNAPSHOT_KEYS
+    finally:
+        hist.deactivate(force=True)
+
+
 @pytest.mark.slow
 def test_stream_row_disabled_leaves_no_stream_keys(tmp_path):
     rc, v = run_bench(tmp_path, {"DSI_BENCH_TPU_TIMEOUTS": "0",
