@@ -1355,6 +1355,138 @@ def run_mesh_row() -> dict:
     return row
 
 
+def run_serve_row() -> dict:
+    """The serving-daemon A/B (ISSUE 11 satellite): M small word-count
+    jobs submitted to the packed resident daemon (``dsi_tpu/serve``,
+    one ``mrserve`` subprocess on the 8-vdev CPU mesh) versus the SAME
+    M jobs run serially as one-shot ``wcstream`` CLIs — each of which
+    pays its own process start + jax init + compile, which is exactly
+    the cost the daemon exists to amortize.  Reports
+    ``serve_packed_mbps`` / ``serve_oneshot_mbps`` (wall MB/s over the
+    submit-to-done window vs the serial CLI loop) and
+    ``serve_amortized_warm_s`` (the daemon's boot-to-ready cost divided
+    across the M tenants).  Parity bar: every tenant's daemon output
+    must byte-compare equal to the sequential oracle, or the row
+    suppresses its throughput.  Measured keys XOR ``serve_skipped`` —
+    the bench-contract discipline.  ``DSI_BENCH_SERVE_JOBS`` (default
+    8; 0 disables) and ``DSI_BENCH_SERVE_MB`` (per-job MB, default 1)
+    size it; chip-independent (host subprocesses), so it rides every
+    verdict branch like the mesh row."""
+    try:
+        jobs = int(os.environ.get("DSI_BENCH_SERVE_JOBS", "8"))
+    except ValueError:
+        jobs = 8
+    if jobs <= 0:
+        return {"serve_skipped": "disabled (DSI_BENCH_SERVE_JOBS=0)"}
+    per_mb = env_float("DSI_BENCH_SERVE_MB", 1.0)
+    import shutil
+    import tempfile
+
+    from dsi_tpu.serve import client as sv
+
+    sdir = os.path.join(WORKDIR, "serve-row")
+    shutil.rmtree(sdir, ignore_errors=True)
+    os.makedirs(sdir)
+    spool = os.path.join(sdir, "spool")
+    # AF_UNIX socket paths cap at ~108 bytes; WORKDIR can be deep.
+    sock = os.path.join(tempfile.mkdtemp(prefix="dsi-bench-sv-"),
+                        "s.sock")
+    files = []
+    for i in range(jobs):
+        path = os.path.join(sdir, f"t{i}.txt")
+        vocab = [f"t{i}w{j:04d}" for j in range(600)]
+        line = " ".join(vocab) + "\n"
+        reps = max(1, round(per_mb * 1e6 / len(line)))
+        with open(path, "w") as f:
+            f.write(line * reps)
+        files.append(path)
+    total_mb = sum(os.path.getsize(p) for p in files) / 1e6
+    # Per-tenant oracles, no jax in this (parent) process.
+    from dsi_tpu.apps import wc
+    from dsi_tpu.mr.sequential import run_sequential
+
+    oracles = {}
+    for i, p in enumerate(files):
+        out = p + ".oracle"
+        run_sequential(wc.Map, wc.Reduce, [p], out)
+        with open(out, encoding="utf-8") as f:
+            oracles[i] = sorted(l for l in f if l.strip())
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+    budget = env_float("DSI_BENCH_SERVE_TIMEOUT", 300.0)
+
+    # ── packed daemon half ──
+    t_boot = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dsi_tpu.cli.mrserve", "--spool", spool,
+         "--socket", sock, "--chunk-bytes", "65536"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        sv.wait_ready(sock, timeout=budget)
+        warm_s = time.perf_counter() - t_boot
+        t0 = time.perf_counter()
+        reps = [sv.submit(sock, f"t{i}", [files[i]])
+                for i in range(jobs)]
+        final = sv.wait(sock, [r["job_id"] for r in reps],
+                        timeout=budget)
+        packed_s = time.perf_counter() - t0
+        bad = [j for j, r in final.items() if r["state"] != "done"]
+        if bad:
+            return {"serve_skipped": f"daemon jobs failed: {bad}"}
+        for i, rep in enumerate(reps):
+            got = []
+            for r in range(10):
+                with open(os.path.join(rep["out_dir"], f"mr-out-{r}"),
+                          encoding="utf-8") as f:
+                    got.extend(l for l in f if l.strip())
+            if sorted(got) != oracles[i]:
+                return {"serve_skipped": f"tenant t{i} parity mismatch "
+                                         f"(throughput suppressed)",
+                        "serve_parity": False}
+        try:
+            sv.shutdown(sock)
+            proc.wait(timeout=30)
+        except Exception:
+            proc.kill()
+    except Exception as e:
+        return {"serve_skipped": f"daemon half failed: "
+                                 f"{type(e).__name__}: {e}"}
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # ── one-shot serial half: the same M jobs, a fresh CLI each ──
+    t1 = time.perf_counter()
+    for i, p in enumerate(files):
+        wd = os.path.join(sdir, f"oneshot-{i}")
+        os.makedirs(wd, exist_ok=True)
+        r = subprocess.run(
+            [sys.executable, "-m", "dsi_tpu.cli.wcstream",
+             "--workdir", wd, p],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=budget)
+        if r.returncode != 0:
+            return {"serve_skipped": f"one-shot CLI {i} rc="
+                                     f"{r.returncode}: {r.stderr[-200:]}"}
+    oneshot_s = time.perf_counter() - t1
+    row = {"serve_jobs": jobs, "serve_mb": round(total_mb, 2),
+           "serve_parity": True,
+           "serve_packed_mbps": round(total_mb / packed_s, 2),
+           "serve_oneshot_mbps": round(total_mb / oneshot_s, 2),
+           "serve_amortized_warm_s": round(warm_s / jobs, 3)}
+    log(f"serve row: {jobs} jobs x {per_mb} MB — packed daemon "
+        f"{row['serve_packed_mbps']} MB/s ({packed_s:.2f}s after "
+        f"{warm_s:.2f}s boot = {row['serve_amortized_warm_s']}s/tenant) "
+        f"vs serial one-shot CLIs {row['serve_oneshot_mbps']} MB/s "
+        f"({oneshot_s:.2f}s)")
+    return row
+
+
 def run_native_oracle_row(files, oracle_out, total_mb, native_ok,
                           fw_oracle_mbps) -> dict:
     """Sequential run of the SAME C++ task bodies the native-backend
@@ -1706,6 +1838,16 @@ def main() -> None:
     else:
         # Measured-XOR-skipped holds on the fast path too.
         fw["mesh_skipped"] = f"budget {budget_s:.0f}s < 60s"
+    # The serving-daemon A/B row: chip-independent (mrserve + one-shot
+    # CLI subprocesses on the virtual CPU mesh), rides every branch.
+    if budget_s >= 60 or "DSI_BENCH_SERVE_JOBS" in os.environ:
+        try:
+            fw.update(run_serve_row())
+        except Exception as e:
+            fw["serve_skipped"] = (f"serve row failed: "
+                                   f"{type(e).__name__}: {e}")
+    else:
+        fw["serve_skipped"] = f"budget {budget_s:.0f}s < 60s"
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,

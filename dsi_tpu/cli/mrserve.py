@@ -1,0 +1,125 @@
+"""The resident MapReduce-as-a-service daemon (``dsi_tpu/serve``).
+
+Boots once — device mesh init, AOT warm, spool hygiene — then serves
+job submissions over a Unix-socket control plane until shut down.  Many
+small jobs amortize the start cost K one-shot CLIs would each pay, and
+word-count tenants additionally PACK into shared device steps (K
+tenants ≈ 1 dispatch; ``serve/pack.py``).  Kill it however you like:
+accepted jobs are journaled durably and per-tenant delta-checkpoint
+chains make the restart resume every in-flight tenant with
+byte-identical output.
+
+Usage:
+    python -m dsi_tpu.cli.mrserve --spool DIR [--socket PATH]
+        [--nreduce N] [--chunk-bytes B] [--devices D]
+        [--max-resident K] [--quota-steps Q] [--checkpoint-every K]
+        [--retention-days D] [--statusz-port P] [--trace-dir DIR]
+        [--no-warm]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--spool", required=True,
+                   help="daemon state root: control socket, job "
+                        "journal, per-tenant checkpoint chains, job "
+                        "outputs")
+    p.add_argument("--socket", default=None,
+                   help="control socket path (default: "
+                        "<spool>/mrserve.sock)")
+    p.add_argument("--nreduce", type=int, default=10,
+                   help="the daemon's reduce-partition degree (packed "
+                        "steps share it; submissions must match)")
+    p.add_argument("--chunk-bytes", type=int, default=1 << 16,
+                   help="per-lane bytes per packed step (rounded up to "
+                        "a power of two, min 256)")
+    p.add_argument("--devices", type=int, default=None,
+                   help="mesh size = packing lanes (default: all local "
+                        "devices)")
+    p.add_argument("--max-resident", type=int, default=8,
+                   help="jobs held in memory at once; the rest park as "
+                        "checkpoint chains until scheduled")
+    p.add_argument("--quota-steps", type=int, default=64,
+                   help="confirmed steps a resident job may take while "
+                        "others queue before it is evicted to its "
+                        "chain")
+    p.add_argument("--checkpoint-every", type=int, default=8,
+                   help="confirmed packed steps between per-tenant "
+                        "snapshots (delta chains; eviction and crash "
+                        "recovery both resume from them)")
+    p.add_argument("--retention-days", type=float, default=14.0,
+                   help="age after which a DONE tenant's checkpoint "
+                        "chains are garbage-collected at boot (live "
+                        "chains are never touched)")
+    p.add_argument("--statusz-port", type=int, default=None,
+                   help="serve live telemetry on 127.0.0.1:PORT — "
+                        "/statusz gains a per-tenant section and "
+                        "/metrics dsi_serve_* series; 0 picks a free "
+                        "port (env DSI_STATUSZ_PORT)")
+    p.add_argument("--trace-dir", default=None,
+                   help="unified trace output dir (dsi_tpu/obs)")
+    p.add_argument("--no-warm", action="store_true",
+                   help="skip the boot-time AOT warm (tests)")
+    args = p.parse_args(argv)
+
+    if args.trace_dir:
+        from dsi_tpu.obs import configure_tracing
+
+        configure_tracing(trace_dir=args.trace_dir)
+
+    # Live telemetry BEFORE jax init, the wcstream discipline: /statusz
+    # answers while the mesh is still coming up.
+    if args.statusz_port is not None or os.environ.get("DSI_STATUSZ_PORT"):
+        from dsi_tpu.obs.live import start_from_args
+
+        start_from_args(args.statusz_port, live_dir=args.trace_dir)
+
+    from dsi_tpu.utils.platformpin import pin_platform_from_env
+
+    pin_platform_from_env()
+
+    from dsi_tpu.serve.daemon import ServeDaemon
+
+    daemon = ServeDaemon(
+        args.spool, socket_path=args.socket, n_reduce=args.nreduce,
+        chunk_bytes=args.chunk_bytes, devices=args.devices,
+        max_resident=args.max_resident, quota_steps=args.quota_steps,
+        checkpoint_every=args.checkpoint_every,
+        retention_s=args.retention_days * 86400.0,
+        warm=not args.no_warm)
+
+    def _stop(_sig, _frm):
+        daemon.stop()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    daemon.start()
+    print(f"mrserve: spool={daemon.spool} socket={daemon.socket_path} "
+          f"lanes={args.devices or 'auto'} (boot reaped "
+          f"{daemon.boot_reaped} tmp orphans, gc'd "
+          f"{daemon.boot_gc_chains} aged chains)",
+          file=sys.stderr, flush=True)
+    daemon.ready.wait()
+    print("mrserve: ready", file=sys.stderr, flush=True)
+    try:
+        while daemon._thread.is_alive():
+            daemon.join(timeout=0.5)
+    finally:
+        daemon.close()
+        if args.trace_dir:
+            from dsi_tpu.obs import flush_tracing_report
+
+            flush_tracing_report(args.trace_dir, "mrserve")
+    print("mrserve: stopped", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,91 @@
+"""Submit jobs to (and query) a running ``mrserve`` daemon.
+
+No jax import ever: this is the thin control-plane client
+(``serve/client.py``) — submitting costs one framed-JSON RPC on the
+daemon's Unix socket, which is the whole point of the resident daemon.
+
+Usage:
+    python -m dsi_tpu.cli.mrsubmit --spool DIR --tenant T [--app wc]
+        [--pattern P] [--wait] [--timeout S] inputfiles...
+    python -m dsi_tpu.cli.mrsubmit --spool DIR --status [JOB_ID]
+    python -m dsi_tpu.cli.mrsubmit --spool DIR --shutdown
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dsi_tpu.serve import client
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*")
+    p.add_argument("--spool", default=None,
+                   help="the daemon's spool (socket defaults to "
+                        "<spool>/mrserve.sock)")
+    p.add_argument("--socket", default=None,
+                   help="explicit control socket path (wins over "
+                        "--spool)")
+    p.add_argument("--tenant", default="default")
+    p.add_argument("--app", choices=("wc", "grep"), default="wc")
+    p.add_argument("--pattern", default=None,
+                   help="literal pattern (grep)")
+    p.add_argument("--nreduce", type=int, default=None,
+                   help="must match the daemon's degree (default: the "
+                        "daemon's)")
+    p.add_argument("--wait", action="store_true",
+                   help="block until the job finishes; rc 0 only when "
+                        "it is done")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--status", nargs="?", const="", default=None,
+                   metavar="JOB_ID",
+                   help="query one job (or, with no id, every job + "
+                        "the tenant table) instead of submitting")
+    p.add_argument("--shutdown", action="store_true",
+                   help="ask the daemon to stop")
+    args = p.parse_args(argv)
+
+    sock = args.socket or (client.default_socket(args.spool)
+                           if args.spool else None)
+    if not sock:
+        p.error("need --socket or --spool")
+
+    if args.shutdown:
+        client.shutdown(sock)
+        print("mrsubmit: shutdown requested", file=sys.stderr)
+        return 0
+    if args.status is not None:
+        out = client.status(sock, job_id=args.status or None)
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    if not args.files:
+        p.error("nothing to submit (no input files)")
+
+    try:
+        rep = client.submit(sock, args.tenant, args.files, app=args.app,
+                            pattern=args.pattern, n_reduce=args.nreduce)
+    except Exception as e:  # noqa: BLE001 — the CLI reports, rc says it
+        print(f"mrsubmit: submit failed: {e}", file=sys.stderr)
+        return 1
+    jid = rep["job_id"]
+    print(json.dumps(rep))
+    if not args.wait:
+        return 0
+    try:
+        final = client.wait(sock, [jid], timeout=args.timeout)[jid]
+    except TimeoutError as e:
+        print(f"mrsubmit: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({"job": final}, sort_keys=True))
+    if final["state"] != "done":
+        print(f"mrsubmit: job {jid} {final['state']}: "
+              f"{final.get('error')}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -184,19 +184,14 @@ def main(argv=None) -> int:
 
         flush_tracing_report(args.trace_dir, "wcstream")
     if acc is None:
-        # Host fallback: the sequential oracle semantics, partitioned output.
+        # Host fallback: the sequential oracle semantics, partitioned
+        # output — the ONE shared implementation (serve/pack.py), so the
+        # CLI and the serving daemon cannot drift.
         print("wcstream: stream needs the host path; running host word count",
               file=sys.stderr)
-        from dsi_tpu.apps import wc
-        from dsi_tpu.mr.worker import ihash
+        from dsi_tpu.serve.pack import host_wordcount
 
-        counts: dict = {}
-        for f in args.files:
-            with open(f, "rb") as fh:
-                text = fh.read().decode("utf-8", errors="replace")
-            for kv in wc.Map(f, text):
-                counts[kv.key] = counts.get(kv.key, 0) + 1
-        acc = {w: (c, ihash(w) % args.nreduce) for w, c in counts.items()}
+        acc = host_wordcount(args.files, args.nreduce)
     os.makedirs(args.workdir, exist_ok=True)
     write_partitioned_output(acc, args.nreduce, args.workdir)
 

@@ -76,6 +76,36 @@ def _mname(s: str) -> str:
     return _METRIC_SANE.sub("_", str(s))
 
 
+# ── pluggable sections (the serving daemon's tenant table) ─────────────
+#
+# A resident process with state of its own (``dsi_tpu/serve``'s
+# per-tenant table) registers a section here: ``statusz_fn`` returns the
+# section's plain-text body (one indented line per row), ``metrics_fn``
+# (optional) returns ready Prometheus lines.  Both are called on demand
+# under the same no-staleness rule as the built-in sections; a provider
+# that raises is skipped, never kills the scrape.
+
+_sections_lock = threading.Lock()
+_sections: Dict[str, tuple] = {}
+
+
+def register_section(name: str, statusz_fn, metrics_fn=None) -> None:
+    """Add (or replace) a named /statusz section + optional /metrics
+    lines provider."""
+    with _sections_lock:
+        _sections[name] = (statusz_fn, metrics_fn)
+
+
+def unregister_section(name: str) -> None:
+    with _sections_lock:
+        _sections.pop(name, None)
+
+
+def _section_items() -> list:
+    with _sections_lock:
+        return sorted(_sections.items())
+
+
 class LiveTelemetry:
     """One live telemetry server + sampler (module docstring)."""
 
@@ -170,6 +200,13 @@ class LiveTelemetry:
             out.append(f"-- last stall --\n  {stall}")
         if s["counters"]:
             out.append(f"-- counters --\n  {s['counters']}")
+        for name, (status_fn, _metrics_fn) in _section_items():
+            try:
+                body = status_fn()
+            except Exception:
+                continue  # a broken provider must not kill the scrape
+            out.append(f"-- {name} --")
+            out.append(body.rstrip("\n") if body else "  (empty)")
         return "\n".join(out) + "\n"
 
     def metrics_text(self) -> str:
@@ -214,6 +251,15 @@ class LiveTelemetry:
                      f'{{worker="{_mname(w)}"}} {a}')
         for name, v in sorted(s["counters"].items()):
             L.append(f'dsi_counter{{name="{_mname(name)}"}} {v}')
+        for name, (_status_fn, metrics_fn) in _section_items():
+            if metrics_fn is None:
+                continue
+            try:
+                extra = metrics_fn()
+            except Exception:
+                continue
+            if extra:
+                L.append(extra.rstrip("\n"))
         return "\n".join(L) + "\n"
 
     # ── sampler ──

@@ -102,6 +102,7 @@ from dsi_tpu.parallel.pipeline import (
     StepPipeline,
     pipeline_depth,
 )
+from dsi_tpu.parallel.stepobj import EngineStep
 from dsi_tpu.parallel.shuffle import (
     AXIS,
     default_mesh,
@@ -424,6 +425,36 @@ def grep_host_oracle(blocks: Iterable[bytes], pattern: str, *,
     return GrepStreamResult(line_no, matched, occurrences, tuple(hist), top)
 
 
+class GrepStep(EngineStep):
+    """Resumable step object over the streaming grep engine — the
+    ``{advance, confirm, checkpoint, restore, close}`` lifecycle
+    (``parallel/stepobj.py``) with :func:`grep_streaming`'s parameters
+    and semantics.  A non-literal pattern routes to the host path at
+    construction (the object is already terminal, ``close()`` → None);
+    ``resume=True`` restores the newest valid chain before the first
+    dispatch."""
+
+    def __init__(self, blocks: Iterable[bytes], pattern: str,
+                 mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
+                 depth: Optional[int] = None, aot: bool = False,
+                 device_accumulate: bool = False,
+                 sync_every: Optional[int] = None,
+                 mesh_shards: Optional[int] = None,
+                 topk: int = DEFAULT_TOPK, bins: int = GREP_BINS,
+                 pipeline_stats: Optional[dict] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: Optional[int] = None,
+                 checkpoint_async: Optional[bool] = None,
+                 checkpoint_delta: Optional[bool] = None,
+                 resume: bool = False):
+        super().__init__()
+        _grep_setup(self, blocks, pattern, mesh, chunk_bytes, depth, aot,
+                    device_accumulate, sync_every, mesh_shards, topk,
+                    bins, pipeline_stats, checkpoint_dir,
+                    checkpoint_every, checkpoint_async, checkpoint_delta,
+                    resume)
+
+
 def grep_streaming(
         blocks: Iterable[bytes], pattern: str, mesh: Mesh | None = None,
         chunk_bytes: int = 1 << 20, depth: Optional[int] = None,
@@ -489,8 +520,26 @@ def grep_streaming(
     candidate rows appended since the previous save (the histogram is
     cumulative KBs and rides every delta whole, newest-wins).
     """
+    return GrepStep(
+        blocks, pattern, mesh=mesh, chunk_bytes=chunk_bytes, depth=depth,
+        aot=aot, device_accumulate=device_accumulate,
+        sync_every=sync_every, mesh_shards=mesh_shards, topk=topk,
+        bins=bins, pipeline_stats=pipeline_stats,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        checkpoint_async=checkpoint_async,
+        checkpoint_delta=checkpoint_delta, resume=resume).close()
+
+
+def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
+                device_accumulate, sync_every, mesh_shards, topk, bins,
+                pipeline_stats, checkpoint_dir, checkpoint_every,
+                checkpoint_async, checkpoint_delta, resume):
+    """The engine body behind :class:`GrepStep`: full setup (resume
+    restore included) ending with the pipeline armed and the lifecycle
+    hooks attached to ``step``."""
     if not is_literal_pattern(pattern):
-        return None
+        step._phase = "hostpath"  # terminal before any device work
+        return
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -819,27 +868,39 @@ def grep_streaming(
                         thread_name="dsi-grep-batcher", engine="grep")
 
     feed = skip_stream(blocks, start_offset) if start_offset else blocks
-    result: Optional[GrepStreamResult]
-    try:
-        pipe.run(lambda: batch_lines(feed, n_dev, chunk_bytes,
-                                     pool=pool, offsets=offsets))
+    step._pipe = pipe
+    pipe.begin(lambda: batch_lines(feed, n_dev, chunk_bytes,
+                                   pool=pool, offsets=offsets))
+    step._host_excs = (_LineTooLong,)
+    step._save = save_ckpt if ck_store is not None else None
+    step._writer = ck_writer
+    if resume:
+        step._restore_info = {
+            "resume_cursor": stats.get("resume_cursor", 0),
+            "resume_gap_s": stats.get("resume_gap_s", 0.0)}
+
+    def on_complete():
+        h, t, cands = hist_h, totals, cand_h
         if device_accumulate:
             fault_point("pre-sync")
             topk_svc.close()  # the exact final drain into the KeyCounts
             final = hist_svc.close()
-            hist_h = final[:bins]
-            totals = final[bins:]
-            cand_h = [(line, occ) for line, occ in acc.finalize().items()]
+            h = final[:bins]
+            t = final[bins:]
+            cands = [(line, occ) for line, occ in acc.finalize().items()]
         if ck_writer is not None:
             ck_writer.drain()  # surface async commit errors; counters
             # settle before the caller reads them
-        top = tuple(sorted(cand_h, key=lambda r: (-r[1], r[0]))[:topk])
-        result = GrepStreamResult(int(totals[0]), int(totals[1]),
-                                  int(totals[2]),
-                                  tuple(int(x) for x in hist_h), top)
-    except _LineTooLong:
-        result = None  # caller routes the job to the host path
-    finally:
+        top = tuple(sorted(cands, key=lambda r: (-r[1], r[0]))[:topk])
+        step.result = GrepStreamResult(int(t[0]), int(t[1]), int(t[2]),
+                                       tuple(int(x) for x in h), top)
+
+    released = []
+
+    def release():
+        if released:
+            return
+        released.append(True)
         if ck_writer is not None:
             ck_writer.shutdown()
         if pipeline_stats is not None:
@@ -851,7 +912,9 @@ def grep_streaming(
                 if k in stats:
                     stats[k] = round(stats[k], 4)
             pipeline_stats.update(stats)
-    return result
+
+    step._on_complete = on_complete
+    step._release = release
 
 
 def warm_grepstream_aot(mesh: Mesh | None = None,
@@ -1021,6 +1084,50 @@ class _AbortRung(Exception):
     (non-ASCII input, or a word wider than the packed window)."""
 
 
+class IndexerStep(EngineStep):
+    """Resumable step object over the streaming indexer's wave walk —
+    :func:`indexer_streaming`'s parameters and semantics behind the
+    ``{advance, confirm, checkpoint, restore, close}`` lifecycle.  The
+    word-window rung ladder lives INSIDE the lifecycle: a wave proving
+    the rung too narrow tears it down and ``advance()`` transparently
+    restarts at the 64-byte rung; non-ASCII input (or a word wider than
+    64 bytes) routes to the host path (``close()`` → None)."""
+
+    _rung_excs = (_AbortRung,)
+
+    def __init__(self, docs: Sequence[bytes], mesh: Mesh | None = None,
+                 n_reduce: int = 10, max_word_len: int = 16,
+                 u_cap: int = 1 << 15, depth: Optional[int] = None,
+                 device_accumulate: bool = False,
+                 sync_every: Optional[int] = None,
+                 mesh_shards: Optional[int] = None,
+                 topk: int = DEFAULT_TOPK, stats: Optional[dict] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: Optional[int] = None,
+                 checkpoint_async: Optional[bool] = None,
+                 checkpoint_delta: Optional[bool] = None,
+                 resume: bool = False):
+        super().__init__()
+        _indexer_setup(self, docs, mesh, n_reduce, max_word_len, u_cap,
+                       depth, device_accumulate, sync_every, mesh_shards,
+                       topk, stats, checkpoint_dir, checkpoint_every,
+                       checkpoint_async, checkpoint_delta, resume)
+
+    def _next_rung(self) -> bool:
+        self._pipe.end()
+        if self._writer is not None:
+            self._writer.shutdown()  # a rung restart discards rung state
+        if not self._outcome["high"]:
+            nxt = [m for m in self._rungs if m > self._mwl]
+            if nxt:
+                self._begin_rung(nxt[0])
+                return True
+        # Non-ASCII, or a word wider than 64 bytes: the host path's job.
+        self.result = None
+        self._phase = "hostpath"
+        return False
+
+
 def indexer_streaming(
         docs: Sequence[bytes], mesh: Mesh | None = None, n_reduce: int = 10,
         max_word_len: int = 16, u_cap: int = 1 << 15,
@@ -1072,6 +1179,23 @@ def indexer_streaming(
     uninterrupted walk would.  Resumed postings (incl. per-word order)
     and df top-k are bit-identical to an uninterrupted run.
     """
+    return IndexerStep(
+        docs, mesh=mesh, n_reduce=n_reduce, max_word_len=max_word_len,
+        u_cap=u_cap, depth=depth, device_accumulate=device_accumulate,
+        sync_every=sync_every, mesh_shards=mesh_shards, topk=topk,
+        stats=stats, checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        checkpoint_async=checkpoint_async,
+        checkpoint_delta=checkpoint_delta, resume=resume).close()
+
+
+def _indexer_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
+                   depth, device_accumulate, sync_every, mesh_shards,
+                   topk, stats, checkpoint_dir, checkpoint_every,
+                   checkpoint_async, checkpoint_delta, resume):
+    """The engine body behind :class:`IndexerStep`: corpus-wide setup,
+    then ``begin_rung`` (the former per-rung ``run``) arms the pipeline
+    and attaches the lifecycle hooks to ``step``."""
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -1128,7 +1252,7 @@ def indexer_streaming(
         else:
             ck_store.reset()
 
-    def run(mwl: int):
+    def begin_rung(mwl: int):
         kk = mwl // 4
         table = PostingsTable()
         state = {"cap": rung0_cap(size_max, u_cap),
@@ -1439,24 +1563,26 @@ def indexer_streaming(
                             inflight_key="max_inflight_waves",
                             thread_name="dsi-idx-materializer",
                             engine="indexer")
-        try:
-            try:
-                pipe.run(materialize)
-            except _AbortRung:
-                return ("high" if outcome["high"] else "widen", None)
-            if buf_dev is not None:
-                fault_point("pre-sync")
-                buf_dev.close()
-                if topk_svc is not None:
-                    topk_svc.close()
-            if ck_writer is not None:
-                ck_writer.drain()  # surface async commit errors before
-                # the payload (and the save counters) are read
-        finally:
-            if ck_writer is not None:
-                ck_writer.shutdown()
+        step._pipe = pipe
+        step._mwl = mwl
+        step._outcome = outcome
+        step._save = save_ckpt if ck_policy is not None else None
+        step._writer = ck_writer
+        pipe.begin(materialize)
 
-        def payload():
+        def end_ok():
+            try:
+                if buf_dev is not None:
+                    fault_point("pre-sync")
+                    buf_dev.close()
+                    if topk_svc is not None:
+                        topk_svc.close()
+                if ck_writer is not None:
+                    ck_writer.drain()  # surface async commit errors
+                    # before the payload (and save counters) are read
+            finally:
+                if ck_writer is not None:
+                    ck_writer.shutdown()
             postings = {
                 w: (part, [d for d, _ in pairs])
                 for w, (part, pairs) in table.finalize().items()}
@@ -1466,9 +1592,9 @@ def indexer_streaming(
                 df_map = {w: len(ds) for w, (_, ds) in postings.items()}
             top = tuple(sorted(((c, w) for w, c in df_map.items()),
                                key=lambda r: (-r[0], r[1]))[:topk])
-            return postings, top
+            step.result = (postings, top)
 
-        return ("ok", payload)
+        step._on_complete = end_ok
 
     rungs = ((max_word_len, 64) if max_word_len < 64 else (max_word_len,))
     if resume_meta is not None:
@@ -1476,18 +1602,23 @@ def indexer_streaming(
         # provably aborted before the checkpointed one began).
         rungs = tuple(m for m in rungs
                       if m >= int(resume_meta["mwl"])) or rungs
-    try:
-        for mwl in rungs:
-            status, payload = run(mwl)
-            if status == "high":
-                return None
-            if status == "widen":
-                continue
-            return payload()
-        return None  # a word wider than 64 bytes: the host path's job
-    finally:
+    step._rungs = tuple(rungs)
+    step._begin_rung = begin_rung
+
+    released = []
+
+    def release():
+        if released:
+            return
+        released.append(True)
+        w = step._writer  # the CURRENT rung's writer (re-set per rung)
+        if w is not None:
+            w.shutdown()
         if stats is not None:
             stats.update(st)
+
+    step._release = release
+    begin_rung(rungs[0])
 
 
 def write_indexer_output(result, doc_names: Sequence[str], n_reduce: int,

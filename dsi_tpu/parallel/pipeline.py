@@ -432,7 +432,118 @@ class StepPipeline:
                 raise item
             yield item
 
-    # ── the window ──
+    # ── the window: incremental API ──
+    #
+    # ``run`` used to own the whole loop; the resumable step objects
+    # (``parallel/stepobj.py``) and the serving daemon need to drive it
+    # one step at a time, so the loop is split into four primitives —
+    # ``begin`` (arm the feed/watchdog), ``pump`` (dispatch the next
+    # item, retiring the oldest record when the window is full),
+    # ``drain`` (retire everything in flight — the confirmed-boundary
+    # maker for forced checkpoints and eviction), and ``end`` (tear the
+    # producer/watchdog down, idempotent).  ``run`` is exactly
+    # begin → pump* → drain with ``end`` in a finally, so its semantics
+    # — dispatch/finish interleaving included — are unchanged.
+
+    def begin(self, make_items: Callable[[], Iterator]) -> None:
+        """Arm the pipeline over ``make_items()``'s items.  Must be
+        balanced by :meth:`end` (any number of ``pump``/``drain`` calls
+        in between)."""
+        self._pending: collections.deque = collections.deque()
+        self._inflight.clear()
+        self._last_retire_t = time.perf_counter()
+        self._stop_evt = threading.Event()
+        self._out_q: queue.Queue = queue.Queue(maxsize=self.depth + 1)
+        self._started: list = []
+        self._idx = 0
+        self._ended = False
+        # The stall watchdog rides only telemetry-active runs: the
+        # default path starts zero extra threads.
+        self._watchdog: Optional[_StallWatchdog] = None
+        hists = _hist.active_histograms()
+        if hists is not None:
+            self._finish_hist = _hist.LatencyHistogram()
+            self._watchdog = _StallWatchdog(self, hists)
+            self._watchdog.start()
+        _hist.register_pipeline(self)
+        self._feed_iter: Optional[Iterator] = self._feed(
+            make_items, self._out_q, self._stop_evt, self._started)
+
+    def _finish_oldest(self) -> None:
+        # The per-step trace span: its wall IS the step's retire cost
+        # (deferred flag wait + merge or replay) — the unit the
+        # straggler table in scripts/tracecat.py ranks and the
+        # ``finish`` histogram the watchdog thresholds on.
+        step, _ts = self._inflight[0]
+        with _span("finish", lane="dispatch", step=step,
+                   engine=self._engine) as sp:
+            self._finish(self._pending.popleft())
+        self._inflight.popleft()
+        self.finished += 1
+        self._last_retire_t = time.perf_counter()
+        if self._finish_hist is not None:
+            self._finish_hist.record(sp.elapsed_s)
+
+    def pump(self) -> bool:
+        """One turn of the crank: dispatch the next produced item,
+        retiring the oldest in-flight record first when the window is
+        full.  Returns False when the item stream is exhausted (records
+        may still be in flight — ``drain`` retires them)."""
+        try:
+            item = next(self._feed_iter)
+        except StopIteration:
+            return False
+        with _span("dispatch", step=self._idx, engine=self._engine):
+            rec = self._dispatch(item)
+        self._idx += 1
+        self.dispatched = self._idx
+        if rec is None:
+            return True
+        self._pending.append(rec)
+        self._inflight.append((self._idx - 1, time.perf_counter()))
+        if len(self._pending) > self._stats[self._inflight_key]:
+            self._stats[self._inflight_key] = len(self._pending)
+        if len(self._pending) >= self.depth:
+            self._finish_oldest()
+        return True
+
+    def drain(self) -> None:
+        """Retire every in-flight record (FIFO).  After this the
+        pipeline sits at a CONFIRMED boundary — everything dispatched
+        has passed its deferred checks and merged — which is what a
+        forced checkpoint or a tenant eviction needs."""
+        while self._pending:
+            self._finish_oldest()
+
+    @property
+    def inflight(self) -> int:
+        return len(self._pending)
+
+    def end(self) -> None:
+        """Tear down the producer thread and watchdog.  Idempotent, and
+        safe mid-stream (an eviction abandons unread items; the resume
+        re-reads them from the durable cursor)."""
+        if getattr(self, "_ended", True):
+            return
+        self._ended = True
+        _hist.unregister_pipeline(self)
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog.join(timeout=5.0)  # fast: stop() wakes its wait
+            self._watchdog = None
+        if self._started:
+            self._stop_evt.set()
+            thread = self._started[0]
+            # Unblock a producer stuck on a full queue; bounded — a
+            # producer mid-build exits at its next stop check.
+            deadline = time.monotonic() + 5.0
+            while (thread.is_alive()
+                   and time.monotonic() < deadline):
+                try:
+                    self._out_q.get_nowait()
+                except queue.Empty:
+                    thread.join(0.05)
+        self._feed_iter = None
 
     def run(self, make_items: Callable[[], Iterator]) -> None:
         """Drive the full pipeline over ``make_items()``'s items: keep up
@@ -440,69 +551,10 @@ class StepPipeline:
         order as the window fills, drain the window at stream end.  Any
         exception (producer or consumer) unwinds with the producer thread
         stopped and its queue drained."""
-        pending: collections.deque = collections.deque()
-        steps = self._inflight  # (ordinal, dispatch ts) — live-readable
-        steps.clear()
-        self._last_retire_t = time.perf_counter()
-        stop = threading.Event()
-        out_q: queue.Queue = queue.Queue(maxsize=self.depth + 1)
-        started: list = []
-        idx = 0
-        # The stall watchdog rides only telemetry-active runs: the
-        # default path starts zero extra threads.
-        watchdog: Optional[_StallWatchdog] = None
-        hists = _hist.active_histograms()
-        if hists is not None:
-            self._finish_hist = _hist.LatencyHistogram()
-            watchdog = _StallWatchdog(self, hists)
-            watchdog.start()
-        _hist.register_pipeline(self)
-
-        def finish_oldest() -> None:
-            # The per-step trace span: its wall IS the step's retire cost
-            # (deferred flag wait + merge or replay) — the unit the
-            # straggler table in scripts/tracecat.py ranks and the
-            # ``finish`` histogram the watchdog thresholds on.
-            step, _ts = steps[0]
-            with _span("finish", lane="dispatch", step=step,
-                       engine=self._engine) as sp:
-                self._finish(pending.popleft())
-            steps.popleft()
-            self.finished += 1
-            self._last_retire_t = time.perf_counter()
-            if self._finish_hist is not None:
-                self._finish_hist.record(sp.elapsed_s)
-
+        self.begin(make_items)
         try:
-            for item in self._feed(make_items, out_q, stop, started):
-                with _span("dispatch", step=idx, engine=self._engine):
-                    rec = self._dispatch(item)
-                idx += 1
-                self.dispatched = idx
-                if rec is None:
-                    continue
-                pending.append(rec)
-                steps.append((idx - 1, time.perf_counter()))
-                if len(pending) > self._stats[self._inflight_key]:
-                    self._stats[self._inflight_key] = len(pending)
-                if len(pending) >= self.depth:
-                    finish_oldest()
-            while pending:
-                finish_oldest()
+            while self.pump():
+                pass
+            self.drain()
         finally:
-            _hist.unregister_pipeline(self)
-            if watchdog is not None:
-                watchdog.stop()
-                watchdog.join(timeout=5.0)  # fast: stop() wakes its wait
-            if started:
-                stop.set()
-                thread = started[0]
-                # Unblock a producer stuck on a full queue; bounded — a
-                # producer mid-build exits at its next stop check.
-                deadline = time.monotonic() + 5.0
-                while (thread.is_alive()
-                       and time.monotonic() < deadline):
-                    try:
-                        out_q.get_nowait()
-                    except queue.Empty:
-                        thread.join(0.05)
+            self.end()

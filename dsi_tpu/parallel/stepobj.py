@@ -1,0 +1,211 @@
+"""Resumable step objects: the engines as explicit state machines.
+
+Every streaming engine used to be a run-to-completion function — setup,
+``StepPipeline.run``, finalize — which is the wrong shape for a serving
+daemon: a resident process multiplexing many tenants needs to *hold* a
+partially-run engine, advance it a few steps, checkpoint it at a
+confirmed boundary, evict it to disk, and resume it later.  This module
+defines the one lifecycle all four engines now implement (the ROADMAP's
+serving-daemon prerequisite, and the substrate the multi-stage dataflow
+item composes):
+
+* ``advance()``  — one turn of the crank: dispatch the next item,
+  retiring the oldest in-flight record when the window is full.
+  Returns False when the engine is finished (input exhausted and the
+  window drained, result built) or routed to the host path.
+* ``confirm()``  — retire EVERY in-flight record, leaving the engine at
+  a confirmed boundary (all merged output has passed its deferred
+  exactness checks); returns the confirmed-step count.  This is the
+  boundary-maker forced checkpoints and eviction stand on.
+* ``checkpoint()`` — ``confirm()`` then one durable snapshot through
+  the engine's own save path (store + writer + delta chain), drained
+  so the manifest is on disk when the call returns.  False when the
+  engine was built without a checkpoint dir.
+* ``restore()``  — report of the restore performed at construction
+  (``resume=True`` loads the newest valid chain before the first
+  dispatch — restore is a *constructor-time* act because device state
+  and sticky rungs must exist before anything is in flight).
+* ``close()``    — finish the run (driving any remaining input),
+  release every resource (producer thread, commit writer, stats
+  copy-out), and return the engine result — or None on the host path.
+* ``suspend()``  — eviction: ``checkpoint()`` then release, leaving a
+  dead object whose chain a fresh ``resume=True`` construction
+  continues bit-identically.
+
+The state machine is deliberately thin: all engine logic stays in the
+engine modules (``parallel/streaming.py``, ``parallel/grepstream.py``,
+``parallel/tfidf.py``), whose step classes set the hooks below in their
+``__init__`` and inherit the lifecycle.  The legacy functions
+(``wordcount_streaming`` et al.) are now drivers over their step class
+— construct, ``advance`` to exhaustion, ``close`` — so the pipelined
+bit-identity guarantees carry over unchanged.
+
+Subclass contract (attributes set by ``__init__``):
+
+* ``_pipe``       — a begun :class:`~dsi_tpu.parallel.pipeline.StepPipeline`
+  (or None when the job was routed to the host path at construction);
+* ``_host_excs``  — exception types meaning "this input needs the host
+  path" (result None, not an error);
+* ``_rung_excs``  — exception types consumed by ``_next_rung()`` (the
+  word-window rung restarts of the wave walks; default ());
+* ``_on_complete``— zero-arg callable run once after the window drains
+  at end of input: device-service close, writer drain, ``self.result``;
+* ``_release``    — IDEMPOTENT zero-arg teardown: writer shutdown,
+  stats copy-out;
+* ``_save``       — zero-arg callable committing one snapshot at the
+  current confirmed boundary (None = checkpointing off);
+* ``_writer``     — the engine's :class:`~dsi_tpu.ckpt.CheckpointWriter`
+  (None when sync or off) so ``checkpoint()`` can drain it durable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class EngineStep:
+    """Base resumable step object (module docstring).  Phases:
+    ``running`` → ``done`` | ``hostpath`` | ``failed`` | ``suspended``,
+    any of which ``close()`` maps to a returned result (or None)."""
+
+    #: Exception types that route the stream to the host path.
+    _host_excs: tuple = ()
+    #: Exception types consumed by :meth:`_next_rung`.
+    _rung_excs: tuple = ()
+
+    def __init__(self) -> None:
+        self.result = None
+        self._phase = "running"
+        self._pipe = None
+        self._save = None
+        self._writer = None
+        self._restore_info: dict = {}
+        self._on_complete = lambda: None
+        self._release = lambda: None
+
+    # ── hooks subclasses may override ──
+
+    def _next_rung(self) -> bool:
+        """Consume a rung-restart exception: tear the old rung down and
+        begin the next one.  True when a fresh rung is armed (advance
+        keeps going); False when the walk is over (phase already moved).
+        The base class has no rungs."""
+        return False
+
+    # ── the lifecycle ──
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
+    @property
+    def confirmed(self) -> int:
+        """Steps retired through their deferred checks so far (current
+        rung for the wave walks)."""
+        return self._pipe.finished if self._pipe is not None else 0
+
+    def advance(self) -> bool:
+        """One turn of the crank; False when there is nothing left to
+        do (finished, host path, or already released)."""
+        if self._phase != "running":
+            return False
+        try:
+            if self._pipe.pump():
+                return True
+            # Input exhausted: drain the window (deferred checks of the
+            # tail), tear the producer down, then the engine epilogue —
+            # the exact order the monolithic functions used.
+            self._pipe.drain()
+            self._pipe.end()
+            self._on_complete()
+            self._phase = "done"
+            return False
+        except self._rung_excs:
+            return self._next_rung()
+        except self._host_excs:
+            self._to_hostpath()
+            return False
+        except BaseException:
+            self._fail()
+            raise
+
+    def confirm(self) -> int:
+        """Retire every in-flight record; returns the confirmed count.
+        After this the engine sits at a consistent boundary."""
+        if self._phase == "running":
+            try:
+                self._pipe.drain()
+            except self._rung_excs:
+                self._next_rung()
+            except self._host_excs:
+                self._to_hostpath()
+            except BaseException:
+                self._fail()
+                raise
+        return self.confirmed
+
+    def checkpoint(self) -> bool:
+        """Force one durable snapshot at a confirmed boundary (the
+        eviction primitive).  Returns False when checkpointing is off
+        or the engine left the running phase."""
+        self.confirm()
+        if self._phase != "running" or self._save is None:
+            return False
+        self._save()
+        if self._writer is not None:
+            self._writer.drain()
+        return True
+
+    def restore(self) -> dict:
+        """What the constructor-time restore did (``resume=True``):
+        e.g. ``{"resume_cursor": ..., "resume_gap_s": ...}`` — empty
+        when the engine started fresh."""
+        return dict(self._restore_info)
+
+    def suspend(self) -> bool:
+        """Evict: checkpoint (when enabled) and release everything.
+        The object is dead afterwards; a fresh construction with
+        ``resume=True`` continues from the chain.  Returns whether a
+        snapshot was committed."""
+        if self._phase != "running":
+            return False
+        saved = self.checkpoint()
+        if self._phase == "running":
+            self._pipe.end()
+            self._release()
+            self._phase = "suspended"
+        return saved
+
+    def close(self):
+        """Finish the run (driving any remaining input) and return the
+        result — None on the host path or after a suspend.  Always
+        releases resources; safe to call more than once."""
+        while self.advance():
+            pass
+        self._release()
+        return self.result
+
+    # ── internal transitions ──
+
+    def _to_hostpath(self) -> None:
+        if self._pipe is not None:
+            self._pipe.end()
+        self.result = None
+        self._phase = "hostpath"
+
+    def _fail(self) -> None:
+        self._phase = "failed"
+        try:
+            if self._pipe is not None:
+                self._pipe.end()
+        finally:
+            self._release()
+
+
+class HostPathStep(EngineStep):
+    """A step object that was routed to the host path at construction
+    (e.g. a non-literal grep pattern): already terminal, result None."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._phase = "hostpath"

@@ -130,6 +130,7 @@ from dsi_tpu.parallel.pipeline import (
     StepPipeline,
     pipeline_depth,
 )
+from dsi_tpu.parallel.stepobj import EngineStep
 from dsi_tpu.parallel.shuffle import (
     AXIS,
     _is_letter_byte,
@@ -547,6 +548,38 @@ def stream_kernel_reps(chunk_np: np.ndarray, mesh: Mesh | None = None,
     return times, exact
 
 
+class WordcountStep(EngineStep):
+    """Resumable step object over the streaming word-count engine: the
+    explicit ``{advance, confirm, checkpoint, restore, close}`` state
+    machine (``parallel/stepobj.py``) the serving daemon multiplexes.
+    Parameters and semantics are exactly :func:`wordcount_streaming`'s
+    (now a construct-drive-close wrapper over this class); a
+    ``resume=True`` construction restores the newest valid chain BEFORE
+    the first dispatch, so device state and sticky rungs exist when the
+    window opens."""
+
+    def __init__(self, blocks: Iterable[bytes], mesh: Mesh | None = None,
+                 n_reduce: int = 10, chunk_bytes: int = 1 << 20,
+                 max_word_len: int = 16, u_cap: int = 1 << 12,
+                 aot: bool = False, on_attempt=None,
+                 depth: Optional[int] = None,
+                 pipeline_stats: Optional[dict] = None,
+                 device_accumulate: bool = False,
+                 sync_every: Optional[int] = None,
+                 mesh_shards: Optional[int] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: Optional[int] = None,
+                 checkpoint_async: Optional[bool] = None,
+                 checkpoint_delta: Optional[bool] = None,
+                 resume: bool = False):
+        super().__init__()
+        _wordcount_setup(self, blocks, mesh, n_reduce, chunk_bytes,
+                         max_word_len, u_cap, aot, on_attempt, depth,
+                         pipeline_stats, device_accumulate, sync_every,
+                         mesh_shards, checkpoint_dir, checkpoint_every,
+                         checkpoint_async, checkpoint_delta, resume)
+
+
 def wordcount_streaming(
         blocks: Iterable[bytes], mesh: Mesh | None = None,
         n_reduce: int = 10, chunk_bytes: int = 1 << 20,
@@ -657,6 +690,26 @@ def wordcount_streaming(
     ``ckpt_barrier_s`` and ``ckpt_deltas``/``ckpt_full_bytes``/
     ``ckpt_delta_bytes``.
     """
+    return WordcountStep(
+        blocks, mesh=mesh, n_reduce=n_reduce, chunk_bytes=chunk_bytes,
+        max_word_len=max_word_len, u_cap=u_cap, aot=aot,
+        on_attempt=on_attempt, depth=depth,
+        pipeline_stats=pipeline_stats,
+        device_accumulate=device_accumulate, sync_every=sync_every,
+        mesh_shards=mesh_shards, checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        checkpoint_async=checkpoint_async,
+        checkpoint_delta=checkpoint_delta, resume=resume).close()
+
+
+def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
+                     max_word_len, u_cap, aot, on_attempt, depth,
+                     pipeline_stats, device_accumulate, sync_every,
+                     mesh_shards, checkpoint_dir, checkpoint_every,
+                     checkpoint_async, checkpoint_delta, resume):
+    """The engine body behind :class:`WordcountStep`: full setup
+    (``resume=True`` chain restore included) ending with the pipeline
+    armed and the lifecycle hooks attached to ``step``."""
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -1066,7 +1119,8 @@ def wordcount_streaming(
                 ck_policy.reset()
         pool.give(buf)
 
-    # ── the window itself: the shared dispatch/finish pipeline core ──
+    # ── the window itself: the shared dispatch/finish pipeline core,
+    # armed for the step object's {advance, confirm, ...} lifecycle ──
     pipe = StepPipeline(depth=depth, dispatch=dispatch, finish=finish_one,
                         stats=stats, produce_key="batch_s",
                         wait_key="batch_wait_s",
@@ -1074,20 +1128,35 @@ def wordcount_streaming(
                         thread_name="dsi-stream-batcher", engine="stream")
 
     feed = skip_stream(blocks, start_offset) if start_offset else blocks
-    result: Optional[Dict[str, Tuple[int, int]]]
-    try:
-        pipe.run(lambda: batch_stream(feed, n_dev, chunk_bytes,
-                                      pool=pool, offsets=offsets))
+    step._pipe = pipe
+    pipe.begin(lambda: batch_stream(feed, n_dev, chunk_bytes,
+                                    pool=pool, offsets=offsets))
+    step._host_excs = (_TokenTooLong, _NeedsHostPath)
+    step._save = save_ckpt if ck_store is not None else None
+    step._writer = ck_writer
+    if resume:
+        step._restore_info = {
+            "resume_cursor": stats.get("resume_cursor", 0),
+            "resume_gap_s": stats.get("resume_gap_s", 0.0)}
+
+    def on_complete():
+        # End-of-stream epilogue, exactly the monolithic function's
+        # success path: final device drain, async-commit errors
+        # surfaced, then the result.
         if table_svc is not None:
             fault_point("pre-sync")
             table_svc.close()  # the "or at stream end" pull
         if ck_writer is not None:
             ck_writer.drain()  # surface async commit errors; counters
             # settle before the caller reads them
-        result = acc.finalize()
-    except (_TokenTooLong, _NeedsHostPath):
-        result = None  # caller routes the job to the host path
-    finally:
+        step.result = acc.finalize()
+
+    released = []
+
+    def release():
+        if released:  # idempotent: close() after a suspend/fail re-runs it
+            return
+        released.append(True)
         if ck_writer is not None:
             ck_writer.shutdown()
         if pipeline_stats is not None:
@@ -1099,4 +1168,6 @@ def wordcount_streaming(
                 if k in stats:
                     stats[k] = round(stats[k], 4)
             pipeline_stats.update(stats)
-    return result
+
+    step._on_complete = on_complete
+    step._release = release

@@ -77,6 +77,7 @@ from dsi_tpu.ops.wordcount import (
 )
 from dsi_tpu.parallel.merge import PostingsTable
 from dsi_tpu.parallel.pipeline import StepPipeline, pipeline_depth
+from dsi_tpu.parallel.stepobj import EngineStep as _EngineStep
 from dsi_tpu.parallel.shuffle import (
     AXIS,
     default_mesh,
@@ -251,6 +252,52 @@ class _AbortRung(Exception):
     unwind the pipeline — dispatching more waves is pure waste."""
 
 
+class TfidfStep(_EngineStep):
+    """Resumable step object over the TF-IDF wave walk —
+    :func:`tfidf_sharded`'s parameters and semantics behind the
+    ``{advance, confirm, checkpoint, restore, close}`` lifecycle
+    (``parallel/stepobj.py``).  The word-window rung ladder lives
+    inside the lifecycle: a wave proving the rung too narrow tears it
+    down and ``advance()`` restarts at the 64-byte rung; non-ASCII
+    input (or a word wider than 64 bytes) routes to the host path."""
+
+    _rung_excs = (_AbortRung,)
+
+    def __init__(self, docs: Sequence[bytes], mesh: Mesh | None = None,
+                 n_reduce: int = 10, max_word_len: int = 16,
+                 u_cap: int = 1 << 15, partitions: Optional[set] = None,
+                 packed: bool = False, device_accumulate: bool = False,
+                 sync_every: Optional[int] = None,
+                 mesh_shards: Optional[int] = None,
+                 wave_stats: Optional[dict] = None,
+                 depth: Optional[int] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: Optional[int] = None,
+                 checkpoint_async: Optional[bool] = None,
+                 checkpoint_delta: Optional[bool] = None,
+                 resume: bool = False):
+        super().__init__()
+        _tfidf_setup(self, docs, mesh, n_reduce, max_word_len, u_cap,
+                     partitions, packed, device_accumulate, sync_every,
+                     mesh_shards, wave_stats, depth, checkpoint_dir,
+                     checkpoint_every, checkpoint_async,
+                     checkpoint_delta, resume)
+
+    def _next_rung(self) -> bool:
+        self._pipe.end()
+        if self._writer is not None:
+            self._writer.shutdown()  # a rung restart discards rung state
+        if not self._outcome["high"]:
+            nxt = [m for m in self._rungs if m > self._mwl]
+            if nxt:
+                self._begin_rung(nxt[0])
+                return True
+        # Non-ASCII, or a word wider than 64 bytes: the host path's job.
+        self.result = None
+        self._phase = "hostpath"
+        return False
+
+
 def tfidf_sharded(
         docs: Sequence[bytes], mesh: Mesh | None = None, n_reduce: int = 10,
         max_word_len: int = 16, u_cap: int = 1 << 15,
@@ -338,6 +385,24 @@ def tfidf_sharded(
     tagged with the word-window rung they belong to; resumed output is
     bit-identical to an uninterrupted walk.
     """
+    return TfidfStep(
+        docs, mesh=mesh, n_reduce=n_reduce, max_word_len=max_word_len,
+        u_cap=u_cap, partitions=partitions, packed=packed,
+        device_accumulate=device_accumulate, sync_every=sync_every,
+        mesh_shards=mesh_shards, wave_stats=wave_stats, depth=depth,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        checkpoint_async=checkpoint_async,
+        checkpoint_delta=checkpoint_delta, resume=resume).close()
+
+
+def _tfidf_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
+                 partitions, packed, device_accumulate, sync_every,
+                 mesh_shards, wave_stats, depth, checkpoint_dir,
+                 checkpoint_every, checkpoint_async, checkpoint_delta,
+                 resume):
+    """The engine body behind :class:`TfidfStep`: corpus-wide setup,
+    then ``begin_rung`` (the former per-rung ``run``) arms the pipeline
+    and attaches the lifecycle hooks to ``step``."""
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -394,13 +459,13 @@ def tfidf_sharded(
         else:
             ck_store.reset()
 
-    def run(mwl: int):
-        """One word-window rung: the whole pipelined wave walk at packed
-        width ``mwl``.  Returns ``("ok", payload)``, ``("high", None)``
-        (non-ASCII: the job is the host path's) or ``("widen", None)``
-        (a word overflowed the window: rerun at the 64-byte rung).
-        Capacity overflow never discards the rung — the overflowing wave
-        alone replays wider and the widened capacity sticks."""
+    def begin_rung(mwl: int):
+        """One word-window rung: arm the pipelined wave walk at packed
+        width ``mwl`` and attach its hooks to ``step``.  Capacity
+        overflow never discards the rung — the overflowing wave alone
+        replays wider and the widened capacity sticks; non-ASCII and
+        word-window overflow raise ``_AbortRung`` through the
+        lifecycle, which restarts wider or routes to the host path."""
         kk = mwl // 4
         # Buffer each wave's surviving rows AS THE WAVES CONFIRM — raw
         # uint32 tables copied out of the wave's transfer buffer (no
@@ -702,21 +767,28 @@ def tfidf_sharded(
                             inflight_key="max_inflight_waves",
                             thread_name="dsi-wave-materializer",
                             engine="tfidf")
-        try:
+        step._pipe = pipe
+        step._mwl = mwl
+        step._outcome = outcome
+        step._save = save_ckpt if ck_policy is not None else None
+        step._writer = ck_writer
+        pipe.begin(materialize)
+
+        def end_ok():
             try:
-                pipe.run(materialize)
-            except _AbortRung:
-                return ("high" if outcome["high"] else "widen", None)
-            if buf_dev is not None:
-                fault_point("pre-sync")
-                buf_dev.close()  # end-of-walk sync
-            if ck_writer is not None:
-                ck_writer.drain()  # surface async commit errors before
-                # the payload (and the save counters) are read
-        finally:
-            if ck_writer is not None:
-                ck_writer.shutdown()
-        return ("ok", table.finalize_packed if packed else table.finalize)
+                if buf_dev is not None:
+                    fault_point("pre-sync")
+                    buf_dev.close()  # end-of-walk sync
+                if ck_writer is not None:
+                    ck_writer.drain()  # surface async commit errors
+                    # before the payload (and save counters) are read
+            finally:
+                if ck_writer is not None:
+                    ck_writer.shutdown()
+            step.result = (table.finalize_packed() if packed
+                           else table.finalize())
+
+        step._on_complete = end_ok
 
     # The word-window ladder (exactness_retry's outer rung, hand-rolled
     # because capacity now widens per wave INSIDE a rung): a word wider
@@ -728,18 +800,23 @@ def tfidf_sharded(
         # aborted before the checkpointed rung began its walk.
         rungs = tuple(m for m in rungs
                       if m >= int(resume_meta["mwl"])) or rungs
-    try:
-        for mwl in rungs:
-            status, payload = run(mwl)
-            if status == "high":
-                return None
-            if status == "widen":
-                continue
-            return payload()
-        return None  # a word wider than 64 bytes: the host path's job
-    finally:
+    step._rungs = tuple(rungs)
+    step._begin_rung = begin_rung
+
+    released = []
+
+    def release():
+        if released:
+            return
+        released.append(True)
+        w = step._writer  # the CURRENT rung's writer (re-set per rung)
+        if w is not None:
+            w.shutdown()
         if wave_stats is not None:
             wave_stats.update(stats)
+
+    step._release = release
+    begin_rung(rungs[0])
 
 
 class FileDocs:

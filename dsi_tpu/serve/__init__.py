@@ -1,0 +1,44 @@
+"""MapReduce-as-a-service: the resident serving daemon.
+
+Everything else in this repo is a one-shot CLI that pays process start,
+jax init, and AOT warm per job — the wrong shape for the ROADMAP's
+"heavy traffic from millions of users", which is many SMALL jobs, not
+one big one.  Dean & Ghemawat ran MapReduce as a shared service behind
+a job-submission control plane (OSDI'04 §3; the status page of §4.8);
+this package is that shape for the device mesh:
+
+* :mod:`~dsi_tpu.serve.pack` — the multi-tenant packed step engine:
+  many tenants' chunks ride ONE compiled wave dispatch, demuxed by the
+  per-row tenant lane, so K tenants cost ~1 dispatch instead of K;
+* :mod:`~dsi_tpu.serve.daemon` — the long-lived ``mrserve`` process:
+  owns the warmed executables, accepts submissions over the repo's own
+  framed-JSON pull-RPC control plane (``mr/rpc.py``, the 6.5840 idiom),
+  journals jobs durably, packs/schedules tenants, evicts idle or
+  over-quota tenants to delta-checkpoint chains, and resumes every
+  in-flight tenant after a crash;
+* :mod:`~dsi_tpu.serve.client` — the no-jax client library behind the
+  ``mrsubmit`` CLI.
+
+The resumable step objects (``parallel/stepobj.py``) are the substrate:
+non-packable apps run as suspendable engine state machines the daemon
+multiplexes, and eviction/resume is the checkpoint subsystem's
+suspend/restore primitive (PR 8) at serving cadence.
+"""
+
+from dsi_tpu.serve.client import (
+    default_socket,
+    ping,
+    shutdown,
+    status,
+    submit,
+    wait,
+)
+
+__all__ = [
+    "default_socket",
+    "ping",
+    "shutdown",
+    "status",
+    "submit",
+    "wait",
+]

@@ -1,0 +1,100 @@
+"""No-jax client library for the serving daemon (``mrsubmit``'s guts).
+
+Every call is one framed-JSON RPC over the daemon's Unix socket
+(``mr/rpc.py`` — dial per call, the 6.5840 idiom), so the client stays
+import-light: submitting a job from a test, the bench's serve row, or a
+shell never pays a jax init.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from dsi_tpu.mr.rpc import CoordinatorGone, call
+
+
+def default_socket(spool: str) -> str:
+    """The daemon's default control socket inside its spool."""
+    return os.path.join(os.path.abspath(spool), "mrserve.sock")
+
+
+def _call(socket_path: str, method: str, args: dict,
+          timeout: float = 30.0) -> dict:
+    ok, reply = call(socket_path, method, args, timeout=timeout)
+    if not ok or not isinstance(reply, dict):
+        raise CoordinatorGone(f"mrserve RPC {method} failed at "
+                              f"{socket_path}")
+    if reply.get("error"):
+        raise RuntimeError(f"mrserve {method}: {reply['error']}")
+    return reply
+
+
+def ping(socket_path: str, timeout: float = 10.0) -> dict:
+    return _call(socket_path, "Ping", {}, timeout=timeout)
+
+
+def wait_ready(socket_path: str, timeout: float = 120.0,
+               poll_s: float = 0.1) -> dict:
+    """Block until the daemon's scheduler (and its warm) is up."""
+    deadline = time.monotonic() + timeout
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            p = ping(socket_path)
+            if p.get("ready"):
+                return p
+        except (CoordinatorGone, OSError) as e:
+            last = e
+        time.sleep(poll_s)
+    raise TimeoutError(f"mrserve at {socket_path} not ready in "
+                       f"{timeout}s (last: {last})")
+
+
+def submit(socket_path: str, tenant: str, files: List[str],
+           app: str = "wc", pattern: Optional[str] = None,
+           n_reduce: Optional[int] = None) -> dict:
+    """Submit one job; returns ``{"job_id", "out_dir"}`` (the daemon
+    journals the job durably before acking)."""
+    args = {"tenant": tenant, "app": app,
+            "files": [os.path.abspath(f) for f in files]}
+    if pattern is not None:
+        args["pattern"] = pattern
+    if n_reduce is not None:
+        args["n_reduce"] = int(n_reduce)
+    return _call(socket_path, "Submit", args)
+
+
+def status(socket_path: str, job_id: Optional[str] = None,
+           tenant: Optional[str] = None) -> dict:
+    args: dict = {}
+    if job_id:
+        args["job_id"] = job_id
+    if tenant:
+        args["tenant"] = tenant
+    return _call(socket_path, "Status", args)
+
+
+def wait(socket_path: str, job_ids: List[str], timeout: float = 300.0,
+         poll_s: float = 0.1) -> Dict[str, dict]:
+    """Poll until every job is done or failed; returns the final
+    records.  Raises TimeoutError with the stragglers listed."""
+    deadline = time.monotonic() + timeout
+    done: Dict[str, dict] = {}
+    while time.monotonic() < deadline:
+        for jid in job_ids:
+            if jid in done:
+                continue
+            job = status(socket_path, job_id=jid)["job"]
+            if job["state"] in ("done", "failed"):
+                done[jid] = job
+        if len(done) == len(job_ids):
+            return done
+        time.sleep(poll_s)
+    missing = [j for j in job_ids if j not in done]
+    raise TimeoutError(f"jobs not finished in {timeout}s: {missing}")
+
+
+def shutdown(socket_path: str, timeout: float = 10.0) -> dict:
+    return _call(socket_path, "Shutdown", {}, timeout=timeout)

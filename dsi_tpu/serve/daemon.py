@@ -1,0 +1,612 @@
+"""The resident serving daemon behind ``mrserve``.
+
+One long-lived process owns the device mesh, the warmed AOT
+executables, and a spool directory; tenants submit jobs over the
+repo's framed-JSON pull-RPC control plane (``mr/rpc.py`` — the 6.5840
+idiom the reference's coordinator already speaks) and the daemon:
+
+* **journals** every submission durably (``spool/jobs/<id>.json``
+  through ``atomicio.write_bytes_durable``) BEFORE acking it, so a
+  ``kill -9`` at any instant loses no accepted job;
+* **packs** word-count tenants into shared device steps
+  (``serve/pack.py``: K tenants ≈ 1 dispatch) and multiplexes other
+  apps as resumable step objects (``parallel/stepobj.py``) on one
+  scheduler thread — a single thread owns all jax work;
+* **evicts** tenants to their delta-checkpoint chains when the
+  resident set is full or a tenant exceeds its step quota while others
+  wait, and resumes them on their next turn (or the tenant's next
+  submission, which re-prioritizes its parked jobs) — ``resume_gap_s``
+  is accounted per tenant;
+* **resumes after a crash**: on boot every journaled job not marked
+  done re-enters the queue with ``resume=True``; per-tenant chains
+  restore the accumulators and cursors, and the re-run output is
+  byte-identical to an uninterrupted run (the CI smoke kills the
+  daemon with ``kill -9`` mid-job and diffs against the sequential
+  oracle);
+* **reports**: a ``tenants`` section on ``/statusz`` and labeled
+  ``dsi_serve_*`` series on ``/metrics`` via the live-telemetry
+  section hooks (``obs/live.py``).
+
+Spool hygiene at boot: ``.tmp-*`` orphans are reaped across the spool
+(``atomicio.reap_tmp_files``), and checkpoint chains of tenants whose
+jobs are all done age out after ``retention_s`` — a live (unfinished)
+job's chain is never touched, and within a live chain the store's own
+chain-aware GC (PR 8) keeps retention safe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from dsi_tpu.mr.rpc import RpcServer
+from dsi_tpu.serve.client import default_socket
+from dsi_tpu.utils.atomicio import (
+    read_bytes_verified,
+    reap_tmp_files,
+    write_bytes_durable,
+)
+
+#: Apps the daemon serves.  ``wc`` rides the packed scheduler; ``grep``
+#: runs as a resumable step object (its kernel is lane-isolated, so
+#: packing it too is a natural follow-up — see DESIGN.md).
+SERVE_APPS = ("wc", "grep")
+
+_JOB_FIELDS = ("job_id", "tenant", "app", "files", "n_reduce", "out_dir",
+               "pattern", "state", "submitted_ts", "error", "stats")
+
+#: Tenant ids become path components (journal names, chain dirs): a
+#: plain slug, no separators, no leading dot.
+_TENANT_RE = re.compile(r"[A-Za-z0-9_-][A-Za-z0-9._-]{0,63}")
+
+
+class ServeDaemon:
+    """One ``mrserve`` process (module docstring)."""
+
+    def __init__(self, spool: str, socket_path: Optional[str] = None,
+                 n_reduce: int = 10, chunk_bytes: int = 1 << 16,
+                 devices: Optional[int] = None,
+                 max_resident: int = 8, quota_steps: int = 64,
+                 checkpoint_every: Optional[int] = 8,
+                 retention_s: float = 14 * 86400.0,
+                 warm: bool = True):
+        self.spool = os.path.abspath(spool)
+        self.jobs_dir = os.path.join(self.spool, "jobs")
+        self.tenants_dir = os.path.join(self.spool, "tenants")
+        self.out_dir = os.path.join(self.spool, "out")
+        for d in (self.spool, self.jobs_dir, self.tenants_dir,
+                  self.out_dir):
+            os.makedirs(d, exist_ok=True)
+        self.socket_path = socket_path or default_socket(self.spool)
+        self.n_reduce = int(n_reduce)
+        # One chunk-width truth: the packer rounds to a pow2 >= 256 (the
+        # wave program's size contract), so the lanes must cut rows at
+        # exactly that width or the batch fill would shape-mismatch.
+        self.chunk_bytes = 1 << max(8, int(chunk_bytes - 1).bit_length())
+        self.devices = devices
+        self.max_resident = max(1, int(max_resident))
+        self.quota_steps = max(1, int(quota_steps))
+        self.checkpoint_every = checkpoint_every
+        self.retention_s = float(retention_s)
+        self.warm = warm
+
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self.ready = threading.Event()
+        self._jobs: Dict[str, Dict] = {}
+        self._queue: deque = deque()
+        self._resident: Dict[str, Dict] = {}
+        self._tenants: Dict[str, Dict] = {}
+        self._seq = 0
+        self.packer = None
+        self.boot_reaped = 0
+        self.boot_gc_chains = 0
+
+        self._boot_hygiene()
+        self._load_journal()
+        self._rpc = RpcServer(self.socket_path, {
+            "Submit": self._rpc_submit,
+            "Status": self._rpc_status,
+            "Ping": self._rpc_ping,
+            "Shutdown": self._rpc_shutdown,
+        })
+        self._thread = threading.Thread(target=self._scheduler,
+                                        name="dsi-mrserve-scheduler",
+                                        daemon=True)
+
+    # ── boot ──
+
+    def _boot_hygiene(self) -> None:
+        """Satellite: reap ``.tmp-*`` orphans everywhere a crashed run
+        can leave them, and age out dead tenants' checkpoint chains."""
+        n = 0
+        roots = [self.spool, self.jobs_dir, self.out_dir,
+                 self.tenants_dir]
+        trace_dir = os.environ.get("DSI_TRACE_DIR")
+        if trace_dir:
+            roots.append(trace_dir)
+        for t in list(os.listdir(self.tenants_dir)):
+            tdir = os.path.join(self.tenants_dir, t)
+            if os.path.isdir(tdir):
+                roots.append(tdir)
+                roots.extend(os.path.join(tdir, j)
+                             for j in os.listdir(tdir)
+                             if os.path.isdir(os.path.join(tdir, j)))
+        for d in roots:
+            try:
+                n += reap_tmp_files(d)
+            except OSError:
+                pass
+        self.boot_reaped = n
+
+    def _gc_aged_chains(self) -> None:
+        """Delete whole per-job chain dirs whose job is done (or
+        unknown) and untouched past the retention age.  A live chain is
+        never a candidate — its base stays protected — and within live
+        chains the store's chain-aware GC already bounds growth."""
+        now = time.time()
+        live = {jid for jid, j in self._jobs.items()
+                if j["state"] != "done"}
+        for t in list(os.listdir(self.tenants_dir)):
+            tdir = os.path.join(self.tenants_dir, t)
+            if not os.path.isdir(tdir):
+                continue
+            for jid in list(os.listdir(tdir)):
+                jdir = os.path.join(tdir, jid)
+                if not os.path.isdir(jdir) or jid in live:
+                    continue
+                try:
+                    mtimes = [os.path.getmtime(os.path.join(jdir, f))
+                              for f in os.listdir(jdir)] or \
+                             [os.path.getmtime(jdir)]
+                    if now - max(mtimes) > self.retention_s:
+                        shutil.rmtree(jdir, ignore_errors=True)
+                        self.boot_gc_chains += 1
+                except OSError:
+                    continue
+
+    def _load_journal(self) -> None:
+        """Re-enter every journaled job; unfinished ones re-queue with
+        their chains — the crash-resume half of the daemon contract."""
+        for name in sorted(os.listdir(self.jobs_dir)):
+            if not name.endswith(".json"):
+                continue
+            raw = read_bytes_verified(os.path.join(self.jobs_dir, name))
+            if raw is None:
+                continue  # torn journal entry: the submit never acked
+            try:
+                job = json.loads(raw)
+            except ValueError:
+                continue
+            self._jobs[job["job_id"]] = job
+            self._tenant(job["tenant"])["jobs"] += 1
+            try:
+                self._seq = max(self._seq,
+                                int(job["job_id"].rsplit("-", 1)[1]) + 1)
+            except (IndexError, ValueError):
+                pass
+            if job["state"] == "done":
+                self._tenant(job["tenant"])["done"] += 1
+            elif job["state"] == "failed":
+                pass
+            else:
+                job["state"] = "queued"
+                self._queue.append(job["job_id"])
+        self._gc_aged_chains()
+
+    # ── bookkeeping ──
+
+    def _tenant(self, tenant: str) -> Dict:
+        return self._tenants.setdefault(tenant, {
+            "jobs": 0, "done": 0, "steps": 0, "rows": 0,
+            "evictions": 0, "resumes": 0, "resume_gap_s": 0.0,
+            "hostpath": 0})
+
+    def _persist(self, job: Dict) -> None:
+        rec = {k: job.get(k) for k in _JOB_FIELDS}
+        write_bytes_durable(
+            os.path.join(self.jobs_dir, f"{job['job_id']}.json"),
+            json.dumps(rec, sort_keys=True).encode("utf-8"))
+
+    # ── RPC handlers (no jax; scheduler owns the device) ──
+
+    def _rpc_submit(self, args: dict) -> dict:
+        tenant = str(args.get("tenant") or "default")
+        # The tenant id is spliced into journal filenames and chain
+        # paths: a separator or dot-dot would write outside the spool
+        # (and dodge the hygiene walks), so the id must be a slug.
+        if not _TENANT_RE.fullmatch(tenant):
+            return {"error": f"invalid tenant {tenant!r}: want "
+                             f"[A-Za-z0-9._-]{{1,64}} with no leading "
+                             f"dot"}
+        app = str(args.get("app") or "wc")
+        files = [os.path.abspath(f) for f in (args.get("files") or [])]
+        if app not in SERVE_APPS:
+            return {"error": f"unknown app {app!r} (have {SERVE_APPS})"}
+        if not files:
+            return {"error": "no input files"}
+        missing = [f for f in files if not os.path.isfile(f)]
+        if missing:
+            return {"error": f"missing input files: {missing}"}
+        n_reduce = int(args.get("n_reduce") or self.n_reduce)
+        if n_reduce != self.n_reduce:
+            # The packed step computes partitions on device with the
+            # daemon's n_reduce; a per-job degree cannot share it.
+            return {"error": f"n_reduce {n_reduce} != daemon's "
+                             f"{self.n_reduce} (packing shares one "
+                             f"partition degree)"}
+        pattern = args.get("pattern")
+        if app == "grep" and not pattern:
+            return {"error": "grep needs a pattern"}
+        with self._wake:
+            jid = f"{tenant}-{self._seq:06d}"
+            self._seq += 1
+            job = {"job_id": jid, "tenant": tenant, "app": app,
+                   "files": files, "n_reduce": n_reduce,
+                   "out_dir": os.path.join(self.out_dir, jid),
+                   "pattern": pattern, "state": "queued",
+                   "submitted_ts": round(time.time(), 3),
+                   "error": None, "stats": {}}
+            self._persist(job)  # durable BEFORE the ack
+            self._jobs[jid] = job
+            self._tenant(tenant)["jobs"] += 1
+            # "Resume on the next submission": the tenant's PARKED jobs
+            # move to the queue front, then the new one joins the tail.
+            # Parked only — front-loading the tenant's never-run queued
+            # backlog too would let one chatty tenant starve the rest.
+            parked = [j for j in self._queue
+                      if self._jobs[j]["tenant"] == tenant
+                      and self._jobs[j]["state"] == "parked"]
+            for j in parked:
+                self._queue.remove(j)
+            self._queue.extendleft(reversed(parked))
+            self._queue.append(jid)
+            self._wake.notify_all()
+        return {"job_id": jid, "out_dir": job["out_dir"]}
+
+    def _rpc_status(self, args: dict) -> dict:
+        jid = args.get("job_id")
+        tenant = args.get("tenant")
+        with self._lock:
+            if jid:
+                job = self._jobs.get(jid)
+                if job is None:
+                    return {"error": f"no such job {jid!r}"}
+                return {"job": {k: job.get(k) for k in _JOB_FIELDS}}
+            jobs = [{k: j.get(k) for k in _JOB_FIELDS}
+                    for j in self._jobs.values()
+                    if tenant is None or j["tenant"] == tenant]
+            return {"jobs": jobs,
+                    "tenants": {t: dict(s)
+                                for t, s in self._tenants.items()}}
+
+    def _rpc_ping(self, args: dict) -> dict:
+        with self._lock:
+            return {"ok": True, "pid": os.getpid(),
+                    "ready": self.ready.is_set(),
+                    "queued": len(self._queue),
+                    "resident": len(self._resident)}
+
+    def _rpc_shutdown(self, args: dict) -> dict:
+        self.stop()
+        return {"ok": True}
+
+    # ── statusz / metrics section (obs/live.py hooks) ──
+
+    def _statusz_section(self) -> str:
+        with self._lock:
+            lines = [f"  queued={len(self._queue)} "
+                     f"resident={len(self._resident)} "
+                     f"jobs={len(self._jobs)}"]
+            if self.packer is not None:
+                st = self.packer.stats
+                lines.append(
+                    f"  packed_steps={st['packed_steps']} "
+                    f"packed_rows={st['packed_rows']} "
+                    f"max_tenants_per_step={st['max_tenants_per_step']} "
+                    f"replays={st['replays']}")
+            for jid, rec in sorted(self._resident.items()):
+                job = self._jobs[jid]
+                if rec["kind"] == "wc":
+                    lane = rec["lane"]
+                    live = (f"steps={lane.steps} "
+                            f"rows={lane.confirmed_rows} "
+                            f"cursor={lane.cursor}")
+                else:
+                    live = f"steps={rec['advanced']}"
+                lines.append(f"  tenant={job['tenant']} job={jid} "
+                             f"app={job['app']} {live}")
+            for t, s in sorted(self._tenants.items()):
+                kv = " ".join(f"{k}={v}" for k, v in sorted(s.items()))
+                lines.append(f"  tenant={t} {kv}")
+        return "\n".join(lines)
+
+    def _metrics_section(self) -> str:
+        from dsi_tpu.obs.live import _mname
+
+        with self._lock:
+            L = [f"dsi_serve_jobs_total {len(self._jobs)}",
+                 f"dsi_serve_queued {len(self._queue)}",
+                 f"dsi_serve_resident {len(self._resident)}"]
+            if self.packer is not None:
+                st = self.packer.stats
+                L.append(f"dsi_serve_packed_steps {st['packed_steps']}")
+                L.append(f"dsi_serve_packed_rows {st['packed_rows']}")
+            for t, s in sorted(self._tenants.items()):
+                lab = f'tenant="{_mname(t)}"'
+                for k in ("steps", "rows", "evictions", "resumes",
+                          "done"):
+                    L.append(f"dsi_serve_tenant_{k}{{{lab}}} {s[k]}")
+                L.append(f"dsi_serve_tenant_resume_gap_seconds{{{lab}}} "
+                         f"{s['resume_gap_s']}")
+        return "\n".join(L)
+
+    # ── scheduler (the one thread that touches jax) ──
+
+    def _admit(self) -> bool:
+        """Move queued jobs into the resident set (resuming from their
+        chains); returns whether anything was admitted.  Caller holds
+        the lock."""
+        admitted = False
+        while self._queue and len(self._resident) < self.max_resident:
+            jid = self._queue.popleft()
+            job = self._jobs[jid]
+            try:
+                rec = self._make_runner(job)
+            except Exception as e:  # noqa: BLE001 — job fails, daemon lives
+                job["state"] = "failed"
+                job["error"] = f"{type(e).__name__}: {e}"
+                self._persist(job)
+                continue
+            was_parked = job["state"] == "parked"
+            job["state"] = "running"
+            self._persist(job)
+            self._resident[jid] = rec
+            ts = self._tenant(job["tenant"])
+            if was_parked or rec.get("resume_cursor", 0):
+                ts["resumes"] += 1
+                ts["resume_gap_s"] = round(
+                    ts["resume_gap_s"] + rec.get("resume_gap_s", 0.0), 4)
+            admitted = True
+        return admitted
+
+    def _make_runner(self, job: Dict) -> Dict:
+        ckpt_dir = os.path.join(self.tenants_dir, job["tenant"],
+                                job["job_id"])
+        if job["app"] == "wc":
+            from dsi_tpu.serve.pack import TenantLane
+
+            lane = TenantLane(job, self.chunk_bytes, ckpt_dir,
+                              checkpoint_every=self.checkpoint_every,
+                              resume=True)
+            return {"kind": "wc", "lane": lane,
+                    "resume_gap_s": lane.resume_gap_s,
+                    "resume_cursor": lane.start_offset}
+        # grep: a resumable step object, time-multiplexed.
+        from dsi_tpu.parallel.grepstream import GrepStep
+        from dsi_tpu.parallel.streaming import stream_files
+
+        stats: Dict = {}
+        step = GrepStep(stream_files(job["files"]), job["pattern"],
+                        mesh=self._mesh, checkpoint_dir=ckpt_dir,
+                        checkpoint_every=self.checkpoint_every,
+                        checkpoint_delta=True, resume=True,
+                        pipeline_stats=stats)
+        info = step.restore()
+        return {"kind": "step", "step": step, "stats": stats,
+                "advanced": 0,
+                "resume_gap_s": info.get("resume_gap_s", 0.0),
+                "resume_cursor": info.get("resume_cursor", 0)}
+
+    def _finish_job(self, jid: str, rec: Dict) -> None:
+        """Finalize one retired runner.  Called WITHOUT the daemon lock
+        held: the heavy half (host-path recomputation, durable output
+        writes) must not freeze the control plane mid-multi-GB job —
+        only the final job/tenant bookkeeping takes the lock."""
+        job = self._jobs[jid]
+        hostpath = False
+        stats: Dict = {}
+        error = None
+        try:
+            if rec["kind"] == "wc":
+                lane = rec["lane"]
+                lane.finalize()
+                hostpath = lane.hostpath
+                stats = {"steps": lane.steps,
+                         "rows": lane.confirmed_rows,
+                         "hostpath": lane.hostpath,
+                         "resume_gap_s": lane.resume_gap_s}
+            else:
+                step = rec["step"]
+                result = step.close()
+                if result is None:
+                    # Host path: the oracle semantics, same output file.
+                    from dsi_tpu.parallel.grepstream import \
+                        grep_host_oracle
+                    from dsi_tpu.parallel.streaming import stream_files
+
+                    result = grep_host_oracle(stream_files(job["files"]),
+                                              job["pattern"])
+                    hostpath = True
+                os.makedirs(job["out_dir"], exist_ok=True)
+                payload = json.dumps(
+                    {"lines": result.lines, "matched": result.matched,
+                     "occurrences": result.occurrences,
+                     "hist": list(result.hist),
+                     "topk": [list(r) for r in result.topk]},
+                    sort_keys=True).encode("utf-8")
+                write_bytes_durable(
+                    os.path.join(job["out_dir"], "grep.json"), payload)
+                stats = {"steps": rec["advanced"]}
+        except Exception as e:  # noqa: BLE001 — job fails, daemon lives
+            error = f"{type(e).__name__}: {e}"
+        with self._lock:
+            job["stats"] = stats
+            job["state"] = "done" if error is None else "failed"
+            job["error"] = error
+            ts = self._tenant(job["tenant"])
+            if hostpath:
+                ts["hostpath"] += 1
+            if error is None:
+                ts["done"] += 1
+                ts["steps"] += int(stats.get("steps") or 0)
+                ts["rows"] += int(stats.get("rows") or 0)
+        self._persist(job)
+
+    def _evict_one(self) -> None:
+        """Park the resident job furthest past its quota so a queued
+        tenant gets a turn — checkpoint to its delta chain, drop the
+        runner, re-queue at the tail.  Caller holds the lock."""
+        victim = None
+        most = -1
+        for jid, rec in self._resident.items():
+            steps = (rec["lane"].steps_since_resume
+                     if rec["kind"] == "wc" else rec["advanced"])
+            if steps >= self.quota_steps and steps > most:
+                victim, most = jid, steps
+        if victim is None:
+            return
+        rec = self._resident.pop(victim)
+        job = self._jobs[victim]
+        try:
+            if rec["kind"] == "wc":
+                rec["lane"].suspend()
+            else:
+                rec["step"].suspend()
+        except Exception as e:  # noqa: BLE001
+            job["state"] = "failed"
+            job["error"] = f"evict: {type(e).__name__}: {e}"
+            self._persist(job)
+            return
+        job["state"] = "parked"
+        self._persist(job)
+        self._queue.append(victim)
+        self._tenant(job["tenant"])["evictions"] += 1
+
+    def _scheduler(self) -> None:
+        from dsi_tpu.parallel.shuffle import default_mesh
+        from dsi_tpu.serve.pack import PackedWcScheduler
+
+        self._mesh = default_mesh(self.devices)
+        self.packer = PackedWcScheduler(self._mesh, self.chunk_bytes,
+                                        self.n_reduce)
+        if self.warm:
+            self.packer.warm()
+        self.ready.set()
+        while not self._stop.is_set():
+            with self._wake:
+                self._admit()
+                if self._queue:
+                    self._evict_one()
+                    self._admit()
+                resident = dict(self._resident)
+            worked = False
+            # One packed step across every runnable wc lane.  A packer
+            # error fails the participating jobs, never the daemon.
+            wc_lanes = [(jid, rec["lane"])
+                        for jid, rec in resident.items()
+                        if rec["kind"] == "wc" and rec["lane"].runnable]
+            if wc_lanes:
+                try:
+                    confirmed = self.packer.step(
+                        [ln for _, ln in wc_lanes])
+                    worked = bool(confirmed) or any(
+                        not ln.runnable for _, ln in wc_lanes)
+                except Exception as e:  # noqa: BLE001
+                    with self._wake:
+                        for jid, _ln in wc_lanes:
+                            rec = self._resident.pop(jid, None)
+                            if rec is None:
+                                continue
+                            job = self._jobs[jid]
+                            job["state"] = "failed"
+                            job["error"] = (f"packed step: "
+                                            f"{type(e).__name__}: {e}")
+                            self._persist(job)
+                    worked = True
+            # A bounded slice of every step-object job.
+            for jid, rec in resident.items():
+                if rec["kind"] != "step":
+                    continue
+                step = rec["step"]
+                try:
+                    for _ in range(8):
+                        if not step.advance():
+                            break
+                        rec["advanced"] += 1
+                        worked = True
+                except Exception as e:  # noqa: BLE001
+                    with self._wake:
+                        if self._resident.pop(jid, None) is not None:
+                            job = self._jobs[jid]
+                            job["state"] = "failed"
+                            job["error"] = f"{type(e).__name__}: {e}"
+                            self._persist(job)
+                    worked = True
+            # Retire finished runners: pop under the lock, finalize
+            # outside it (the heavy half must not block the RPC plane).
+            retired = []
+            with self._wake:
+                for jid, rec in list(self._resident.items()):
+                    finished = (not rec["lane"].runnable
+                                if rec["kind"] == "wc"
+                                else rec["step"].phase != "running")
+                    if finished:
+                        del self._resident[jid]
+                        retired.append((jid, rec))
+            for jid, rec in retired:
+                self._finish_job(jid, rec)
+                worked = True
+            with self._wake:
+                if not worked and not self._queue:
+                    self._wake.wait(timeout=0.2)
+        # Graceful stop: park every resident job so a restart resumes
+        # from fresh chains instead of replaying from the last cadence.
+        with self._wake:
+            for jid, rec in list(self._resident.items()):
+                job = self._jobs[jid]
+                try:
+                    if rec["kind"] == "wc":
+                        rec["lane"].suspend()
+                    else:
+                        rec["step"].suspend()
+                    job["state"] = "parked"
+                except Exception as e:  # noqa: BLE001
+                    job["state"] = "failed"
+                    job["error"] = f"stop: {type(e).__name__}: {e}"
+                self._persist(job)
+            self._resident.clear()
+
+    # ── lifecycle ──
+
+    def start(self) -> "ServeDaemon":
+        from dsi_tpu.obs import live as _live
+
+        _live.register_section("serve tenants", self._statusz_section,
+                               self._metrics_section)
+        self._rpc.start()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._wake:
+            self._wake.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout=timeout)
+
+    def close(self) -> None:
+        from dsi_tpu.obs import live as _live
+
+        self.stop()
+        self.join(timeout=60.0)
+        self._rpc.close()
+        _live.unregister_section("serve tenants")

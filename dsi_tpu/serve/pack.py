@@ -1,0 +1,429 @@
+"""Multi-tenant step packing: K tenants' chunks in ONE device dispatch.
+
+The serving daemon's whole economic argument is amortization: a small
+word-count job costs one or two device steps, so running each tenant's
+job through its own engine pays a full dispatch (and, on a tunneled
+accelerator, ~0.1 s of wire latency) per tenant per step.  This module
+batches them: up to ``n_dev`` pending chunks from DIFFERENT tenants
+fill the rows of one ``[n_dev, chunk_bytes]`` batch and run through one
+compiled program, so K tenants cost ~1 dispatch instead of K.
+
+The demux problem — and why the packed step is the TF-IDF wave
+program.  The word-count step (``shuffle.mapreduce_step``) shuffles
+rows across devices INSIDE the kernel (map → all_to_all → reduce), so
+a device's output table mixes words from every input row: two tenants
+sharing a batch would sum their counts for a shared word, and nothing
+in the output says whose count is whose.  The wave program
+(``tfidf._wave_fn``) already solved this for documents: every shuffled
+row carries a ``doc`` payload lane.  Packing therefore treats each
+tenant's chunk as a *document* — the doc lane IS the tenant lane — and
+the host demuxes the pulled rows by that column into per-tenant
+accumulators.  The ``tf`` payload is the word's in-chunk count and the
+``part`` payload its reduce partition, so a demuxed row drops straight
+into the tenant's :class:`~dsi_tpu.parallel.merge.PackedCounts` in the
+packed-table layout the delta-checkpoint format already speaks
+(``ckpt/delta.py``).  Counts are content-sums, independent of chunking,
+so per-tenant output is byte-identical to the tenant running alone —
+the parity bar the daemon's tests and bench row enforce.
+
+Exactness discipline: the shared sticky rung (capacity / word window /
+grouper / token frac) widens for the whole batch exactly as the wave
+walk's ladder does — a replay re-runs the batch, every lane benefits,
+and the cleared rung sticks.  Per-lane failures do NOT abort the batch:
+a lane whose chunk carries non-ASCII bytes (or a >64-byte word) is
+marked for the host path, its row zeroed, and the batch re-dispatched —
+the surviving lanes' rows are demuxed normally and the dead tenant's
+whole job re-runs on the host oracle path (correctness never depends on
+the kernel, the ``backends/tpu.py`` contract).
+
+Per-tenant state is host-side and checkpointable at every confirmed
+packed step: the accumulator snapshot plus the input-byte cursor, saved
+through the engines' own :class:`~dsi_tpu.ckpt.CheckpointWriter` as a
+delta CHAIN (``HostDeltaLog`` of demuxed step payloads, periodic full
+re-base) — which is what makes tenant eviction cheap and a daemon
+``kill -9`` resumable with byte-identical output.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dsi_tpu.ckpt import (
+    CheckpointPolicy,
+    CheckpointStore,
+    CheckpointWriter,
+    DeltaSteps,
+    HostDeltaLog,
+    drain_packed_steps,
+    fault_point,
+    skip_stream,
+)
+from dsi_tpu.obs import metrics_scope, span as _span
+from dsi_tpu.ops.wordcount import grouper_ladder, rung0_cap
+from dsi_tpu.parallel.merge import PackedCounts
+from dsi_tpu.parallel.shuffle import write_partitioned_output
+
+
+def host_wordcount(files, n_reduce: int) -> Dict[str, tuple]:
+    """The host-path word count (the ``wcstream`` fallback semantics):
+    ``apps.wc.Map`` tokens + ``ihash %% n_reduce`` partitions — the same
+    result the device path produces, by the oracle's definition."""
+    from dsi_tpu.apps import wc
+    from dsi_tpu.mr.worker import ihash
+
+    counts: Dict[str, int] = {}
+    for f in files:
+        with open(f, "rb") as fh:
+            text = fh.read().decode("utf-8", errors="replace")
+        for kv in wc.Map(f, text):
+            counts[kv.key] = counts.get(kv.key, 0) + 1
+    return {w: (c, ihash(w) % n_reduce) for w, c in counts.items()}
+
+
+class TenantLane:
+    """One tenant job's lane in the packed scheduler: a row stream cut
+    from its input files, a host accumulator, and a per-tenant
+    delta-checkpoint chain.
+
+    ``resume=True`` (the default the daemon uses) loads the newest
+    valid chain when one exists — a fresh job's empty directory simply
+    starts fresh, so admission and crash-resume are the same code.
+    """
+
+    def __init__(self, job: Dict, chunk_bytes: int, ckpt_dir: str,
+                 checkpoint_every: Optional[int] = None,
+                 resume: bool = True, delta: bool = True):
+        from dsi_tpu.parallel.streaming import batch_stream, stream_files
+
+        self.job = job
+        self.tenant = job["tenant"]
+        self.n_reduce = int(job["n_reduce"])
+        self.chunk_bytes = int(chunk_bytes)
+        self.acc = PackedCounts()
+        self.offsets: List[int] = []
+        self.rows_taken = 0
+        self.confirmed_rows = 0
+        self.steps = 0                # confirmed packed steps ridden
+        self.steps_since_resume = 0   # the eviction-quota clock
+        self.hostpath = False
+        self.input_done = False
+        self.resume_gap_s = 0.0
+        self.stats: Dict = {}
+        self._pending: List[int] = []  # end offsets of unconfirmed rows
+        ident = {"tenant": self.tenant,
+                 "files": [[os.path.basename(f), os.path.getsize(f)]
+                           for f in job["files"]],
+                 "n_reduce": self.n_reduce,
+                 "chunk_bytes": self.chunk_bytes}
+        self.store = CheckpointStore(ckpt_dir, "serve-wc", ident)
+        self.writer = CheckpointWriter(self.store, self.stats,
+                                       async_=False, delta=delta)
+        self.policy = CheckpointPolicy(checkpoint_every)
+        self.delta_log = HostDeltaLog()
+        start = 0
+        if resume:
+            t0 = time.perf_counter()
+            loaded = self.store.load_latest_chain()
+            if loaded is not None:
+                meta, arrays, deltas = loaded
+                eff = deltas[-1][0] if deltas else meta
+                start = int(eff["cursor"])
+                self.confirmed_rows = int(eff["rows"])
+                self.acc.restore({k[4:]: v for k, v in arrays.items()
+                                  if k.startswith("acc_")})
+                for _, darr in deltas:
+                    # Ordered deltas re-ingest through the host drain
+                    # path — content-exact, the chain-restore argument.
+                    drain_packed_steps(self.acc, darr)
+                self.resume_gap_s = round(time.perf_counter() - t0, 4)
+        else:
+            self.store.reset()
+        self.start_offset = start
+        self.cursor = start
+        blocks = stream_files(job["files"])
+        feed = skip_stream(blocks, start) if start else blocks
+        # One row per "batch": the lane's chunk stream is its document
+        # stream — the packer assigns each row a doc id (= its batch
+        # slot) and demuxes by it after the shuffle.
+        self._rows = batch_stream(feed, 1, self.chunk_bytes,
+                                  offsets=self.offsets)
+
+    # ── the packer-facing surface ──
+
+    @property
+    def runnable(self) -> bool:
+        return not (self.hostpath or self.input_done)
+
+    def take_row(self) -> Optional[np.ndarray]:
+        """The next ``[chunk_bytes]`` row, pending until
+        :meth:`confirm_step` (or abandoned on a host-path flip).  None
+        at end of input or when a >row-wide token forces the host
+        path."""
+        from dsi_tpu.parallel.streaming import _TokenTooLong
+
+        try:
+            batch = next(self._rows)
+        except StopIteration:
+            self.input_done = True
+            return None
+        except _TokenTooLong:
+            self.to_hostpath()
+            return None
+        off = self.start_offset + self.offsets[self.rows_taken]
+        self.rows_taken += 1
+        self._pending.append(off)
+        return batch[0]
+
+    def to_hostpath(self) -> None:
+        """This tenant's input needs the host path: the lane leaves the
+        device batch (its rows are excluded at demux) and the whole job
+        re-runs on the host oracle at finalize."""
+        self.hostpath = True
+        self._pending.clear()
+
+    def merge_rows(self, rows: np.ndarray, kk: int) -> None:
+        """One packed step's demuxed rows for this tenant, in the
+        packed-table layout (kk key lanes + len/count/part)."""
+        if not len(rows):
+            return
+        self.acc.add(rows[:, :kk], rows[:, kk],
+                     rows[:, kk + 1].astype(np.int64), rows[:, kk + 2])
+        self.delta_log.append(rows[None], np.array([len(rows)],
+                                                   dtype=np.int64))
+
+    def confirm_step(self) -> None:
+        """Every pending row of this lane was confirmed by one packed
+        step: advance the durable cursor, count, maybe checkpoint."""
+        if self._pending:
+            self.cursor = self._pending[-1]
+            self.confirmed_rows += len(self._pending)
+            self._pending.clear()
+        self.steps += 1
+        self.steps_since_resume += 1
+        self.policy.note_step()
+        if self.policy.due():
+            self.save_ckpt()
+            self.policy.reset()
+
+    def save_ckpt(self) -> None:
+        """One snapshot at the current confirmed boundary: a delta of
+        the demuxed step payloads since the last save when the chain
+        allows it, else a full accumulator image (the engines'
+        want_delta/re-base discipline, one writer)."""
+        meta = {"cursor": self.cursor, "rows": self.confirmed_rows}
+        kind, parts = "full", None
+        if self.writer.want_delta():
+            entries = self.delta_log.take()
+            if entries is not None:
+                parts, kind = [("", DeltaSteps(entries))], "delta"
+        if parts is None:
+            self.delta_log.reset()
+            parts = [("acc_", self.acc.snapshot())]
+        self.writer.commit(parts, meta, kind=kind)
+
+    def suspend(self) -> None:
+        """Evict: one forced durable snapshot; the object is dead after
+        (a fresh construction resumes the chain)."""
+        if not self.hostpath:
+            self.save_ckpt()
+        self.writer.drain()
+        self.writer.shutdown()
+
+    def finalize(self) -> Dict[str, tuple]:
+        """Job complete: the exact result (host path for a hostpath
+        lane), ``mr-out-<r>`` files written to the job's out dir."""
+        if self.hostpath:
+            res = host_wordcount(self.job["files"], self.n_reduce)
+        else:
+            res = self.acc.finalize()
+        out_dir = self.job["out_dir"]
+        os.makedirs(out_dir, exist_ok=True)
+        write_partitioned_output(res, self.n_reduce, out_dir)
+        self.writer.drain()
+        self.writer.shutdown()
+        return res
+
+
+class PackedWcScheduler:
+    """Shared device-step packer over :class:`TenantLane` rows (module
+    docstring).  One instance per daemon — it owns the sticky dispatch
+    rung and the warmed wave executables; :meth:`step` is one shared
+    dispatch over every runnable lane."""
+
+    def __init__(self, mesh=None, chunk_bytes: int = 1 << 16,
+                 n_reduce: int = 10, u_cap: int = 1 << 12):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dsi_tpu.parallel.shuffle import AXIS, default_mesh
+
+        if mesh is None:
+            mesh = default_mesh()
+        self.mesh = mesh
+        self.n_dev = mesh.devices.size
+        # The wave program's size contract: a power of two, >= 256.
+        self.chunk_bytes = 1 << max(8, int(chunk_bytes - 1).bit_length())
+        self.n_reduce = int(n_reduce)
+        self.groupers = grouper_ladder()
+        self.state = {"cap": rung0_cap(self.chunk_bytes, u_cap),
+                      "mwl": 16, "grouper": self.groupers[0], "frac": 4}
+        self.stats = metrics_scope("serve")
+        self.stats.update({"packed_steps": 0, "packed_rows": 0,
+                           "replays": 0, "upload_s": 0.0, "kernel_s": 0.0,
+                           "pull_s": 0.0, "merge_s": 0.0,
+                           "max_tenants_per_step": 0})
+        self._sh_chunk = NamedSharding(mesh, P(AXIS, None))
+        self._sh_ids = NamedSharding(mesh, P(AXIS))
+        self._jax = jax
+
+    def warm(self) -> None:
+        """Compile (or load the persisted executable of) the
+        sticky-rung wave program from shape structs — the daemon's
+        boot-time warm, paid once for every tenant after it."""
+        import jax
+        import jax.numpy as jnp
+
+        from dsi_tpu.parallel.tfidf import _wave_fn
+
+        sds = jax.ShapeDtypeStruct
+        examples = (sds((self.n_dev, self.chunk_bytes), jnp.uint8),
+                    sds((self.n_dev,), jnp.int32))
+        _wave_fn(examples, n_dev=self.n_dev, n_reduce=self.n_reduce,
+                 max_word_len=self.state["mwl"], u_cap=self.state["cap"],
+                 size=self.chunk_bytes, mesh=self.mesh,
+                 t_cap_frac=self.state["frac"],
+                 grouper=self.state["grouper"])
+
+    # ── one packed step ──
+
+    def _wave_call(self, chunk_np, ids_np, mwl, cap, frac, g):
+        from dsi_tpu.device.table import _quiet_unusable_donation
+        from dsi_tpu.parallel.tfidf import _wave_fn
+
+        with _span("upload", stats=self.stats, key="upload_s"):
+            chunk = self._jax.device_put(chunk_np, self._sh_chunk)
+            ids = self._jax.device_put(ids_np, self._sh_ids)
+        fn = _wave_fn((chunk, ids), n_dev=self.n_dev,
+                      n_reduce=self.n_reduce, max_word_len=mwl,
+                      u_cap=cap, size=self.chunk_bytes, mesh=self.mesh,
+                      t_cap_frac=frac, grouper=g)
+        with _quiet_unusable_donation():
+            return fn(chunk, ids)
+
+    def _dispatch_ladder(self, chunk_np, ids_np, picks):
+        """The synchronous exactness ladder for ONE packed batch — the
+        wave walk's replay discipline, with per-lane host-path
+        attribution instead of rung aborts: a poisoned lane (non-ASCII,
+        or a >64-byte word at the widest rung) is marked, its row
+        zeroed, and the batch re-dispatched, so the other lanes'
+        exactness flags are judged on clean input."""
+        state = self.state
+        cap, mwl = state["cap"], state["mwl"]
+        while True:
+            for g in self.groupers:
+                for frac in (4, 2):
+                    with _span("kernel", stats=self.stats,
+                               key="kernel_s"):
+                        rows, scal = self._wave_call(chunk_np, ids_np,
+                                                     mwl, cap, frac, g)
+                        scal_np = np.asarray(scal)
+                    if not scal_np[:, 4].any():
+                        break
+                if not scal_np[:, 4].any():
+                    break
+            dead = [int(d) for d in np.flatnonzero(scal_np[:, 3])
+                    if int(d) < len(picks) and not picks[int(d)].hostpath]
+            if int(scal_np[:, 2].max()) > 64:
+                dead += [int(d) for d in np.flatnonzero(scal_np[:, 2] > 64)
+                         if int(d) < len(picks)
+                         and not picks[int(d)].hostpath]
+            if dead:
+                for d in dead:
+                    picks[d].to_hostpath()
+                    chunk_np[d, :] = 0
+                self.stats["replays"] += 1
+                continue
+            if int(scal_np[:, 2].max()) > mwl:
+                mwl = 64  # a word overflowed the packed window: widen
+                self.stats["replays"] += 1
+                continue
+            if int(scal_np[:, 1].max()) > cap:
+                cap *= 4  # uniques <= tokens <= size/2: terminates
+                self.stats["replays"] += 1
+                continue
+            break
+        state.update(cap=cap, mwl=mwl, grouper=g, frac=frac)
+        return rows, scal_np, mwl // 4
+
+    def step(self, lanes: List[TenantLane]) -> List[TenantLane]:
+        """Pack up to ``n_dev`` pending rows from ``lanes`` (round-robin
+        across tenants; a lone tenant may fill every row, so
+        single-tenant throughput matches the engine path) into ONE wave
+        dispatch, demux by the doc lane, merge per tenant, confirm.
+        Returns the lanes whose rows were confirmed."""
+        from dsi_tpu.parallel.shuffle import occupied_prefix
+
+        picks: List[TenantLane] = []
+        chunk_np = np.zeros((self.n_dev, self.chunk_bytes), np.uint8)
+        while len(picks) < self.n_dev:
+            progressed = False
+            for lane in list(lanes):
+                if len(picks) >= self.n_dev:
+                    break
+                if not lane.runnable:
+                    continue
+                row = lane.take_row()
+                if row is None:
+                    continue
+                chunk_np[len(picks), :] = row
+                picks.append(lane)
+                progressed = True
+            if not progressed:
+                break
+        if not picks:
+            return []
+        # Doc id = batch slot: rides every shuffled row, so the pull
+        # demuxes exactly.  Idle rows are all-zero chunks (no tokens).
+        ids_np = np.arange(self.n_dev, dtype=np.int32)
+        rows, scal_np, kk = self._dispatch_ladder(chunk_np, ids_np, picks)
+        fault_point("post-dispatch")
+        m = int(scal_np[:, 0].max())
+        if m:
+            with _span("pull", stats=self.stats, key="pull_s"):
+                mp = occupied_prefix(m, rows.shape[1])
+                rows_np = np.asarray(rows[:, :mp])
+            with _span("merge", stats=self.stats, key="merge_s"):
+                for d in range(self.n_dev):
+                    nr = int(scal_np[d, 0])
+                    if not nr:
+                        continue
+                    r = rows_np[d, :nr]
+                    doc = r[:, kk + 2]
+                    for slot, lane in enumerate(picks):
+                        if lane.hostpath:
+                            continue  # dead lane: its rows are dropped
+                        sub = r[doc == slot]
+                        if len(sub):
+                            # Drop the doc column: kk keys + len + tf
+                            # + part, the packed-table layout.
+                            arr = np.concatenate(
+                                [sub[:, :kk + 2], sub[:, kk + 3:kk + 4]],
+                                axis=1)
+                            lane.merge_rows(arr, kk)
+        fault_point("mid-fold")
+        confirmed = []
+        for lane in dict.fromkeys(picks):
+            if lane.hostpath:
+                continue
+            lane.confirm_step()
+            confirmed.append(lane)
+        self.stats["packed_steps"] += 1
+        self.stats["packed_rows"] += len(picks)
+        n_tenants = len({ln.tenant for ln in picks})
+        if n_tenants > self.stats["max_tenants_per_step"]:
+            self.stats["max_tenants_per_step"] = n_tenants
+        return confirmed

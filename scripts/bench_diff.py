@@ -63,6 +63,10 @@ GATES: List[Tuple[str, str, float]] = [
     ("*_mbps", "higher", 0.10),
     ("*_overhead_pct", "lower", 0.50),
     ("resume_gap_s", "lower", 1.00),
+    # The serving daemon's amortized boot cost (ISSUE 11): the *_mbps
+    # and *_parity patterns above already gate its throughput and
+    # per-tenant parity keys; the warm cost gates lower-better here.
+    ("serve_amortized_warm_s", "lower", 1.00),
 ]
 
 

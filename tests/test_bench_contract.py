@@ -71,7 +71,14 @@ def test_disabled_accelerator_half_emits_error_verdict(tmp_path):
 def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
     rc, v = run_bench(tmp_path, {"DSI_BENCH_TPU_TIMEOUTS": "0",
                                  "DSI_BENCH_DEADLINE_S": "600",
-                                 "DSI_BENCH_STREAM_MB": "2"})
+                                 "DSI_BENCH_STREAM_MB": "2",
+                                 # serve row at contract-test scale:
+                                 # 2 tenants x ~0.2 MB keeps the daemon
+                                 # + 2 one-shot CLI boots inside the
+                                 # test budget while exercising the
+                                 # measured path
+                                 "DSI_BENCH_SERVE_JOBS": "2",
+                                 "DSI_BENCH_SERVE_MB": "0.2"})
     assert rc == 0
     assert v["metric"] == "wc_cpu_fallback_throughput"
     assert v["platform"] == "cpu"
@@ -138,6 +145,15 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["mesh_pull_bytes_per_sync"] > 0
         assert v["mesh_host_pull_bytes_per_sync"] > 0
         assert len(v["mesh_shard_widens"]) == v["mesh_shards"]
+    # The serving-daemon A/B row (ISSUE 11): measured XOR skipped; a
+    # measured row carries the per-tenant parity gate, both throughput
+    # halves, and the amortized warm cost.
+    assert ("serve_skipped" in v) != ("serve_packed_mbps" in v)
+    if "serve_packed_mbps" in v:
+        assert v["serve_parity"] is True
+        assert v["serve_jobs"] >= 2
+        assert v["serve_oneshot_mbps"] > 0
+        assert v["serve_amortized_warm_s"] >= 0
 
 
 def test_engine_phase_dicts_come_from_the_registry(tmp_path):

@@ -1,0 +1,266 @@
+"""Serving-daemon tests (``dsi_tpu/serve``).
+
+The daemon's contract, pinned end to end:
+
+* K concurrent tenants pack into shared device steps and each tenant's
+  output is byte-identical to the sequential oracle (the acceptance
+  bar: >= 8 tenants);
+* eviction (max-resident pressure + step quota) parks tenants on their
+  delta-checkpoint chains and resumes them with exact results,
+  ``resume_gap_s`` accounted per tenant;
+* a REAL ``os._exit`` daemon kill mid-job (the fault-injection points
+  ride the packer) resumes every in-flight tenant from its chain on
+  restart with byte-identical output — via the actual ``mrserve``/
+  ``mrsubmit`` CLIs in subprocesses;
+* boot hygiene reaps ``.tmp-*`` orphans and GCs aged dead chains while
+  never touching a live tenant's chain;
+* the ``/statusz`` tenant section and ``dsi_serve_*`` metrics render.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dsi_tpu.serve import client
+from dsi_tpu.serve.daemon import ServeDaemon
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def short_sock() -> str:
+    # AF_UNIX paths cap at ~108 bytes; pytest tmp dirs can exceed it.
+    return os.path.join(tempfile.mkdtemp(prefix="dsi-sv-"), "s.sock")
+
+
+def make_corpus(path, tenant_tag, words=3000, seed=0):
+    toks = [f"{tenant_tag}w{(seed * 31 + j) % 223:03d}" for j in range(words)]
+    with open(path, "w") as f:
+        f.write(" ".join(toks) + "\n")
+    return path
+
+
+def oracle_lines(files):
+    from dsi_tpu.apps import wc
+    from dsi_tpu.mr.sequential import run_sequential
+
+    out = files[0] + ".oracle"
+    run_sequential(wc.Map, wc.Reduce, files, out)
+    with open(out, encoding="utf-8") as f:
+        return sorted(l for l in f if l.strip())
+
+
+def daemon_out_lines(out_dir, n_reduce=10):
+    got = []
+    for r in range(n_reduce):
+        with open(os.path.join(out_dir, f"mr-out-{r}"),
+                  encoding="utf-8") as f:
+            got.extend(l for l in f if l.strip())
+    return sorted(got)
+
+
+def test_daemon_packs_eight_tenants_with_parity(tmp_path):
+    """The acceptance bar: 8 concurrent small jobs, per-tenant byte
+    parity vs the sequential oracle, and the packing evidence — more
+    rows than dispatches, multiple tenants per step."""
+    spool = str(tmp_path / "spool")
+    jobs = []
+    for i in range(8):
+        p = make_corpus(str(tmp_path / f"c{i}.txt"), f"t{i}", seed=i)
+        jobs.append((f"tenant{i}", [p]))
+    d = ServeDaemon(spool, socket_path=short_sock(), max_resident=8,
+                    checkpoint_every=2, warm=False)
+    # Enqueue BEFORE the scheduler starts so the first packed step sees
+    # every tenant (deterministic packing evidence).
+    reps = [d._rpc_submit({"tenant": t, "app": "wc", "files": fs})
+            for t, fs in jobs]
+    assert all("job_id" in r for r in reps)
+    d.start()
+    try:
+        client.wait_ready(d.socket_path, timeout=120)
+        final = client.wait(d.socket_path,
+                            [r["job_id"] for r in reps], timeout=180)
+        assert all(j["state"] == "done" for j in final.values()), final
+        for (tenant, files), rep in zip(jobs, reps):
+            assert daemon_out_lines(rep["out_dir"]) == \
+                oracle_lines(files), tenant
+        st = d.packer.stats
+        assert st["packed_rows"] > st["packed_steps"] >= 1
+        assert st["max_tenants_per_step"] >= 2
+        # The statusz tenant section + metrics series render.
+        text = d._statusz_section()
+        assert "tenant=tenant0" in text and "packed_steps=" in text
+        metrics = d._metrics_section()
+        assert 'dsi_serve_tenant_steps{tenant="tenant0"}' in metrics
+        assert "dsi_serve_packed_steps" in metrics
+        # And ride the live-telemetry plane's /statusz renderer.
+        from dsi_tpu.obs.live import LiveTelemetry
+
+        page = LiveTelemetry().statusz_text()
+        assert "-- serve tenants --" in page
+        assert "tenant=tenant0" in page
+    finally:
+        d.close()
+
+
+def test_eviction_quota_parks_and_resumes(tmp_path):
+    """max_resident=2 + a 1-step quota over 4 multi-step tenants forces
+    evict → park-on-chain → resume cycles; results stay exact and the
+    per-tenant eviction/resume accounting is visible."""
+    spool = str(tmp_path / "spool")
+    jobs = []
+    for i in range(4):
+        p = make_corpus(str(tmp_path / f"c{i}.txt"), f"e{i}",
+                        words=4000, seed=i)
+        jobs.append((f"ev{i}", [p]))
+    d = ServeDaemon(spool, socket_path=short_sock(), max_resident=2,
+                    quota_steps=1, chunk_bytes=1 << 10,
+                    checkpoint_every=1, warm=False)
+    reps = [d._rpc_submit({"tenant": t, "app": "wc", "files": fs})
+            for t, fs in jobs]
+    d.start()
+    try:
+        client.wait_ready(d.socket_path, timeout=120)
+        final = client.wait(d.socket_path,
+                            [r["job_id"] for r in reps], timeout=240)
+        assert all(j["state"] == "done" for j in final.values()), final
+        for (tenant, files), rep in zip(jobs, reps):
+            assert daemon_out_lines(rep["out_dir"]) == \
+                oracle_lines(files), tenant
+        tenants = client.status(d.socket_path)["tenants"]
+        assert sum(s["evictions"] for s in tenants.values()) >= 1
+        assert sum(s["resumes"] for s in tenants.values()) >= 1
+        assert any(s["resume_gap_s"] > 0 for s in tenants.values())
+    finally:
+        d.close()
+
+
+def test_boot_hygiene_reaps_tmp_and_gcs_aged_chains(tmp_path):
+    spool = str(tmp_path / "spool")
+    jobs_dir = os.path.join(spool, "jobs")
+    tenants_dir = os.path.join(spool, "tenants")
+    os.makedirs(jobs_dir)
+    os.makedirs(os.path.join(tenants_dir, "old", "dead-000001"))
+    os.makedirs(os.path.join(tenants_dir, "live", "alive-000002"))
+    # Orphans a crashed writer would leave.
+    for p in (os.path.join(spool, ".tmp-orphan"),
+              os.path.join(jobs_dir, ".tmp-j"),
+              os.path.join(tenants_dir, "old", "dead-000001",
+                           ".tmp-state")):
+        with open(p, "w") as f:
+            f.write("junk")
+    # An aged dead chain vs a live (queued) tenant's chain.
+    old_dir = os.path.join(tenants_dir, "old", "dead-000001")
+    with open(os.path.join(old_dir, "manifest-000001.json"), "w") as f:
+        f.write("{}")
+    past = time.time() - 40 * 86400
+    os.utime(os.path.join(old_dir, "manifest-000001.json"), (past, past))
+    live_dir = os.path.join(tenants_dir, "live", "alive-000002")
+    with open(os.path.join(live_dir, "manifest-000001.json"), "w") as f:
+        f.write("{}")
+    os.utime(os.path.join(live_dir, "manifest-000001.json"),
+             (past, past))
+    from dsi_tpu.utils.atomicio import write_bytes_durable
+
+    job = {"job_id": "alive-000002", "tenant": "live", "app": "wc",
+           "files": ["/nonexistent"], "n_reduce": 10,
+           "out_dir": os.path.join(spool, "out", "alive-000002"),
+           "pattern": None, "state": "running",
+           "submitted_ts": 0, "error": None, "stats": {}}
+    write_bytes_durable(os.path.join(jobs_dir, "alive-000002.json"),
+                        json.dumps(job).encode())
+    d = ServeDaemon(spool, socket_path=short_sock(), warm=False)
+    # Never started: hygiene runs at construction.
+    assert d.boot_reaped >= 3
+    assert not os.path.exists(os.path.join(spool, ".tmp-orphan"))
+    assert not os.path.exists(old_dir)          # aged dead chain: gone
+    assert os.path.exists(live_dir)             # live chain: untouched
+    assert d.boot_gc_chains >= 1
+    d._rpc.close()
+
+
+def test_daemon_kill9_resumes_two_inflight_tenants(tmp_path):
+    """The crash contract, with a REAL ``os._exit`` (fault injection in
+    the packer's mid-fold) through the actual CLIs: two tenants
+    in flight, daemon dies mid-packed-step, a restarted daemon resumes
+    both from their chains, and both outputs byte-compare equal to the
+    sequential oracle."""
+    spool = str(tmp_path / "spool")
+    sock = short_sock()
+    corpora = []
+    for i in range(2):
+        p = make_corpus(str(tmp_path / f"k{i}.txt"), f"k{i}",
+                        words=14000, seed=i)
+        corpora.append(p)
+    env = dict(os.environ)
+    env.update({"DSI_FAULT_POINT": "mid-fold", "DSI_FAULT_STEP": "3"})
+    args = [sys.executable, "-m", "dsi_tpu.cli.mrserve",
+            "--spool", spool, "--socket", sock, "--chunk-bytes", "1024",
+            "--checkpoint-every", "1", "--no-warm"]
+    proc = subprocess.Popen(args, env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    jids = []
+    try:
+        client.wait_ready(sock, timeout=120)
+        for i, p in enumerate(corpora):
+            out = subprocess.run(
+                [sys.executable, "-m", "dsi_tpu.cli.mrsubmit",
+                 "--socket", sock, "--tenant", f"kt{i}", p],
+                capture_output=True, text=True, cwd=REPO, timeout=60)
+            assert out.returncode == 0, out.stderr
+            jids.append(json.loads(out.stdout.strip().splitlines()[0]))
+        rc = proc.wait(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert rc == 87, (rc, proc.stderr.read() if proc.stderr else "")
+
+    # Restart WITHOUT the fault: journaled jobs resume from chains.
+    env2 = dict(os.environ)
+    proc2 = subprocess.Popen(args, env=env2, cwd=REPO,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+    try:
+        client.wait_ready(sock, timeout=120)
+        final = client.wait(sock, [j["job_id"] for j in jids],
+                            timeout=240)
+        assert all(j["state"] == "done" for j in final.values()), final
+        tenants = client.status(sock)["tenants"]
+        for i in range(2):
+            assert tenants[f"kt{i}"]["resumes"] >= 1, tenants
+        for i, (p, rep) in enumerate(zip(corpora, jids)):
+            assert daemon_out_lines(rep["out_dir"]) == \
+                oracle_lines([p]), f"tenant kt{i} parity after kill -9"
+        client.shutdown(sock)
+        proc2.wait(timeout=60)
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+            proc2.wait()
+
+
+def test_submit_validation_errors():
+    d = ServeDaemon(tempfile.mkdtemp(prefix="dsi-sv-spool-"),
+                    socket_path=short_sock(), warm=False)
+    try:
+        assert "error" in d._rpc_submit({"tenant": "t", "app": "nope",
+                                         "files": ["/f"]})
+        assert "error" in d._rpc_submit({"tenant": "t", "app": "wc",
+                                         "files": []})
+        assert "error" in d._rpc_submit({"tenant": "t", "app": "wc",
+                                         "files": ["/no/such/file"]})
+        assert "error" in d._rpc_submit({"tenant": "t", "app": "grep",
+                                         "files": [__file__]})
+        assert "error" in d._rpc_submit({"tenant": "t", "app": "wc",
+                                         "files": [__file__],
+                                         "n_reduce": 3})
+    finally:
+        d._rpc.close()
