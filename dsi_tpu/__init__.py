@@ -33,4 +33,16 @@ Package layout:
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# DSI_LOCKCHECK=1: install the runtime lock-order validator BEFORE any
+# repo module creates a lock (they all import dsi_tpu first), so every
+# threading.Lock/RLock/Condition in the process feeds the acquisition-
+# order graph and an ABBA inversion raises instead of deadlocking.
+# See dsi_tpu/analysis/lockcheck.py and OPERATIONS.md.
+if _os.environ.get("DSI_LOCKCHECK") == "1":
+    from dsi_tpu.analysis.lockcheck import install as _lockcheck_install
+
+    _lockcheck_install()
+
 from dsi_tpu.mr.types import KeyValue  # noqa: F401

@@ -1,0 +1,40 @@
+"""dsi_tpu.analysis — the codebase-invariant analysis plane.
+
+Eleven PRs grew this repo from the paper's single-threaded coordinator
+loop into a system with six concurrent thread types, donated device
+buffers on every hot path, and a crash-durability protocol whose
+invariants were enforced only by reviewer memory.  This package encodes
+those invariants as machine-checked rules — the Python moral equivalent
+of 6.5840's ``go test -race`` grading gate:
+
+* :mod:`~dsi_tpu.analysis.core` — the AST rule engine: per-file
+  findings with ``file:line``, ``# dsicheck: allow[rule] <reason>``
+  suppression comments, JSON + human output (``scripts/dsicheck.py``).
+* :mod:`~dsi_tpu.analysis.rules` — the repo-specific rule catalogue:
+  ``donation-after-use`` (a buffer passed into a ``donate_argnums``
+  position must not be read afterwards — the PR-8 silent-corruption
+  shape), ``raw-write`` (durable paths go through
+  ``atomicio.write_bytes_durable``), ``lock-guard`` (attributes ever
+  mutated under their owning lock must be mutated under it everywhere),
+  ``span-discipline`` (spans are context managers with pinned
+  stage-schema names), ``metric-schema`` (engine stat keys come from
+  the one registry schema), ``jit-purity`` (no time/random/env reads
+  inside jit-compiled bodies).
+* :mod:`~dsi_tpu.analysis.lockcheck` — the RUNTIME lock-order
+  validator (``DSI_LOCKCHECK=1``): wrapped ``threading.Lock`` factories
+  maintain a per-thread held-set and a global acquisition-order graph,
+  raising :class:`~dsi_tpu.analysis.lockcheck.LockOrderError` on a
+  cycle — a scheduler×CommitWorker×sampler deadlock fails loudly
+  instead of hanging the CI smoke.
+
+The static pass runs clean on this tree (``tests/test_static_analysis
+.py`` pins that), so any new finding is a regression, not noise.  No
+third-party imports anywhere in this package: ``dsicheck`` must run in
+a bare-Python CI job with no jax/numpy installed.
+"""
+
+from dsi_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Project,
+    run_project,
+)

@@ -1,0 +1,306 @@
+"""The dsicheck rule engine: files, findings, suppressions, runner.
+
+Deliberately dependency-free (stdlib ``ast`` only): the CI job that
+gates on this runs with a bare interpreter, and the pass must stay
+usable on a box where jax is mid-outage.  Rules are small classes with
+a ``check(module, project)`` generator; the engine owns everything
+rule-agnostic — parsing, the suppression ledger, ordering, rendering —
+so a rule is only its invariant.
+
+Suppression contract (the reviewed escape hatch): a finding on line N
+is suppressed when line N *or line N-1* carries::
+
+    # dsicheck: allow[<rule-id>] <reason>
+
+``allow[all]`` suppresses every rule on that line.  The reason is not
+optional in spirit — the clean-tree test counts suppressions, so a
+bare allow is visible in review either way.  Suppressed findings are
+still collected (``--json`` shows them); only unsuppressed ones fail
+the build.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+_ALLOW_RE = re.compile(r"#\s*dsicheck:\s*allow\[([a-zA-Z0-9_,\- ]+)\]")
+
+
+@dataclass(order=True)
+class Finding:
+    """One rule violation, anchored to a source line."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = field(default=False, compare=False)
+
+    def render(self) -> str:
+        sup = "  (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}{sup}")
+
+    def as_json(self) -> Dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message,
+                "suppressed": self.suppressed}
+
+
+class SourceFile:
+    """One parsed module: AST + raw lines + the allow-comment ledger."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel  # repo-relative, forward slashes — what rules match
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        #: line -> set of allowed rule ids ("all" = wildcard).
+        self.allows: Dict[int, Set[str]] = {}
+        for lineno, rules in _scan_allows(text):
+            self.allows.setdefault(lineno, set()).update(rules)
+            # A comment-only allow line annotates the next CODE line
+            # (reason comments are encouraged to span several lines, so
+            # the anchor walks past the rest of the comment block).
+            if self._comment_only(lineno):
+                ln = lineno + 1
+                while ln <= len(self.lines) and self._comment_only(ln):
+                    ln += 1
+                if ln <= len(self.lines):
+                    self.allows.setdefault(ln, set()).update(rules)
+
+    def _comment_only(self, lineno: int) -> bool:
+        text = (self.lines[lineno - 1]
+                if 0 < lineno <= len(self.lines) else "")
+        stripped = text.strip()
+        return not stripped or stripped.startswith("#")
+
+    def allowed(self, line: int, rule: str) -> bool:
+        """True when ``line``, or a comment-only line/block ending
+        above it, carries an allow comment matching ``rule``.  A
+        trailing annotation on the previous CODE line does NOT leak
+        onto this one — each violating line needs its own decision."""
+        def match(ln: int) -> bool:
+            got = self.allows.get(ln)
+            return bool(got and (rule in got or "all" in got))
+
+        if match(line):
+            return True
+        return self._comment_only(line - 1) and match(line - 1)
+
+
+def _scan_allows(text: str) -> Iterator[tuple]:
+    """Yield (lineno, [rule, ...]) for every dsicheck allow comment.
+    Tokenize-based so a ``# dsicheck:`` inside a string literal (this
+    engine's own source, the fixtures' docstrings) is not an
+    annotation."""
+    import io
+
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ALLOW_RE.search(tok.string)
+            if m:
+                rules = [r.strip() for r in m.group(1).split(",")
+                         if r.strip()]
+                yield tok.start[0], rules
+    except tokenize.TokenError:
+        return
+
+
+class Rule:
+    """Base class: subclasses set ``rule_id``/``summary`` and implement
+    ``check``.  ``applies`` scopes a rule off specific files (e.g. the
+    raw-write rule exempts ``utils/atomicio.py`` — the implementation
+    of the discipline cannot route through itself)."""
+
+    rule_id: str = ""
+    summary: str = ""
+
+    def applies(self, rel: str) -> bool:
+        return True
+
+    def check(self, module: SourceFile,
+              project: "Project") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class Project:
+    """The scanned file set plus cross-file context (pinned constants
+    resolved from the obs schema modules)."""
+
+    def __init__(self, root: str, files: Sequence[SourceFile]):
+        self.root = root
+        self.files = list(files)
+        self._by_rel = {f.rel: f for f in self.files}
+
+    def get(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel)
+
+
+def load_files(root: str, paths: Sequence[str]
+               ) -> Tuple[List[SourceFile], List[Finding]]:
+    """Collect ``.py`` files under each path (file or directory),
+    skipping caches/build dirs, as SourceFiles.  Unparsable files are
+    reported as ``parse-error`` findings (never suppressible — a file
+    the engine cannot read is a file no rule inspected), not as an
+    exception: the CI gate must fail with a file:line, not a
+    traceback."""
+    out: List[SourceFile] = []
+    errors: List[Finding] = []
+    seen: Set[str] = set()
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap):
+            cands = [ap]
+        else:
+            cands = []
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git",
+                                            "build", ".aotcache")]
+                cands.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        for c in sorted(cands):
+            if c in seen:
+                continue
+            seen.add(c)
+            rel = os.path.relpath(c, root).replace(os.sep, "/")
+            try:
+                with open(c, encoding="utf-8") as f:
+                    text = f.read()
+                out.append(SourceFile(c, rel, text))
+            except (SyntaxError, ValueError, UnicodeDecodeError,
+                    OSError) as e:
+                line = getattr(e, "lineno", None) or 1
+                col = getattr(e, "offset", None) or 1
+                errors.append(Finding(
+                    rel, int(line), int(col), "parse-error",
+                    f"file could not be parsed "
+                    f"({type(e).__name__}: {e}) — no rule inspected "
+                    f"it"))
+    return out, errors
+
+
+def default_rules() -> List[Rule]:
+    from dsi_tpu.analysis.rules import all_rules
+
+    return all_rules()
+
+
+def run_project(root: str, paths: Sequence[str],
+                rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run every rule over every file; findings come back sorted with
+    suppression already applied (``.suppressed`` set, nothing
+    dropped).  Unparsable files surface as ``parse-error`` findings."""
+    if rules is None:
+        rules = default_rules()
+    files, findings = load_files(root, paths)
+    project = Project(root, files)
+    for mod in files:
+        for rule in rules:
+            if not rule.applies(mod.rel):
+                continue
+            for f in rule.check(mod, project):
+                f.suppressed = mod.allowed(f.line, f.rule)
+                findings.append(f)
+    findings.sort()
+    return findings
+
+
+def render_human(findings: Sequence[Finding],
+                 show_suppressed: bool = False) -> str:
+    lines = []
+    unsup = [f for f in findings if not f.suppressed]
+    sup = [f for f in findings if f.suppressed]
+    for f in unsup:
+        lines.append(f.render())
+    if show_suppressed:
+        for f in sup:
+            lines.append(f.render())
+    lines.append(f"dsicheck: {len(unsup)} finding(s), "
+                 f"{len(sup)} suppressed")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps({
+        "findings": [f.as_json() for f in findings if not f.suppressed],
+        "suppressed": [f.as_json() for f in findings if f.suppressed],
+    }, indent=1, sort_keys=True)
+
+
+# ── shared AST helpers used by several rules ───────────────────────────
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: ``open`` / ``np.savez`` /
+    ``self._lock.acquire`` -> ``open`` / ``np.savez`` /
+    ``self._lock.acquire`` (best effort; '' when not a plain name
+    chain)."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def literal(node: ast.AST):
+    """ast.literal_eval that answers None instead of raising."""
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError):
+        return None
+
+
+def module_constants(tree: ast.Module) -> Dict[str, object]:
+    """Top-level ``NAME = <literal>`` assignments — how rules resolve
+    module-level donation tuples and pinned schema constants without
+    importing (the scanned file may need jax; the scanner must not)."""
+    out: Dict[str, object] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            val = literal(node.value)
+            if val is not None:
+                out[node.targets[0].id] = val
+    return out
+
+
+def scope_nodes(scope: ast.AST, skip_classes: bool = False):
+    """Every node under ``scope`` without descending into nested
+    function scopes (and, with ``skip_classes``, class bodies) — the
+    one scope walker every rule shares, so scope-boundary semantics
+    cannot drift between rules."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if skip_classes and isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a ``self.x`` expression, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None

@@ -1,0 +1,293 @@
+"""DSI_LOCKCHECK=1 — the runtime lock-order validator.
+
+The static ``lock-guard`` rule proves mutations happen *under* their
+lock; it cannot prove two locks are always taken in the same ORDER.
+With six thread types (serve scheduler, CommitWorker, pipeline
+producer, statusz sampler, stall watchdog, RPC handlers) an ABBA
+inversion deadlocks silently — the CI smoke would hang to its timeout
+with nothing attributable.  This module is the lockdep-style dynamic
+half:
+
+* :func:`install` replaces ``threading.Lock``/``RLock`` factories with
+  tracked wrappers (``threading.Condition(tracked_lock)`` composes —
+  the wrapper exposes ``acquire``/``release``/``_is_owned``, which is
+  the whole protocol Condition needs);
+* every acquisition maintains a per-thread **held-list** and a global
+  **acquisition-order graph** whose nodes are lock *creation sites*
+  (``file:line`` — the lockdep "lock class": instances allocated at
+  one site share ordering discipline, so an inversion between two
+  instances of the same pair of classes is caught even when the exact
+  instances differ across threads);
+* an edge A→B is added when B is acquired while A is held; if B→…→A
+  already exists the acquisition **raises** :class:`LockOrderError`
+  *before blocking* — the deadlock becomes a loud traceback with both
+  chains named instead of a hang.
+
+Installed at import of :mod:`dsi_tpu` when ``DSI_LOCKCHECK=1`` (before
+any repo module creates a lock), which is how the CI daemon smoke runs
+it.  Same-site nesting (two instances of one lock class, e.g. paired
+``LatencyHistogram.merge``) is recorded but not raised on — ordering
+within a class needs an instance tiebreak the call sites own; the
+limitation is documented in DESIGN.md.
+
+Cost: one dict update + a bounded DFS per *novel* edge, a set lookup
+per repeat edge — measurable but fine for smokes and soaks; never
+enabled by default.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import _thread
+from typing import Dict, List, Optional, Set, Tuple
+
+_real_allocate = _thread.allocate_lock
+
+
+class LockOrderError(RuntimeError):
+    """An acquisition that would complete a cycle in the global
+    lock-order graph — i.e. a schedule exists where this line
+    deadlocks."""
+
+
+class _State:
+    """The global validator state (its own RAW lock: the tracking
+    machinery must never route through the wrappers it tracks)."""
+
+    def __init__(self):
+        self.mu = _real_allocate()
+        #: site -> set of sites acquired while it was held
+        self.edges: Dict[str, Set[str]] = {}
+        #: edges already checked (skip the DFS on the hot path)
+        self.seen: Set[Tuple[str, str]] = set()
+        self.tls = threading.local()
+        self.violations: List[str] = []
+        self.raise_on_cycle = True
+
+    def held(self) -> List:
+        return getattr(self.tls, "held", [])
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """A src→…→dst path in the edge graph, or None."""
+        stack = [(src, [src])]
+        visited = {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in self.edges.get(node, ()):
+                if nxt == dst:
+                    return path + [dst]
+                if nxt not in visited:
+                    visited.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def before_acquire(self, site: str) -> None:
+        held = self.held()
+        if not held:
+            return
+        for h in held:
+            a = h._site
+            if a == site:
+                continue  # same lock class: documented blind spot
+            with self.mu:
+                if (a, site) in self.seen:
+                    continue
+                back = self._path(site, a)
+                self.seen.add((a, site))
+                self.edges.setdefault(a, set()).add(site)
+            if back is not None:
+                chain = " -> ".join(back)
+                msg = (f"lock-order cycle: acquiring {site} while "
+                       f"holding {a}, but the graph already has "
+                       f"{chain} — an ABBA deadlock schedule exists "
+                       f"(held here: "
+                       f"{[x._site for x in held]})")
+                with self.mu:
+                    self.violations.append(msg)
+                print(f"lockcheck: {msg}", file=sys.stderr, flush=True)
+                if self.raise_on_cycle:
+                    raise LockOrderError(msg)
+
+    def note_acquired(self, lock) -> None:
+        held = getattr(self.tls, "held", None)
+        if held is None:
+            held = self.tls.held = []
+        held.append(lock)
+
+    def note_released(self, lock) -> None:
+        held = getattr(self.tls, "held", None)
+        if held and lock in held:
+            # remove the most recent occurrence (re-entrant RLocks pop
+            # at count zero; out-of-order releases stay correct)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is lock:
+                    del held[i]
+                    break
+
+
+_state: Optional[_State] = None
+_orig_lock = None
+_orig_rlock = None
+
+
+_THIS_FILE = os.path.abspath(__file__)
+
+
+def _caller_site() -> str:
+    """file:line of the frame that called the lock factory — the lock
+    class identity (skips this module and threading's own frames)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) != _THIS_FILE and \
+                os.path.basename(fn) != "threading.py":
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return "?:0"
+
+
+class TrackedLock:
+    """A ``threading.Lock`` stand-in that feeds the order graph."""
+
+    _reentrant = False
+
+    def __init__(self, site: Optional[str] = None):
+        self._lock = _real_allocate()
+        self._site = site or _caller_site()
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        st = _state
+        me = _thread.get_ident()
+        if st is not None and not (self._reentrant
+                                   and self._owner == me):
+            st.before_acquire(self._site)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            first = self._count == 0 or self._owner != me
+            self._owner = me
+            self._count += 1
+            if st is not None and first:
+                st.note_acquired(self)
+        return got
+
+    def release(self):
+        st = _state
+        self._count -= 1
+        if self._count <= 0:
+            self._count = 0
+            self._owner = None
+            if st is not None:
+                st.note_released(self)
+        self._lock.release()
+
+    # The protocol threading.Condition composes over.  _release_save /
+    # _acquire_restore matter for REENTRANT locks: Condition's fallback
+    # calls release() once, which on an RLock held at count > 1 leaves
+    # the underlying lock held through the wait — the validator would
+    # itself manufacture a deadlock that does not exist without it.
+    def _is_owned(self) -> bool:
+        return self._owner == _thread.get_ident()
+
+    def _release_save(self):
+        count, owner = self._count, self._owner
+        self._count = 0
+        self._owner = None
+        st = _state
+        if st is not None:
+            st.note_released(self)
+        for _ in range(count if self._reentrant else 1):
+            self._lock.release()
+        return count, owner
+
+    def _acquire_restore(self, saved):
+        count, owner = saved
+        for _ in range(count if self._reentrant else 1):
+            self._lock.acquire()
+        self._count, self._owner = count, owner
+        st = _state
+        # Re-acquisition after a wait is not a NEW ordering decision
+        # (Condition semantics: the caller logically held the lock all
+        # along), so only the held-list is restored — no order edge.
+        if st is not None:
+            st.note_acquired(self)
+
+    def locked(self) -> bool:
+        return self._count > 0
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} site={self._site} "
+                f"locked={self.locked()}>")
+
+
+class TrackedRLock(TrackedLock):
+    _reentrant = True
+
+    def __init__(self, site: Optional[str] = None):
+        # bypass the parent's plain-lock constructor path
+        self._lock = _thread.RLock()
+        self._site = site or _caller_site()
+        self._owner = None
+        self._count = 0
+
+
+def install(raise_on_cycle: bool = True) -> None:
+    """Patch the ``threading`` lock factories.  Idempotent.  Locks
+    created BEFORE install (interpreter-startup stdlib locks) stay
+    untracked — which is why ``dsi_tpu/__init__`` installs on import
+    when ``DSI_LOCKCHECK=1``, before any repo lock exists."""
+    global _state, _orig_lock, _orig_rlock
+    if _state is not None:
+        _state.raise_on_cycle = raise_on_cycle
+        return
+    _state = _State()
+    _state.raise_on_cycle = raise_on_cycle
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    threading.Lock = TrackedLock  # type: ignore[misc,assignment]
+    threading.RLock = TrackedRLock  # type: ignore[misc,assignment]
+
+
+def uninstall() -> None:
+    """Restore the real factories (tests).  Already-created tracked
+    locks keep working — their tracking calls see ``_state is None``
+    and degrade to plain locking."""
+    global _state, _orig_lock, _orig_rlock
+    if _state is None:
+        return
+    threading.Lock = _orig_lock  # type: ignore[misc]
+    threading.RLock = _orig_rlock  # type: ignore[misc]
+    _state = None
+    _orig_lock = _orig_rlock = None
+
+
+def installed() -> bool:
+    return _state is not None
+
+
+def violations() -> List[str]:
+    """Messages of every cycle detected so far (also raised unless
+    ``install(raise_on_cycle=False)``)."""
+    if _state is None:
+        return []
+    with _state.mu:
+        return list(_state.violations)
+
+
+def order_graph() -> Dict[str, Set[str]]:
+    """A copy of the acquisition-order graph (site -> successors)."""
+    if _state is None:
+        return {}
+    with _state.mu:
+        return {k: set(v) for k, v in _state.edges.items()}

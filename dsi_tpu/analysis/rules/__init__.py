@@ -1,0 +1,32 @@
+"""The dsicheck rule catalogue (one module per invariant family).
+
+Rule ids (the ``allow[...]`` vocabulary)::
+
+    donation-after-use   a donated buffer read after the donating call
+    raw-write            a write bypassing the atomicio durable path
+    lock-guard           a guarded attribute mutated outside its lock
+    span-discipline      spans not context-managed / off-schema names
+    metric-schema        engine stat keys missing from the registry
+    jit-purity           time/random/env reads inside jit bodies
+"""
+
+from typing import List
+
+from dsi_tpu.analysis.core import Rule
+from dsi_tpu.analysis.rules.donation import DonationAfterUseRule
+from dsi_tpu.analysis.rules.jitpure import JitPurityRule
+from dsi_tpu.analysis.rules.lockguard import LockGuardRule
+from dsi_tpu.analysis.rules.rawwrite import RawWriteRule
+from dsi_tpu.analysis.rules.schema import MetricSchemaRule
+from dsi_tpu.analysis.rules.spans import SpanDisciplineRule
+
+
+def all_rules() -> List[Rule]:
+    return [
+        DonationAfterUseRule(),
+        RawWriteRule(),
+        LockGuardRule(),
+        SpanDisciplineRule(),
+        MetricSchemaRule(),
+        JitPurityRule(),
+    ]

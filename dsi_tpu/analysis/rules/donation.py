@@ -1,0 +1,282 @@
+"""donation-after-use: the PR-8 silent-corruption shape, statically.
+
+``aotcache.cached_compile(donate_argnums=...)`` / ``jax.jit(...,
+donate_argnums=...)`` hand the named argument positions' buffers to the
+compiled program — after the call the caller's array aliases freed (or
+worse, recycled) device memory.  jax catches a re-DONATION ("Array has
+been deleted"); it does NOT catch a plain host-side read of a donated
+numpy buffer that the runtime already recycled — that is the
+silent-count-corruption class behind the persisted-AOT heap flake
+(CHANGES.md PR 8), which only a parity gate ever caught.
+
+The rule, per function body:
+
+1. find names bound to donating callables — ``f = cached_compile(...,
+   donate_argnums=D)`` / ``jax.jit(..., donate_argnums=D)`` (optionally
+   wrapped in ``x64_scoped``), where ``D`` is a literal tuple or a
+   module-level constant (``_TABLE_DONATE`` style); ``self.x = ...``
+   bindings are tracked class-wide the same way;
+2. at each call through such a name, the arguments in donated positions
+   that are plain names or ``self`` attributes become *consumed*;
+3. any later read of a consumed name in the same function is a finding,
+   unless an assignment re-bound it in between (the idiomatic
+   ``table = fold(table, ...)`` re-binding is the expected kill).
+
+Scope is one function body with statements in line order — the
+analysis does not chase aliases, dict-stored callables, or
+cross-function flows (the fixtures pin what it DOES catch; DESIGN.md
+documents the blind spots).  A deliberate post-donation touch must be
+annotated ``# dsicheck: allow[donation-after-use] <why it is safe>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dsi_tpu.analysis.core import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted,
+    literal,
+    module_constants,
+    self_attr,
+)
+
+#: Call targets that produce a donating callable when handed a
+#: non-empty donate_argnums.
+_FACTORIES = ("cached_compile", "aotcache.cached_compile", "jax.jit",
+              "jit")
+#: Transparent wrappers whose first argument is the real callable.
+_WRAPPERS = ("x64_scoped", "jaxcompat.x64_scoped")
+
+
+def _donate_positions(call: ast.Call,
+                      consts: Dict[str, object]) -> Optional[Tuple[int, ...]]:
+    """The donated argument indices of a factory call, resolved from a
+    literal or a module-level constant; None when absent/empty or
+    unresolvable."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        val = literal(kw.value)
+        if val is None and isinstance(kw.value, ast.Name):
+            val = consts.get(kw.value.id)
+        if val is None:
+            return None
+        if isinstance(val, int):
+            val = (val,)
+        try:
+            pos = tuple(int(v) for v in val)
+        except (TypeError, ValueError):
+            return None
+        return pos or None
+    return None
+
+
+def _unwrap(call: ast.AST) -> Optional[ast.Call]:
+    """The innermost factory call: looks through x64_scoped(...) and
+    conditional expressions (``X if donate else ()`` stays on the
+    caller)."""
+    if not isinstance(call, ast.Call):
+        return None
+    name = dotted(call.func)
+    if name.endswith(_WRAPPERS) and call.args:
+        return _unwrap(call.args[0])
+    if any(name == f or name.endswith("." + f) for f in _FACTORIES):
+        return call
+    return None
+
+
+class _FnScan:
+    """One function body's donating-call / consumed-name bookkeeping."""
+
+    def __init__(self, donating: Dict[str, Tuple[int, ...]],
+                 consts: Dict[str, object]):
+        # name -> donated positions; names are 'x' or 'self.x'.
+        self.donating = dict(donating)
+        self.consts = consts
+        # consumed name -> (line of the donating call)
+        self.consumed: Dict[str, int] = {}
+        self.findings: List[Tuple[int, int, str, str]] = []
+
+    def _key(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        attr = self_attr(node)
+        return f"self.{attr}" if attr is not None else None
+
+    def kill(self, target: ast.AST) -> None:
+        """An assignment target re-binds a name: it is fresh again."""
+        for node in ast.walk(target):
+            k = self._key(node)
+            if k is not None and isinstance(getattr(node, "ctx", None),
+                                            (ast.Store, ast.Del)):
+                self.consumed.pop(k, None)
+
+    def note_call(self, call: ast.Call) -> None:
+        # A direct factory(...)(...) immediate call donates too.
+        callee = self._key(call.func)
+        pos: Optional[Tuple[int, ...]] = None
+        if callee is not None and callee in self.donating:
+            pos = self.donating[callee]
+        else:
+            inner = _unwrap(call.func)
+            if inner is not None:
+                pos = _donate_positions(inner, self.consts)
+        if not pos:
+            return
+        for i in pos:
+            if i < len(call.args):
+                k = self._key(call.args[i])
+                if k is not None:
+                    self.consumed[k] = call.lineno
+
+    def note_read(self, node: ast.AST) -> None:
+        k = self._key(node)
+        if k is None or not isinstance(getattr(node, "ctx", None),
+                                       ast.Load):
+            return
+        at = self.consumed.get(k)
+        if at is not None and node.lineno > at:
+            self.findings.append(
+                (node.lineno, node.col_offset, k,
+                 f"`{k}` was donated to a compiled call on line {at} "
+                 f"and read again here — donated buffers must not be "
+                 f"reused (re-bind the name, copy before donating, or "
+                 f"annotate why this read is safe)"))
+            # one report per consumption, not per subsequent read
+            self.consumed.pop(k, None)
+
+
+class DonationAfterUseRule(Rule):
+    rule_id = "donation-after-use"
+    summary = ("a buffer passed in a donate_argnums position is read "
+               "after the donating call")
+
+    def check(self, module: SourceFile,
+              project: Project) -> Iterator[Finding]:
+        consts = module_constants(module.tree)
+        # Class-wide self.x -> donated positions (factory assigned to an
+        # attribute in one method, called in another).
+        class_donating: Dict[ast.ClassDef, Dict[str, Tuple[int, ...]]] = {}
+        for cls in [n for n in ast.walk(module.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            attrs: Dict[str, Tuple[int, ...]] = {}
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                inner = _unwrap(node.value)
+                if inner is None:
+                    continue
+                pos = _donate_positions(inner, consts)
+                if not pos:
+                    continue
+                for tgt in node.targets:
+                    attr = self_attr(tgt)
+                    if attr is not None:
+                        attrs[f"self.{attr}"] = pos
+            class_donating[cls] = attrs
+
+        owner: Dict[ast.AST, ast.ClassDef] = {}
+        for cls in class_donating:
+            for node in ast.walk(cls):
+                owner.setdefault(node, cls)
+
+        for fn in [n for n in ast.walk(module.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            donating = dict(class_donating.get(owner.get(fn), {}) or {})
+            # First pass: local names bound to donating factories.
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                inner = _unwrap(node.value)
+                if inner is None:
+                    continue
+                pos = _donate_positions(inner, consts)
+                if not pos:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        donating[tgt.id] = pos
+            if not donating and not any(
+                    isinstance(n, ast.Call) and _unwrap(n.func)
+                    for n in ast.walk(fn)):
+                continue
+            scan = _FnScan(donating, consts)
+            self._walk_body(fn.body, scan)
+            for line, col, _name, msg in scan.findings:
+                yield Finding(module.rel, line, col, self.rule_id, msg)
+
+    # Statement-ordered walk: reads are checked in source order, and
+    # assignment targets kill consumption AFTER their value side was
+    # checked (``x = f(x)`` donates then immediately re-binds — clean).
+    def _walk_body(self, body, scan: _FnScan) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, scan)
+
+    def _walk_stmt(self, stmt: ast.stmt, scan: _FnScan) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes analyzed on their own
+        if isinstance(stmt, ast.Assign):
+            self._walk_expr(stmt.value, scan)
+            for tgt in stmt.targets:
+                scan.kill(tgt)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._walk_expr(stmt.value, scan)
+            scan.note_read(stmt.target)  # aug-assign READS the target
+            scan.kill(stmt.target)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._walk_expr(stmt.value, scan)
+            scan.kill(stmt.target)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                scan.kill(t)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._walk_expr(stmt.test, scan)
+            self._walk_body(stmt.body, scan)
+            self._walk_body(stmt.orelse, scan)
+            return
+        if isinstance(stmt, ast.For):
+            self._walk_expr(stmt.iter, scan)
+            scan.kill(stmt.target)
+            self._walk_body(stmt.body, scan)
+            self._walk_body(stmt.orelse, scan)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._walk_expr(item.context_expr, scan)
+                if item.optional_vars is not None:
+                    scan.kill(item.optional_vars)
+            self._walk_body(stmt.body, scan)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, scan)
+            for h in stmt.handlers:
+                self._walk_body(h.body, scan)
+            self._walk_body(stmt.orelse, scan)
+            self._walk_body(stmt.finalbody, scan)
+            return
+        # Return/Expr/Raise/Assert/...: check every expression inside.
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._walk_expr(node, scan)
+
+    def _walk_expr(self, expr: ast.expr, scan: _FnScan) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                scan.note_read(node)
+        # Calls noted AFTER reads: the donating call's own arguments are
+        # legitimate reads; consumption starts on the next line.
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                scan.note_call(node)

@@ -1,0 +1,446 @@
+"""lock-guard: inferred GuardedBy checking for shared mutable state.
+
+Six thread types mutate shared dicts/deques/counters in this repo
+(serve scheduler, CommitWorker, pipeline producer, statusz sampler,
+stall watchdog, RPC handler threads); the locking convention was only
+in reviewers' heads.  This rule infers it and enforces it:
+
+1. **Lock discovery** — a class attribute assigned ``threading.Lock()``
+   / ``RLock()`` / ``Condition(...)`` is a lock; ``Condition(self.x)``
+   is an *alias* of ``x`` (the daemon's ``_wake``/``_lock`` pair, the
+   coordinator's ``_deadline_cv``/``mu`` pair acquire the same mutex).
+2. **Guarded-set inference** — every ``self.x`` the class MUTATES
+   inside a ``with self.<lock>`` block joins the lock's guarded set
+   (mutation = assign / augassign / del, subscript store, a mutating
+   method call like ``append``/``pop``/``setdefault``, or
+   ``heapq.heappush(self.x, ...)``).
+3. **Held-context inference** — a private method whose every
+   intra-class call site is lock-held is analyzed as lock-held itself
+   (fixpoint), which is exactly the repo's documented "caller holds the
+   lock" convention (``ServeDaemon._admit``, ``Coordinator._touch``);
+   ``__init__`` and private helpers reachable only from it are
+   construction-time (no other thread can hold a reference yet) and
+   exempt.
+4. **Finding** — a mutation of a guarded attribute anywhere else.
+
+The same inference runs at module level: a global assigned inside a
+``with <module-lock>:`` block is guarded; a bare assignment to it
+elsewhere (outside module top level) is a finding.
+
+Reads are deliberately NOT checked (several hot paths publish racy
+reads by design — the pipeline's in-flight deque, the histogram
+snapshot — and flagging them would bury the real signal); the runtime
+lock-order validator (``analysis/lockcheck.py``) covers the dynamic
+half.  A deliberate unlocked mutation is annotated
+``# dsicheck: allow[lock-guard] <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dsi_tpu.analysis.core import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted,
+    self_attr,
+)
+from dsi_tpu.analysis.core import scope_nodes as _core_scope_nodes
+
+_LOCK_FACTORIES = ("threading.Lock", "threading.RLock",
+                   "threading.Condition", "Lock", "RLock", "Condition")
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard",
+}
+_HEAPQ = {"heapq.heappush", "heapq.heappop", "heapq.heapify",
+          "heappush", "heappop", "heapify"}
+
+
+def _lock_factory(value: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
+    """(kind, aliased_attr) when ``value`` constructs a lock: kind is
+    the factory name; aliased_attr is the ``self.x`` a Condition wraps
+    (None for a lock of its own)."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted(value.func)
+    if name not in _LOCK_FACTORIES:
+        return None
+    alias = None
+    if name.endswith("Condition") and value.args:
+        alias = self_attr(value.args[0])
+    return name, alias
+
+
+class _Mutation:
+    __slots__ = ("attr", "line", "col", "how")
+
+    def __init__(self, attr: str, line: int, col: int, how: str):
+        self.attr, self.line, self.col, self.how = attr, line, col, how
+
+
+def _mutations_in(nodes: List[ast.AST]) -> List[_Mutation]:
+    """self-attribute mutations among ``nodes`` (non-recursive: the
+    caller hands a pre-pruned node list)."""
+    out: List[_Mutation] = []
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                out.extend(_target_mutations(tgt))
+        elif isinstance(node, ast.AugAssign):
+            out.extend(_target_mutations(node.target))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                out.extend(_target_mutations(tgt))
+        elif isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in _HEAPQ and node.args:
+                attr = self_attr(node.args[0])
+                if attr is not None:
+                    out.append(_Mutation(attr, node.lineno,
+                                         node.col_offset, name))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                attr = self_attr(node.func.value)
+                if attr is not None:
+                    out.append(_Mutation(attr, node.lineno,
+                                         node.col_offset,
+                                         f".{node.func.attr}()"))
+    return out
+
+
+def _target_mutations(tgt: ast.AST) -> List[_Mutation]:
+    out: List[_Mutation] = []
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for el in tgt.elts:
+            out.extend(_target_mutations(el))
+        return out
+    if isinstance(tgt, ast.Starred):
+        return _target_mutations(tgt.value)
+    attr = self_attr(tgt)
+    if attr is not None:
+        out.append(_Mutation(attr, tgt.lineno, tgt.col_offset, "="))
+        return out
+    if isinstance(tgt, ast.Subscript):
+        attr = self_attr(tgt.value)
+        if attr is not None:
+            out.append(_Mutation(attr, tgt.lineno, tgt.col_offset,
+                                 "[...]="))
+    return out
+
+
+def _scope_nodes(scope: ast.AST):
+    """Method-body nodes: the shared core walker, additionally pruning
+    nested class bodies (a class defined inside a method owns its own
+    lock discipline)."""
+    return _core_scope_nodes(scope, skip_classes=True)
+
+
+class _MethodInfo:
+    def __init__(self, fn: ast.FunctionDef):
+        self.fn = fn
+        self.name = fn.name
+        # Nodes inside any `with self.<lock>` region, per lock attr.
+        self.locked_nodes: Dict[str, List[ast.AST]] = {}
+        self.unlocked_nodes: List[ast.AST] = []
+        self.calls_self: List[Tuple[str, bool, Set[str]]] = []
+        # (callee, under_lock, lock_names) for self.method() calls
+
+
+class LockGuardRule(Rule):
+    rule_id = "lock-guard"
+    summary = "guarded attribute mutated outside its owning lock"
+
+    def check(self, module: SourceFile,
+              project: Project) -> Iterator[Finding]:
+        for cls in [n for n in ast.walk(module.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            yield from self._check_class(module, cls)
+        yield from self._check_module_globals(module)
+
+    # ── class-level analysis ──
+
+    def _check_class(self, module: SourceFile,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # 1. discover lock attrs + condition aliases.
+        locks: Set[str] = set()
+        alias_of: Dict[str, str] = {}
+        for fn in methods:
+            for node in _scope_nodes(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                got = _lock_factory(node.value)
+                if got is None:
+                    continue
+                _kind, aliased = got
+                for tgt in node.targets:
+                    attr = self_attr(tgt)
+                    if attr is None:
+                        continue
+                    locks.add(attr)
+                    if aliased:
+                        alias_of[attr] = aliased
+        if not locks:
+            return
+
+        def canon(lock_attr: str) -> str:
+            seen = set()
+            while lock_attr in alias_of and lock_attr not in seen:
+                seen.add(lock_attr)
+                lock_attr = alias_of[lock_attr]
+            return lock_attr
+
+        # 2. split every method into locked/unlocked regions.
+        infos: Dict[str, _MethodInfo] = {}
+        for fn in methods:
+            info = _MethodInfo(fn)
+            self._split(fn, locks, canon, info)
+            infos[fn.name] = info
+
+        # 3. infer held/init-exempt methods (fixpoint).
+        held, init_exempt = self._infer_contexts(infos)
+
+        # 4. guarded sets from locked-region mutations.
+        guarded: Dict[str, str] = {}  # attr -> lock
+        for info in infos.values():
+            regions = dict(info.locked_nodes)
+            if info.name in held:
+                regions.setdefault(held[info.name], []).extend(
+                    info.unlocked_nodes)
+            for lock, nodes in regions.items():
+                for m in _mutations_in(nodes):
+                    if m.attr not in locks:
+                        guarded.setdefault(m.attr, lock)
+        if not guarded:
+            return
+
+        # 5. findings: guarded-attr mutations in unlocked regions.
+        for info in infos.values():
+            if info.name == "__init__" or info.name in init_exempt \
+                    or info.name in held:
+                continue
+            for m in _mutations_in(info.unlocked_nodes):
+                lock = guarded.get(m.attr)
+                if lock is None:
+                    continue
+                yield Finding(
+                    module.rel, m.line, m.col, self.rule_id,
+                    f"{cls.name}.{m.attr} is guarded by self.{lock} "
+                    f"(mutated under it elsewhere) but mutated here "
+                    f"({m.how}) in {info.name}() without holding it")
+            # mutations under the WRONG lock
+            for lock, nodes in info.locked_nodes.items():
+                for m in _mutations_in(nodes):
+                    want = guarded.get(m.attr)
+                    if want is not None and want != lock:
+                        yield Finding(
+                            module.rel, m.line, m.col, self.rule_id,
+                            f"{cls.name}.{m.attr} is guarded by "
+                            f"self.{want} but mutated here under "
+                            f"self.{lock}")
+
+    def _split(self, fn: ast.AST, locks: Set[str], canon,
+               info: _MethodInfo,
+               current: Optional[str] = None) -> None:
+        """Walk one method, assigning each node to its lock region.
+        ``current`` is the canonical lock attr currently held."""
+        for stmt in (fn.body if hasattr(fn, "body") else []):
+            self._split_stmt(stmt, locks, canon, info, current)
+
+    def _split_stmt(self, stmt, locks, canon, info, current) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        entered = current
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                attr = self_attr(item.context_expr)
+                if attr is not None and attr in locks:
+                    entered = canon(attr)
+            self._bucket(stmt, info, current, items_only=True)
+            for inner in stmt.body:
+                self._split_stmt(inner, locks, canon, info, entered)
+            return
+        # Compound statements recurse so a with-block nested under an
+        # if/try keeps its region.
+        self._bucket(stmt, info, current, items_only=True)
+        for name in ("body", "orelse", "finalbody"):
+            for inner in getattr(stmt, name, []) or []:
+                self._split_stmt(inner, locks, canon, info, current)
+        for h in getattr(stmt, "handlers", []) or []:
+            for inner in h.body:
+                self._split_stmt(inner, locks, canon, info, current)
+
+    def _bucket(self, stmt, info: _MethodInfo, current: Optional[str],
+                items_only: bool = False) -> None:
+        """File the statement's own (non-block) nodes into the current
+        region and note intra-class calls."""
+        nodes: List[ast.AST] = [stmt]
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                continue
+            # ast.walk yields the child itself first — no re-append.
+            nodes.extend(n for n in ast.walk(child)
+                         if not isinstance(n, (ast.stmt,
+                                               ast.ExceptHandler)))
+        if current is not None:
+            info.locked_nodes.setdefault(current, []).extend(nodes)
+        else:
+            info.unlocked_nodes.extend(nodes)
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                attr = self_attr(node.func)
+                if attr is not None:
+                    info.calls_self.append(
+                        (attr, current is not None,
+                         {current} if current else set()))
+
+    def _infer_contexts(self, infos: Dict[str, _MethodInfo]):
+        """(held, init_exempt): held maps a private method name to the
+        lock every one of its call sites holds; init_exempt are private
+        methods reachable only from __init__/other exempt methods."""
+        # call sites per callee: (caller, under_lock, locks)
+        sites: Dict[str, List[Tuple[str, bool, Set[str]]]] = {}
+        for info in infos.values():
+            for callee, under, lks in info.calls_self:
+                if callee in infos:
+                    sites.setdefault(callee, []).append(
+                        (info.name, under, lks))
+        init_exempt: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, info in infos.items():
+                if name == "__init__" or name in init_exempt \
+                        or not name.startswith("_") \
+                        or name.startswith("__"):
+                    continue
+                callers = sites.get(name)
+                if not callers:
+                    continue
+                if all(c == "__init__" or c in init_exempt
+                       for c, _u, _l in callers):
+                    init_exempt.add(name)
+                    changed = True
+        held: Dict[str, str] = {}
+        changed = True
+        while changed:
+            changed = False
+            for name, info in infos.items():
+                if name in held or name == "__init__" \
+                        or name in init_exempt \
+                        or not name.startswith("_") \
+                        or name.startswith("__"):
+                    continue
+                callers = sites.get(name)
+                if not callers:
+                    continue
+                lock_votes: Set[str] = set()
+                ok = True
+                for caller, under, lks in callers:
+                    if caller == "__init__" or caller in init_exempt:
+                        continue  # construction-time call: no vote
+                    if under:
+                        lock_votes.update(lks)
+                    elif caller in held:
+                        lock_votes.add(held[caller])
+                    else:
+                        ok = False
+                        break
+                if ok and len(lock_votes) == 1:
+                    held[name] = next(iter(lock_votes))
+                    changed = True
+        return held, init_exempt
+
+    # ── module-level globals ──
+
+    def _check_module_globals(self,
+                              module: SourceFile) -> Iterator[Finding]:
+        tree = module.tree
+        # module-level lock names
+        locks: Set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and \
+                    _lock_factory(node.value) is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        locks.add(tgt.id)
+        if not locks:
+            return
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        guarded: Dict[str, str] = {}
+        bare: List[Tuple[str, int, int]] = []
+        for fn in funcs:
+            declared = {n for node in _scope_nodes(fn)
+                        if isinstance(node, ast.Global)
+                        for n in node.names}
+            if not declared:
+                continue
+            self._module_regions(fn, locks, declared, guarded, bare)
+        for name, line, col in bare:
+            lock = guarded.get(name)
+            if lock is not None:
+                yield Finding(
+                    module.rel, line, col, self.rule_id,
+                    f"module global `{name}` is guarded by `{lock}` "
+                    f"(assigned under it elsewhere) but assigned here "
+                    f"without holding it")
+
+    def _module_regions(self, fn, locks, declared, guarded, bare,
+                        current: Optional[str] = None) -> None:
+        for stmt in (fn.body if hasattr(fn, "body") else []):
+            entered = current
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    if isinstance(item.context_expr, ast.Name) and \
+                            item.context_expr.id in locks:
+                        entered = item.context_expr.id
+                for inner in stmt.body:
+                    self._module_stmt(inner, locks, declared, guarded,
+                                      bare, entered)
+                continue
+            self._module_stmt(stmt, locks, declared, guarded, bare,
+                              current)
+
+    def _module_stmt(self, stmt, locks, declared, guarded, bare,
+                     current) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.With):
+            entered = current
+            for item in stmt.items:
+                if isinstance(item.context_expr, ast.Name) and \
+                        item.context_expr.id in locks:
+                    entered = item.context_expr.id
+            for inner in stmt.body:
+                self._module_stmt(inner, locks, declared, guarded, bare,
+                                  entered)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id in declared:
+                    if current is not None:
+                        guarded.setdefault(tgt.id, current)
+                    else:
+                        bare.append((tgt.id, tgt.lineno,
+                                     tgt.col_offset))
+        for name in ("body", "orelse", "finalbody"):
+            for inner in getattr(stmt, name, []) or []:
+                self._module_stmt(inner, locks, declared, guarded, bare,
+                                  current)
+        for h in getattr(stmt, "handlers", []) or []:
+            for inner in h.body:
+                self._module_stmt(inner, locks, declared, guarded, bare,
+                                  current)

@@ -1,0 +1,120 @@
+"""raw-write: the durable-write discipline, statically.
+
+Every byte that must survive a crash — checkpoint payloads/manifests
+under ``--checkpoint-dir``, the serve spool journal, trace artifacts,
+the control-plane journal — goes through ``utils/atomicio`` (temp +
+fsync + rename + CRC sidecar + parent-dir fsync).  PR 5 built that
+path precisely because bare ``open(..., 'wb')`` writes had already
+shipped torn-file windows; this rule keeps the next subsystem from
+re-introducing one.
+
+Flagged, anywhere under ``dsi_tpu/`` except ``utils/atomicio.py``
+itself:
+
+* ``open(...)`` with a write-capable literal mode (any of ``w a x +``);
+* ``np.save``/``np.savez``/``np.savez_compressed`` whose target is not
+  provably an in-memory ``io.BytesIO`` (serializing into a buffer that
+  is then committed durably is the checkpoint store's own idiom).
+
+A write that is *genuinely* non-durable — rebuildable caches, bounded
+telemetry rings, best-effort markers — is annotated
+``# dsicheck: allow[raw-write] <reason>`` at the call site, which is
+exactly the reviewable inventory of "bytes we are allowed to lose"
+(today: the AOT cache entry + its execfail marker, the live.jsonl
+ring, the nfak cost cache, and the journal's append handle whose
+durability comes from its own per-record fsync discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from dsi_tpu.analysis.core import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    call_name,
+    scope_nodes as _scope_nodes,
+)
+
+_WRITE_CHARS = set("wax+")
+_NP_WRITERS = ("save", "savez", "savez_compressed")
+
+
+def _mode_of(call: ast.Call) -> str:
+    """The literal mode argument of an ``open()`` call ('' when absent
+    or not a literal — absent means 'r', non-literal is not judged)."""
+    mode_node = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if isinstance(mode_node, ast.Constant) and \
+            isinstance(mode_node.value, str):
+        return mode_node.value
+    return ""
+
+
+class RawWriteRule(Rule):
+    rule_id = "raw-write"
+    summary = "file write bypassing the atomicio durable-write path"
+
+    def applies(self, rel: str) -> bool:
+        # The discipline's implementation is the one legitimate home of
+        # raw writes.
+        return not rel.endswith("utils/atomicio.py")
+
+    def check(self, module: SourceFile,
+              project: Project) -> Iterator[Finding]:
+        for fn_body, bytesio_names in _function_scopes(module.tree):
+            for node in fn_body:
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name == "open":
+                    mode = _mode_of(node)
+                    if set(mode) & _WRITE_CHARS:
+                        yield Finding(
+                            module.rel, node.lineno, node.col_offset,
+                            self.rule_id,
+                            f"bare open(..., {mode!r}) — durable writes "
+                            f"go through atomicio.write_bytes_durable/"
+                            f"atomic_write; annotate genuinely "
+                            f"non-durable writes")
+                elif name.split(".")[-1] in _NP_WRITERS and \
+                        name.split(".")[0] in ("np", "numpy"):
+                    tgt = node.args[0] if node.args else None
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id in bytesio_names:
+                        continue  # serialize-to-buffer: durable commit
+                    yield Finding(
+                        module.rel, node.lineno, node.col_offset,
+                        self.rule_id,
+                        f"direct {name}(...) to a path — serialize into "
+                        f"io.BytesIO and commit via "
+                        f"atomicio.write_bytes_durable")
+
+
+def _function_scopes(tree: ast.Module):
+    """Yield (nodes, bytesio_names) per function scope (plus the module
+    top level), where bytesio_names are locals assigned from
+    ``io.BytesIO()`` — the allowed np.savez targets."""
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        names: Set[str] = set()
+        body_nodes = []
+        for node in _scope_nodes(scope):
+            body_nodes.append(node)
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                cn = call_name(node.value)
+                if cn in ("io.BytesIO", "BytesIO"):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+        yield body_nodes, names

@@ -1,0 +1,145 @@
+"""metric-schema: engine stat keys come from the one registry schema.
+
+Before PR 6 each engine grew its own stats spellings and every consumer
+re-learned them; ``obs/registry.py`` unified the READ side, but nothing
+stopped a new engine key from drifting in unregistered — the schema
+docstring and ``test_bench_contract.py`` were two hand-maintained
+lists.  Now the registry owns one machine-readable key set
+(``SCHEMA_KEYS`` = phases + counters + legacy spellings) and this rule
+closes the write side: every string literal used as a stats-scope key
+anywhere in the engine/device/ckpt/serve modules must be in it.
+
+A "stats scope write" is any of::
+
+    stats["k"] = / += ...        stats.setdefault("k", ...)
+    st["k"] ... self.stats["k"] ... self._stats[...]
+    _span(..., stats=stats, key="k")
+
+where the receiver is a registered scope by construction: a name
+assigned from ``metrics_scope(...)``, a parameter/attribute named
+``stats``/``_stats``/``st``/``pstats``/``wave_stats``/
+``pipeline_stats``, or ``self.stats``/``self._stats``.  Adding an
+engine key is therefore a one-line schema change in
+``obs/registry.py`` — which is exactly where the contract test and
+every consumer will see it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from dsi_tpu.analysis.core import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted,
+    self_attr,
+)
+from dsi_tpu.obs.registry import LEGACY_ALIASES, SCHEMA_KEYS
+
+#: Identifier spellings that denote an engine stats scope.
+_STATS_NAMES = {"stats", "_stats", "st", "pstats", "wave_stats",
+                "pipeline_stats"}
+
+_ALLOWED = frozenset(SCHEMA_KEYS) | frozenset(LEGACY_ALIASES)
+
+
+def _is_stats_recv(node: ast.AST, scope_names: Set[str],
+                   nonscope: Set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        if node.id in nonscope:
+            return False
+        return node.id in _STATS_NAMES or node.id in scope_names
+    attr = self_attr(node)
+    if attr is not None:
+        return attr in _STATS_NAMES
+    return False
+
+
+class MetricSchemaRule(Rule):
+    rule_id = "metric-schema"
+    summary = "stats key not in the registry schema (obs/registry.py)"
+
+    def applies(self, rel: str) -> bool:
+        # The registry defines the schema; the analysis rules and the
+        # aotcache's module-level counters are not engine scopes.
+        return not rel.endswith(("obs/registry.py",))
+
+    def check(self, module: SourceFile,
+              project: Project) -> Iterator[Finding]:
+        # Names assigned from metrics_scope(...) anywhere in the module.
+        scope_names: Set[str] = set()
+        # Module-level dict-literal globals (aotcache's process-wide
+        # cache counters) are NOT engine scopes even when they happen
+        # to be spelled `stats` — scopes are created per-run via
+        # metrics_scope().
+        nonscope: Set[str] = set()
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Dict):
+                nonscope.update(t.id for t in node.targets
+                                if isinstance(t, ast.Name))
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                cn = dotted(node.value.func)
+                if cn.endswith("metrics_scope"):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            scope_names.add(tgt.id)
+        nonscope -= scope_names
+
+        def bad(key: str) -> bool:
+            return key not in _ALLOWED
+
+        for node in ast.walk(module.tree):
+            # stats["k"] = / += / del  (Store/Del contexts only: reads
+            # of foreign dicts named `st` must not be judged)
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    _is_stats_recv(node.value, scope_names, nonscope) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                key = node.slice.value
+                if bad(key):
+                    yield self._finding(module, node, key)
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                # stats.setdefault("k", ...)
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr == "setdefault" and \
+                        _is_stats_recv(fn.value, scope_names, nonscope) and \
+                        node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    key = node.args[0].value
+                    if bad(key):
+                        yield self._finding(module, node, key)
+                # stats.update({"k": ..., ...})
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr == "update" and \
+                        _is_stats_recv(fn.value, scope_names, nonscope) and \
+                        node.args and isinstance(node.args[0], ast.Dict):
+                    for k in node.args[0].keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str) and bad(k.value):
+                            yield self._finding(module, k, k.value)
+                # _span(..., stats=X, key="k")
+                kws = {kw.arg: kw.value for kw in node.keywords}
+                if "stats" in kws and "key" in kws and \
+                        _is_stats_recv(kws["stats"], scope_names, nonscope) and \
+                        isinstance(kws["key"], ast.Constant) and \
+                        isinstance(kws["key"].value, str):
+                    key = kws["key"].value
+                    if bad(key):
+                        yield self._finding(module, node, key)
+
+    def _finding(self, module: SourceFile, node: ast.AST,
+                 key: str) -> Finding:
+        return Finding(
+            module.rel, node.lineno, node.col_offset, self.rule_id,
+            f"stats key {key!r} is not in the registry schema — add it "
+            f"to obs/registry.py SCHEMA_KEYS (one source of truth) or "
+            f"rename to a schema key")

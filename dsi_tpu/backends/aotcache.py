@@ -430,6 +430,10 @@ def _verify_first_call(exe, path: str, name: str, jitted,
                 # entry directly; a kernel edit changes the fingerprint
                 # (and the marker path) and gets a fresh chance.
                 try:
+                    # dsicheck: allow[raw-write] best-effort poison
+                    # marker: losing it to a crash only costs one
+                    # retried load; tearing it is harmless (existence
+                    # is the signal, content is diagnostic)
                     with open(path + ".execfail", "w") as f:
                         f.write(f"{type(e).__name__}: {str(e)[:200]}\n")
                 except OSError:
@@ -486,6 +490,10 @@ def _try_save(path: str, compiled, name: str) -> None:
         payload, in_tree, out_tree = serialize(compiled)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
+        # dsicheck: allow[raw-write] cache entry: temp+rename keeps it
+        # atomic; fsync durability is deliberately skipped (an entry
+        # lost to power failure recompiles; _try_load discards a
+        # corrupt one), and pickle streams too large to buffer twice
         with open(tmp, "wb") as f:
             pickle.dump((payload, in_tree, out_tree), f)
         os.replace(tmp, path)  # atomic: concurrent writers can't corrupt

@@ -117,6 +117,9 @@ class Journal:
         size = os.path.getsize(self.path) if os.path.exists(self.path) else 0
         trunc_at = getattr(self, "_trunc_at", None)
         if size > 0:
+            # dsicheck: allow[raw-write] in-place truncation IS the
+            # torn-tail repair — rewriting the whole journal through
+            # the atomic path would widen the crash window it closes
             with open(self.path, "rb+") as f:
                 if trunc_at is not None and trunc_at < size:
                     f.truncate(trunc_at)
@@ -127,6 +130,10 @@ class Journal:
                         keep = data.rfind(b"\n") + 1
                         f.truncate(keep)
                         size = keep
+        # dsicheck: allow[raw-write] append-only commit log: durability
+        # comes from the per-record fsync in _write + the parent-dir
+        # fsync below, and replay tolerates a torn tail by truncation —
+        # the rename discipline cannot express an append stream
         self._fh = open(self.path, "a")
         # Record writes fsync the FILE, but a freshly created journal's
         # directory entry was never made durable — a crash right after

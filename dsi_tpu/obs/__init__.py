@@ -37,9 +37,11 @@ from dsi_tpu.obs.hist import (
     active_histograms,
 )
 from dsi_tpu.obs.registry import (
+    COUNTER_KEYS,
     ENGINES,
     LEGACY_ALIASES,
     PHASE_KEYS,
+    SCHEMA_KEYS,
     MetricsRegistry,
     MetricsScope,
     get_registry,
@@ -47,6 +49,7 @@ from dsi_tpu.obs.registry import (
 )
 from dsi_tpu.obs.trace import (
     LANES,
+    SPAN_NAMES,
     Tracer,
     configure,
     count,
@@ -83,10 +86,13 @@ __all__ = [
     "LatencyHistogram",
     "StageHistograms",
     "active_histograms",
+    "COUNTER_KEYS",
     "LEGACY_ALIASES",
     "PHASE_KEYS",
+    "SCHEMA_KEYS",
     "MetricsRegistry",
     "MetricsScope",
+    "SPAN_NAMES",
     "Tracer",
     "configure",
     "configure_tracing",

@@ -277,6 +277,10 @@ class LiveTelemetry:
         try:
             path = os.path.join(self.live_dir, "live.jsonl")
             tmp = f"{path}.tmp-{os.getpid()}"
+            # dsicheck: allow[raw-write] bounded live ring, rewritten
+            # every sample: temp+rename keeps readers untorn; fsync on
+            # a 1 Hz telemetry loop would tax the engine for bytes
+            # that are stale one interval later by design
             with open(tmp, "w", encoding="utf-8") as f:
                 f.write("\n".join(self.ring) + "\n")
             os.replace(tmp, path)  # atomic: readers never see a torn file

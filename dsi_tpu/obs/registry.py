@@ -85,6 +85,14 @@ above, which is the view new consumers (``scripts/tracecat.py``, the
 trace-file registry snapshot, the schema contract test) use.  The
 aliases below are the complete drift list — adding an engine key that
 needs a NEW alias is a schema change and belongs in this table.
+
+Since ISSUE 12 the prose above is backed by ONE machine-readable
+tuple: :data:`SCHEMA_KEYS` (= :data:`PHASE_KEYS` +
+:data:`COUNTER_KEYS`).  The ``metric-schema`` dsicheck rule gates
+every stats-scope write against it and the bench contract test pins
+every engine's unified view inside it, so adding an engine key is
+exactly one edit here — and forgetting that edit fails both the static
+gate and tier-1.
 """
 
 from __future__ import annotations
@@ -114,6 +122,35 @@ PHASE_KEYS = (
     "sync_s", "drain_s", "widen_s", "ckpt_s", "ckpt_capture_s",
     "ckpt_commit_s", "ckpt_barrier_s",
 )
+
+#: The canonical counter/gauge keys (module docstring) — previously
+#: prose; now machine-readable because the ``metric-schema`` dsicheck
+#: rule and the bench contract test both read THIS tuple, so the
+#: docstring, the static gate, and the test cannot drift apart.
+COUNTER_KEYS = (
+    # pipeline / engine counters
+    "steps", "waves", "depth", "replays", "step_pulls", "sync_pulls",
+    "widens", "folds", "fold_overflows", "appends", "append_overflows",
+    "postings_widens", "topk_snapshots", "hist_folds", "hist_pulls",
+    "table_cap", "l_cap", "sync_every", "max_inflight",
+    "buffer_allocs", "device_accumulate", "donate_chunks", "stalls",
+    "upload_mode",
+    # checkpoint/restore
+    "ckpt_saves", "ckpt_every", "ckpt_async", "ckpt_delta",
+    "ckpt_deltas", "ckpt_full_bytes", "ckpt_delta_bytes",
+    "resume_gap_s", "resume_cursor", "resume_wave",
+    # mesh-sharded services
+    "mesh_shards", "pull_bytes", "shard_widens", "shard_imbalance",
+    "resharded_resume",
+    # serving daemon (the "serve" scope, serve/pack.py)
+    "packed_steps", "packed_rows", "max_tenants_per_step",
+    "host_fallbacks",
+)
+
+#: THE schema: every key an engine scope may carry, under its unified
+#: spelling.  Legacy spellings (LEGACY_ALIASES keys) are additionally
+#: accepted at write sites; ``unified()`` maps them here.
+SCHEMA_KEYS = PHASE_KEYS + COUNTER_KEYS
 
 #: The engine names the four streaming engines register under.
 ENGINES = ("stream", "tfidf", "grep", "indexer")

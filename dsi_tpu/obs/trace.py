@@ -68,6 +68,18 @@ LANES = (
     "counters",
 )
 
+#: The pinned span-name schema: every span opened anywhere in the repo
+#: draws its name from this set (lanes double as span names for the
+#: simple stages; the rest are the documented sub-stages).  The
+#: ``span-discipline`` rule in ``dsi_tpu/analysis`` enforces it
+#: statically, and ``scripts/tracecat.py``'s flame/straggler tables key
+#: on these names — an off-schema span would silently fall out of every
+#: rollup, so adding one is a schema change and belongs here first.
+SPAN_NAMES = frozenset(LANES) | frozenset((
+    "wait", "finish", "drain", "append", "hist_fold", "hist_pull",
+    "ckpt_capture", "ckpt_commit", "ckpt_save", "ckpt_restore", "task",
+))
+
 _BUFFER_ENV = "DSI_TRACE_BUFFER_EVENTS"
 _BUFFER_DEFAULT = 500_000
 

@@ -412,6 +412,10 @@ def _save_cost(key: str, entry: dict) -> None:
     costs[key] = entry
     tmp = _cost_path() + f".tmp{os.getpid()}"
     try:
+        # dsicheck: allow[raw-write] calibration cost cache:
+        # temp+rename for atomicity, no fsync — a lost entry just
+        # re-measures, and _save_cost already swallows OSError because
+        # persistence here is an optimization, never a failure
         with open(tmp, "w") as f:
             json.dump(costs, f, indent=1)
         os.replace(tmp, _cost_path())

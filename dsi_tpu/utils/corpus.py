@@ -19,6 +19,8 @@ from typing import List
 
 import numpy as np
 
+from dsi_tpu.utils.atomicio import atomic_write
+
 _PUNCT = np.frombuffer(b".,;:!?", dtype=np.uint8)
 
 
@@ -65,7 +67,14 @@ def generate_file(path: str, size_bytes: int, seed: int,
         else:
             pieces.append(b"\n")
     blob = b"".join(pieces)[:size_bytes]
-    with open(path, "wb") as f:
+    # Atomic commit (temp + rename, utils/atomicio): a generator killed
+    # mid-write must not leave a torn pg-*.txt that happens to pass
+    # ensure_corpus's size check on a later retry, and two processes
+    # generating the same corpus dir concurrently (bench + soak) must
+    # never interleave writes into one file.  Durability (fsync) is
+    # deliberately not needed — the corpus is deterministic from its
+    # seed and regenerates.
+    with atomic_write(path, "wb") as f:
         f.write(blob)
 
 

@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Reproducer harness for the persisted-AOT heap-corruption flake.
+
+The flake (CHANGES.md PR 8, OPERATIONS.md runbook): on some boxes a
+1-device CPU process that LOADS persisted ``dacc_*``/``stream_*`` AOT
+entries at a widen shape intermittently dies with glibc heap
+corruption (``malloc(): ... corrupted`` / segfault) — or, worse,
+silently corrupts counts, which only a parity gate catches.  Tier-1
+never hits it (multi-device processes skip persistence) and bench
+self-suppresses via its parity gate, so every occurrence so far has
+been shrugged off without attribution.
+
+This harness makes the next occurrence attributable:
+
+* rep 0 runs ``wcstream --devices 1 --device-accumulate`` with a small
+  ``--u-cap`` over a high-cardinality corpus, forcing a table widen —
+  compiling AND PERSISTING the base + widen-shape entries;
+* reps 1..N rerun the identical job, now LOADING every persisted entry
+  (the flake's trigger), under ``PYTHONMALLOC=debug`` (heap-corruption
+  checks on every malloc/free) and ``PYTHONFAULTHANDLER=1`` (a Python
+  traceback on SIGSEGV/SIGABRT), with ``--check`` as the
+  silent-corruption parity oracle;
+* every rep's stderr — including the aotcache ``loaded from <file>
+  (digest=... shapes=...)`` attribution lines — lands in the dump dir;
+  a failing rep gets a ``FAULT-<rep>.log`` naming rc, signal, and the
+  exact entries loaded, and the harness exits 1.
+
+CI runs this as an advisory (continue-on-error) job and uploads the
+dump dir, so a red run is evidence, not noise.  Locally::
+
+    python scripts/aot_flake_repro.py --reps 6 --out /tmp/aot-flake
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_corpus(path: str, mb: float) -> None:
+    """High-cardinality text: enough distinct words to force a widen
+    past the harness's small --u-cap."""
+    words = [f"w{i:05d}" for i in range(4000)]
+    line = (" ".join(words[:200]) + "\n")
+    out = []
+    total = 0
+    i = 0
+    target = int(mb * (1 << 20))
+    while total < target:
+        chunk = " ".join(words[(i * 37) % 3800:(i * 37) % 3800 + 200]) \
+            + "\n"
+        out.append(chunk)
+        total += len(chunk)
+        i += 1
+    blob = ("".join(out))[:target].encode()
+    tmp = path + f".tmp{os.getpid()}"
+    # dsicheck: allow[raw-write] harness-local corpus, regenerated per run
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def run_rep(rep: int, corpus: str, out_dir: str, cache_dir: str,
+            workdir: str, debug_malloc: bool) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DSI_AOT_CACHE_DIR": cache_dir,
+        "PYTHONFAULTHANDLER": "1",
+        # rep 0 compiles+persists; later reps must really LOAD
+        "DSI_AOT_FRESH": "0",
+    })
+    env.pop("XLA_FLAGS", None)  # 1 device: the persistence-active shape
+    if debug_malloc and rep > 0:
+        env["PYTHONMALLOC"] = "debug"
+    cmd = [sys.executable, "-m", "dsi_tpu.cli.wcstream",
+           "--devices", "1", "--chunk-bytes", "65536", "--aot",
+           "--u-cap", "512", "--device-accumulate", "--sync-every", "4",
+           "--workdir", workdir, "--check", corpus]
+    t0 = time.time()
+    p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=1200)
+    dt = round(time.time() - t0, 1)
+    with open(os.path.join(out_dir, f"rep-{rep}.stderr.log"), "w") as f:
+        # dsicheck: allow[raw-write] diagnostic dump, loss-tolerable
+        f.write(p.stderr)
+    loads = [ln for ln in p.stderr.splitlines()
+             if "loaded from" in ln and "[aotcache]" in ln]
+    sig = -p.returncode if p.returncode < 0 else None
+    rec = {"rep": rep, "rc": p.returncode, "signal": sig,
+           "seconds": dt, "aot_loads": len(loads),
+           "parity_ok": "MISMATCH" not in p.stdout + p.stderr}
+    if p.returncode != 0 or not rec["parity_ok"]:
+        fault = os.path.join(out_dir, f"FAULT-{rep}.log")
+        with open(fault, "w") as f:  # dsicheck: allow[raw-write] dump
+            f.write(f"rc={p.returncode} signal={sig} parity_ok="
+                    f"{rec['parity_ok']} seconds={dt}\n\n"
+                    f"== persisted entries loaded by this rep ==\n"
+                    + "\n".join(loads)
+                    + "\n\n== stderr tail ==\n"
+                    + "\n".join(p.stderr.splitlines()[-120:]) + "\n")
+        rec["fault_log"] = fault
+        print(f"rep {rep}: FAULT (rc={p.returncode} signal={sig} "
+              f"parity_ok={rec['parity_ok']}) -> {fault}",
+              file=sys.stderr)
+    else:
+        print(f"rep {rep}: ok rc=0 loads={len(loads)} {dt}s",
+              file=sys.stderr)
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--reps", type=int, default=4,
+                    help="loading reps after the persist rep (default 4)")
+    ap.add_argument("--mb", type=float, default=4.0,
+                    help="corpus size in MiB (default 4)")
+    ap.add_argument("--out", default="/tmp/aot-flake",
+                    help="dump directory (uploaded by CI)")
+    ap.add_argument("--no-debug-malloc", action="store_true",
+                    help="skip PYTHONMALLOC=debug (timing-sensitive "
+                         "repro attempts)")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.out, exist_ok=True)
+    cache_dir = os.path.join(args.out, "aotcache")
+    workdir = os.path.join(args.out, "wd")
+    os.makedirs(workdir, exist_ok=True)
+    corpus = os.path.join(args.out, "corpus.txt")
+    make_corpus(corpus, args.mb)
+
+    reps = []
+    failed = False
+    for rep in range(args.reps + 1):
+        rec = run_rep(rep, corpus, args.out, cache_dir, workdir,
+                      debug_malloc=not args.no_debug_malloc)
+        reps.append(rec)
+        if rep == 0 and rec["rc"] != 0:
+            print("rep 0 (persist pass) failed — environment problem, "
+                  "not the flake; aborting", file=sys.stderr)
+            failed = True
+            break
+        if rep > 0 and rec["aot_loads"] == 0:
+            print(f"rep {rep}: WARNING: no persisted loads happened — "
+                  f"the trigger is not being exercised", file=sys.stderr)
+        failed = failed or rec["rc"] != 0 or not rec["parity_ok"]
+    summary = {"failed": failed, "reps": reps}
+    with open(os.path.join(args.out, "summary.json"), "w") as f:
+        # dsicheck: allow[raw-write] diagnostic dump, loss-tolerable
+        json.dump(summary, f, indent=1)
+    print(json.dumps({"aot_flake_failed": failed,
+                      "reps": len(reps),
+                      "out": args.out}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""dsicheck — the repo's codebase-invariant static analysis gate.
+
+Runs the ``dsi_tpu/analysis`` rule engine over the tree (default:
+the ``dsi_tpu`` package) and exits non-zero on any unsuppressed
+finding.  No jax/numpy required — safe as a bare-interpreter CI job
+and during accelerator outages.
+
+    python scripts/dsicheck.py                 # the tier-1 gate
+    python scripts/dsicheck.py --json          # machine output
+    python scripts/dsicheck.py --rules lock-guard,raw-write path/
+    python scripts/dsicheck.py --list-rules
+    python scripts/dsicheck.py --show-suppressed
+
+Suppression: ``# dsicheck: allow[<rule>] <reason>`` on the finding's
+line or the line above (``allow[all]`` for every rule).  Policy in
+DESIGN.md "Static analysis": a suppression must say WHY the invariant
+does not apply — the clean-tree test keeps the suppressed inventory
+visible in review.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dsi_tpu.analysis import core  # noqa: E402
+from dsi_tpu.analysis.rules import all_rules  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dsicheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO, "dsi_tpu")],
+                    help="files/dirs to scan (default: dsi_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.rule_id:<20} {r.summary}")
+        return 0
+    if args.rules:
+        want = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = want - {r.rule_id for r in rules}
+        if unknown:
+            print(f"dsicheck: unknown rule(s): {sorted(unknown)} "
+                  f"(--list-rules)", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.rule_id in want]
+
+    findings = core.run_project(REPO, args.paths, rules)
+    if args.json:
+        print(core.render_json(findings))
+    else:
+        print(core.render_human(findings,
+                                show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""Known-bad: donated buffers read after the donating call."""
+
+from dsi_tpu.backends.aotcache import cached_compile
+
+_TABLE_DONATE = (0, 1)
+
+
+def local_factory_read_after_donate(chunk, table, impl):
+    fold = cached_compile("fold", impl, (table, chunk),
+                          donate_argnums=(0,))
+    out = fold(table, chunk)
+    return table.sum(), out  # EXPECT: donation-after-use
+
+
+def module_constant_positions(rows, nus, impl):
+    fold = cached_compile("fold2", impl, (rows, nus),
+                          donate_argnums=_TABLE_DONATE)
+    fold(rows, nus)
+    nus = 0                # re-bound from scratch: clean
+    return rows[0], nus    # EXPECT: donation-after-use
+
+
+def rebinding_is_clean(table, chunk, impl):
+    fold = cached_compile("fold3", impl, (table, chunk),
+                          donate_argnums=(0,))
+    table = fold(table, chunk)   # the idiomatic kill
+    return table.sum()           # clean: re-bound name
+
+
+class AttrDonor:
+    def __init__(self, impl, rows):
+        self._fold = cached_compile("fold4", impl, (rows,),
+                                    donate_argnums=(0,))
+        self.rows = rows
+
+    def step(self):
+        out = self._fold(self.rows)
+        return self.rows.sum(), out  # EXPECT: donation-after-use

@@ -1,0 +1,37 @@
+"""Known-bad: nondeterminism inside jit-compiled bodies."""
+
+import os
+import random
+import time
+
+import jax
+import numpy as np
+
+from dsi_tpu.backends.aotcache import cached_compile
+
+
+@jax.jit
+def decorated_impure(x):
+    t = time.perf_counter()  # EXPECT: jit-purity
+    return x + t
+
+
+def _step_impl(x):
+    if os.environ.get("DSI_FAST"):  # EXPECT: jit-purity
+        return x
+    return x + random.random()  # EXPECT: jit-purity
+
+
+def build(example):
+    return cached_compile("step", _step_impl, (example,))
+
+
+def _noise_impl(x):
+    return x + np.random.rand()  # EXPECT: jit-purity
+
+
+_noise = jax.jit(_noise_impl)
+
+
+def host_side_is_clean():
+    return time.perf_counter()  # clean: not a jit target

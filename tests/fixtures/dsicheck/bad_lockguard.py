@@ -1,0 +1,50 @@
+"""Known-bad: guarded state mutated outside its owning lock."""
+
+import heapq
+import threading
+
+_lock = threading.Lock()
+_active = None
+
+
+def set_active(v):
+    global _active
+    with _lock:
+        _active = v
+
+
+def clear_active():
+    global _active
+    _active = None  # EXPECT: lock-guard (module global)
+
+
+class Scheduler:
+    def __init__(self):
+        self.mu = threading.Lock()
+        self._wake = threading.Condition(self.mu)
+        self._queue = []
+        self._jobs = {}
+        self._seq = 0
+        self._boot()  # construction-time helper: exempt
+
+    def _boot(self):
+        self._jobs["seed"] = 1  # clean: reachable only from __init__
+
+    def submit(self, job):
+        with self._wake:  # Condition aliases mu: counts as holding it
+            self._seq += 1
+            self._jobs[job] = self._seq
+            heapq.heappush(self._queue, job)
+
+    def _admit(self):
+        # every call site holds the lock -> analyzed as lock-held
+        self._jobs.pop("seed", None)
+
+    def scheduler_loop(self):
+        with self.mu:
+            self._admit()
+        self._seq += 1  # EXPECT: lock-guard (unlocked counter bump)
+        self._queue.append("x")  # EXPECT: lock-guard (unlocked mutator)
+
+    def racy_drain(self):
+        heapq.heappop(self._queue)  # EXPECT: lock-guard (heapq escape)

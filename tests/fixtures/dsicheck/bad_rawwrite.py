@@ -1,0 +1,30 @@
+"""Known-bad: writes bypassing the atomicio durable path."""
+
+import io
+
+import numpy as np
+
+
+def torn_manifest(path, payload):
+    with open(path, "wb") as f:  # EXPECT: raw-write
+        f.write(payload)
+
+
+def appender(path):
+    f = open(path, mode="a")  # EXPECT: raw-write
+    f.write("x\n")
+
+
+def direct_savez(path, arrays):
+    np.savez(path, **arrays)  # EXPECT: raw-write
+
+
+def buffered_savez_is_clean(arrays):
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)  # clean: serialize-to-buffer idiom
+    return buf.getvalue()
+
+
+def reading_is_clean(path):
+    with open(path) as f:  # clean: default mode 'r'
+        return f.read()

@@ -1,0 +1,14 @@
+"""Known-bad: engine stat keys drifting out of the registry schema."""
+
+from dsi_tpu.obs import metrics_scope, span as _span
+
+
+def engine_run():
+    stats = metrics_scope("stream")
+    stats["steps"] = 0                    # clean: schema key
+    stats["step_throughputz"] = 1.0       # EXPECT: metric-schema
+    stats.setdefault("batch_s", 0.0)      # clean: legacy alias
+    stats.setdefault("warmup_fraction", 0)  # EXPECT: metric-schema
+    with _span("kernel", stats=stats, key="kernal_s"):  # EXPECT: metric-schema
+        pass
+    return stats

@@ -1,0 +1,24 @@
+"""Known-bad: span-discipline violations."""
+
+from dsi_tpu.obs import span as _span
+
+
+def leaked_span(stats):
+    sp = _span("upload", stats=stats, key="upload_s")  # EXPECT: span-discipline
+    sp.__enter__()
+    return sp
+
+
+def off_schema_name(stats):
+    with _span("uplaod", stats=stats, key="upload_s"):  # EXPECT: span-discipline
+        pass
+
+
+def off_taxonomy_lane():
+    with _span("fold", lane="device-stuff"):  # EXPECT: span-discipline
+        pass
+
+
+def clean(stats):
+    with _span("kernel", stats=stats, key="kernel_s"):
+        pass

@@ -1,0 +1,20 @@
+"""Fixture proving the ``# dsicheck: allow[...]`` escape hatch: every
+violation here is annotated, so the engine reports them only as
+suppressed."""
+
+
+def annotated_same_line(path, payload):
+    with open(path, "wb") as f:  # dsicheck: allow[raw-write] fixture
+        f.write(payload)
+
+
+def annotated_block_above(path, payload):
+    # dsicheck: allow[raw-write] multi-line reason comments anchor to
+    # the next code line, so the reason can actually explain itself
+    with open(path, "wb") as f:
+        f.write(payload)
+
+
+def annotated_wildcard(path):
+    f = open(path, "a")  # dsicheck: allow[all] wildcard escape
+    return f

@@ -163,8 +163,8 @@ def test_engine_phase_dicts_come_from_the_registry(tmp_path):
     The alias table is closed: a legacy spelling surviving into the
     unified view, or a brand-new drift key, fails here."""
     jax = pytest.importorskip("jax")
-    from dsi_tpu.obs.registry import (LEGACY_ALIASES, MetricsScope,
-                                      get_registry)
+    from dsi_tpu.obs.registry import (LEGACY_ALIASES, SCHEMA_KEYS,
+                                      MetricsScope, get_registry)
     from dsi_tpu.parallel.grepstream import (grep_streaming,
                                              indexer_streaming)
     from dsi_tpu.parallel.shuffle import default_mesh
@@ -200,6 +200,13 @@ def test_engine_phase_dicts_come_from_the_registry(tmp_path):
             assert key in u, (engine, key)
         # No legacy spelling leaks through the unified view.
         assert not (set(LEGACY_ALIASES) & set(u)), (engine, u)
+        # ONE source of truth (ISSUE 12): every unified key an engine
+        # actually reports is in the registry's machine-readable
+        # schema — the same tuple the dsicheck metric-schema rule
+        # gates writes against, so this list and the static gate
+        # cannot drift apart.
+        drift = set(u) - set(SCHEMA_KEYS)
+        assert not drift, (engine, sorted(drift))
     # The registry snapshot (embedded in trace artifacts) carries all
     # four engines under the same shape.
     snap = reg.snapshot()["engines"]
@@ -249,6 +256,25 @@ def test_mesh_shard_keys_reconcile_with_span_totals(tmp_path):
     assert fold_spans and all(e[2] == "shuffle" for e in fold_spans)
     assert sum(e[4] for e in fold_spans) == pytest.approx(
         pstats["fold_s"], rel=0.05, abs=0.05)
+
+
+def test_schema_is_single_sourced():
+    """The registry's SCHEMA_KEYS is THE schema: it contains every
+    phase key and every alias target, has no duplicates, and the
+    dsicheck metric-schema rule reads the very same tuple — so adding
+    an engine key is exactly one edit in obs/registry.py."""
+    from dsi_tpu.analysis.rules import schema as schema_rule
+    from dsi_tpu.obs.registry import (COUNTER_KEYS, LEGACY_ALIASES,
+                                      PHASE_KEYS, SCHEMA_KEYS)
+
+    assert set(PHASE_KEYS) <= set(SCHEMA_KEYS)
+    assert set(COUNTER_KEYS) <= set(SCHEMA_KEYS)
+    assert len(SCHEMA_KEYS) == len(set(SCHEMA_KEYS)), "duplicate keys"
+    # every legacy spelling maps INTO the schema, never out of it
+    assert set(LEGACY_ALIASES.values()) <= set(SCHEMA_KEYS)
+    # the static gate accepts exactly schema + legacy spellings
+    assert schema_rule._ALLOWED == \
+        frozenset(SCHEMA_KEYS) | frozenset(LEGACY_ALIASES)
 
 
 def test_histogram_keys_pinned_in_registry_schema():

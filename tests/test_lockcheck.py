@@ -1,0 +1,174 @@
+"""Runtime lock-order validator (dsi_tpu/analysis/lockcheck.py).
+
+The acceptance bar: a synthetic ABBA deadlock is caught (raised before
+blocking, both chains named), the daemon's real lock idioms — Condition
+built over a tracked Lock, cv.wait with timeout, RLock reentrancy,
+stdlib queue — all compose cleanly, and the coordinator's full
+lock/condvar machinery runs green under the validator (the in-process
+twin of the CI serve smoke's ``DSI_LOCKCHECK=1``)."""
+
+import threading
+import time
+
+import pytest
+
+from dsi_tpu.analysis import lockcheck
+
+
+@pytest.fixture()
+def tracked():
+    lockcheck.install()
+    try:
+        yield
+    finally:
+        lockcheck.uninstall()
+
+
+def test_abba_cycle_raises_before_blocking(tracked):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:  # establishes A -> B
+            pass
+    with b:
+        with pytest.raises(lockcheck.LockOrderError) as ei:
+            b2 = a  # the inversion: B held, acquiring A
+            b2.acquire()
+    msg = str(ei.value)
+    assert "cycle" in msg and "->" in msg
+    assert lockcheck.violations(), "violation not recorded"
+    # Single-threaded throughout: the validator flags the SCHEDULE
+    # hazard, it does not need the deadlock to actually happen.
+
+
+def test_consistent_order_never_flags(tracked):
+    a = threading.Lock()
+    b = threading.Lock()
+    c = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                with c:
+                    pass
+    assert lockcheck.violations() == []
+    g = lockcheck.order_graph()
+    assert any(g.values()), "edges should have been recorded"
+
+
+def test_condition_wait_and_rlock_compose(tracked):
+    mu = threading.Lock()
+    cv = threading.Condition(mu)
+    hits = []
+
+    def waiter():
+        with cv:
+            while not hits:
+                if cv.wait(timeout=5.0):
+                    break
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cv:
+        hits.append(1)
+        cv.notify_all()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    # cv.wait released the tracked lock: this thread could take it.
+    r = threading.RLock()
+    with r:
+        with r:  # reentrant: no self-deadlock, no bogus edge
+            pass
+    assert lockcheck.violations() == []
+
+
+def test_condition_wait_over_reentrant_rlock_fully_releases(tracked):
+    """Regression (review finding): Condition.wait over an RLock held
+    at count 2 must release ALL levels — without _release_save/
+    _acquire_restore on the wrapper, Condition's fallback released one
+    level, the underlying lock stayed held through the wait, and the
+    validator itself manufactured a deadlock."""
+    cv = threading.Condition(threading.RLock())
+    hits = []
+
+    def waiter():
+        with cv:
+            with cv:  # re-entrant: count 2 at the wait
+                while not hits:
+                    if cv.wait(timeout=5.0):
+                        break
+                hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    notified = False
+    while time.monotonic() < deadline:
+        # the notifier must be able to take the lock DURING the wait
+        if cv.acquire(timeout=0.1):
+            try:
+                hits.append(1)
+                cv.notify_all()
+                notified = True
+            finally:
+                cv.release()
+            break
+    t.join(timeout=10.0)
+    assert notified, "underlying RLock stayed held through cv.wait"
+    assert not t.is_alive() and "woke" in hits
+    assert lockcheck.violations() == []
+
+
+def test_same_site_nesting_is_not_a_cycle(tracked):
+    def make():
+        return threading.Lock()  # one creation site, many instances
+
+    x, y = make(), make()
+    with x:
+        with y:  # same lock class nested: recorded, never raised
+            pass
+    with y:
+        with x:
+            pass
+    assert lockcheck.violations() == []
+
+
+def test_uninstall_restores_and_tracked_locks_degrade(tracked):
+    held_before = threading.Lock()
+    lockcheck.uninstall()
+    assert not lockcheck.installed()
+    # a wrapper created while installed still locks correctly
+    with held_before:
+        assert held_before.locked()
+    assert not held_before.locked()
+    lockcheck.install()  # the fixture's uninstall stays balanced
+
+
+def test_coordinator_runs_green_under_validator(tracked, tmp_path):
+    """The real control plane (mu + deadline Condition + watchdog
+    thread + journal) under the validator: assignment, completion,
+    requeue arming, and close() must produce zero violations — the
+    in-process twin of the CI daemon smoke's DSI_LOCKCHECK=1."""
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr.coordinator import Coordinator
+
+    files = [str(tmp_path / f"in-{i}.txt") for i in range(3)]
+    for f in files:
+        open(f, "w").write("a b c\n")  # dsicheck: allow[raw-write] test input
+    c = Coordinator(files, n_reduce=2,
+                    config=JobConfig(n_reduce=2, task_timeout_s=30.0,
+                                     workdir=str(tmp_path)))
+    try:
+        for i in range(3):
+            r = c.request_task({"WorkerId": "w0"})
+            assert r["TaskStatus"] == 0
+            c.map_complete({"TaskNumber": r["CMap"], "WorkerId": "w0"})
+        for i in range(2):
+            r = c.request_task({"WorkerId": "w0"})
+            assert r["TaskStatus"] == 1
+            c.reduce_complete({"TaskNumber": r["CReduce"],
+                               "WorkerId": "w0"})
+        assert c.done()
+        assert c.straggler_suspects() == {}
+    finally:
+        c.close()
+    assert lockcheck.violations() == []
