@@ -14,7 +14,15 @@ itself:
 * ``open(...)`` with a write-capable literal mode (any of ``w a x +``);
 * ``np.save``/``np.savez``/``np.savez_compressed`` whose target is not
   provably an in-memory ``io.BytesIO`` (serializing into a buffer that
-  is then committed durably is the checkpoint store's own idiom).
+  is then committed durably is the checkpoint store's own idiom —
+  since ISSUE 13 the store's compressed-delta path is
+  ``np.savez_compressed(BytesIO)``, recognized the same way).  A
+  BytesIO target is recognized as a plain/annotated/walrus-assigned
+  local or the inline ``np.savez*(io.BytesIO(), ...)`` spelling.
+
+The parallel ingest pool (``utils/ioread.py``) needs no exemption by
+construction: it is mmap ``ACCESS_READ`` + read-mode fallbacks only —
+there are no temp spools to lose.
 
 A write that is *genuinely* non-durable — rebuildable caches, bounded
 telemetry rings, best-effort markers — is annotated
@@ -90,6 +98,9 @@ class RawWriteRule(Rule):
                     if isinstance(tgt, ast.Name) and \
                             tgt.id in bytesio_names:
                         continue  # serialize-to-buffer: durable commit
+                    if isinstance(tgt, ast.Call) and \
+                            call_name(tgt) in ("io.BytesIO", "BytesIO"):
+                        continue  # inline buffer: same idiom
                     yield Finding(
                         module.rel, node.lineno, node.col_offset,
                         self.rule_id,
@@ -100,21 +111,30 @@ class RawWriteRule(Rule):
 
 def _function_scopes(tree: ast.Module):
     """Yield (nodes, bytesio_names) per function scope (plus the module
-    top level), where bytesio_names are locals assigned from
-    ``io.BytesIO()`` — the allowed np.savez targets."""
+    top level), where bytesio_names are locals bound to
+    ``io.BytesIO()`` — plain, annotated, or walrus assignment — the
+    allowed np.savez targets."""
     scopes = [tree] + [n for n in ast.walk(tree)
                        if isinstance(n, (ast.FunctionDef,
                                          ast.AsyncFunctionDef))]
     for scope in scopes:
         names: Set[str] = set()
         body_nodes = []
+
+        def note(target, value):
+            if isinstance(value, ast.Call) and \
+                    call_name(value) in ("io.BytesIO", "BytesIO") and \
+                    isinstance(target, ast.Name):
+                names.add(target.id)
+
         for node in _scope_nodes(scope):
             body_nodes.append(node)
-            if isinstance(node, ast.Assign) and \
-                    isinstance(node.value, ast.Call):
-                cn = call_name(node.value)
-                if cn in ("io.BytesIO", "BytesIO"):
-                    for tgt in node.targets:
-                        if isinstance(tgt, ast.Name):
-                            names.add(tgt.id)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    note(tgt, node.value)
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                note(node.target, node.value)
+            elif isinstance(node, ast.NamedExpr):
+                note(node.target, node.value)
         yield body_nodes, names

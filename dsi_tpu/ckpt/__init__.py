@@ -61,6 +61,7 @@ from dsi_tpu.ckpt.delta import (
 from dsi_tpu.ckpt.policy import (
     CheckpointPolicy,
     checkpoint_async_default,
+    checkpoint_compress_default,
     checkpoint_delta_default,
     checkpoint_every_default,
     checkpoint_rebase_default,
@@ -87,6 +88,7 @@ __all__ = [
     "FAULT_POINTS",
     "FaultInjected",
     "checkpoint_async_default",
+    "checkpoint_compress_default",
     "checkpoint_delta_default",
     "checkpoint_every_default",
     "checkpoint_rebase_default",

@@ -88,6 +88,38 @@ def checkpoint_delta_default(flag: bool | None = None) -> bool:
     return bool(flag)
 
 
+_CKPT_COMPRESS_ENV = "DSI_STREAM_CKPT_COMPRESS"
+#: Which checkpoint payload kinds are zlib-compressed
+#: (``np.savez_compressed`` through the store's BytesIO
+#: serialize-then-commit idiom — the durable path is untouched).
+#: Default ``deltas``: delta payloads are written at cadence (every
+#: save on a delta chain) and their packed word tables compress 2-5x,
+#: while full images are the latency-sensitive sync-save path, so they
+#: stay raw unless ``all`` is asked for.
+_CKPT_COMPRESS_DEFAULT = "deltas"
+_CKPT_COMPRESS_MODES = ("off", "deltas", "all")
+
+
+def checkpoint_compress_default(mode: str | None = None) -> str:
+    """Resolve the payload-compression mode — one of ``off`` (every
+    payload raw npz, the pre-ISSUE-13 bytes), ``deltas`` (default:
+    ``delta-<seq>.npz`` compressed, full images raw), ``all``: explicit
+    wins, else ``DSI_STREAM_CKPT_COMPRESS`` with the historical bool
+    spellings accepted (``0``/``off``/``false`` → off, ``1``/``on`` →
+    deltas)."""
+    if mode is None:
+        mode = os.environ.get(_CKPT_COMPRESS_ENV,
+                              _CKPT_COMPRESS_DEFAULT)
+    m = str(mode).strip().lower()
+    if m in ("0", "off", "false", "no", "none"):
+        return "off"
+    if m in ("1", "on", "true", "yes", "delta", "deltas"):
+        return "deltas"
+    if m in ("2", "all", "full"):
+        return "all"
+    return _CKPT_COMPRESS_DEFAULT
+
+
 def checkpoint_rebase_default(every: int | None = None) -> int:
     """Resolve the rebase cadence — every Nth save is a full image,
     i.e. up to ``N - 1`` deltas chain between fulls: explicit wins,

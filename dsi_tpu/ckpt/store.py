@@ -23,6 +23,16 @@ The manifest carries the job identity (engine name + the shape knobs
 that change byte layout); resuming against a different job is refused
 rather than silently corrupting state — the journal-header rule.
 
+Payloads may be COMPRESSED (``DSI_STREAM_CKPT_COMPRESS``, ISSUE 13 —
+default ``deltas``): the serialize step swaps ``np.savez`` for
+``np.savez_compressed`` into the same ``BytesIO``, so the durable
+commit path (CRC sidecar, tmp+fsync+rename) and every loader are
+byte-for-byte unchanged — ``np.load`` reads both flavors, mixed
+chains restore fine, and the mode is deliberately NOT part of the job
+identity.  ``last_payload_raw_bytes``/``last_compress_s`` feed the
+``ckpt_delta_raw_bytes``/``ckpt_compress_s`` attribution through the
+writer.
+
 ## Delta chains (incremental snapshots)
 
 A checkpoint may be INCREMENTAL: ``save_delta`` writes a
@@ -48,11 +58,13 @@ import io
 import json
 import os
 import re
+import time
 import zlib
 from typing import Dict, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from dsi_tpu.ckpt.policy import checkpoint_compress_default
 from dsi_tpu.obs import trace_event as _trace_event
 from dsi_tpu.utils.atomicio import (
     read_bytes_verified,
@@ -69,6 +81,34 @@ _MANIFEST_RE = re.compile(r"^manifest-(\d{6})\.json$")
 
 class CheckpointMismatch(RuntimeError):
     """A valid checkpoint exists but belongs to a different job."""
+
+
+def _zlevel() -> int:
+    """Deflate level for compressed payloads (``DSI_STREAM_CKPT_ZLEVEL``,
+    default 1): on the 2-core boxes the CommitWorker shares with the
+    engine, level 1 keeps ~85% of level 6's ratio at ~1/3 the CPU —
+    cadence-1 overhead stays flat while the bytes still drop 2-5x."""
+    try:
+        return min(9, max(1, int(os.environ.get("DSI_STREAM_CKPT_ZLEVEL",
+                                                "1"))))
+    except ValueError:
+        return 1
+
+
+def _write_npz_compressed(buf, arrays: Dict[str, np.ndarray]) -> None:
+    """``np.savez_compressed`` with a CHOSEN deflate level (numpy
+    hardcodes the zlib default): same zip-of-.npy container, so
+    ``np.load`` reads it identically and mixed chains stay readable."""
+    import zipfile
+
+    from numpy.lib import format as npformat
+
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED,
+                         compresslevel=_zlevel()) as zf:
+        for k, v in arrays.items():
+            with zf.open(k + ".npy", "w", force_zip64=True) as f:
+                npformat.write_array(f, np.asarray(v),
+                                     allow_pickle=False)
 
 
 def skip_stream(blocks: Iterable[bytes], skip: int) -> Iterator[bytes]:
@@ -91,7 +131,8 @@ class CheckpointStore:
     """Save/load numbered (payload, manifest) checkpoint pairs in one
     directory, newest-valid-wins, last two retained."""
 
-    def __init__(self, directory: str, engine: str, job: Dict):
+    def __init__(self, directory: str, engine: str, job: Dict,
+                 compress: Optional[str] = None):
         self.dir = directory
         self.engine = engine
         #: The identity a checkpoint must match to be resumable: every
@@ -99,9 +140,22 @@ class CheckpointStore:
         #: mesh width, reduce count, pattern, ...).  JSON-normalised so
         #: tuple-vs-list spelling differences can't refuse a real match.
         self.job = json.loads(json.dumps(job))
+        #: Payload-compression mode (``ckpt/policy.py
+        #: checkpoint_compress_default``: off / deltas / all).  Purely a
+        #: serialization choice — ``np.load`` reads both npz flavors, so
+        #: mixed chains restore fine and the mode is NOT part of the job
+        #: identity.
+        self.compress = checkpoint_compress_default(compress)
         #: Serialized payload size of the most recent save — the bench's
         #: delta-vs-full bytes evidence rides this through the writer.
         self.last_payload_bytes = 0
+        #: Raw array bytes behind the most recent payload (sum of
+        #: ``nbytes`` — the compression ratio's denominator) and the
+        #: seconds the compressing serialize spent (0.0 for a raw save);
+        #: the writer maps these to ``ckpt_delta_raw_bytes`` /
+        #: ``ckpt_compress_s``.
+        self.last_payload_raw_bytes = 0
+        self.last_compress_s = 0.0
         os.makedirs(self.dir, exist_ok=True)
 
     # ── paths ──
@@ -172,7 +226,20 @@ class CheckpointStore:
         seqs = self._seqs()
         seq = (seqs[-1] + 1) if seqs else 1
         buf = io.BytesIO()
-        np.savez(buf, **arrays)
+        compress = (self.compress == "all"
+                    or (self.compress == "deltas" and kind == "delta"))
+        if compress:
+            # Same serialize-then-commit idiom, deflated payload; with
+            # --ckpt-async this runs on the CommitWorker, so the
+            # compression wall never lands on the engine thread.
+            t0 = time.perf_counter()
+            _write_npz_compressed(buf, arrays)
+            self.last_compress_s = time.perf_counter() - t0
+        else:
+            np.savez(buf, **arrays)
+            self.last_compress_s = 0.0
+        self.last_payload_raw_bytes = sum(
+            int(np.asarray(v).nbytes) for v in arrays.values())
         payload = buf.getvalue()
         path = (self._delta_path(seq) if kind == "delta"
                 else self._payload_path(seq))

@@ -58,8 +58,11 @@ class CheckpointWriter:
     every commit advances the window — one implementation instead of
     four per-engine copies.  ``stats`` receives
     ``ckpt_saves``/``ckpt_deltas``, ``ckpt_commit_s``/
-    ``ckpt_barrier_s``, and the ``ckpt_full_bytes``/
-    ``ckpt_delta_bytes`` payload totals the bench's delta A/B reads."""
+    ``ckpt_barrier_s``, the ``ckpt_full_bytes``/``ckpt_delta_bytes``
+    payload totals the bench's delta A/B reads, and the compression
+    attribution (``ckpt_compress`` mode, ``ckpt_delta_raw_bytes``
+    uncompressed denominator, ``ckpt_compress_s`` zlib wall — on the
+    worker thread under async, exactly like ``ckpt_commit_s``)."""
 
     def __init__(self, store: CheckpointStore, stats: dict,
                  async_: bool = False, delta: bool = False,
@@ -78,10 +81,12 @@ class CheckpointWriter:
         if self.async_:
             self._worker = CommitWorker(name="dsi-ckpt-writer")
         for key in ("ckpt_saves", "ckpt_deltas", "ckpt_full_bytes",
-                    "ckpt_delta_bytes"):
+                    "ckpt_delta_bytes", "ckpt_delta_raw_bytes"):
             self.stats.setdefault(key, 0)
-        for key in ("ckpt_commit_s", "ckpt_barrier_s"):
+        for key in ("ckpt_commit_s", "ckpt_barrier_s",
+                    "ckpt_compress_s"):
             self.stats.setdefault(key, 0.0)
+        self.stats.setdefault("ckpt_compress", store.compress)
 
     def want_delta(self) -> bool:
         """True when the NEXT save may be incremental: delta mode is
@@ -111,10 +116,15 @@ class CheckpointWriter:
                     self.stats["ckpt_deltas"] += 1
                     self.stats["ckpt_delta_bytes"] += \
                         self.store.last_payload_bytes
+                    # The compression A/B's denominator: what this
+                    # delta's arrays would have cost raw.
+                    self.stats["ckpt_delta_raw_bytes"] += \
+                        self.store.last_payload_raw_bytes
                 else:
                     self.store.save(arrays, meta)
                     self.stats["ckpt_full_bytes"] += \
                         self.store.last_payload_bytes
+                self.stats["ckpt_compress_s"] += self.store.last_compress_s
                 self.stats["ckpt_saves"] += 1
             fault_point("post-ckpt")
 

@@ -97,6 +97,13 @@ def main(argv=None) -> int:
     p.add_argument("--check", action="store_true",
                    help="run the host oracle scan over the same stream "
                         "and verify parity (exit 2 on mismatch)")
+    p.add_argument("--ingest-readers", type=int, default=None,
+                   dest="ingest_readers",
+                   help="parallel mmap'd input readers with readahead "
+                        "(utils/ioread.py): N threads fill blocks ahead "
+                        "of the batcher; cursors/checkpoints stay "
+                        "byte-exact (default: DSI_INGEST_READERS or 0 "
+                        "= inline reads)")
     p.add_argument("--trace-dir", default=None,
                    help="write this run's unified trace (dsi_tpu/obs): "
                         "Perfetto trace.json + trace.jsonl event log; "
@@ -134,6 +141,7 @@ def main(argv=None) -> int:
     from dsi_tpu.parallel.grepstream import grep_host_oracle, grep_streaming
     from dsi_tpu.parallel.shuffle import default_mesh
     from dsi_tpu.parallel.streaming import stream_files
+    from dsi_tpu.utils.ioread import open_blocks
 
     from dsi_tpu.ckpt import CheckpointMismatch
 
@@ -141,7 +149,8 @@ def main(argv=None) -> int:
     pstats: dict = {}
     try:
         res = grep_streaming(
-            stream_files(args.files), pattern, mesh=mesh,
+            open_blocks(args.files, readers=args.ingest_readers),
+            pattern, mesh=mesh,
             chunk_bytes=args.chunk_bytes, depth=args.pipeline_depth,
             aot=args.aot, device_accumulate=args.device_accumulate,
             sync_every=args.sync_every, mesh_shards=args.mesh_shards,

@@ -48,6 +48,19 @@ mode flags), ``ckpt_deltas`` (incremental saves among ``ckpt_saves``),
 ``ckpt_full_bytes``/``ckpt_delta_bytes`` (serialized payload totals by
 kind — the bench's delta-vs-full evidence).
 
+Compressed wire + ingest keys (ISSUE 13): ``ingest_readers``/
+``ingest_blocks``/``readahead_hit_pct`` and the ``ingest_wait_s``
+phase come from the parallel reader pool (``utils/ioread.py``, folded
+into the engine scope at release by ``parallel/pipeline.py
+fold_source_stats``); ``wire_upload`` (the chunk-codec mode flag),
+``wire_steps``/``wire_raw_steps`` (packed vs raw-fallback uploads),
+``wire_packed_bytes``, ``wire_ratio`` (raw/packed upload bytes) and
+the ``decode_s`` phase (host encode + decode-prologue dispatch) come
+from the chunk-upload codec (``ops/wirecodec.py``);
+``ckpt_compress``/``ckpt_delta_raw_bytes`` and the
+``ckpt_compress_s`` phase are the compressed-checkpoint attribution
+(``ckpt/store.py`` via the writer).
+
 Mesh-sharded service keys (``mesh_shards`` > 0, the shuffle-fold path
 — ``device/table.py``): ``mesh_shards`` (the sharding degree),
 ``pull_bytes`` (total D2H drain payload, counted in BOTH modes — the
@@ -121,6 +134,8 @@ PHASE_KEYS = (
     "pull_s", "merge_s", "replay_s", "fold_s", "append_s", "hist_s",
     "sync_s", "drain_s", "widen_s", "ckpt_s", "ckpt_capture_s",
     "ckpt_commit_s", "ckpt_barrier_s",
+    # compressed wire + ingest (ISSUE 13)
+    "decode_s", "ingest_wait_s", "ckpt_compress_s",
 )
 
 #: The canonical counter/gauge keys (module docstring) — previously
@@ -142,6 +157,12 @@ COUNTER_KEYS = (
     # mesh-sharded services
     "mesh_shards", "pull_bytes", "shard_widens", "shard_imbalance",
     "resharded_resume",
+    # compressed wire + parallel ingest (ISSUE 13): reader-pool fold
+    # (utils/ioread.py ingest_stats → fold_source_stats) and the
+    # chunk-upload codec's attribution (ops/wirecodec.py)
+    "ingest_readers", "ingest_blocks", "readahead_hit_pct",
+    "wire_upload", "wire_steps", "wire_raw_steps", "wire_packed_bytes",
+    "wire_ratio", "ckpt_delta_raw_bytes", "ckpt_compress",
     # serving daemon (the "serve" scope, serve/pack.py)
     "packed_steps", "packed_rows", "max_tenants_per_step",
     "host_fallbacks",

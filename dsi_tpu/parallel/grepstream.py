@@ -100,6 +100,7 @@ from dsi_tpu.parallel.merge import PackedCounts, PostingsTable
 from dsi_tpu.parallel.pipeline import (
     BufferPool,
     StepPipeline,
+    fold_source_stats,
     pipeline_depth,
 )
 from dsi_tpu.parallel.stepobj import EngineStep
@@ -903,12 +904,14 @@ def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
         released.append(True)
         if ck_writer is not None:
             ck_writer.shutdown()
+        fold_source_stats(stats, blocks)
         if pipeline_stats is not None:
             stats["batch_allocs"] = pool.allocs
             for k in ("batch_s", "batch_wait_s", "upload_s", "kernel_s",
                       "pull_s", "merge_s", "replay_s", "fold_s", "sync_s",
                       "widen_s", "hist_s", "ckpt_s", "ckpt_capture_s",
-                      "ckpt_commit_s", "ckpt_barrier_s"):
+                      "ckpt_commit_s", "ckpt_barrier_s",
+                      "ckpt_compress_s"):
                 if k in stats:
                     stats[k] = round(stats[k], 4)
             pipeline_stats.update(stats)
@@ -1614,6 +1617,7 @@ def _indexer_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
         w = step._writer  # the CURRENT rung's writer (re-set per rung)
         if w is not None:
             w.shutdown()
+        fold_source_stats(st, docs)  # a doc source may pool-read too
         if stats is not None:
             stats.update(st)
 

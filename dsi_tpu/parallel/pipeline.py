@@ -58,6 +58,24 @@ def pipeline_depth(depth: Optional[int] = None) -> int:
     return max(1, depth)
 
 
+def fold_source_stats(stats: dict, source) -> None:
+    """Fold a block source's ingest counters into an engine's metrics
+    scope at release time.  The parallel reader pool
+    (``utils/ioread.py ParallelBlocks``) exposes ``ingest_stats()``
+    (``ingest_readers``/``ingest_blocks``/``readahead_hit_pct``/
+    ``ingest_wait_s`` — all pinned in ``obs/registry.py SCHEMA_KEYS``);
+    plain iterables have nothing to report and this is a no-op.  One
+    helper for all four engines so the fold — and its
+    never-trade-a-result-for-telemetry error policy — cannot drift."""
+    fn = getattr(source, "ingest_stats", None)
+    if not callable(fn):
+        return
+    try:
+        stats.update(fn())
+    except Exception:
+        pass
+
+
 class BufferPool:
     """Small rotating pool of reusable fixed-shape host buffers.
 

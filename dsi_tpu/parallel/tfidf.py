@@ -76,7 +76,8 @@ from dsi_tpu.ops.wordcount import (
     unpack_key_lanes,
 )
 from dsi_tpu.parallel.merge import PostingsTable
-from dsi_tpu.parallel.pipeline import StepPipeline, pipeline_depth
+from dsi_tpu.parallel.pipeline import (StepPipeline, fold_source_stats,
+                                       pipeline_depth)
 from dsi_tpu.parallel.stepobj import EngineStep as _EngineStep
 from dsi_tpu.parallel.shuffle import (
     AXIS,
@@ -812,6 +813,7 @@ def _tfidf_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
         w = step._writer  # the CURRENT rung's writer (re-set per rung)
         if w is not None:
             w.shutdown()
+        fold_source_stats(stats, docs)  # a doc source may pool-read too
         if wave_stats is not None:
             wave_stats.update(stats)
 

@@ -67,6 +67,17 @@ GATES: List[Tuple[str, str, float]] = [
     # and *_parity patterns above already gate its throughput and
     # per-tenant parity keys; the warm cost gates lower-better here.
     ("serve_amortized_warm_s", "lower", 1.00),
+    # Compressed wire + parallel ingest (ISSUE 13): codec ratios and
+    # the readahead hit rate regress when they DROP (a codec change
+    # that stops shrinking the shuffle payload, a pool change that
+    # stops running ahead), delta-checkpoint payload bytes when they
+    # RISE (compression silently off, delta windows ballooning).  The
+    # *_parity patterns above already gate wire/ingest correctness.
+    ("wire_ratio", "higher", 0.10),
+    ("wire_upload_ratio", "higher", 0.10),
+    ("ckpt_compress_ratio", "higher", 0.10),
+    ("readahead_hit_pct", "higher", 0.10),
+    ("ckpt_delta_bytes*", "lower", 0.50),
 ]
 
 

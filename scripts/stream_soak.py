@@ -56,6 +56,12 @@ def main() -> int:
                     help="incremental checkpoints, full re-base every "
                          "DSI_STREAM_CKPT_REBASE saves (env "
                          "DSI_STREAM_CKPT_DELTA)")
+    ap.add_argument("--wire-upload", action="store_true", default=None,
+                    dest="wire_upload",
+                    help="compress chunk uploads host-side and decode "
+                         "on device as a map prologue "
+                         "(ops/wirecodec.py; env DSI_STREAM_WIRE; "
+                         "results bit-identical either way)")
     ap.add_argument("--trace-dir", default=None,
                     help="write the soak's unified trace (dsi_tpu/obs): "
                          "Perfetto trace.json + trace.jsonl; render "
@@ -120,6 +126,7 @@ def main() -> int:
                               checkpoint_async=args.ckpt_async,
                               checkpoint_delta=args.ckpt_delta,
                               resume=args.resume,
+                              wire_upload=args.wire_upload,
                               pipeline_stats=pstats)
     dt = time.perf_counter() - t0
     assert acc is not None

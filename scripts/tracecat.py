@@ -291,6 +291,35 @@ def ckpt(events, metas, out) -> bool:
     return True
 
 
+def wire(events, metas, out) -> bool:
+    """The compressed-wire + parallel-ingest keys (ISSUE 13): decode
+    span totals plus the codec/reader-pool counters from the phase
+    dicts."""
+    tot = _span_totals(events, ("decode",))
+    keys = ("wire_steps", "wire_raw_steps", "wire_packed_bytes",
+            "wire_ratio", "decode_s", "ingest_readers", "ingest_blocks",
+            "readahead_hit_pct", "ingest_wait_s", "ckpt_compress",
+            "ckpt_delta_raw_bytes", "ckpt_compress_s")
+    rows = []
+    for meta in metas:
+        engines = (meta.get("registry") or {}).get("engines") or {}
+        for eng, ph in sorted(engines.items()):
+            kv = {k: ph[k] for k in keys if ph.get(k)}
+            if kv:
+                rows.append((meta.get("_file", "?"), eng, kv))
+    if not (tot or rows):
+        return False
+    if "decode" in tot:
+        t, n = tot["decode"]
+        print(f"  {'decode':<14} total={t:.3f}s count={n} "
+              f"mean={1e3 * t / n:.2f}ms", file=out)
+    for fname, eng, kv in rows:
+        print(f"  {eng} [{fname}]: " + " ".join(
+            f"{k}={round(v, 4) if isinstance(v, float) else v}"
+            for k, v in kv.items()), file=out)
+    return True
+
+
 def histograms(metas, out) -> bool:
     """The stage latency percentile table (obs/hist.py) embedded in
     each trace's registry snapshot."""
@@ -368,6 +397,8 @@ def main(argv=None) -> int:
     for title, fn in (("shuffle lane", lambda o: shuffle(events, metas, o)),
                       ("ckpt capture/commit", lambda o: ckpt(events, metas,
                                                              o)),
+                      ("wire codec / ingest pool",
+                       lambda o: wire(events, metas, o)),
                       ("stage latency histograms",
                        lambda o: histograms(metas, o))):
         buf = io.StringIO()

@@ -32,7 +32,7 @@ def main() -> int:
     ap.add_argument("--file-size", type=int, default=300000,
                     help="harness split size (test_mr.sh ensure_corpus)")
     ap.add_argument("--phase", choices=("harness", "stream", "grep",
-                                        "mesh", "all"),
+                                        "mesh", "wire", "all"),
                     default="all",
                     help="which program group to warm: 'harness' = the "
                          "per-task worker kernels test_mr.sh runs touch; "
@@ -41,7 +41,10 @@ def main() -> int:
                          "on-device top-k/histogram service; 'mesh' = the "
                          "mesh-sharded shuffle-fold programs (mesh_fold_*/"
                          "mesh_grow_*/mesh_hist_pull_*) for --mesh-shards "
-                         "runs; 'all' = everything.  Remote compiles cost "
+                         "runs; 'wire' = the chunk-upload decode "
+                         "prologues (wire_decode_*/wire_decode7_*, "
+                         "ops/wirecodec.py) a --wire-upload run reaches; "
+                         "'all' = everything.  Remote compiles cost "
                          "tens of minutes EACH on the axon tunnel, so the "
                          "ladder (warm_loop.sh) warms the group it is "
                          "about to collect evidence with, not everything "
@@ -245,6 +248,23 @@ def main() -> int:
         warm_indexer_aot(mesh=mesh, sizes=(1 << 18,), caps=(1 << 14,),
                          device_accumulate=True)
         print(f"grep/indexer programs: {time.perf_counter() - t0:.1f}s",
+              flush=True)
+
+    if args.phase in ("wire", "all"):
+        # Chunk-upload decode prologues (ISSUE 13, ops/wirecodec.py):
+        # every rung — nibble literal ladder + the 7-bit ASCII
+        # fallback — at both the CLI default (1 MiB) and bench stream
+        # (2 MiB) chunk shapes, so a --wire-upload/DSI_STREAM_WIRE run
+        # on the chip loads serialized decoders instead of paying a
+        # remote cold compile per rung the codec happens to pick.
+        from dsi_tpu.ops.wirecodec import warm_wire_aot
+        from dsi_tpu.parallel.shuffle import default_mesh
+
+        t0 = time.perf_counter()
+        mesh = default_mesh()
+        warm_wire_aot(mesh=mesh, chunk_bytes=1 << 20)
+        warm_wire_aot(mesh=mesh, chunk_bytes=1 << 21)
+        print(f"wire decode programs: {time.perf_counter() - t0:.1f}s",
               flush=True)
 
     if args.phase in ("mesh", "all"):

@@ -19,10 +19,31 @@ def direct_savez(path, arrays):
     np.savez(path, **arrays)  # EXPECT: raw-write
 
 
+def direct_savez_compressed(path, arrays):
+    np.savez_compressed(path, **arrays)  # EXPECT: raw-write
+
+
 def buffered_savez_is_clean(arrays):
     buf = io.BytesIO()
     np.savez(buf, **arrays)  # clean: serialize-to-buffer idiom
     return buf.getvalue()
+
+
+def buffered_savez_compressed_is_clean(arrays):
+    # The compressed-delta store path (ISSUE 13): same idiom, zlib'd.
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)  # clean: serialize-to-buffer
+    return buf.getvalue()
+
+
+def annotated_buffer_is_clean(arrays):
+    buf: io.BytesIO = io.BytesIO()
+    np.savez_compressed(buf, **arrays)  # clean: annotated assignment
+    return buf.getvalue()
+
+
+def inline_buffer_is_clean(arrays):
+    np.savez_compressed(io.BytesIO(), **arrays)  # clean: inline buffer
 
 
 def reading_is_clean(path):

@@ -120,6 +120,12 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
                     == (v["ckpt_deltas"] >= 1))
             if "ckpt_delta_bytes_per_save" in v:
                 assert v["ckpt_delta_bytes_per_save"] > 0
+                # Compressed deltas (ISSUE 13, default on): the raw
+                # denominator and ratio ride alongside, and zlib on
+                # the packed word tables must actually shrink them.
+                if "ckpt_compress_ratio" in v:
+                    assert v["ckpt_delta_raw_bytes_per_save"] > 0
+                    assert v["ckpt_compress_ratio"] > 1.0
     # The distributed N-worker row (the reference's own headline shape,
     # test-mr.sh:36-53) rides the same verdict: measured or skipped.
     assert ("framework_skipped" in v) != ("framework_mbps" in v)
@@ -145,6 +151,19 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["mesh_pull_bytes_per_sync"] > 0
         assert v["mesh_host_pull_bytes_per_sync"] > 0
         assert len(v["mesh_shard_widens"]) == v["mesh_shards"]
+    # The compressed-wire + parallel-ingest A/B row (ISSUE 13):
+    # measured XOR skipped, with the ingest keys as the completion
+    # marker (a mid-row parity failure ships its skip reason plus
+    # whatever halves had already measured cleanly).
+    assert ("wire_skipped" in v) != ("readahead_hit_pct" in v)
+    if "readahead_hit_pct" in v:
+        assert v["wire_parity"] is True
+        assert v["wire_ratio"] > 1.0   # dictionary+varint vs raw rows
+        assert v["wire_upload_parity"] is True
+        assert v["ingest_parity"] is True
+        assert v["ingest_readers"] == 4
+        assert v["ingest_materialize_s"] >= 0
+        assert v["ingest_serial_materialize_s"] >= 0
     # The serving-daemon A/B row (ISSUE 11): measured XOR skipped; a
     # measured row carries the per-tenant parity gate, both throughput
     # halves, and the amortized warm cost.

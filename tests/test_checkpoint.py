@@ -382,6 +382,52 @@ def test_wc_delta_chain_resume_across_mesh_degrees(monkeypatch,
     assert "resharded_resume" in stats and stats["resharded_resume"] == 2
 
 
+@pytest.mark.parametrize("point", ["mid-fold", "post-ckpt"])
+def test_wc_crash_resume_parity_with_reader_pool(monkeypatch, tmp_path,
+                                                 point):
+    """Cursor exactness under the parallel ingest pool (ISSUE 13): a
+    crash with readahead in flight — the pool has read blocks the
+    batcher never consumed — must resume byte-identically from the
+    durable cursor, even when the resume run uses a DIFFERENT reader
+    count (batching is a pure function of the byte stream; the pool
+    only changes scheduling)."""
+    from dsi_tpu.utils.ioread import ParallelBlocks, serial_blocks
+
+    half = len(WC_TEXT) // 2
+    paths = []
+    for i, piece in enumerate((WC_TEXT[:half], WC_TEXT[half:])):
+        p = tmp_path / f"c{i}.txt"
+        p.write_bytes(piece)
+        paths.append(str(p))
+
+    def pool_run(readers, **kw):
+        reset_faults()
+        # Small blocks so readahead is GENUINELY in flight at the crash
+        # (several blocks resident in slots beyond the consumed cursor).
+        return wordcount_streaming(
+            ParallelBlocks(paths, block_bytes=2048, readers=readers),
+            mesh=_mesh(), n_reduce=10, chunk_bytes=WC_CHUNK, u_cap=256,
+            sync_every=2, checkpoint_every=2, **kw)
+
+    # Baseline over the SAME byte stream (the pool inserts the
+    # stream_files file separator, so WC_TEXT alone is not it).
+    reset_faults()
+    baseline = wordcount_streaming(
+        [b"".join(serial_blocks(paths))], mesh=_mesh(), n_reduce=10,
+        chunk_bytes=WC_CHUNK, u_cap=256)
+    ck = str(tmp_path / "ck")
+    _fault_env(monkeypatch, point, _FAULT_AT[point])
+    with pytest.raises(FaultInjected):
+        pool_run(3, checkpoint_dir=ck)
+    _clear_fault(monkeypatch)
+    stats: dict = {}
+    res = pool_run(2, checkpoint_dir=ck, resume=True,
+                   pipeline_stats=stats)
+    assert res == baseline
+    assert stats["resume_cursor"] > 0  # restored, not replayed from 0
+    assert stats["ingest_readers"] == 2
+
+
 @pytest.mark.parametrize("depth", [1, 3])
 def test_wc_crash_resume_parity_across_depths(monkeypatch, tmp_path,
                                               depth):

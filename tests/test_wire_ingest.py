@@ -1,0 +1,355 @@
+"""Compressed wire + parallel ingest (ISSUE 13).
+
+Three layers under test:
+
+* **`utils/ioread.py`** — the parallel mmap reader pool: the yielded
+  byte stream must be BYTE-IDENTICAL to the serial reader at any
+  reader count/block size (that identity is what keeps checkpoint
+  cursors exact), the readahead stats must be sane, and abandoning the
+  iterator mid-stream must tear the pool down cleanly.
+* **`ops/wirecodec.py`** — both codecs round-trip bit-exactly: the
+  varint streams, the shuffle-row dictionary codec (including the
+  non-trimmable-key fallback), and the chunk codec in every mode
+  (nibble rungs, 7-bit ASCII, raw refusal), with the compiled jax
+  decode prologue equal to the numpy oracle.
+* **engine integration** — `wordcount_streaming` with `wire_upload`
+  on vs off is bit-identical across depth x dacc x mesh, reading
+  through the reader pool is bit-identical to inline reads (with the
+  ingest keys folded into `pipeline_stats`), and the compressed
+  checkpoint store restores chains written in any compress mode.
+"""
+
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import numpy as np
+
+from dsi_tpu.ckpt import CheckpointStore
+from dsi_tpu.ops import wirecodec as wc
+from dsi_tpu.parallel.grepstream import grep_streaming
+from dsi_tpu.parallel.shuffle import default_mesh
+from dsi_tpu.parallel.streaming import wordcount_streaming
+from dsi_tpu.utils import ioread
+
+
+def _mesh():
+    return default_mesh(4)
+
+
+def _letters(i: int) -> str:
+    return "".join(chr(97 + (i // 26 ** j) % 26) for j in range(3))
+
+
+WC_WORDS = [_letters(i) for i in range(120)]
+WC_TEXT = ((" ".join(WC_WORDS) + "\n") * 80).encode()  # ~38 KB, ~10 steps
+WC_CHUNK = 1 << 10
+
+
+# ── utils/ioread.py ────────────────────────────────────────────────────
+
+
+def _write_files(tmp_path, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i, size in enumerate(sizes):
+        p = tmp_path / f"f{i}.txt"
+        p.write_bytes(bytes(rng.integers(32, 127, size, dtype=np.uint8)))
+        paths.append(str(p))
+    return paths
+
+
+@pytest.mark.parametrize("readers", [1, 3, 8])
+def test_parallel_blocks_byte_identical_to_serial(tmp_path, readers):
+    paths = _write_files(tmp_path, [0, 17, 5000, 123457, 0, 64])
+    want = b"".join(ioread.serial_blocks(paths, block_bytes=1000))
+    pool = ioread.ParallelBlocks(paths, block_bytes=997, readers=readers)
+    got = b"".join(pool)
+    assert got == want
+    st = pool.ingest_stats()
+    assert st["ingest_readers"] == readers
+    assert st["ingest_blocks"] > 100
+    assert 0.0 <= st["readahead_hit_pct"] <= 100.0
+    assert st["ingest_wait_s"] >= 0.0
+
+
+def test_parallel_blocks_abandoned_mid_stream_tears_down(tmp_path):
+    paths = _write_files(tmp_path, [50000, 50000])
+    pool = ioread.ParallelBlocks(paths, block_bytes=512, readers=2)
+    it = iter(pool)
+    next(it)
+    next(it)
+    it.close()  # the generator's finally runs pool.close()
+    for t in pool._threads:
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+
+
+def test_parallel_blocks_second_pass_raises_not_hangs(tmp_path):
+    paths = _write_files(tmp_path, [5000])
+    pool = ioread.ParallelBlocks(paths, block_bytes=512, readers=2)
+    assert b"".join(pool)  # first pass exhausts and closes
+    with pytest.raises(RuntimeError, match="single-pass"):
+        next(iter(pool))
+
+
+def test_open_blocks_resolves_reader_knob(tmp_path, monkeypatch):
+    paths = _write_files(tmp_path, [100])
+    monkeypatch.delenv("DSI_INGEST_READERS", raising=False)
+    assert not isinstance(ioread.open_blocks(paths),
+                          ioread.ParallelBlocks)
+    monkeypatch.setenv("DSI_INGEST_READERS", "3")
+    pool = ioread.open_blocks(paths)
+    assert isinstance(pool, ioread.ParallelBlocks)
+    assert pool.readers == 3
+    # Explicit argument wins over the env.
+    assert ioread.open_blocks(paths, readers=2).readers == 2
+
+
+def test_parallel_blocks_missing_file_raises(tmp_path):
+    paths = _write_files(tmp_path, [4096])
+    pool = ioread.ParallelBlocks(paths, block_bytes=512, readers=2)
+    os.remove(paths[0])
+    # The plan was built at construction; the read itself must surface
+    # the error on the CONSUMER thread, not hang the pool.
+    with pytest.raises(OSError):
+        list(pool)
+
+
+# ── wirecodec: varints + shuffle-row codec ─────────────────────────────
+
+
+def test_varint_round_trip_boundaries():
+    vals = [0, 1, 127, 128, 255, 16383, 16384, 2 ** 32 - 1, 2 ** 40]
+    enc = wc.varint_encode(vals)
+    dec, off = wc.varint_decode(enc + b"trailing", len(vals))
+    assert list(dec) == vals
+    assert off == len(enc)
+    assert wc.varint_encode([]) == b""
+    with pytest.raises(ValueError):
+        wc.varint_decode(b"\x80\x80", 1)  # truncated continuation
+
+
+def _fake_packed_table(n_dev=4, mp=64, kk=4, nus=(50, 3, 0, 64)):
+    rows = np.zeros((n_dev, mp, kk + 3), np.uint32)
+    words = [b"the", b"a", b"wordcount", b"zz", b"longestword1"]
+    for d in range(n_dev):
+        for r in range(nus[d]):
+            w = words[(d + r) % len(words)] + str(r % 7).encode()
+            kb = np.zeros(kk * 4, np.uint8)
+            kb[:len(w)] = np.frombuffer(w, np.uint8)
+            rows[d, r, :kk] = kb.view(">u4")
+            rows[d, r, kk] = len(w)
+            rows[d, r, kk + 1] = r + 1
+            rows[d, r, kk + 2] = r % 10
+    return rows, np.asarray(nus, np.int64)
+
+
+def test_pack_rows_round_trip_and_ratio():
+    rows, nus = _fake_packed_table()
+    blob = wc.pack_rows(rows, nus)
+    rows2, nus2 = wc.unpack_rows(blob)
+    assert np.array_equal(rows2, rows)
+    assert np.array_equal(nus2, nus)
+    # The acceptance bar: dictionary+varint beats raw rows well past
+    # 1.5x on word-count-shaped payloads.
+    assert wc.rows_raw_bytes(nus, 4) / len(blob) > 1.5
+
+
+def test_pack_rows_empty_and_untrimmable_fallback():
+    rows = np.zeros((2, 8, 7), np.uint32)
+    blob = wc.pack_rows(rows, [0, 0])
+    rows2, nus2 = wc.unpack_rows(blob)
+    assert rows2.shape == (2, 8, 7) and not nus2.any()
+    # A key whose lanes carry bytes BEYOND its recorded length defeats
+    # trailing-zero trimming: the codec must fall back to full-width
+    # dictionary entries, still bit-exact.
+    rows, nus = _fake_packed_table(nus=(4, 0, 0, 0))
+    kb = np.full(16, 0xAB, np.uint8)
+    rows[0, 0, :4] = kb.view(">u4")
+    rows[0, 0, 4] = 3  # claims 3 bytes; lanes hold 16 nonzero
+    blob = wc.pack_rows(rows, nus)
+    rows2, nus2 = wc.unpack_rows(blob)
+    for d in range(4):
+        assert np.array_equal(rows2[d, :int(nus[d])], rows[d, :int(nus[d])])
+
+
+# ── wirecodec: chunk codec, every mode ─────────────────────────────────
+
+
+def test_chunk_codec_nibble_mode_round_trip():
+    text = (b"the the the and and of of to a in is it " * 2000)
+    n = 1 << 13
+    batch = np.zeros((2, n), np.uint8)
+    batch[0] = np.frombuffer(text[:n], np.uint8)
+    batch[1, :50] = np.frombuffer(text[:50], np.uint8)
+    mode, packed, cap = wc.encode_chunk(batch)
+    assert mode == "nib" and cap in wc.lit_caps(n)
+    assert packed.nbytes < batch.nbytes  # the wire actually shrinks
+    assert np.array_equal(wc.decode_chunk_host(mode, packed, n), batch)
+    out = np.asarray(wc.decode_chunk_device(
+        jax.device_put(packed), n=n, lit_cap=cap, mode=mode))
+    assert np.array_equal(out, batch)
+
+
+def test_chunk_codec_7bit_mode_round_trip():
+    # Uniform letter usage defeats the 15-entry dictionary; all-ASCII
+    # input must fall to the guaranteed 8/7 mode.
+    rng = np.random.default_rng(7)
+    batch = rng.integers(0, 128, (3, 1 << 12), dtype=np.uint8)
+    mode, packed, cap = wc.encode_chunk(batch)
+    assert mode == "b7" and cap == 0
+    assert packed.nbytes * 8 == batch.nbytes * 7
+    assert np.array_equal(wc.decode_chunk_host(mode, packed, 1 << 12),
+                          batch)
+    out = np.asarray(wc.decode_chunk_device(
+        jax.device_put(packed), n=1 << 12, lit_cap=0, mode=mode))
+    assert np.array_equal(out, batch)
+
+
+def test_chunk_codec_refuses_incompressible_and_odd_shapes():
+    rng = np.random.default_rng(1)
+    assert wc.encode_chunk(
+        rng.integers(0, 256, (2, 1 << 10), dtype=np.uint8)) is None
+    assert wc.encode_chunk(np.zeros((2, 12), np.uint8)) is None  # n%8
+
+
+# ── engine integration ─────────────────────────────────────────────────
+
+
+def _wc_run(blocks, stats=None, **kw):
+    return wordcount_streaming(blocks, mesh=_mesh(), n_reduce=10,
+                               chunk_bytes=WC_CHUNK, u_cap=256,
+                               pipeline_stats=stats, **kw)
+
+
+@pytest.mark.parametrize("dacc,depth,shards", [
+    (False, 1, None), (False, 2, None), (True, 2, None), (True, 2, 4),
+])
+def test_wire_upload_bit_identical(dacc, depth, shards):
+    base = _wc_run([WC_TEXT])
+    stats: dict = {}
+    got = _wc_run([WC_TEXT], stats=stats, wire_upload=True, depth=depth,
+                  device_accumulate=dacc, mesh_shards=shards)
+    assert got == base
+    assert stats["wire_upload"] is True
+    assert stats["wire_steps"] + stats["wire_raw_steps"] == stats["steps"]
+    assert stats["wire_steps"] > 0          # this text compresses
+    assert stats["wire_ratio"] > 1.0
+    assert stats["decode_s"] >= 0.0
+
+
+def test_reader_pool_bit_identical_with_stats(tmp_path):
+    paths = []
+    half = len(WC_TEXT) // 2
+    for i, piece in enumerate((WC_TEXT[:half], WC_TEXT[half:])):
+        p = tmp_path / f"c{i}.txt"
+        p.write_bytes(piece)
+        paths.append(str(p))
+    want = _wc_run([b"".join(ioread.serial_blocks(paths))])
+    stats: dict = {}
+    got = _wc_run(ioread.ParallelBlocks(paths, block_bytes=4096,
+                                        readers=3), stats=stats)
+    assert got == want
+    assert stats["ingest_readers"] == 3
+    assert stats["ingest_blocks"] > 0
+    assert "readahead_hit_pct" in stats and "ingest_wait_s" in stats
+
+
+def test_reader_pool_grep_bit_identical(tmp_path):
+    lines = []
+    for i in range(2000):
+        lines.append(b"ab " * (i % 5) + b"line" + str(i).encode())
+    text = b"\n".join(lines) + b"\n"
+    p = tmp_path / "g.txt"
+    p.write_bytes(text)
+    want = grep_streaming([text], "ab", mesh=_mesh(), chunk_bytes=1 << 11)
+    stats: dict = {}
+    got = grep_streaming(
+        ioread.ParallelBlocks([str(p)], block_bytes=1500, readers=2),
+        "ab", mesh=_mesh(), chunk_bytes=1 << 11, pipeline_stats=stats)
+    assert got == want
+    assert stats["ingest_readers"] == 2
+
+
+# ── compressed checkpoint store ────────────────────────────────────────
+
+
+def _arrays():
+    rng = np.random.default_rng(3)
+    # Repetitive packed-table-shaped payload: zlib must bite hard.
+    rows = np.repeat(rng.integers(0, 1000, (1, 64, 7)), 16,
+                     axis=0).astype(np.uint32)
+    return {"rows": rows, "nus": np.full(16, 64, np.int64)}
+
+
+def test_store_compresses_deltas_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("DSI_STREAM_CKPT_COMPRESS", raising=False)
+    st = CheckpointStore(str(tmp_path / "ck"), "wordcount", {"j": 1})
+    assert st.compress == "deltas"
+    arrays = _arrays()
+    st.save(arrays, {"cursor": 0})
+    full_bytes = st.last_payload_bytes
+    assert st.last_compress_s == 0.0  # fulls stay raw under "deltas"
+    st.save_delta(arrays, {"cursor": 1})
+    # Identical arrays: the compressed delta must be >= 2x smaller than
+    # the raw full image of the same payload — the acceptance bar.
+    assert st.last_payload_bytes * 2 <= full_bytes
+    assert st.last_payload_raw_bytes == sum(v.nbytes
+                                            for v in arrays.values())
+    assert st.last_compress_s >= 0.0
+    # Chain restore reads the mixed raw/zlib chain transparently.
+    meta, base, deltas = st.load_latest_chain()
+    assert int(deltas[-1][0]["cursor"]) == 1
+    assert np.array_equal(base["rows"], arrays["rows"])
+    assert np.array_equal(deltas[0][1]["rows"], arrays["rows"])
+
+
+@pytest.mark.parametrize("mode,full_zipped,delta_zipped", [
+    ("off", False, False), ("deltas", False, True), ("all", True, True),
+])
+def test_store_compress_modes(tmp_path, mode, full_zipped, delta_zipped):
+    st = CheckpointStore(str(tmp_path / mode), "wordcount", {"j": 1},
+                         compress=mode)
+    arrays = _arrays()
+    st.save(arrays, {})
+    full = st.last_payload_bytes
+    st.save_delta(arrays, {})
+    delta = st.last_payload_bytes
+    raw = st.last_payload_raw_bytes
+    # Zipped payloads of this repetitive table are far below raw;
+    # unzipped ones are raw + npz framing overhead.
+    assert (full < raw) == full_zipped
+    assert (delta < raw) == delta_zipped
+    assert st.load_latest_chain() is not None
+
+
+def test_wc_crash_resume_with_compressed_deltas(monkeypatch, tmp_path):
+    """End-to-end: wire upload + compressed async delta chain + a
+    mid-fold fault, resumed bit-identically (the CI smoke's in-process
+    twin)."""
+    from dsi_tpu.ckpt import FaultInjected, reset_faults
+
+    base = _wc_run([WC_TEXT])
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("DSI_STREAM_CKPT_COMPRESS", "deltas")
+    monkeypatch.setenv("DSI_FAULT_MODE", "raise")
+    monkeypatch.setenv("DSI_FAULT_POINT", "mid-fold")
+    monkeypatch.setenv("DSI_FAULT_STEP", "4")
+    reset_faults()
+    with pytest.raises(FaultInjected):
+        _wc_run([WC_TEXT], checkpoint_dir=ck, checkpoint_every=1,
+                checkpoint_async=True, checkpoint_delta=True,
+                wire_upload=True)
+    for k in ("DSI_FAULT_MODE", "DSI_FAULT_POINT", "DSI_FAULT_STEP"):
+        monkeypatch.delenv(k, raising=False)
+    reset_faults()
+    stats: dict = {}
+    got = _wc_run([WC_TEXT], stats=stats, checkpoint_dir=ck,
+                  checkpoint_every=1, checkpoint_async=True,
+                  checkpoint_delta=True, wire_upload=True, resume=True)
+    assert got == base
+    assert stats["resume_cursor"] > 0
+    assert stats["ckpt_compress"] == "deltas"
