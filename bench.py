@@ -1691,6 +1691,114 @@ def run_serve_row() -> dict:
     return row
 
 
+def run_plan_row() -> dict:
+    """The plan-layer A/B (ISSUE 14 satellite): one grep→wordcount
+    CHAIN with the matching-line intermediate device-resident
+    (``dsi_tpu/plan``, ``planrun`` subprocess) versus the SAME two
+    stages run staged — full host materialization between them, the
+    6.5840 shape.  Reports ``plan_chained_mbps`` / ``plan_staged_mbps``
+    (corpus MB over each run's summed stage walls, from the CLI's
+    ``--stats-json``), ``plan_intermediate_bytes`` (host-crossing
+    handoff bytes of the chained run — MUST be 0, the ``plan_zero_copy``
+    bool gates it) vs ``plan_staged_intermediate_bytes`` (the full
+    materialization), parity-gated by byte-comparing the two runs'
+    mr-out-* sets.  Runs in fresh subprocesses on 1-device CPU under
+    ``DSI_AOT_FRESH=1`` like the other stream rows (the attributed
+    persisted-AOT-load flake stays out of bench rounds), so it is
+    chip-independent and rides every verdict branch.  Measured keys XOR
+    ``plan_skipped`` — the bench-contract discipline.
+    ``DSI_BENCH_PLAN_MB`` (default 8; 0 disables) sizes it."""
+    mb = env_float("DSI_BENCH_PLAN_MB", 8.0)
+    if mb <= 0:
+        return {"plan_skipped": "disabled (DSI_BENCH_PLAN_MB=0)"}
+    budget = env_float("DSI_BENCH_PLAN_TIMEOUT", 300.0)
+    import shutil
+
+    pdir = os.path.join(WORKDIR, "plan-row")
+    shutil.rmtree(pdir, ignore_errors=True)
+    os.makedirs(pdir)
+    corpus_path = os.path.join(pdir, "corpus.txt")
+    with open(corpus_path, "w") as f:
+        i = 0
+        written = 0
+        target = mb * 1e6
+        while written < target:
+            if i % 3 == 0:
+                line = (f"dsi chain w{i % 211:03d} step keeps bytes on "
+                        f"device w{i % 97:02d} dsi\n")
+            else:
+                line = f"filler row{i} nothing matches here at all\n"
+            f.write(line)
+            written += len(line)
+            i += 1
+    total_mb = os.path.getsize(corpus_path) / 1e6
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # 1-device CPU + fresh compiles: the stream rows' AOT-flake hygiene
+    # (aot_fresh_cpu_guard), in subprocess form.
+    env.pop("XLA_FLAGS", None)
+    env["DSI_AOT_FRESH"] = "1"
+
+    def one(mode: str) -> tuple[dict, str]:
+        wd = os.path.join(pdir, mode)
+        sj = os.path.join(pdir, f"{mode}.stats.json")
+        cmd = [sys.executable, "-m", "dsi_tpu.cli.planrun",
+               "--chain", "grep-wc", "--pattern", "dsi",
+               "--chunk-bytes", str(1 << 20),
+               "--workdir", wd, "--stats-json", sj, corpus_path]
+        if mode == "staged":
+            cmd.insert(-1, "--staged")
+        r = subprocess.run(cmd, env=env,
+                           cwd=os.path.dirname(os.path.abspath(__file__)),
+                           capture_output=True, text=True, timeout=budget)
+        if r.returncode != 0:
+            raise RuntimeError(f"{mode} planrun rc={r.returncode}: "
+                               f"{r.stderr[-300:]}")
+        with open(sj, encoding="utf-8") as f:
+            return json.load(f), wd
+
+    try:
+        chained, wd_c = one("chained")
+        staged, wd_s = one("staged")
+    except Exception as e:
+        return {"plan_skipped": f"plan row failed: "
+                                f"{type(e).__name__}: {e}"}
+
+    def outset(wd: str) -> list:
+        got = []
+        for r in range(10):
+            with open(os.path.join(wd, f"mr-out-{r}"),
+                      encoding="utf-8") as f:
+                got.extend(l for l in f if l.strip())
+        return sorted(got)
+
+    try:
+        parity = outset(wd_c) == outset(wd_s)
+    except OSError as e:
+        return {"plan_skipped": f"missing chain output: {e}"}
+    if not parity:
+        return {"plan_skipped": "chained vs staged parity mismatch "
+                                "(throughput suppressed)",
+                "plan_parity": False}
+    inter_c = int(chained.get("plan_intermediate_bytes", -1))
+    inter_s = int(staged.get("plan_intermediate_bytes", 0))
+    chained_s = float(chained.get("plan_s", 0.0)) or 1e-9
+    staged_s = float(staged.get("plan_s", 0.0)) or 1e-9
+    row = {"plan_mb": round(total_mb, 2), "plan_parity": True,
+           "plan_zero_copy": inter_c == 0,
+           "plan_chained_mbps": round(total_mb / chained_s, 2),
+           "plan_staged_mbps": round(total_mb / staged_s, 2),
+           "plan_intermediate_bytes": inter_c,
+           "plan_staged_intermediate_bytes": inter_s,
+           "plan_stage_walls": chained.get("plan_stage_walls", {})}
+    log(f"plan row: {total_mb:.1f} MB grep→wc — chained "
+        f"{row['plan_chained_mbps']} MB/s ({chained_s:.2f}s, "
+        f"{inter_c} host bytes between stages) vs staged "
+        f"{row['plan_staged_mbps']} MB/s ({staged_s:.2f}s, "
+        f"{inter_s} host bytes)")
+    return row
+
+
 def run_native_oracle_row(files, oracle_out, total_mb, native_ok,
                           fw_oracle_mbps) -> dict:
     """Sequential run of the SAME C++ task bodies the native-backend
@@ -2052,6 +2160,17 @@ def main() -> None:
                                    f"{type(e).__name__}: {e}")
     else:
         fw["serve_skipped"] = f"budget {budget_s:.0f}s < 60s"
+    # The plan-layer chained-vs-staged A/B row (ISSUE 14):
+    # chip-independent (planrun subprocesses on 1-device CPU under
+    # DSI_AOT_FRESH=1, the stream rows' hygiene), rides every branch.
+    if budget_s >= 60 or "DSI_BENCH_PLAN_MB" in os.environ:
+        try:
+            fw.update(run_plan_row())
+        except Exception as e:
+            fw["plan_skipped"] = (f"plan row failed: "
+                                  f"{type(e).__name__}: {e}")
+    else:
+        fw["plan_skipped"] = f"budget {budget_s:.0f}s < 60s"
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,

@@ -1,0 +1,193 @@
+"""Multi-stage dataflow plan runner — chained jobs, no host round-trip.
+
+Runs one of the canonical plans (``dsi_tpu/plan``) end to end: stages
+execute as resumable step objects and the intermediate between them
+stays DEVICE-RESIDENT (stage N+1's upload is stage N's output —
+``device/relay.py``), against the ``--staged`` baseline that
+materializes every intermediate through the host the way the 6.5840
+contract does.  Stage boundaries are durable commit points
+(``--checkpoint-dir``): a crash anywhere in the chain resumes from the
+last COMPLETED stage (``--resume``), never from zero.
+
+Chains:
+  grep-wc   — grep → word count over exactly the matching lines;
+              writes the word counts as mr-out-<r> files in --workdir.
+  indexer   — indexer → df-top-k (k-row snapshot off the resident df
+              table) → per-term postings join; writes plan-join.json.
+
+Usage:
+    python -m dsi_tpu.cli.planrun --chain grep-wc --pattern PAT
+        [--staged] [--chunk-bytes B] [--devices D] [--pipeline-depth K]
+        [--device-accumulate] [--sync-every K] [--mesh-shards N]
+        [--nreduce N] [--u-cap U] [--topk K] [--aot]
+        [--checkpoint-dir DIR] [--resume] [--workdir DIR] [--check]
+        [--stats] [--stats-json FILE] [--trace-dir DIR] inputfiles...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="+")
+    p.add_argument("--chain", choices=("grep-wc", "indexer"),
+                   default="grep-wc")
+    p.add_argument("--pattern", default=None,
+                   help="literal grep pattern (required for grep-wc)")
+    p.add_argument("--staged", action="store_true",
+                   help="run the HOST-materialization baseline: every "
+                        "inter-stage intermediate is pulled to the host "
+                        "and re-fed (the 6.5840 shape) — results are "
+                        "bit-identical to the chained default")
+    p.add_argument("--chunk-bytes", type=_positive_int, default=1 << 20)
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--pipeline-depth", type=_positive_int, default=None)
+    p.add_argument("--device-accumulate", action="store_true")
+    p.add_argument("--sync-every", type=_positive_int, default=None)
+    p.add_argument("--mesh-shards", type=int, default=None)
+    p.add_argument("--nreduce", type=_positive_int, default=10)
+    p.add_argument("--u-cap", type=_positive_int, default=1 << 12)
+    p.add_argument("--topk", type=_positive_int, default=16)
+    p.add_argument("--aot", action="store_true")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="stage-manifest commits land here: each "
+                        "completed stage writes a durable manifest "
+                        "(ckpt/store.py discipline) — see --resume")
+    p.add_argument("--resume", action="store_true",
+                   help="skip every stage whose manifest verifies and "
+                        "continue from the last completed stage's "
+                        "commit point")
+    p.add_argument("--workdir", default=".")
+    p.add_argument("--check", action="store_true",
+                   help="also run the OTHER handoff mode (staged vs "
+                        "chained) in-process and verify the results "
+                        "are identical")
+    p.add_argument("--stats", action="store_true")
+    p.add_argument("--stats-json", default=None,
+                   help="write the plan stats scope (plan_* keys) as "
+                        "JSON there — the bench row's parse surface")
+    p.add_argument("--trace-dir", default=None)
+    args = p.parse_args(argv)
+
+    if args.resume and not args.checkpoint_dir:
+        p.error("--resume requires --checkpoint-dir")
+    if args.chain == "grep-wc" and not args.pattern:
+        p.error("--chain grep-wc requires --pattern")
+
+    if args.trace_dir:
+        from dsi_tpu.obs import configure_tracing
+
+        configure_tracing(trace_dir=args.trace_dir)
+
+    from dsi_tpu.utils.platformpin import pin_platform_from_env
+
+    pin_platform_from_env()
+
+    from dsi_tpu.ckpt import CheckpointMismatch
+    from dsi_tpu.parallel.shuffle import default_mesh
+    from dsi_tpu.plan import (PlanHostPath, grep_wordcount_plan,
+                              indexer_join_plan, run_plan)
+
+    mesh = default_mesh(args.devices)
+    defaults = dict(chunk_bytes=args.chunk_bytes,
+                    depth=args.pipeline_depth,
+                    device_accumulate=args.device_accumulate,
+                    sync_every=args.sync_every,
+                    mesh_shards=args.mesh_shards, aot=args.aot,
+                    n_reduce=args.nreduce, u_cap=args.u_cap,
+                    topk=args.topk)
+
+    def build():
+        if args.chain == "grep-wc":
+            return grep_wordcount_plan(args.pattern, paths=args.files,
+                                       **defaults)
+        docs = []
+        for path in args.files:
+            with open(path, "rb") as f:
+                docs.append(f.read())
+        return indexer_join_plan(docs, **defaults)  # topk rides defaults
+
+    stats: dict = {}
+    try:
+        res = run_plan(build(), mesh=mesh, staged=args.staged,
+                       checkpoint_dir=args.checkpoint_dir,
+                       resume=args.resume, stats=stats)
+    except CheckpointMismatch as e:
+        print(f"planrun: {e}", file=sys.stderr)
+        return 1
+    except PlanHostPath as e:
+        # The chain contract is device-resident intermediates; a
+        # host-path input breaks it loudly — run the standalone engines
+        # (wcstream/grepstream) for such inputs.
+        print(f"planrun: {e}", file=sys.stderr)
+        return 1
+
+    if args.resume:
+        print(f"planrun: resumed past "
+              f"{stats.get('plan_resumed_stages', 0)} committed "
+              f"stage(s)", file=sys.stderr)
+    for name, wall in stats.get("plan_stage_walls", {}).items():
+        print(f"planrun: stage {name}: {wall}s", file=sys.stderr)
+    print(f"planrun: handoff={stats.get('plan_handoff')} "
+          f"intermediate_bytes={stats.get('plan_intermediate_bytes')} "
+          f"commit_bytes={stats.get('plan_commit_bytes')}",
+          file=sys.stderr)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    if args.chain == "grep-wc":
+        from dsi_tpu.parallel.shuffle import write_partitioned_output
+
+        g = res.results["grep"]
+        print(f"planrun: grep lines={g.lines} matched={g.matched} "
+              f"occurrences={g.occurrences}", file=sys.stderr)
+        write_partitioned_output(res.final, args.nreduce, args.workdir)
+    else:
+        out = {w: {"df": df, "part": part, "docs": list(docs)}
+               for w, (df, part, docs) in res.final.items()}
+        path = os.path.join(args.workdir, "plan-join.json")
+        # dsicheck: allow[raw-write] report artifact, not durable state
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"topk": [[c, w] for c, w in
+                                res.results.get("dftopk", ())],
+                       "join": out}, f, sort_keys=True, indent=1)
+        print(f"planrun: join of {len(out)} terms -> {path}",
+              file=sys.stderr)
+
+    if args.stats:
+        print(f"planrun: plan_stats={stats}", file=sys.stderr)
+    if args.stats_json:
+        # dsicheck: allow[raw-write] bench parse surface, not durable state
+        with open(args.stats_json, "w", encoding="utf-8") as f:
+            json.dump({k: v for k, v in stats.items()}, f, default=str)
+    if args.trace_dir:
+        from dsi_tpu.obs import flush_tracing_report
+
+        flush_tracing_report(args.trace_dir, "planrun")
+
+    if args.check:
+        twin = run_plan(build(), mesh=mesh, staged=not args.staged)
+        ok = twin.final == res.final
+        if args.chain == "grep-wc":
+            ok = ok and twin.results["grep"] == res.results["grep"]
+        if not ok:
+            print("planrun: PARITY FAILURE chained vs staged",
+                  file=sys.stderr)
+            return 2
+        print("planrun: parity OK (chained == staged)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,9 @@ host visibility).
   histogram the grep/indexer streaming engines fold into.
 * :mod:`~dsi_tpu.device.policy` — :class:`SyncPolicy`, the one owner of
   the every-K-folds pull cadence.
+* :mod:`~dsi_tpu.device.relay` — :class:`DeviceRelay` /
+  :class:`HostRelay`, the plan layer's inter-stage byte handoff (stage
+  N+1's upload IS stage N's device-resident output).
 """
 
 from dsi_tpu.device.policy import (SyncPolicy, mesh_shards_default,
@@ -26,6 +29,7 @@ from dsi_tpu.device.table import (
     warm_device_fold,
 )
 from dsi_tpu.device.postings import DevicePostings
+from dsi_tpu.device.relay import DeviceRelay, HostRelay
 from dsi_tpu.device.topk import (
     DeviceHistogram,
     DeviceTopK,
@@ -39,8 +43,10 @@ from dsi_tpu.device.topk import (
 __all__ = [
     "DeviceHistogram",
     "DevicePostings",
+    "DeviceRelay",
     "DeviceTable",
     "DeviceTopK",
+    "HostRelay",
     "KeyCounts",
     "SyncPolicy",
     "device_fold_persisted",

@@ -1,0 +1,282 @@
+"""Device-resident stage relay: the plan layer's inter-stage byte buffer.
+
+A multi-stage plan (``dsi_tpu/plan``) chains engines so that stage N+1's
+upload IS stage N's device-resident output.  The unit of that handoff is
+a byte stream in the engines' native batch layout — ``[n_dev, cap]``
+uint8 rows, zero-padded past the fill point — and this module owns the
+two relay flavors the plan driver chooses between:
+
+* :class:`DeviceRelay` — the chained path.  A producing stage appends
+  each confirmed step's compacted output (e.g. the grep emit kernel's
+  matching-line bytes) WITHOUT pulling it: a compiled per-row pack
+  program concatenates the new bytes after the current fill point of a
+  device-resident accumulation buffer, sealing a buffer when the next
+  append would overflow it and starting the next one from the appended
+  chunk itself.  The consuming stage iterates :meth:`batches` and feeds
+  the buffers straight into its step program — zero intermediate bytes
+  cross the host (``plan_intermediate_bytes`` stays 0) unless a spill
+  budget forces the oldest sealed buffers out (the spill-compacted
+  fallback for intermediates wider than HBM).
+* :class:`HostRelay` — the staged baseline.  Every append pulls the
+  compacted bytes to the host (the full host round-trip the plan layer
+  exists to remove), and the consumer reads a plain block stream.  Same
+  byte content as the device path by construction, which is what makes
+  the two modes bit-comparable end to end.
+
+Byte-stream contract (what makes the handoff chunking-safe): producers
+append whole newline-terminated lines per device row, so every relay row
+boundary falls on a line boundary and the zero tail of a buffer row
+terminates any final token — a downstream word-count over the relay sees
+exactly the same token multiset as the staged baseline's contiguous
+stream, whatever the buffer chunking.
+
+Durability: :meth:`DeviceRelay.capture` pulls a NON-destructive image of
+every live buffer (the stage-commit payload — device copies stay
+resident for the downstream stage), and :meth:`DeviceRelay.restore`
+rebuilds a relay from that image in host mode, which is how a crashed
+chain resumes from the last completed stage's commit instead of from
+zero.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsi_tpu.parallel.shuffle import AXIS
+
+#: jax.jit donate_argnums for the pack program: both the accumulation
+#: buffer (rebound to the program's output) and the appended chunk are
+#: consumed by the concatenation.
+_RELAY_DONATE = (0, 2)
+
+
+def _pack_impl(acc, off, new):
+    """Per-row concatenation at a dynamic offset: ``out[r, i] = acc[r, i]``
+    for ``i < off[r]`` else ``new[r, i - off[r]]``.  Pure elementwise +
+    per-row gather, so a ``[AXIS, None]``-sharded call stays shard-local
+    (no collectives — each device packs its own row)."""
+    n = acc.shape[1]
+    idx = jnp.arange(n, dtype=jnp.int32)[None, :]
+    offc = off[:, None].astype(jnp.int32)
+    shifted = jnp.take_along_axis(new, jnp.clip(idx - offc, 0, n - 1),
+                                  axis=1)
+    return jnp.where(idx < offc, acc, shifted)
+
+
+_pack_jit = jax.jit(_pack_impl, donate_argnums=_RELAY_DONATE)
+
+
+def _relay_pack_program(*, n_dev: int, cap: int):
+    """(name, fn) for one compiled relay pack shape — the shared
+    definition discipline (``streaming._step_program``)."""
+
+    def fn(acc, off, new):
+        return _pack_impl(acc, off, new)
+
+    return f"plan_pack_d{n_dev}_c{cap}", fn
+
+
+def _relay_structs(n_dev: int, cap: int):
+    sds = jax.ShapeDtypeStruct
+    return (sds((n_dev, cap), jnp.uint8), sds((n_dev,), jnp.int32),
+            sds((n_dev, cap), jnp.uint8))
+
+
+def _pack_fn(aot: bool, *, n_dev: int, cap: int):
+    if not aot:
+        return _pack_jit
+    from dsi_tpu.backends import aotcache
+    from dsi_tpu.device.table import _quiet_unusable_donation
+
+    name, fn = _relay_pack_program(n_dev=n_dev, cap=cap)
+    with _quiet_unusable_donation():
+        return aotcache.cached_compile(name, fn, _relay_structs(n_dev, cap),
+                                       donate_argnums=_RELAY_DONATE)
+
+
+class DeviceRelay:
+    """Device-resident inter-stage byte buffer (module docstring).
+
+    ``stats`` is the plan run's metrics scope: ``plan_intermediate_bytes``
+    counts bytes that crossed the host on the HANDOFF path (0 here unless
+    spilled), ``plan_relay_buffers`` the sealed-buffer count, and
+    ``plan_spilled_bytes`` the spill volume.  ``spill_bytes`` bounds
+    device residency: when the relay's buffer bytes exceed it, the oldest
+    sealed buffers are pulled to the host (counted) until back under.
+    """
+
+    def __init__(self, mesh: Mesh, *, cap: int, aot: bool = False,
+                 stats: Optional[dict] = None, spill_bytes: int = 0):
+        self.mesh = mesh
+        self.n_dev = int(mesh.devices.size)
+        self.cap = int(cap)
+        self.aot = bool(aot)
+        self.stats = stats if stats is not None else {}
+        self.stats.setdefault("plan_intermediate_bytes", 0)
+        self.stats.setdefault("plan_handoff_bytes", 0)
+        self.stats.setdefault("plan_relay_buffers", 0)
+        self.stats.setdefault("plan_spilled_bytes", 0)
+        self.spill_bytes = max(0, int(spill_bytes))
+        self._sh = NamedSharding(mesh, P(AXIS, None))
+        self._sh1 = NamedSharding(mesh, P(AXIS))
+        #: Sealed buffers in append order: jax.Array (device-resident)
+        #: or np.ndarray (spilled / restored), each with its fill lens.
+        self._sealed: List = []
+        self._sealed_lens: List[np.ndarray] = []
+        self._acc = None
+        self._lens = np.zeros(self.n_dev, dtype=np.int64)
+        #: Total content bytes appended (the logical intermediate size).
+        self.total_bytes = 0
+
+    # ── producer side ──
+
+    def append(self, comp_dev, kept: np.ndarray) -> None:
+        """Append one confirmed step's compacted ``[n_dev, cap]`` output
+        (fill ``kept[r]`` bytes per row, zero tail).  ``comp_dev`` is
+        consumed (donated to the pack program or adopted as the next
+        accumulation buffer) — the producer must not reuse it."""
+        kept = np.asarray(kept, dtype=np.int64)
+        if int(kept.sum()) == 0:
+            return
+        self.total_bytes += int(kept.sum())
+        self.stats["plan_handoff_bytes"] += int(kept.sum())
+        if self._acc is None:
+            self._acc = comp_dev
+            self._lens = kept.copy()
+        elif bool(((self._lens + kept) > self.cap).any()):
+            self._seal()
+            self._acc = comp_dev
+            self._lens = kept.copy()
+        else:
+            off = jax.device_put(self._lens.astype(np.int32), self._sh1)
+            fn = _pack_fn(self.aot, n_dev=self.n_dev, cap=self.cap)
+            self._acc = fn(self._acc, off, comp_dev)
+            self._lens += kept
+        self._maybe_spill()
+
+    def _seal(self) -> None:
+        self._sealed.append(self._acc)
+        self._sealed_lens.append(self._lens.copy())
+        self._acc = None
+        self.stats["plan_relay_buffers"] += 1
+
+    def _maybe_spill(self) -> None:
+        if not self.spill_bytes:
+            return
+        buf_bytes = self.n_dev * self.cap
+
+        def resident() -> int:
+            live = sum(1 for b in self._sealed
+                       if not isinstance(b, np.ndarray))
+            return (live + (1 if self._acc is not None else 0)) * buf_bytes
+
+        i = 0
+        while resident() > self.spill_bytes and i < len(self._sealed):
+            if not isinstance(self._sealed[i], np.ndarray):
+                host = np.asarray(self._sealed[i])
+                content = int(self._sealed_lens[i].sum())
+                self._sealed[i] = host
+                self.stats["plan_spilled_bytes"] += content
+                self.stats["plan_intermediate_bytes"] += content
+            i += 1
+
+    # ── consumer side ──
+
+    def batches(self) -> Iterator:
+        """Yield every buffer (sealed first, then the open tail) in
+        append order, dropping the relay's own reference as each is
+        handed over — the downstream stage owns (and may donate) it.
+        Host-resident buffers (spills, restores) yield as np.ndarray;
+        the consumer's upload of those is the counted fallback path."""
+        if self._acc is not None:
+            self._seal()
+        while self._sealed:
+            yield self._sealed.pop(0)
+            self._sealed_lens.pop(0)
+
+    # ── durability (the stage-commit payload) ──
+
+    def capture(self) -> Dict[str, np.ndarray]:
+        """NON-destructive host image of every live buffer: the stage
+        commit's payload.  Device copies stay resident — the downstream
+        stage still consumes them directly; these pulls are durability
+        cost (``plan_commit_bytes``), not handoff bytes."""
+        arrays: Dict[str, np.ndarray] = {}
+        bufs = list(self._sealed) + (
+            [self._acc] if self._acc is not None else [])
+        lens = list(self._sealed_lens) + (
+            [self._lens] if self._acc is not None else [])
+        for i, (b, ln) in enumerate(zip(bufs, lens)):
+            arrays[f"rbuf{i}"] = np.asarray(b)
+            arrays[f"rlen{i}"] = np.asarray(ln, dtype=np.int64)
+        arrays["rcount"] = np.array([len(bufs)], dtype=np.int64)
+        return arrays
+
+    @classmethod
+    def restore(cls, mesh: Mesh, arrays: Dict[str, np.ndarray], *,
+                cap: int, stats: Optional[dict] = None) -> "DeviceRelay":
+        """Rebuild a relay from a :meth:`capture` image, host-resident
+        (the consumer re-uploads — the resume path's restaging cost,
+        counted under ``plan_restored_bytes``)."""
+        relay = cls(mesh, cap=cap, stats=stats)
+        relay.stats.setdefault("plan_restored_bytes", 0)
+        n = int(arrays.get("rcount", np.zeros(1))[0])
+        for i in range(n):
+            relay._sealed.append(np.asarray(arrays[f"rbuf{i}"],
+                                            dtype=np.uint8))
+            ln = np.asarray(arrays[f"rlen{i}"], dtype=np.int64)
+            relay._sealed_lens.append(ln)
+            relay.total_bytes += int(ln.sum())
+            relay.stats["plan_restored_bytes"] += int(ln.sum())
+        relay.stats["plan_relay_buffers"] += n
+        return relay
+
+
+class HostRelay:
+    """The staged-baseline handoff: every append pulls the compacted
+    bytes to the host; the consumer reads one contiguous block stream —
+    the full host round-trip between stages, byte-identical content to
+    :class:`DeviceRelay`'s by construction."""
+
+    def __init__(self, stats: Optional[dict] = None):
+        self.stats = stats if stats is not None else {}
+        self.stats.setdefault("plan_intermediate_bytes", 0)
+        self.stats.setdefault("plan_handoff_bytes", 0)
+        self._chunks: List[bytes] = []
+        self.total_bytes = 0
+
+    def append(self, comp_dev, kept: np.ndarray) -> None:
+        comp_np = np.asarray(comp_dev)
+        kept = np.asarray(kept, dtype=np.int64)
+        for r in range(comp_np.shape[0]):
+            k = int(kept[r])
+            if k:
+                self._chunks.append(comp_np[r, :k].tobytes())
+        content = int(kept.sum())
+        self.total_bytes += content
+        self.stats["plan_handoff_bytes"] += content
+        self.stats["plan_intermediate_bytes"] += content
+
+    def blocks(self) -> Iterator[bytes]:
+        yield from self._chunks
+
+    def capture(self) -> Dict[str, np.ndarray]:
+        """Stage-commit payload: the materialized stream as one array."""
+        joined = b"".join(self._chunks)
+        return {"hbytes": np.frombuffer(joined, dtype=np.uint8).copy()}
+
+    @classmethod
+    def restore(cls, arrays: Dict[str, np.ndarray],
+                stats: Optional[dict] = None) -> "HostRelay":
+        relay = cls(stats=stats)
+        raw = np.asarray(arrays.get("hbytes", np.zeros(0, np.uint8)),
+                         dtype=np.uint8).tobytes()
+        if raw:
+            relay._chunks.append(raw)
+            relay.total_bytes = len(raw)
+        return relay

@@ -61,6 +61,21 @@ from the chunk-upload codec (``ops/wirecodec.py``);
 ``ckpt_compress_s`` phase are the compressed-checkpoint attribution
 (``ckpt/store.py`` via the writer).
 
+Plan-layer keys (``dsi_tpu/plan`` — the "plan" scope of a multi-stage
+chain run): ``plan_stages`` (stage count), ``plan_handoff``
+(``device``/``host`` — which relay flavor carried the intermediates),
+``plan_intermediate_bytes`` (bytes that crossed the host on the
+inter-stage handoff path: 0 on an unspilled device-relay chain, the
+full materialization on the staged baseline), ``plan_handoff_bytes``
+(total intermediate content the relays carried — the saved-bytes
+denominator), ``plan_relay_buffers`` /
+``plan_spilled_bytes`` / ``plan_restored_bytes`` (relay residency
+accounting), ``plan_commit_bytes`` (durable stage-manifest payloads —
+durability cost, deliberately NOT handoff bytes),
+``plan_resumed_stages`` (stages skipped by a resume from stage
+manifests), ``plan_stage_walls`` (per-stage wall seconds, keyed by
+stage name), plus the ``plan_s`` / ``stage_commit_s`` phases.
+
 Mesh-sharded service keys (``mesh_shards`` > 0, the shuffle-fold path
 — ``device/table.py``): ``mesh_shards`` (the sharding degree),
 ``pull_bytes`` (total D2H drain payload, counted in BOTH modes — the
@@ -136,6 +151,8 @@ PHASE_KEYS = (
     "ckpt_commit_s", "ckpt_barrier_s",
     # compressed wire + ingest (ISSUE 13)
     "decode_s", "ingest_wait_s", "ckpt_compress_s",
+    # plan layer (ISSUE 14): per-stage walls + stage-commit writes
+    "plan_s", "stage_commit_s",
 )
 
 #: The canonical counter/gauge keys (module docstring) — previously
@@ -166,6 +183,13 @@ COUNTER_KEYS = (
     # serving daemon (the "serve" scope, serve/pack.py)
     "packed_steps", "packed_rows", "max_tenants_per_step",
     "host_fallbacks",
+    # plan layer (the "plan" scope, dsi_tpu/plan + device/relay.py):
+    # multi-stage chain accounting — handoff bytes vs commit bytes is
+    # the zero-host-round-trip evidence
+    "plan_stages", "plan_handoff", "plan_handoff_bytes",
+    "plan_intermediate_bytes", "plan_commit_bytes",
+    "plan_relay_buffers", "plan_spilled_bytes", "plan_restored_bytes",
+    "plan_resumed_stages", "plan_stage_walls",
 )
 
 #: THE schema: every key an engine scope may carry, under its unified

@@ -64,8 +64,8 @@ _HOT_STAGES = frozenset(_hist.HIST_STAGES)
 #: display order, then the device-service lanes, then the control plane.
 LANES = (
     "materialize", "upload", "dispatch", "kernel", "pull", "merge",
-    "replay", "shuffle", "fold", "sync", "widen", "ckpt", "control",
-    "counters",
+    "replay", "shuffle", "fold", "sync", "widen", "ckpt", "plan",
+    "control", "counters",
 )
 
 #: The pinned span-name schema: every span opened anywhere in the repo
@@ -78,7 +78,7 @@ LANES = (
 SPAN_NAMES = frozenset(LANES) | frozenset((
     "wait", "finish", "drain", "append", "hist_fold", "hist_pull",
     "ckpt_capture", "ckpt_commit", "ckpt_save", "ckpt_restore", "task",
-    "decode",
+    "decode", "stage_commit",
 ))
 
 _BUFFER_ENV = "DSI_TRACE_BUFFER_EVENTS"

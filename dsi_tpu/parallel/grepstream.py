@@ -241,13 +241,19 @@ def batch_lines(blocks: Iterable[bytes], n_dev: int, chunk_bytes: int,
 
 
 def _grep_step_device(chunk, pat, dlen, base, *, l_cap: int, bins: int,
-                      k: int):
+                      k: int, emit: bool = False):
     """Per-device step body (runs under shard_map): literal match mask
     (``len(pattern)`` shifted compares, the ``ops/grepk.py`` idiom) →
     per-line occurrence counts (cumsum line ids + segment-sum) →
     histogram, totals, and the top-k candidate rows in DeviceTable's
     packed (key lanes, len, count, part) layout with the GLOBAL line
-    number (``base`` + local) as the kk=2 key."""
+    number (``base`` + local) as the kk=2 key.
+
+    ``emit=True`` (the plan layer's stage handoff, ``dsi_tpu/plan``)
+    additionally COMPACTS the matching lines' bytes to the front of a
+    ``[n]`` output row (stable partition, zero tail) plus the kept byte
+    count — the device-resident intermediate a downstream stage consumes
+    without any host round-trip."""
     n = chunk.shape[-1]
     m = pat.shape[-1]
     chunk = chunk.reshape(-1)
@@ -315,32 +321,53 @@ def _grep_step_device(chunk, pat, dlen, base, *, l_cap: int, bins: int,
     # program's [n_dev, 5] int32 contract (device/table._step_structs).
     scal = jnp.stack([n_cand, n_lines, overflow.astype(jnp.int32),
                       matched, occurrences]).astype(jnp.int32)
-    return hist_ext[None], cand[None], scal[None]
+    if not emit:
+        return hist_ext[None], cand[None], scal[None]
+    # Matching-line compaction: keep every byte whose line matched (the
+    # terminating newline included — a newline at position i has
+    # line_id == its own line's id), stable-partition kept bytes to the
+    # front (sort by (dropped, position) — order-preserving), zero the
+    # tail.  Rows past l_cap attribute arbitrarily, but such a step
+    # raises the overflow flag and replays wider before confirmation,
+    # so a confirmed emit is always exact.
+    keep = valid & (jnp.take(occv, jnp.minimum(line_id, l_cap - 1)) > 0)
+    keep_inv = jnp.where(keep, jnp.int32(0), jnp.int32(1))
+    _, _, comp = lax.sort((keep_inv, pos, chunk), num_keys=2)
+    kept_n = jnp.sum(keep.astype(jnp.int32))
+    comp = jnp.where(pos < kept_n, comp, 0)
+    return (hist_ext[None], cand[None], scal[None], comp[None],
+            kept_n.reshape(1))
 
 
 def _grep_step_impl(chunks, pats, lens, bases, *, l_cap: int, bins: int,
-                    k: int, mesh: Mesh):
-    body = functools.partial(_grep_step_device, l_cap=l_cap, bins=bins, k=k)
+                    k: int, mesh: Mesh, emit: bool = False):
+    body = functools.partial(_grep_step_device, l_cap=l_cap, bins=bins,
+                             k=k, emit=emit)
+    out_specs = (P(AXIS, None), P(AXIS, None, None), P(AXIS, None))
+    if emit:
+        out_specs += (P(AXIS, None), P(AXIS))
     return shard_map(
         body, mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS, None), P(AXIS, None, None), P(AXIS, None)),
+        out_specs=out_specs,
     )(chunks, pats, lens, bases)
 
 
 def _grep_program(*, n_dev: int, chunk_bytes: int, m: int, l_cap: int,
-                  bins: int, k: int, mesh: Mesh):
+                  bins: int, k: int, mesh: Mesh, emit: bool = False):
     """(name, fn) for one compiled grep step shape — single definition
     shared by the run, the warmer, and the cache-existence probe (the
-    ``streaming._step_program`` discipline)."""
+    ``streaming._step_program`` discipline).  The emit variant (the plan
+    handoff's extra compaction outputs) is a distinct executable and
+    gets a distinct name."""
 
     def fn(chunks, pats, lens, bases):
         return _grep_step_impl(chunks, pats, lens, bases, l_cap=l_cap,
-                               bins=bins, k=k, mesh=mesh)
+                               bins=bins, k=k, mesh=mesh, emit=emit)
 
     fn._aot_code_deps = (_wc_mod, _grepk_mod)
     name = (f"grep_stream_d{n_dev}_c{chunk_bytes}_m{m}_l{l_cap}"
-            f"_b{bins}_t{k}")
+            f"_b{bins}_t{k}" + ("_em" if emit else ""))
     return name, fn
 
 
@@ -433,7 +460,14 @@ class GrepStep(EngineStep):
     and semantics.  A non-literal pattern routes to the host path at
     construction (the object is already terminal, ``close()`` → None);
     ``resume=True`` restores the newest valid chain before the first
-    dispatch."""
+    dispatch.
+
+    ``line_sink`` (the plan layer's stage handoff, ``dsi_tpu/plan``) is
+    a relay — :class:`~dsi_tpu.device.relay.DeviceRelay` or
+    :class:`~dsi_tpu.device.relay.HostRelay` — receiving every confirmed
+    step's compacted matching-line bytes via ``append(comp, kept)``:
+    the step program grows the emit outputs and the downstream stage's
+    upload becomes this stage's device-resident output."""
 
     def __init__(self, blocks: Iterable[bytes], pattern: str,
                  mesh: Mesh | None = None, chunk_bytes: int = 1 << 20,
@@ -447,13 +481,13 @@ class GrepStep(EngineStep):
                  checkpoint_every: Optional[int] = None,
                  checkpoint_async: Optional[bool] = None,
                  checkpoint_delta: Optional[bool] = None,
-                 resume: bool = False):
+                 resume: bool = False, line_sink=None):
         super().__init__()
         _grep_setup(self, blocks, pattern, mesh, chunk_bytes, depth, aot,
                     device_accumulate, sync_every, mesh_shards, topk,
                     bins, pipeline_stats, checkpoint_dir,
                     checkpoint_every, checkpoint_async, checkpoint_delta,
-                    resume)
+                    resume, line_sink)
 
 
 def grep_streaming(
@@ -534,10 +568,18 @@ def grep_streaming(
 def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
                 device_accumulate, sync_every, mesh_shards, topk, bins,
                 pipeline_stats, checkpoint_dir, checkpoint_every,
-                checkpoint_async, checkpoint_delta, resume):
+                checkpoint_async, checkpoint_delta, resume,
+                line_sink=None):
     """The engine body behind :class:`GrepStep`: full setup (resume
     restore included) ending with the pipeline armed and the lifecycle
     hooks attached to ``step``."""
+    emit = line_sink is not None
+    if emit and checkpoint_dir:
+        # The relay's content is not part of the engine checkpoint, so a
+        # mid-stage resume would drop already-emitted lines; chains
+        # commit at stage boundaries instead (plan/driver.py).
+        raise ValueError("line_sink and checkpoint_dir are exclusive: "
+                         "chained stages commit at stage boundaries")
     if not is_literal_pattern(pattern):
         step._phase = "hostpath"  # terminal before any device work
         return
@@ -769,9 +811,12 @@ def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
                 bases = jax.device_put(bases_np.astype(np.uint64), sh1)
         fn = _grep_fn((chunks, pat_dev, lens, bases), n_dev=n_dev,
                       chunk_bytes=chunk_bytes, m=m, l_cap=l_cap, bins=bins,
-                      k=topk, mesh=mesh)
+                      k=topk, mesh=mesh, emit=emit)
         with _quiet_unusable_donation():
-            return fn(chunks, pat_dev, lens, bases)
+            outs = fn(chunks, pat_dev, lens, bases)
+        if emit:
+            return outs  # (hist, cand, scal, comp, kept)
+        return outs + (None, None)
 
     def dispatch(item):
         buf, lens_np, row_lines = item
@@ -780,8 +825,8 @@ def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
         np.cumsum(row_lines[:-1], out=bases[1:])
         bases[1:] += next_line[0]
         next_line[0] += int(row_lines.sum())
-        hist_d, cand_d, scal = step_call(buf, lens_np, bases,
-                                         state["l_cap"])
+        hist_d, cand_d, scal, comp_d, kept_d = step_call(
+            buf, lens_np, bases, state["l_cap"])
         stats["steps"] += 1
         rec_offset = 0
         if offsets is not None:
@@ -789,33 +834,36 @@ def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
             dispatch_idx[0] += 1
         fault_point("post-dispatch")
         return (buf, lens_np, row_lines, bases, state["l_cap"],
-                hist_d, cand_d, scal, rec_offset, next_line[0])
+                hist_d, cand_d, scal, comp_d, kept_d, rec_offset,
+                next_line[0])
 
     def replay_step(buf, lens_np, bases_np, used_l_cap):
         """Late-detected line-capacity overflow: replay just this step
         at the wider sticky rung.  Exactly-once — the optimistic
-        attempt's tensors are dropped unmerged."""
+        attempt's tensors are dropped unmerged (the emit outputs
+        included: occurrence counts and kept bytes do not depend on the
+        rung, so the replay reproduces them exactly)."""
         stats["replays"] += 1
         with _span("replay", stats=stats, key="replay_s"):
             for l_cap in rungs:
                 if l_cap <= used_l_cap:
                     continue
-                hist_d, cand_d, scal = step_call(buf, lens_np, bases_np,
-                                                 l_cap)
+                hist_d, cand_d, scal, comp_d, kept_d = step_call(
+                    buf, lens_np, bases_np, l_cap)
                 scal_np = np.asarray(scal)
                 if not scal_np[:, 2].any():
                     state["l_cap"] = max(state["l_cap"], l_cap)
                     stats["l_cap"] = state["l_cap"]
-                    return hist_d, cand_d, scal, scal_np
+                    return hist_d, cand_d, scal, comp_d, kept_d, scal_np
         raise RuntimeError("grep l_cap ladder exhausted (n+1 must fit)")
 
     def finish_one(record) -> None:
         buf, lens_np, row_lines, bases_np, l_cap_used, hist_d, cand_d, \
-            scal, rec_offset, rec_lines = record
+            scal, comp_d, kept_d, rec_offset, rec_lines = record
         with _span("kernel", stats=stats, key="kernel_s"):
             scal_np = np.asarray(scal)  # blocks until the kernel lands
         if scal_np[:, 2].any():  # l_cap overflow: replay wider, sticky
-            hist_d, cand_d, scal, scal_np = replay_step(
+            hist_d, cand_d, scal, comp_d, kept_d, scal_np = replay_step(
                 buf, lens_np, bases_np, l_cap_used)
         if not np.array_equal(scal_np[:, 1].astype(np.int64), row_lines):
             # The global line numbering depends on host/device agreeing
@@ -850,6 +898,14 @@ def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
                         line = (int(cand_np[d, i, 0]) << 32) | int(
                             cand_np[d, i, 1])
                         cand_h.append((line, int(cand_np[d, i, 3])))
+        if emit:
+            # The stage handoff: this confirmed step's compacted
+            # matching-line bytes flow into the relay — device-resident
+            # (DeviceRelay packs on device) or pulled (HostRelay, the
+            # staged baseline).  The kept counts are the only host-side
+            # metadata (n_dev int32s).
+            kept_np = np.asarray(kept_d).astype(np.int64)
+            line_sink.append(comp_d, kept_np)
         # Confirmed: merged/folded, nothing later is.  Fault before the
         # cursor advances — the torn-update instant.
         fault_point("mid-fold")
@@ -924,13 +980,15 @@ def warm_grepstream_aot(mesh: Mesh | None = None,
                         chunk_bytes: int = 1 << 20, pattern_len: int = 3,
                         bins: int = GREP_BINS, topk: int = DEFAULT_TOPK,
                         device_accumulate: bool = False,
-                        mesh_shards: int = 0) -> None:
+                        mesh_shards: int = 0, emit: bool = False) -> None:
     """Compile + persist the grep step programs at BOTH ``l_cap`` rungs
     (the optimistic and the ``n + 1`` replay shape — an ungated
     escalation must load, never cold-compile) plus, with
     ``device_accumulate``, the top-k fold/snapshot and histogram fold
     shapes (the ``mesh_*`` shuffle-fold variants under ``mesh_shards``).
-    From shape structs alone; mirror of ``warm_stream_aot``."""
+    ``emit`` additionally warms the plan handoff's ``*_em`` compaction
+    variant (and the relay pack program at this chunk shape).  From
+    shape structs alone; mirror of ``warm_stream_aot``."""
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -938,6 +996,14 @@ def warm_grepstream_aot(mesh: Mesh | None = None,
     for l_cap in line_cap_rungs(chunk_bytes):
         _grep_fn(examples, n_dev=n_dev, chunk_bytes=chunk_bytes,
                  m=pattern_len, l_cap=l_cap, bins=bins, k=topk, mesh=mesh)
+        if emit:
+            _grep_fn(examples, n_dev=n_dev, chunk_bytes=chunk_bytes,
+                     m=pattern_len, l_cap=l_cap, bins=bins, k=topk,
+                     mesh=mesh, emit=True)
+    if emit:
+        from dsi_tpu.device.relay import _pack_fn
+
+        _pack_fn(True, n_dev=n_dev, cap=chunk_bytes)
     if device_accumulate:
         from dsi_tpu.device.topk import warm_histogram, warm_topk_service
 
@@ -951,7 +1017,7 @@ def grepstream_persisted(mesh: Mesh | None = None,
                          chunk_bytes: int = 1 << 20, pattern_len: int = 3,
                          bins: int = GREP_BINS, topk: int = DEFAULT_TOPK,
                          device_accumulate: bool = False,
-                         mesh_shards: int = 0) -> bool:
+                         mesh_shards: int = 0, emit: bool = False) -> bool:
     """True when every program a ``grep_streaming`` run at these shapes
     can reach (both ``l_cap`` rungs; plus the device services', keyed on
     the ``mesh_*`` variants under ``mesh_shards``) is in the persistent
@@ -964,11 +1030,21 @@ def grepstream_persisted(mesh: Mesh | None = None,
     n_dev = mesh.devices.size
     examples = _grep_examples(n_dev, chunk_bytes, pattern_len)
     for l_cap in line_cap_rungs(chunk_bytes):
-        name, fn = _grep_program(n_dev=n_dev, chunk_bytes=chunk_bytes,
-                                 m=pattern_len, l_cap=l_cap, bins=bins,
-                                 k=topk, mesh=mesh)
-        if not is_persisted(name, fn, examples,
-                            donate_argnums=_GREP_DONATE):
+        for em in ((False, True) if emit else (False,)):
+            name, fn = _grep_program(n_dev=n_dev, chunk_bytes=chunk_bytes,
+                                     m=pattern_len, l_cap=l_cap, bins=bins,
+                                     k=topk, mesh=mesh, emit=em)
+            if not is_persisted(name, fn, examples,
+                                donate_argnums=_GREP_DONATE):
+                return False
+    if emit:
+        from dsi_tpu.device.relay import (_RELAY_DONATE,
+                                          _relay_pack_program,
+                                          _relay_structs)
+
+        name, fn = _relay_pack_program(n_dev=n_dev, cap=chunk_bytes)
+        if not is_persisted(name, fn, _relay_structs(n_dev, chunk_bytes),
+                            donate_argnums=_RELAY_DONATE):
             return False
     if device_accumulate:
         from dsi_tpu.device.topk import (histogram_persisted,
@@ -1094,7 +1170,15 @@ class IndexerStep(EngineStep):
     word-window rung ladder lives INSIDE the lifecycle: a wave proving
     the rung too narrow tears it down and ``advance()`` transparently
     restarts at the 64-byte rung; non-ASCII input (or a word wider than
-    64 bytes) routes to the host path (``close()`` → None)."""
+    64 bytes) routes to the host path (``close()`` → None).
+
+    ``keep_services=True`` (the plan layer's stage handoff) completes
+    the walk WITHOUT draining the device services: ``exported`` then
+    carries the live :class:`DeviceTopK` df table, the
+    :class:`DevicePostings` buffer, and the host accumulators, so a
+    downstream stage can take a k-row df snapshot (no drain-to-host)
+    and a selective postings join instead of the full materialization;
+    ``result`` is a handoff marker, not the (postings, topk) tuple."""
 
     _rung_excs = (_AbortRung,)
 
@@ -1109,12 +1193,13 @@ class IndexerStep(EngineStep):
                  checkpoint_every: Optional[int] = None,
                  checkpoint_async: Optional[bool] = None,
                  checkpoint_delta: Optional[bool] = None,
-                 resume: bool = False):
+                 resume: bool = False, keep_services: bool = False):
         super().__init__()
         _indexer_setup(self, docs, mesh, n_reduce, max_word_len, u_cap,
                        depth, device_accumulate, sync_every, mesh_shards,
                        topk, stats, checkpoint_dir, checkpoint_every,
-                       checkpoint_async, checkpoint_delta, resume)
+                       checkpoint_async, checkpoint_delta, resume,
+                       keep_services)
 
     def _next_rung(self) -> bool:
         self._pipe.end()
@@ -1195,7 +1280,8 @@ def indexer_streaming(
 def _indexer_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
                    depth, device_accumulate, sync_every, mesh_shards,
                    topk, stats, checkpoint_dir, checkpoint_every,
-                   checkpoint_async, checkpoint_delta, resume):
+                   checkpoint_async, checkpoint_delta, resume,
+                   keep_services=False):
     """The engine body behind :class:`IndexerStep`: corpus-wide setup,
     then ``begin_rung`` (the former per-rung ``run``) arms the pipeline
     and attaches the lifecycle hooks to ``step``."""
@@ -1574,6 +1660,27 @@ def _indexer_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
         pipe.begin(materialize)
 
         def end_ok():
+            if keep_services:
+                # The plan handoff: finish the walk but leave the
+                # device services RESIDENT — no drain-to-host.  The
+                # downstream stages pull a k-row df snapshot
+                # (DeviceTopK.sync) and close the postings buffer
+                # themselves; the host residue travels alongside so a
+                # widen that already drained stays accounted for.
+                try:
+                    if ck_writer is not None:
+                        ck_writer.drain()
+                finally:
+                    if ck_writer is not None:
+                        ck_writer.shutdown()
+                step.exported = {
+                    "kk": kk, "n_real": n_real, "topk": topk,
+                    "device_accumulate": device_accumulate,
+                    "topk_svc": topk_svc, "postings_svc": buf_dev,
+                    "df_acc": df_acc, "table": table,
+                    "buffer_rows": buffer_rows}
+                step.result = ("plan-handoff",)
+                return
             try:
                 if buf_dev is not None:
                     fault_point("pre-sync")

@@ -106,7 +106,10 @@ class BufferPool:
         return np.zeros(self._shape, dtype=self._dtype)
 
     def give(self, buf: Optional[np.ndarray]) -> None:
-        if buf is None or buf.shape != self._shape:
+        # Only host buffers re-enter the pool: a device-resident batch
+        # (the plan layer's stage handoff feeds jax.Arrays through the
+        # same dispatch/finish path) must never be handed to a writer.
+        if not isinstance(buf, np.ndarray) or buf.shape != self._shape:
             return
         with self._lock:
             if len(self._free) < self._retain:

@@ -75,6 +75,10 @@ class EngineStep:
 
     def __init__(self) -> None:
         self.result = None
+        #: Stage-handoff surface (dsi_tpu/plan): engines that complete
+        #: with live device state to pass downstream (e.g. the indexer's
+        #: keep_services mode) publish it here; empty otherwise.
+        self.exported: dict = {}
         self._phase = "running"
         self._pipe = None
         self._save = None

@@ -139,6 +139,7 @@ from dsi_tpu.parallel.shuffle import (
     _mapreduce_step_impl,
     _slice_pack,
     default_mesh,
+    mapreduce_step,
     mapreduce_step_donate,
     occupied_prefix,
 )
@@ -558,7 +559,20 @@ class WordcountStep(EngineStep):
     (now a construct-drive-close wrapper over this class); a
     ``resume=True`` construction restores the newest valid chain BEFORE
     the first dispatch, so device state and sticky rungs exist when the
-    window opens."""
+    window opens.
+
+    ``device_batches`` (the plan layer's stage handoff, ``dsi_tpu/plan``)
+    replaces the block stream with an iterator of ready
+    ``[n_dev, chunk_bytes]`` batches — jax.Arrays consumed IN PLACE
+    (the upstream stage's device-resident output IS this stage's
+    upload; no host bytes move) or np.ndarrays (spilled/restored
+    buffers, re-uploaded like any batch).  Batch rows must respect the
+    engine's cut contract (no token straddles a row's fill point; zero
+    tails terminate the last token).  Step programs run NON-donated in
+    this mode so a late-detected overflow can replay from the same
+    resident buffer; ``checkpoint_dir`` is refused (a byte cursor has
+    no meaning over foreign batches — chains commit at stage
+    boundaries instead)."""
 
     def __init__(self, blocks: Iterable[bytes], mesh: Mesh | None = None,
                  n_reduce: int = 10, chunk_bytes: int = 1 << 20,
@@ -574,14 +588,15 @@ class WordcountStep(EngineStep):
                  checkpoint_async: Optional[bool] = None,
                  checkpoint_delta: Optional[bool] = None,
                  resume: bool = False,
-                 wire_upload: Optional[bool] = None):
+                 wire_upload: Optional[bool] = None,
+                 device_batches=None):
         super().__init__()
         _wordcount_setup(self, blocks, mesh, n_reduce, chunk_bytes,
                          max_word_len, u_cap, aot, on_attempt, depth,
                          pipeline_stats, device_accumulate, sync_every,
                          mesh_shards, checkpoint_dir, checkpoint_every,
                          checkpoint_async, checkpoint_delta, resume,
-                         wire_upload)
+                         wire_upload, device_batches)
 
 
 def wordcount_streaming(
@@ -730,10 +745,14 @@ def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
                      pipeline_stats, device_accumulate, sync_every,
                      mesh_shards, checkpoint_dir, checkpoint_every,
                      checkpoint_async, checkpoint_delta, resume,
-                     wire_upload=None):
+                     wire_upload=None, device_batches=None):
     """The engine body behind :class:`WordcountStep`: full setup
     (``resume=True`` chain restore included) ending with the pipeline
     armed and the lifecycle hooks attached to ``step``."""
+    if device_batches is not None and checkpoint_dir:
+        raise ValueError("device_batches and checkpoint_dir are "
+                         "exclusive: chained stages commit at stage "
+                         "boundaries (dsi_tpu/plan), not byte cursors")
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -764,7 +783,13 @@ def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
     # by default = bit-identical raw uploads; on, a batch the codec
     # cannot shrink still ships raw — the knob only ever changes what
     # crosses the wire, never what HBM (and therefore the result) sees.
-    wire = wirecodec.wire_upload_default(wire_upload)
+    # Device-batch input has no wire to compress (nothing is uploaded).
+    wire = (wirecodec.wire_upload_default(wire_upload)
+            if device_batches is None else False)
+    # Device-resident batches replay from the SAME buffer on a
+    # late-detected overflow, so their step programs must not consume
+    # it — donation is a host-upload optimization only.
+    donate_steps = device_batches is None
     wire_raw_total = [0]  # raw-equivalent bytes of the packed uploads
     if wire:
         stats.update({"wire_upload": True, "wire_steps": 0,
@@ -978,8 +1003,11 @@ def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
                   u_cap=cap, mesh=mesh, t_cap_frac=frac, grouper=g)
         with _quiet_unusable_donation():  # first call per rung compiles
             if aot:
-                return _aot_step(chunks_dev, **kw)
-            return mapreduce_step_donate(chunks_dev, **kw)
+                return _aot_step_fn(chunks_dev, donate=donate_steps,
+                                    **kw)(chunks_dev)
+            if donate_steps:
+                return mapreduce_step_donate(chunks_dev, **kw)
+            return mapreduce_step(chunks_dev, **kw)
 
     def pull_packed(keys, lens, cnts, parts, scal_np):
         """One packed host tensor per step (the single-pull D2H shape,
@@ -1052,6 +1080,11 @@ def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
         if on_attempt is not None:
             on_attempt(mwl, cap)
         chunks = None
+        if not isinstance(buf, np.ndarray):
+            # Device-resident handoff (dsi_tpu/plan): the upstream
+            # stage's output IS this step's upload — the batch is
+            # already a sharded jax.Array, so nothing crosses the host.
+            chunks = buf
         if wire:
             # Host-side encode + packed upload + on-device decode
             # prologue.  The decode output feeds the step exactly where
@@ -1190,10 +1223,13 @@ def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
                         inflight_key="max_inflight_chunks",
                         thread_name="dsi-stream-batcher", engine="stream")
 
-    feed = skip_stream(blocks, start_offset) if start_offset else blocks
     step._pipe = pipe
-    pipe.begin(lambda: batch_stream(feed, n_dev, chunk_bytes,
-                                    pool=pool, offsets=offsets))
+    if device_batches is not None:
+        pipe.begin(lambda: iter(device_batches))
+    else:
+        feed = skip_stream(blocks, start_offset) if start_offset else blocks
+        pipe.begin(lambda: batch_stream(feed, n_dev, chunk_bytes,
+                                        pool=pool, offsets=offsets))
     step._host_excs = (_TokenTooLong, _NeedsHostPath)
     step._save = save_ckpt if ck_store is not None else None
     step._writer = ck_writer

@@ -1,0 +1,532 @@
+"""Plan driver: run a Stage DAG with device-resident handoffs.
+
+The execution half of ``dsi_tpu/plan`` (graph model in
+``plan/graph.py``): stages run in topological order, each as a
+resumable step object (``parallel/stepobj.py``) driven one ``advance()``
+at a time, and the edge between two stages is a relay
+(``device/relay.py``) — stage N+1's upload IS stage N's device-resident
+output.  ``staged=True`` swaps every relay for its host flavor (full
+materialization between stages), which is both the A/B baseline the
+bench row measures against and the bit-parity oracle the tests compare
+with: the two modes produce identical results by construction.
+
+## Stage commits (crash-resume at stage granularity)
+
+With ``checkpoint_dir``, each completed stage writes a durable STAGE
+MANIFEST through the existing checkpoint machinery
+(``ckpt/store.py`` — CRC'd payload + manifest, newest-valid-wins): the
+stage's result plus whatever its downstream edge needs (the relay
+image, the indexer's service images).  A ``resume=True`` run walks the
+stage stores in plan order and skips every stage whose manifest
+verifies, reconstructing its outputs host-side — so a crash ANYWHERE in
+the chain (including a real ``os._exit`` mid-stage, the CI smoke)
+resumes from the last completed stage's commit point, not from zero.  A
+torn stage manifest simply fails verification and that stage re-runs
+from its upstream's commit — the fallback the ckpt store's
+newest-valid-wins walk already owes us.
+
+Fault points (``ckpt/fault.py`` discipline, arbitrary names accepted):
+``plan-stage<i>-advance`` fires per ``advance()`` of stage *i* (so
+"kill mid-stage-2" is deterministic regardless of how many steps stage
+1 ran), and ``post-stage-commit`` right after a stage manifest lands.
+
+Blind spots, stated: intra-stage engine checkpoints are disabled on
+chained stages (a byte cursor has no meaning over a device relay), so a
+crash mid-stage re-runs THAT stage from its upstream commit; a stream
+that needs the host path (non-ASCII, non-literal pattern) fails the
+chain loudly instead of silently degrading — run the engines standalone
+for host-path inputs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dsi_tpu.ckpt import CheckpointStore, fault_point
+from dsi_tpu.obs import metrics_scope, span as _span
+from dsi_tpu.plan.graph import Plan, PlanError, Stage
+
+
+class PlanHostPath(RuntimeError):
+    """A stage's engine routed to the host path: the chain cannot keep
+    the intermediate on device, and silently degrading would invalidate
+    the zero-host-bytes contract — the caller decides what to do."""
+
+
+class StageOut:
+    """One stage's outputs in the driver context: ``result`` (the
+    stage's value), ``relay`` (the outgoing byte relay, grep), and
+    ``handoff`` (exported live services, indexer)."""
+
+    __slots__ = ("result", "relay", "handoff", "resumed")
+
+    def __init__(self, result=None, relay=None, handoff=None,
+                 resumed: bool = False):
+        self.result = result
+        self.relay = relay
+        self.handoff = handoff
+        self.resumed = resumed
+
+
+class PlanResult:
+    """``results[name]`` per stage, ``final`` = last stage's result,
+    ``stats`` = the run's plan scope (plan_* keys, obs/registry.py)."""
+
+    def __init__(self, results: Dict, final, stats: Dict):
+        self.results = results
+        self.final = final
+        self.stats = stats
+
+
+def _spill_bytes(plan: Plan) -> int:
+    mb = plan.defaults.get("spill_mb")
+    if mb is None:
+        try:
+            mb = float(os.environ.get("DSI_PLAN_SPILL_MB", "0"))
+        except ValueError:
+            mb = 0.0
+    return int(float(mb) * 1e6)
+
+
+def _drive(step, i: int):
+    """Advance stage *i* to completion (rung restarts included) with
+    the per-advance fault point, then close."""
+    while True:
+        fault_point(f"plan-stage{i}-advance")
+        if not step.advance():
+            break
+    return step.close()
+
+
+def _stage_store(checkpoint_dir: str, i: int, stage: Stage,
+                 plan_sig: Dict, staged: bool) -> CheckpointStore:
+    """One ckpt store per stage, keyed by the plan signature + handoff
+    mode: resuming a chained run from a staged run's manifests (or
+    either from a different plan) refuses instead of misreading."""
+    d = os.path.join(checkpoint_dir, f"stage{i:02d}-{stage.name}")
+    return CheckpointStore(d, f"plan-{stage.kind}",
+                           {"plan": plan_sig, "stage": stage.name,
+                            "staged": bool(staged)})
+
+
+# ── result codecs (stage-commit payloads) ─────────────────────────────
+
+
+def _encode_counts(d: Dict) -> Dict[str, np.ndarray]:
+    words = sorted(d)
+    joined = "\n".join(words).encode("ascii")
+    return {"wc_words": np.frombuffer(joined, np.uint8).copy(),
+            "wc_cnt": np.array([d[w][0] for w in words], np.int64),
+            "wc_part": np.array([d[w][1] for w in words], np.int64)}
+
+
+def _decode_counts(arrays: Dict[str, np.ndarray]) -> Dict:
+    raw = np.asarray(arrays.get("wc_words", np.zeros(0, np.uint8)),
+                     np.uint8).tobytes().decode("ascii")
+    words = raw.split("\n") if raw else []
+    cnt = np.asarray(arrays.get("wc_cnt", np.zeros(0)), np.int64)
+    part = np.asarray(arrays.get("wc_part", np.zeros(0)), np.int64)
+    return {w: (int(c), int(p)) for w, c, p in zip(words, cnt, part)}
+
+
+def _encode_words(words: List[str], prefix: str) -> Dict[str, np.ndarray]:
+    joined = "\n".join(words).encode("ascii")
+    return {f"{prefix}words": np.frombuffer(joined, np.uint8).copy()}
+
+
+def _decode_words(arrays: Dict[str, np.ndarray], prefix: str) -> List[str]:
+    raw = np.asarray(arrays.get(f"{prefix}words", np.zeros(0, np.uint8)),
+                     np.uint8).tobytes().decode("ascii")
+    return raw.split("\n") if raw else []
+
+
+def _encode_join(join: Dict) -> Dict[str, np.ndarray]:
+    words = sorted(join, key=lambda w: (-join[w][0], w))
+    docs_flat: List[int] = []
+    offs = [0]
+    for w in words:
+        docs_flat.extend(join[w][2])
+        offs.append(len(docs_flat))
+    out = _encode_words(words, "j_")
+    out["j_df"] = np.array([join[w][0] for w in words], np.int64)
+    out["j_part"] = np.array([join[w][1] for w in words], np.int64)
+    out["j_docs"] = np.array(docs_flat, np.int64)
+    out["j_offs"] = np.array(offs, np.int64)
+    return out
+
+
+def _decode_join(arrays: Dict[str, np.ndarray]) -> Dict:
+    words = _decode_words(arrays, "j_")
+    df = np.asarray(arrays.get("j_df", np.zeros(0)), np.int64)
+    part = np.asarray(arrays.get("j_part", np.zeros(0)), np.int64)
+    docs = np.asarray(arrays.get("j_docs", np.zeros(0)), np.int64)
+    offs = np.asarray(arrays.get("j_offs", np.zeros(1)), np.int64)
+    return {w: (int(df[i]), int(part[i]),
+                tuple(int(x) for x in docs[offs[i]:offs[i + 1]]))
+            for i, w in enumerate(words)}
+
+
+# ── the driver ────────────────────────────────────────────────────────
+
+
+def run_plan(plan: Plan, *, mesh=None, staged: bool = False,
+             checkpoint_dir: Optional[str] = None, resume: bool = False,
+             stats: Optional[dict] = None) -> PlanResult:
+    """Run ``plan`` end to end (module docstring).  ``staged=True`` is
+    the host-materialization baseline; results are bit-identical to the
+    chained mode by construction.  ``checkpoint_dir`` turns stage
+    boundaries into durable commit points; ``resume=True`` skips every
+    stage whose manifest verifies."""
+    from dsi_tpu.parallel.shuffle import default_mesh
+
+    if resume and not checkpoint_dir:
+        raise PlanError("resume=True requires checkpoint_dir")
+    if mesh is None:
+        mesh = default_mesh()
+    sc = metrics_scope("plan")
+    sc.update({"plan_stages": len(plan), "plan_intermediate_bytes": 0,
+               "plan_commit_bytes": 0, "plan_resumed_stages": 0,
+               "plan_handoff": "host" if staged else "device",
+               "plan_s": 0.0, "stage_commit_s": 0.0,
+               "plan_stage_walls": {}})
+    order = plan.ordered()
+    sig = plan.signature()
+    ctx: Dict[str, StageOut] = {}
+    completed = 0
+    if checkpoint_dir:
+        if resume:
+            for i, stage in enumerate(order):
+                loaded = _stage_store(checkpoint_dir, i, stage, sig,
+                                      staged).load_latest()
+                if loaded is None:
+                    break  # this stage (and everything after) re-runs
+                meta, arrays = loaded
+                ctx[stage.name] = _load_commit(plan, stage, meta, arrays,
+                                               mesh, staged, sc)
+                completed += 1
+            sc["plan_resumed_stages"] = completed
+        else:
+            for i, stage in enumerate(order):
+                _stage_store(checkpoint_dir, i, stage, sig,
+                             staged).reset()
+    for i, stage in enumerate(order):
+        if i < completed:
+            continue
+        t0 = time.perf_counter()
+        with _span("plan", stats=sc, key="plan_s", stage=stage.name,
+                   kind=stage.kind):
+            out = _run_stage(plan, i, stage, ctx, mesh, staged, sc)
+        ctx[stage.name] = out
+        sc["plan_stage_walls"][stage.name] = round(
+            time.perf_counter() - t0, 4)
+        if checkpoint_dir:
+            with _span("stage_commit", lane="plan", stats=sc,
+                       key="stage_commit_s", stage=stage.name):
+                arrays, meta = _commit_payload(plan, stage, out, staged)
+                store = _stage_store(checkpoint_dir, i, stage, sig,
+                                     staged)
+                store.save(arrays, meta)
+                sc["plan_commit_bytes"] += store.last_payload_bytes
+            fault_point("post-stage-commit")
+    sc["plan_s"] = round(sc["plan_s"], 4)
+    sc["stage_commit_s"] = round(sc["stage_commit_s"], 4)
+    if stats is not None:
+        stats.update(sc)
+    results = {name: out.result for name, out in ctx.items()}
+    return PlanResult(results, ctx[order[-1].name].result, sc)
+
+
+def _engine_kw(plan: Plan, stage: Stage) -> Dict:
+    return {
+        "chunk_bytes": int(plan.param(stage, "chunk_bytes", 1 << 20)),
+        "depth": plan.param(stage, "depth"),
+        "aot": bool(plan.param(stage, "aot", False)),
+        "device_accumulate": bool(
+            plan.param(stage, "device_accumulate", False)),
+        "sync_every": plan.param(stage, "sync_every"),
+        "mesh_shards": plan.param(stage, "mesh_shards"),
+    }
+
+
+def _source_blocks(plan: Plan, stage: Stage):
+    paths = plan.param(stage, "paths")
+    data = plan.param(stage, "data")
+    if paths:
+        from dsi_tpu.parallel.streaming import stream_files
+
+        return stream_files(list(paths))
+    if data is not None:
+        return [bytes(data)]
+    raise PlanError(f"stage {stage.name!r} has neither paths nor data")
+
+
+def _run_stage(plan: Plan, i: int, stage: Stage, ctx: Dict, mesh,
+               staged: bool, sc: dict) -> StageOut:
+    kw = _engine_kw(plan, stage)
+    if stage.kind == "grep":
+        from dsi_tpu.device.relay import DeviceRelay, HostRelay
+        from dsi_tpu.parallel.grepstream import GrepStep
+
+        relay = (HostRelay(stats=sc) if staged
+                 else DeviceRelay(mesh, cap=kw["chunk_bytes"],
+                                  aot=kw["aot"], stats=sc,
+                                  spill_bytes=_spill_bytes(plan)))
+        step = GrepStep(_source_blocks(plan, stage),
+                        plan.param(stage, "pattern"), mesh=mesh,
+                        topk=int(plan.param(stage, "topk", 16)),
+                        line_sink=relay, **kw)
+        res = _drive(step, i)
+        if res is None:
+            raise PlanHostPath(f"stage {stage.name!r}: grep needs the "
+                               f"host path (non-literal pattern or "
+                               f"over-wide line)")
+        return StageOut(result=res, relay=relay)
+
+    if stage.kind == "wordcount":
+        from dsi_tpu.parallel.streaming import WordcountStep
+
+        wc_kw = dict(kw, n_reduce=int(plan.param(stage, "n_reduce", 10)),
+                     u_cap=int(plan.param(stage, "u_cap", 1 << 12)))
+        if stage.deps:
+            up = ctx[stage.deps[0]]
+            if hasattr(up.relay, "blocks"):  # staged / restored host
+                step = WordcountStep(up.relay.blocks(), mesh=mesh,
+                                     **wc_kw)
+            else:
+                step = WordcountStep([], mesh=mesh,
+                                     device_batches=up.relay.batches(),
+                                     **wc_kw)
+        else:  # a source wordcount (no upstream): plain stream
+            step = WordcountStep(_source_blocks(plan, stage), mesh=mesh,
+                                 **wc_kw)
+        res = _drive(step, i)
+        if res is None:
+            raise PlanHostPath(f"stage {stage.name!r}: wordcount needs "
+                               f"the host path (non-ASCII or >64-byte "
+                               f"word)")
+        return StageOut(result=res)
+
+    if stage.kind == "indexer":
+        from dsi_tpu.parallel.grepstream import IndexerStep
+
+        step = IndexerStep(list(plan.param(stage, "docs")), mesh=mesh,
+                           n_reduce=int(plan.param(stage, "n_reduce", 10)),
+                           u_cap=int(plan.param(stage, "u_cap", 1 << 15)),
+                           topk=int(plan.param(stage, "topk", 16)),
+                           keep_services=not staged,
+                           depth=kw["depth"],
+                           device_accumulate=kw["device_accumulate"],
+                           sync_every=kw["sync_every"],
+                           mesh_shards=kw["mesh_shards"])
+        res = _drive(step, i)
+        if res is None:
+            raise PlanHostPath(f"stage {stage.name!r}: indexer needs "
+                               f"the host path (non-ASCII or >64-byte "
+                               f"word)")
+        if staged:
+            return StageOut(result=res)
+        return StageOut(result=None, handoff=step.exported)
+
+    if stage.kind == "df_topk":
+        fault_point(f"plan-stage{i}-advance")
+        k = int(plan.param(stage, "topk", 16))
+        up = ctx[stage.deps[0]]
+        if up.handoff is None:  # staged (or restored) indexer result
+            _, top = up.result
+            return StageOut(result=tuple(top[:k]))
+        return StageOut(result=_df_topk_from_handoff(up.handoff, k))
+
+    if stage.kind == "postings_join":
+        fault_point(f"plan-stage{i}-advance")
+        up_idx = ctx[stage.deps[0]]
+        top = ctx[stage.deps[1]].result
+        words = [w for _, w in top]
+        if up_idx.handoff is None:
+            postings, _ = up_idx.result
+            join = {w: (df, postings[w][0], tuple(postings[w][1]))
+                    for df, w in top if w in postings}
+        else:
+            h = up_idx.handoff
+            if h.get("postings_svc") is not None:
+                h["postings_svc"].close()  # flush the device buffer's
+                h["postings_svc"] = None  # remainder into the table
+            packed = h["table"].finalize_packed()
+            found = packed.lookup_many(words)
+            join = {w: (df, found[w][0],
+                        tuple(d for d, _ in found[w][1]))
+                    for df, w in top if w in found}
+        return StageOut(result=join)
+
+    raise PlanError(f"unrunnable stage kind {stage.kind!r}")
+
+
+def _df_topk_from_handoff(h: Dict, k: int) -> Tuple:
+    """The chained df-top-k: a k-row snapshot off the RESIDENT df table
+    (no drain-to-host) when it holds the complete state; the exact
+    drain fallback when a widen already spilled rows into the host
+    accumulator (or there is no device table at all) — the fallback is
+    counted pull volume, never a correctness trade."""
+    from dsi_tpu.ops.wordcount import decode_packed
+
+    tk = h.get("topk_svc")
+    df_acc = h["df_acc"]
+    residue = bool(df_acc.snapshot())
+    if tk is not None and not residue:
+        tk.sync()  # flushes the fold lag, pulls k rows per device
+        out = []
+        for c, keys, ln in tk.snapshot:
+            w = decode_packed(np.array([keys], np.uint32),
+                              np.array([int(ln)]), 1)[0]
+            out.append((int(c), w))
+        h["topk_svc"] = None  # the table is never drained: drop it
+        return tuple(out[:k])
+    if tk is not None:
+        tk.close()  # exact drain into df_acc (the widen-residue path)
+        h["topk_svc"] = None
+    dfm = {w: c for w, (c, _p) in df_acc.finalize().items()}
+    if not dfm:
+        # Host-merge indexer (no dacc): document frequency is the
+        # postings list length; close any device buffer first.
+        if h.get("postings_svc") is not None:
+            h["postings_svc"].close()
+            h["postings_svc"] = None
+        dfm = {w: int(e - s) for w, s, e in _word_spans(h["table"])}
+    return tuple(sorted(((c, w) for w, c in dfm.items()),
+                        key=lambda r: (-r[0], r[1]))[:k])
+
+
+def _word_spans(table):
+    from dsi_tpu.ops.wordcount import decode_packed
+
+    packed = table.finalize_packed()
+    words = decode_packed(packed.skeys, packed.lens, len(packed.skeys))
+    for i, w in enumerate(words):
+        yield w, int(packed.starts[i]), int(packed.ends[i])
+
+
+# ── stage-commit payloads ─────────────────────────────────────────────
+
+
+def _commit_payload(plan: Plan, stage: Stage, out: StageOut,
+                    staged: bool) -> Tuple[Dict, Dict]:
+    meta = {"stage": stage.name, "kind": stage.kind}
+    if stage.kind == "grep":
+        res = out.result
+        arrays = out.relay.capture()
+        arrays["g_hist"] = np.array(res.hist, np.int64)
+        arrays["g_tot"] = np.array(
+            [res.lines, res.matched, res.occurrences], np.int64)
+        arrays["g_topk"] = np.array(res.topk, np.int64).reshape(-1, 2)
+        meta["relay_cap"] = int(plan.param(stage, "chunk_bytes", 1 << 20))
+        return arrays, meta
+    if stage.kind == "wordcount":
+        return _encode_counts(out.result), meta
+    if stage.kind == "indexer":
+        if staged:
+            postings, top = out.result
+            join_like = {w: (len(ds), part, tuple(ds))
+                         for w, (part, ds) in postings.items()}
+            arrays = _encode_join(join_like)
+            arrays.update(_encode_words([w for _, w in top], "t_"))
+            arrays["t_df"] = np.array([c for c, _ in top], np.int64)
+            return arrays, meta
+        h = out.handoff
+        arrays: Dict[str, np.ndarray] = {}
+        tk = h.get("topk_svc")
+        if tk is not None:
+            for kk2, v in tk.checkpoint_state().items():
+                arrays[f"tk_{kk2}"] = np.asarray(v)
+            meta["table_kk"] = tk.kk
+        pb = h.get("postings_svc")
+        if pb is not None:
+            img = pb.checkpoint_state()
+            arrays["pb_buf"] = np.asarray(img["buf"])
+            arrays["pb_nrows"] = np.asarray(img["nrows"])
+        for kk2, v in h["df_acc"].snapshot().items():
+            arrays[f"df_{kk2}"] = np.asarray(v)
+        for kk2, v in h["table"].snapshot().items():
+            arrays[f"pt_{kk2}"] = np.asarray(v)
+        meta["kk"] = h["kk"]
+        meta["n_real"] = h["n_real"]
+        return arrays, meta
+    if stage.kind == "df_topk":
+        arrays = _encode_words([w for _, w in out.result], "t_")
+        arrays["t_df"] = np.array([c for c, _ in out.result], np.int64)
+        return arrays, meta
+    if stage.kind == "postings_join":
+        return _encode_join(out.result), meta
+    raise PlanError(f"uncommittable stage kind {stage.kind!r}")
+
+
+def _load_commit(plan: Plan, stage: Stage, meta: Dict, arrays: Dict,
+                 mesh, staged: bool, sc: dict) -> StageOut:
+    """Reconstruct a completed stage's outputs from its manifest —
+    host-side (device state died with the crashed process; the drain
+    path re-derives equivalent host state, the cross-degree-resume
+    argument)."""
+    if stage.kind == "grep":
+        from dsi_tpu.device.relay import DeviceRelay, HostRelay
+        from dsi_tpu.parallel.grepstream import GrepStreamResult
+
+        tot = arrays["g_tot"]
+        res = GrepStreamResult(
+            int(tot[0]), int(tot[1]), int(tot[2]),
+            tuple(int(x) for x in arrays["g_hist"]),
+            tuple((int(a), int(b)) for a, b in arrays["g_topk"]))
+        if "hbytes" in arrays:
+            relay = HostRelay.restore(arrays, stats=sc)
+        else:
+            relay = DeviceRelay.restore(
+                mesh, arrays, cap=int(meta["relay_cap"]), stats=sc)
+        return StageOut(result=res, relay=relay, resumed=True)
+    if stage.kind == "wordcount":
+        return StageOut(result=_decode_counts(arrays), resumed=True)
+    if stage.kind == "indexer":
+        if staged:
+            join_like = _decode_join(arrays)
+            postings = {w: (part, list(ds))
+                        for w, (_df, part, ds) in join_like.items()}
+            top = tuple(zip(
+                (int(c) for c in arrays.get("t_df", ())),
+                _decode_words(arrays, "t_")))
+            return StageOut(result=(postings, top), resumed=True)
+        from dsi_tpu.device.postings import DevicePostings
+        from dsi_tpu.device.table import DeviceTable
+        from dsi_tpu.parallel.merge import PackedCounts, PostingsTable
+
+        kk = int(meta["kk"])
+        n_real = int(meta["n_real"])
+        df_acc = PackedCounts()
+        df_acc.restore({k[3:]: v for k, v in arrays.items()
+                        if k.startswith("df_")})
+        table = PostingsTable()
+        table.restore({k[3:]: v for k, v in arrays.items()
+                       if k.startswith("pt_")})
+        tk_img = {k[3:]: v for k, v in arrays.items()
+                  if k.startswith("tk_")}
+        if tk_img:
+            DeviceTable.drain_image(df_acc, tk_img)
+        if "pb_buf" in arrays:
+            def sink(r):
+                r = r[r[:, kk + 2] < n_real]
+                if len(r):
+                    table.add(r, kk)
+
+            DevicePostings.drain_image(
+                sink, {"buf": arrays["pb_buf"],
+                       "nrows": arrays["pb_nrows"]})
+        handoff = {"kk": kk, "n_real": n_real, "topk_svc": None,
+                   "postings_svc": None, "df_acc": df_acc,
+                   "table": table, "device_accumulate": True}
+        return StageOut(result=None, handoff=handoff, resumed=True)
+    if stage.kind == "df_topk":
+        top = tuple(zip((int(c) for c in arrays.get("t_df", ())),
+                        _decode_words(arrays, "t_")))
+        return StageOut(result=top, resumed=True)
+    if stage.kind == "postings_join":
+        return StageOut(result=_decode_join(arrays), resumed=True)
+    raise PlanError(f"unloadable stage kind {stage.kind!r}")

@@ -1,0 +1,179 @@
+"""Plan/Stage graph model: multi-stage dataflow without the host trip.
+
+Dean & Ghemawat's flagship production use was a *sequence* of five to
+ten MapReduces (the indexing pipeline, OSDI'04 §6.4); the 6.5840
+contract this repo reproduces materializes every job's full output
+before the next can start.  A :class:`Plan` is the declarative side of
+the fix: a small DAG of :class:`Stage` nodes whose edges are
+device-resident handoffs (``dsi_tpu/device/relay.py``,
+``parallel/stepobj.py`` exports) instead of host materializations.  The
+driver (``plan/driver.py``) runs it.
+
+Stage kinds (what the driver knows how to run):
+
+* ``grep``          — streaming literal grep over a byte source,
+  emitting the matching lines into the outgoing relay (the
+  ``GrepStep(line_sink=...)`` emit path).
+* ``wordcount``     — streaming word count consuming an upstream relay
+  (``WordcountStep(device_batches=...)``) or a host block stream (the
+  staged baseline / a source stage).
+* ``indexer``       — wave-walk inverted index over a document list,
+  completing with live device services exported
+  (``IndexerStep(keep_services=True)``).
+* ``df_topk``       — k-row document-frequency snapshot off an upstream
+  indexer's resident :class:`DeviceTopK` (no drain-to-host).
+* ``postings_join`` — per-term postings lookup for an upstream df_topk's
+  terms (selective decode, not the full materialization).
+
+A plan is VALIDATED at build time (unique names, known deps, acyclic)
+and serializes to a :meth:`Plan.signature` — the job identity its stage
+manifests carry, so a resume against a different plan refuses instead of
+misreading stage payloads.  Bulk inputs (corpus bytes, document lists)
+enter the signature as CRCs, not content.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: The stage kinds plan/driver.py can run.
+STAGE_KINDS = ("grep", "wordcount", "indexer", "df_topk", "postings_join")
+
+#: Stage params carrying bulk payloads: identity-hashed, never inlined
+#: into the signature.
+_BULK_PARAMS = ("data", "docs", "paths")
+
+
+class PlanError(ValueError):
+    """A malformed plan: unknown kind, missing dep, duplicate name,
+    cycle — raised at build/validate time, never mid-run."""
+
+
+class Stage:
+    """One node: ``name`` (unique), ``kind`` (STAGE_KINDS), ``deps``
+    (upstream stage names this one consumes), ``params`` (kind-specific
+    knobs; bulk inputs under ``data``/``docs``/``paths``)."""
+
+    def __init__(self, name: str, kind: str,
+                 deps: Sequence[str] = (), **params):
+        if kind not in STAGE_KINDS:
+            raise PlanError(f"unknown stage kind {kind!r} "
+                            f"(have: {', '.join(STAGE_KINDS)})")
+        self.name = str(name)
+        self.kind = kind
+        self.deps: Tuple[str, ...] = tuple(deps)
+        self.params: Dict = dict(params)
+
+    def identity(self) -> Dict:
+        """JSON-ready identity: params with bulk payloads replaced by
+        (length, crc32) pairs so the signature stays small and stable."""
+        out = {"name": self.name, "kind": self.kind,
+               "deps": list(self.deps)}
+        for k in sorted(self.params):
+            v = self.params[k]
+            if k in _BULK_PARAMS and v is not None:
+                if k == "docs":
+                    crc = 0
+                    total = 0
+                    for d in v:
+                        crc = zlib.crc32(bytes(d), crc)
+                        total += len(d)
+                    out[k] = {"n": len(v), "bytes": total, "crc32": crc}
+                elif k == "data":
+                    out[k] = {"bytes": len(v),
+                              "crc32": zlib.crc32(bytes(v))}
+                else:  # paths: names are identity enough (files change
+                    out[k] = list(v)  # under any cursor scheme anyway)
+            else:
+                out[k] = v
+        return out
+
+
+class Plan:
+    """An ordered, validated stage DAG.  ``add`` returns the stage so
+    chains read naturally::
+
+        p = Plan("grep-wc", chunk_bytes=1 << 20)
+        g = p.add(Stage("grep", "grep", pattern="the", paths=files))
+        p.add(Stage("wc", "wordcount", deps=[g.name]))
+    """
+
+    def __init__(self, name: str, **defaults):
+        self.name = str(name)
+        #: Plan-wide engine knobs every stage inherits (chunk_bytes,
+        #: depth, device_accumulate, sync_every, mesh_shards, aot, ...);
+        #: a stage's own params override.
+        self.defaults: Dict = dict(defaults)
+        self._stages: List[Stage] = []
+        self._by_name: Dict[str, Stage] = {}
+
+    def add(self, stage: Stage) -> Stage:
+        if stage.name in self._by_name:
+            raise PlanError(f"duplicate stage name {stage.name!r}")
+        for d in stage.deps:
+            if d not in self._by_name:
+                raise PlanError(f"stage {stage.name!r} depends on "
+                                f"unknown stage {d!r} (deps must be "
+                                f"added first — the DAG is built in "
+                                f"topological order)")
+        self._stages.append(stage)
+        self._by_name[stage.name] = stage
+        return stage
+
+    def __len__(self) -> int:
+        return len(self._stages)
+
+    def __getitem__(self, name: str) -> Stage:
+        return self._by_name[name]
+
+    def ordered(self) -> Tuple[Stage, ...]:
+        """The stages in execution order.  Insertion order IS a
+        topological order (``add`` refuses forward deps), so this is
+        deterministic and needs no tie-breaking."""
+        return tuple(self._stages)
+
+    def param(self, stage: Stage, key: str, default=None):
+        """Stage-over-plan parameter resolution."""
+        if key in stage.params:
+            return stage.params[key]
+        return self.defaults.get(key, default)
+
+    def signature(self) -> Dict:
+        """The plan's job identity (stage-manifest ``job`` field):
+        JSON-normalised, bulk inputs as CRCs."""
+        return json.loads(json.dumps({
+            "plan": self.name,
+            "defaults": {k: v for k, v in sorted(self.defaults.items())
+                         if not callable(v)},
+            "stages": [s.identity() for s in self._stages],
+        }))
+
+
+# ── the two canonical chains ──────────────────────────────────────────
+
+
+def grep_wordcount_plan(pattern: str, *, paths: Optional[Sequence[str]]
+                        = None, data: Optional[bytes] = None,
+                        **defaults) -> Plan:
+    """grep → wordcount-over-matching-lines: stage 2 counts words over
+    exactly the lines stage 1 matched, with the matching-line bytes
+    staying device-resident between the stages."""
+    p = Plan("grep-wc", **defaults)
+    g = p.add(Stage("grep", "grep", pattern=pattern, paths=paths,
+                    data=data))
+    p.add(Stage("wc", "wordcount", deps=[g.name]))
+    return p
+
+
+def indexer_join_plan(docs: Sequence[bytes], *, topk: int = 16,
+                      **defaults) -> Plan:
+    """indexer → df-top-k → per-term postings join: stage 2 takes a
+    k-row snapshot of the resident df table (no drain), stage 3 decodes
+    postings for just those k terms."""
+    p = Plan("indexer-join", **defaults)
+    i = p.add(Stage("indexer", "indexer", docs=list(docs), topk=topk))
+    t = p.add(Stage("dftopk", "df_topk", deps=[i.name], topk=topk))
+    p.add(Stage("join", "postings_join", deps=[i.name, t.name]))
+    return p

@@ -78,6 +78,14 @@ GATES: List[Tuple[str, str, float]] = [
     ("ckpt_compress_ratio", "higher", 0.10),
     ("readahead_hit_pct", "higher", 0.10),
     ("ckpt_delta_bytes*", "lower", 0.50),
+    # Plan layer (ISSUE 14): the *_mbps/*_parity patterns above already
+    # gate the chained-vs-staged throughputs and byte parity; the
+    # device handoff's host-crossing bytes gate lower-better (a relay
+    # regression quietly re-introducing host round-trips), and the
+    # zero-copy invariant is boolean (old=0 bytes reads "unknown" under
+    # the numeric rule, so the bool carries the gate).
+    ("plan_zero_copy", "bool", 0.0),
+    ("plan_intermediate_bytes", "lower", 0.50),
 ]
 
 

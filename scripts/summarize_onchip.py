@@ -74,6 +74,12 @@ def _bench_line(path: str) -> str:
             "ckpt_every", "ckpt_saves", "ckpt_deltas",
             "ckpt_full_bytes_per_save", "ckpt_delta_bytes_per_save",
             "ckpt_barrier_s", "resume_gap_s", "resume_parity",
+            # The plan-layer chained-vs-staged A/B (ISSUE 14): the
+            # device-resident handoff against the host-materialization
+            # baseline — the zero-copy evidence the on-chip sweep wants.
+            "plan_mb", "plan_chained_mbps", "plan_staged_mbps",
+            "plan_intermediate_bytes", "plan_staged_intermediate_bytes",
+            "plan_zero_copy", "plan_parity",
             "tpu_error")
     parts = [f"{k}={d[k]}" for k in keys if k in d]
     phases = d.get("phases")
@@ -83,7 +89,9 @@ def _bench_line(path: str) -> str:
               # The per-phase SPAN rollups (dsi_tpu/obs): present when
               # the bench ran traced (DSI_BENCH_TRACE=1/DSI_TRACE_DIR) —
               # same measurements as the phases plus per-span counts/max.
-              "stream_spans", "tfidf_spans", "grep_spans"):
+              "stream_spans", "tfidf_spans", "grep_spans",
+              # The plan row's per-stage wall decomposition.
+              "plan_stage_walls"):
         if k in d:
             parts.append(f"{k}=" + json.dumps(d[k]))
     return "  " + "  ".join(parts)

@@ -320,6 +320,44 @@ def wire(events, metas, out) -> bool:
     return True
 
 
+def plan(events, metas, out) -> bool:
+    """The plan layer (ISSUE 14): per-stage walls from the ``plan``
+    lane's spans plus the handoff accounting — how many intermediate
+    bytes the chain carried and how many of them were SAVED from the
+    host round-trip (handoff minus host-crossing)."""
+    walls = []
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") == "plan":
+            walls.append((e.get("stage", "?"), e.get("dur", 0.0)))
+    tot = _span_totals(events, ("stage_commit",))
+    keys = ("plan_stages", "plan_handoff", "plan_handoff_bytes",
+            "plan_intermediate_bytes", "plan_commit_bytes",
+            "plan_relay_buffers", "plan_spilled_bytes",
+            "plan_restored_bytes", "plan_resumed_stages")
+    rows = []
+    for meta in metas:
+        engines = (meta.get("registry") or {}).get("engines") or {}
+        ph = engines.get("plan") or {}
+        kv = {k: ph[k] for k in keys if k in ph}
+        if kv:
+            rows.append((meta.get("_file", "?"), kv))
+    if not (walls or rows):
+        return False
+    for stage, dur in walls:
+        print(f"  stage {stage:<14} wall={dur:.3f}s", file=out)
+    if "stage_commit" in tot:
+        t, n = tot["stage_commit"]
+        print(f"  {'stage_commit':<20} total={t:.3f}s count={n}",
+              file=out)
+    for fname, kv in rows:
+        saved = (kv.get("plan_handoff_bytes", 0)
+                 - kv.get("plan_intermediate_bytes", 0))
+        print(f"  plan [{fname}]: handoff_bytes_saved={saved} " + " ".join(
+            f"{k}={v}" for k, v in kv.items()
+            if not isinstance(v, dict)), file=out)
+    return True
+
+
 def histograms(metas, out) -> bool:
     """The stage latency percentile table (obs/hist.py) embedded in
     each trace's registry snapshot."""
@@ -399,6 +437,8 @@ def main(argv=None) -> int:
                                                              o)),
                       ("wire codec / ingest pool",
                        lambda o: wire(events, metas, o)),
+                      ("plan layer",
+                       lambda o: plan(events, metas, o)),
                       ("stage latency histograms",
                        lambda o: histograms(metas, o))):
         buf = io.StringIO()

@@ -32,7 +32,7 @@ def main() -> int:
     ap.add_argument("--file-size", type=int, default=300000,
                     help="harness split size (test_mr.sh ensure_corpus)")
     ap.add_argument("--phase", choices=("harness", "stream", "grep",
-                                        "mesh", "wire", "all"),
+                                        "mesh", "wire", "plan", "all"),
                     default="all",
                     help="which program group to warm: 'harness' = the "
                          "per-task worker kernels test_mr.sh runs touch; "
@@ -44,6 +44,9 @@ def main() -> int:
                          "runs; 'wire' = the chunk-upload decode "
                          "prologues (wire_decode_*/wire_decode7_*, "
                          "ops/wirecodec.py) a --wire-upload run reaches; "
+                         "'plan' = the chain-handoff programs a planrun "
+                         "chain reaches (the grep *_em emit variants + "
+                         "the plan_pack_* relay concat, ISSUE 14); "
                          "'all' = everything.  Remote compiles cost "
                          "tens of minutes EACH on the axon tunnel, so the "
                          "ladder (warm_loop.sh) warms the group it is "
@@ -265,6 +268,24 @@ def main() -> int:
         warm_wire_aot(mesh=mesh, chunk_bytes=1 << 20)
         warm_wire_aot(mesh=mesh, chunk_bytes=1 << 21)
         print(f"wire decode programs: {time.perf_counter() - t0:.1f}s",
+              flush=True)
+
+    if args.phase in ("plan", "all"):
+        # Plan-layer chain handoff (ISSUE 14): the grep emit variants
+        # (*_em — both l_cap rungs at the planrun default chunk shape)
+        # plus the relay's plan_pack_* concat program, so a chained
+        # planrun on the chip loads instead of cold-compiling.  The
+        # wordcount stage's NON-donated step programs compile per run's
+        # sticky rung (the kernel row already persists the non-donated
+        # 2 MiB shape; other shapes compile on first chain).
+        from dsi_tpu.parallel.grepstream import warm_grepstream_aot
+        from dsi_tpu.parallel.shuffle import default_mesh
+
+        t0 = time.perf_counter()
+        mesh = default_mesh()
+        warm_grepstream_aot(mesh=mesh, chunk_bytes=1 << 20,
+                            device_accumulate=True, emit=True)
+        print(f"plan chain programs: {time.perf_counter() - t0:.1f}s",
               flush=True)
 
     if args.phase in ("mesh", "all"):

@@ -12,3 +12,16 @@ def engine_run():
     with _span("kernel", stats=stats, key="kernal_s"):  # EXPECT: metric-schema
         pass
     return stats
+
+
+def plan_run():
+    # The ISSUE-14 plan-scope keys are IN the schema: none of these may
+    # fire (the not-overfire half of the gate).
+    sc = metrics_scope("plan")
+    sc["plan_stages"] = 2                  # clean: schema key
+    sc.setdefault("plan_intermediate_bytes", 0)  # clean: schema key
+    sc.update({"plan_handoff": "device"})  # clean: schema key
+    with _span("plan", stats=sc, key="plan_s"):
+        pass
+    sc["plan_commit_bytez"] = 1            # EXPECT: metric-schema
+    return sc

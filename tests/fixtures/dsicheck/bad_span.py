@@ -22,3 +22,18 @@ def off_taxonomy_lane():
 def clean(stats):
     with _span("kernel", stats=stats, key="kernel_s"):
         pass
+
+
+def clean_plan(stats):
+    # The ISSUE-14 plan lane/names are pinned in SPAN_NAMES/LANES: a
+    # plan-layer span must NOT fire the rule...
+    with _span("plan", stats=stats, key="plan_s", stage="grep"):
+        pass
+    with _span("stage_commit", lane="plan", stats=stats,
+               key="stage_commit_s"):
+        pass
+
+
+def off_plan_name():
+    with _span("stage_comit", lane="plan"):  # EXPECT: span-discipline
+        pass

@@ -78,7 +78,11 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
                                  # test budget while exercising the
                                  # measured path
                                  "DSI_BENCH_SERVE_JOBS": "2",
-                                 "DSI_BENCH_SERVE_MB": "0.2"})
+                                 "DSI_BENCH_SERVE_MB": "0.2",
+                                 # plan row at contract-test scale:
+                                 # 2 planrun subprocesses (chained +
+                                 # staged) over a 1 MB corpus
+                                 "DSI_BENCH_PLAN_MB": "1"})
     assert rc == 0
     assert v["metric"] == "wc_cpu_fallback_throughput"
     assert v["platform"] == "cpu"
@@ -173,6 +177,17 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["serve_jobs"] >= 2
         assert v["serve_oneshot_mbps"] > 0
         assert v["serve_amortized_warm_s"] >= 0
+    # The plan-layer chained-vs-staged A/B row (ISSUE 14): measured XOR
+    # skipped; a measured row carries the byte-parity gate, BOTH
+    # throughputs, and the zero-host-bytes invariant of the
+    # device-resident handoff against the staged materialization.
+    assert ("plan_skipped" in v) != ("plan_chained_mbps" in v)
+    if "plan_chained_mbps" in v:
+        assert v["plan_parity"] is True
+        assert v["plan_zero_copy"] is True
+        assert v["plan_intermediate_bytes"] == 0
+        assert v["plan_staged_intermediate_bytes"] > 0
+        assert v["plan_staged_mbps"] > 0
 
 
 def test_engine_phase_dicts_come_from_the_registry(tmp_path):
