@@ -99,6 +99,13 @@ Environment knobs:
                           row measured; accelerators opt in with 1 (four
                           more stream passes on a time-boxed window);
                           0 disables.
+  DSI_BENCH_SPEC_MB       size of the speculative-execution A/B row
+                          (default 4; 0 disables): the same shard job
+                          with one injected slow worker, backup
+                          dispatch on vs --no-spec — spec_backup_mbps
+                          vs spec_nobackup_mbps, spec_backup_fired,
+                          spec_duplicate_commits (must be 0), each arm
+                          parity-gated vs the sequential oracle.
   DSI_BENCH_FRAMEWORK_MB  corpus size for the distributed N-worker row
                           (default 48; 0 disables it; auto-shrunk so its
                           oracle pass costs ~100 s on a slow box, skipped
@@ -1799,6 +1806,103 @@ def run_plan_row() -> dict:
     return row
 
 
+def run_spec_row() -> dict:
+    """The speculative-execution A/B (ISSUE 15 satellite): one shard
+    job with an INJECTED slow shard (worker 0 sleeps per advance
+    slice), run twice in fresh subprocess fleets — backup dispatch ON
+    (``spec_backup_mbps``) vs ``--no-spec`` (``spec_nobackup_mbps``).
+    Reports ``spec_backup_fired`` (backup dispatches in the armed run —
+    the row is only meaningful when >= 1), ``spec_duplicate_commits``
+    (journal double-commits across BOTH arms — MUST be 0; the
+    first-commit-wins gate), and ``spec_resumed`` (attempts that
+    restored a checkpoint chain).  Each arm is parity-gated against the
+    sequential host oracle by ``shardrun --check`` (exit 2 = mismatch,
+    throughput suppressed).  Chip-independent (1-device CPU workers),
+    measured keys XOR ``spec_skipped``.  ``DSI_BENCH_SPEC_MB`` (default
+    4; 0 disables) sizes it."""
+    mb = env_float("DSI_BENCH_SPEC_MB", 4.0)
+    if mb <= 0:
+        return {"spec_skipped": "disabled (DSI_BENCH_SPEC_MB=0)"}
+    budget = env_float("DSI_BENCH_SPEC_TIMEOUT", 300.0)
+    import shutil
+
+    sdir = os.path.join(WORKDIR, "spec-row")
+    shutil.rmtree(sdir, ignore_errors=True)
+    os.makedirs(sdir)
+    corpus_path = os.path.join(sdir, "corpus.txt")
+    with open(corpus_path, "w") as f:
+        i = 0
+        written = 0
+        target = mb * 1e6
+        while written < target:
+            line = (" ".join(
+                "spec" + chr(ord("a") + (i + j) % 23) * 2
+                for j in range(9)) + "\n")
+            f.write(line)
+            written += len(line)
+            i += 1
+    total_mb = os.path.getsize(corpus_path) / 1e6
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1-device CPU workers
+    env["DSI_AOT_FRESH"] = "1"  # the stream rows' CPU flake hygiene
+
+    def one(mode: str) -> dict:
+        wd = os.path.join(sdir, mode)
+        sj = os.path.join(sdir, f"{mode}.stats.json")
+        e = dict(env)
+        e["DSI_MR_SOCKET"] = os.path.join(sdir, f"{mode}.sock")
+        cmd = [sys.executable, "-m", "dsi_tpu.cli.shardrun",
+               "--workers", "3", "--shards", "3",
+               "--workdir", wd, "--chunk-bytes", str(1 << 16),
+               "--ckpt-secs", "0.2", "--progress-s", "0.1",
+               "--spec-floor", "2.0", "--shard-timeout", "120",
+               "--slow-worker", "0:1.0",
+               "--check", "--stats-json", sj, corpus_path]
+        if mode == "nobackup":
+            cmd.insert(-1, "--no-spec")
+        r = subprocess.run(cmd, env=e,
+                           cwd=os.path.dirname(os.path.abspath(__file__)),
+                           capture_output=True, text=True,
+                           timeout=budget)
+        if r.returncode == 2:
+            raise RuntimeError(f"{mode} arm parity mismatch")
+        if r.returncode != 0:
+            raise RuntimeError(f"{mode} shardrun rc={r.returncode}: "
+                               f"{r.stderr[-300:]}")
+        with open(sj, encoding="utf-8") as f:
+            return json.load(f)
+
+    try:
+        backup = one("backup")
+        nobackup = one("nobackup")
+    except Exception as e:
+        return {"spec_skipped": f"spec row failed: "
+                                f"{type(e).__name__}: {e}"}
+    dup = (int(backup.get("duplicate_commits", 0))
+           + int(nobackup.get("duplicate_commits", 0)))
+    backup_s = float(backup.get("wall_s", 0.0)) or 1e-9
+    nobackup_s = float(nobackup.get("wall_s", 0.0)) or 1e-9
+    row = {"spec_mb": round(total_mb, 2), "spec_parity": True,
+           "spec_backup_mbps": round(total_mb / backup_s, 2),
+           "spec_nobackup_mbps": round(total_mb / nobackup_s, 2),
+           "spec_backup_fired": int(backup.get("backup_dispatches", 0)),
+           "spec_duplicate_commits": dup,
+           # Bool twin of duplicate_commits for the bench_diff gate: a
+           # healthy old value of 0 reads "unknown" under the numeric
+           # lower-better rule (the plan_zero_copy precedent), so the
+           # bool carries the first-commit-wins regression gate.
+           "spec_exactly_once": dup == 0,
+           "spec_resumed": int(backup.get("resumed_attempts", 0)),
+           "spec_commit_losses": int(backup.get("commit_losses", 0))}
+    log(f"spec row: {total_mb:.1f} MB, slow shard injected — backup "
+        f"{row['spec_backup_mbps']} MB/s ({backup_s:.2f}s, "
+        f"{row['spec_backup_fired']} backups, {row['spec_resumed']} "
+        f"resumed) vs no-backup {row['spec_nobackup_mbps']} MB/s "
+        f"({nobackup_s:.2f}s); duplicate commits {dup}")
+    return row
+
+
 def run_native_oracle_row(files, oracle_out, total_mb, native_ok,
                           fw_oracle_mbps) -> dict:
     """Sequential run of the SAME C++ task bodies the native-backend
@@ -2171,6 +2275,17 @@ def main() -> None:
                                   f"{type(e).__name__}: {e}")
     else:
         fw["plan_skipped"] = f"budget {budget_s:.0f}s < 60s"
+    # The speculative-execution backup-dispatch A/B row (ISSUE 15):
+    # chip-independent (shardrun subprocess fleets on 1-device CPU),
+    # rides every branch.
+    if budget_s >= 60 or "DSI_BENCH_SPEC_MB" in os.environ:
+        try:
+            fw.update(run_spec_row())
+        except Exception as e:
+            fw["spec_skipped"] = (f"spec row failed: "
+                                  f"{type(e).__name__}: {e}")
+    else:
+        fw["spec_skipped"] = f"budget {budget_s:.0f}s < 60s"
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,

@@ -44,10 +44,13 @@ device_accumulate mode.
 """
 
 from dsi_tpu.ckpt.fault import (
+    CHAOS_EXIT,
     FAULT_EXIT,
     FAULT_POINTS,
     FaultInjected,
+    chaos_kill_point,
     fault_point,
+    reset_chaos,
     reset_faults,
 )
 from dsi_tpu.ckpt.delta import (
@@ -84,9 +87,12 @@ __all__ = [
     "Deferred",
     "DeltaSteps",
     "HostDeltaLog",
+    "CHAOS_EXIT",
     "FAULT_EXIT",
     "FAULT_POINTS",
     "FaultInjected",
+    "chaos_kill_point",
+    "reset_chaos",
     "checkpoint_async_default",
     "checkpoint_compress_default",
     "checkpoint_delta_default",

@@ -50,6 +50,11 @@ from typing import Dict
 #: fired" rather than "something died".
 FAULT_EXIT = 87
 
+#: The chaos-kill exit code (``DSI_CHAOS_WORKER_KILL``) — distinct from
+#: FAULT_EXIT so a grid can tell a scripted point-kill from a random
+#: boundary-kill in the same run.
+CHAOS_EXIT = 88
+
 FAULT_POINTS = ("post-dispatch", "mid-fold", "pre-sync", "post-ckpt",
                 "mid-capture", "mid-commit")
 
@@ -104,3 +109,83 @@ def fault_point(point: str) -> None:
     # flush — anything the checkpoint path did not make durable BEFORE
     # this instant is gone, which is the whole point.
     os._exit(FAULT_EXIT)
+
+
+# ── chaos injection (ISSUE 15 satellite) ──────────────────────────────
+#
+# ``DSI_CHAOS_WORKER_KILL=p[,seed]`` makes a worker ``os._exit`` with
+# probability ``p`` at task boundaries — the scriptable kill/recovery
+# grid knob.  Determinism: the per-process RNG is seeded from (seed,
+# ``DSI_CHAOS_WORKER_INDEX``) — the spawner stamps each worker with its
+# fleet index — so a grid re-run draws the SAME kill sequence per
+# worker regardless of pids or wall time.  Same discipline as
+# ``fault_point``: trace-flush before the exit, then a real
+# ``os._exit`` with no unwind.
+
+_chaos_rng = None
+_chaos_key = None
+
+
+def parse_chaos_spec(spec: str):
+    """``"p"`` or ``"p,seed"`` → ``(p, seed)``; malformed specs read as
+    disabled (0.0, 0) — chaos must never crash the worker by itself."""
+    try:
+        parts = spec.split(",")
+        p = float(parts[0])
+        seed = int(parts[1]) if len(parts) > 1 and parts[1].strip() else 0
+    except (ValueError, IndexError):
+        return 0.0, 0
+    return (p, seed) if 0.0 < p <= 1.0 else (0.0, 0)
+
+
+def chaos_decision(p: float, seed: int, index: str, draw: int) -> bool:
+    """Whether the ``draw``-th boundary of worker ``index`` under
+    (p, seed) dies — a pure function, so grids are predictable and the
+    unit tests can pin the schedule without spawning processes."""
+    import random
+
+    rng = random.Random(f"{seed}:{index}")
+    hit = False
+    for _ in range(draw):
+        hit = rng.random() < p
+    return hit
+
+
+def chaos_kill_point(boundary: str = "task") -> None:
+    """Note one task boundary; die with probability p when
+    ``DSI_CHAOS_WORKER_KILL`` is armed.  Free when unset (one env
+    read)."""
+    global _chaos_rng, _chaos_key
+    spec = os.environ.get("DSI_CHAOS_WORKER_KILL")
+    if not spec:
+        return
+    p, seed = parse_chaos_spec(spec)
+    if p <= 0.0:
+        return
+    import random
+
+    index = os.environ.get("DSI_CHAOS_WORKER_INDEX", "0")
+    key = (spec, index)
+    if _chaos_rng is None or _chaos_key != key:
+        _chaos_rng = random.Random(f"{seed}:{index}")
+        _chaos_key = key
+    if _chaos_rng.random() >= p:
+        return
+    print(f"CHAOS: killing worker (index={index}) at {boundary} "
+          f"boundary (p={p})", file=sys.stderr, flush=True)
+    try:  # same trace-flush-then-die discipline as fault_point
+        from dsi_tpu.obs import trace as _obs_trace
+
+        tracer = _obs_trace.get_tracer()
+        tracer.event("chaos_kill", boundary=boundary, index=index)
+        tracer.flush()
+    except Exception:
+        pass
+    os._exit(CHAOS_EXIT)
+
+
+def reset_chaos() -> None:
+    """Forget the per-process chaos RNG (in-process test isolation)."""
+    global _chaos_rng, _chaos_key
+    _chaos_rng = None
+    _chaos_key = None

@@ -1,0 +1,289 @@
+"""One-command speculative shard job: coordinator + N shard workers.
+
+The ``mrrun`` shape for streaming-shard jobs (ISSUE 15): plan the input
+into newline-aligned cursor-range shards, run the shard-scheduler
+coordinator IN-PROCESS (it is jax-free, and the driver reads its
+speculation counters directly), spawn N ``shardworker`` subprocesses,
+wait for every shard to commit exactly once, then merge the committed
+per-shard outputs into ``mr-out-0``.
+
+Chaos/straggler injection for grids and the bench A/B:
+
+* ``--slow-worker I:SECONDS`` — worker I sleeps that long per advance
+  slice (``DSI_SHARD_SLOW_S``): the forced straggler the backup
+  dispatcher must fire on;
+* ``--fault-worker I:POINT[:STEP]`` — worker I inherits
+  ``DSI_FAULT_POINT``/``DSI_FAULT_STEP`` (``ckpt/fault.py``): a real
+  ``os._exit`` mid-shard, whose takeover must resume from the chain;
+* ``DSI_CHAOS_WORKER_KILL=p[,seed]`` passes through to every worker
+  (each stamped with ``DSI_CHAOS_WORKER_INDEX`` for determinism).
+
+``--check`` runs the sequential host oracle over the whole input and
+byte-compares the merged output.  ``--stats-json`` dumps the
+coordinator's ``spec_stats()`` (backup_dispatches, requeues, commits,
+duplicate_commits, resume cursors) plus walls — the evidence surface
+the CI smoke and the bench row assert on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _parse_worker_knob(text: str, what: str):
+    i, _, rest = text.partition(":")
+    if not rest:
+        raise SystemExit(f"shardrun: malformed {what}: {text!r} "
+                         f"(want INDEX:VALUE)")
+    return int(i), rest
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="+")
+    p.add_argument("--engine", choices=("wordcount", "grep"),
+                   default="wordcount")
+    p.add_argument("--pattern", default="",
+                   help="literal pattern (grep engine)")
+    p.add_argument("--workers", type=int, default=3)
+    p.add_argument("--shards", type=int, default=0,
+                   help="shard count (default 2x workers)")
+    p.add_argument("--workdir", default=".")
+    p.add_argument("--chunk-bytes", type=int, default=1 << 20)
+    p.add_argument("--nreduce", type=int, default=10)
+    p.add_argument("--ckpt-every", type=int, default=32,
+                   help="engine checkpoint cadence, confirmed steps")
+    p.add_argument("--ckpt-secs", type=float, default=1.0,
+                   help="worker-driven durable checkpoint cadence, "
+                        "seconds (the resume-granularity knob)")
+    p.add_argument("--progress-s", type=float, default=0.25,
+                   help="worker heartbeat cadence, seconds")
+    p.add_argument("--shard-timeout", type=float, default=10.0,
+                   help="presumed-dead progress silence, seconds")
+    p.add_argument("--spec-floor", type=float, default=2.0,
+                   help="backup-dispatch staleness floor, seconds")
+    p.add_argument("--no-spec", action="store_true",
+                   help="disable speculative backup dispatch (the "
+                        "bench A/B's control arm)")
+    p.add_argument("--journal", default="",
+                   help="commit journal (default <workdir>/shards."
+                        "journal; exactly-once needs it)")
+    p.add_argument("--slow-worker", default="",
+                   help="I:SECONDS — straggler injection for worker I")
+    p.add_argument("--fault-worker", default="",
+                   help="I:POINT[:STEP] — DSI_FAULT_POINT kill for "
+                        "worker I")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--check", action="store_true",
+                   help="byte-compare the merged output vs the "
+                        "sequential host oracle")
+    p.add_argument("--stats-json", default="")
+    p.add_argument("--trace-dir", default=None)
+    p.add_argument("--out", default="mr-out-0",
+                   help="merged output name (relative to workdir)")
+    args = p.parse_args(argv)
+
+    workdir = os.path.abspath(args.workdir)
+    os.makedirs(workdir, exist_ok=True)
+    files = [os.path.abspath(f) for f in args.files]
+    n_shards = args.shards or 2 * args.workers
+    journal = os.path.abspath(args.journal) if args.journal \
+        else os.path.join(workdir, "shards.journal")
+
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr import shards as sh
+    from dsi_tpu.mr.coordinator import Coordinator
+
+    env = dict(os.environ)
+    env.setdefault("DSI_MR_SOCKET", os.path.join(workdir, "mr.sock"))
+    # Workers run with cwd=workdir; make the package importable there
+    # even when it is not installed (the test-sandbox case).
+    import dsi_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    if args.trace_dir:
+        trace_dir = os.path.abspath(args.trace_dir)
+        env["DSI_TRACE_DIR"] = trace_dir
+        from dsi_tpu.obs import configure_tracing, trace_event
+
+        configure_tracing(trace_dir=trace_dir, basename="trace-shardrun")
+        trace_event("shardrun.start", engine=args.engine,
+                    workers=args.workers, shards=n_shards,
+                    files=len(files))
+
+    plan = sh.plan_shards(files, n_shards)
+    if not plan:
+        print("shardrun: empty input", file=sys.stderr)
+        return 1
+    knobs = {"engine": args.engine, "chunk_bytes": args.chunk_bytes,
+             "n_reduce": args.nreduce, "ckpt_every": args.ckpt_every,
+             "ckpt_secs": args.ckpt_secs}
+    if args.engine == "grep":
+        if not args.pattern:
+            p.error("--engine grep requires --pattern")
+        knobs["pattern"] = args.pattern
+    cfg = JobConfig(workdir=workdir, socket_path=env["DSI_MR_SOCKET"],
+                    journal_path=journal,
+                    shard_timeout_s=args.shard_timeout,
+                    spec_backup=not args.no_spec,
+                    spec_floor_s=args.spec_floor,
+                    shard_progress_s=args.progress_s)
+    coord = Coordinator(files, 0, cfg, shard_plan=plan,
+                        shard_opts={"knobs": knobs})
+    coord.serve()
+
+    slow = _parse_worker_knob(args.slow_worker, "--slow-worker") \
+        if args.slow_worker else None
+    fault = _parse_worker_knob(args.fault_worker, "--fault-worker") \
+        if args.fault_worker else None
+
+    def worker_env(i: int) -> dict:
+        we = dict(env)
+        we["DSI_CHAOS_WORKER_INDEX"] = str(i)
+        if slow is not None and i == slow[0]:
+            we["DSI_SHARD_SLOW_S"] = slow[1]
+        if fault is not None and i == fault[0]:
+            point, _, step_n = fault[1].partition(":")
+            we["DSI_FAULT_POINT"] = point
+            if step_n:
+                we["DSI_FAULT_STEP"] = step_n
+        return we
+
+    worker_cmd = [sys.executable, "-m", "dsi_tpu.cli.shardworker",
+                  "--progress-s", str(args.progress_s)]
+    t0 = time.monotonic()
+    deadline = t0 + args.timeout
+    workers = [subprocess.Popen(worker_cmd, env=worker_env(i),
+                                cwd=workdir)
+               for i in range(args.workers)]
+    envs = [worker_env(i) for i in range(args.workers)]
+    # A worker that died crashed (chaos/fault kill) is respawned WITHOUT
+    # its kill knobs — the grid's "the fleet recovers" arm; budget keeps
+    # a truly broken setup from spinning.
+    respawn_budget = max(8, 2 * len(plan))
+    rc = 0
+    try:
+        while not coord.done():
+            if time.monotonic() > deadline:
+                print("shardrun: job exceeded --timeout; killing",
+                      file=sys.stderr)
+                rc = 1
+                break
+            for i, w in enumerate(workers):
+                if w.poll() is not None and w.returncode != 0 \
+                        and not coord.done():
+                    if respawn_budget <= 0:
+                        print("shardrun: workers failing repeatedly; "
+                              "giving up", file=sys.stderr)
+                        rc = 1
+                        break
+                    respawn_budget -= 1
+                    clean = {k: v for k, v in envs[i].items()
+                             if k not in ("DSI_FAULT_POINT",
+                                          "DSI_FAULT_STEP",
+                                          "DSI_CHAOS_WORKER_KILL")}
+                    workers[i] = subprocess.Popen(worker_cmd, env=clean,
+                                                  cwd=workdir)
+            if rc:
+                break
+            time.sleep(0.1)
+    finally:
+        run_stats = coord.spec_stats()
+        run_stats["wall_s"] = round(time.monotonic() - t0, 3)
+        coord.close()
+        for w in workers:
+            if w.poll() is None:
+                w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                w.kill()
+
+    if rc == 0 and run_stats.get("job_failed"):
+        print("shardrun: job failed (shard attempts exhausted)",
+              file=sys.stderr)
+        rc = 1
+
+    merged_path = os.path.join(workdir, args.out)
+    if rc == 0:
+        from dsi_tpu.utils.atomicio import atomic_write
+
+        payloads = []
+        for spec in plan:
+            path = os.path.join(workdir, f"mr-shard-out-{spec.sid}")
+            try:
+                with open(path, "rb") as f:
+                    payloads.append(f.read())
+            except OSError as e:
+                print(f"shardrun: missing committed shard output: {e}",
+                      file=sys.stderr)
+                rc = 1
+                break
+        if rc == 0:
+            merged = (sh.merge_grep(payloads) if args.engine == "grep"
+                      else sh.merge_wordcount(payloads))
+            with atomic_write(merged_path, mode="wb") as f:
+                f.write(merged)
+            run_stats["merged_bytes"] = len(merged)
+            # Every shard committed durably: the checkpoint chains are
+            # dead weight now (a resume keys off the journal, which
+            # says there is nothing left to run).
+            import shutil
+
+            shutil.rmtree(os.path.join(workdir, ".shards"),
+                          ignore_errors=True)
+
+    if args.stats_json:
+        # dsicheck: allow[raw-write] bench/CI parse surface, not durable state
+        with open(args.stats_json, "w", encoding="utf-8") as f:
+            json.dump(run_stats, f, sort_keys=True, indent=1)
+    if args.trace_dir:
+        from dsi_tpu.obs import flush_tracing, trace_event
+
+        trace_event("shardrun.exit", rc=rc,
+                    backups=run_stats.get("backup_dispatches"),
+                    commits=run_stats.get("commits"))
+        flush_tracing()
+    print(f"shardrun: {len(plan)} shards, "
+          f"{run_stats.get('commits', 0)} commits, "
+          f"{run_stats.get('backup_dispatches', 0)} backups, "
+          f"{run_stats.get('requeues', 0)} requeues, "
+          f"{run_stats.get('duplicate_commits', 0)} duplicate commits, "
+          f"wall {run_stats.get('wall_s')}s", file=sys.stderr)
+    if rc != 0:
+        return rc
+
+    if args.check:
+        if args.engine == "grep":
+            from dsi_tpu.parallel.grepstream import grep_host_oracle
+
+            # format_grep drops topk exactly like merge_grep, so the
+            # oracle bytes and the merged bytes share one shape.
+            want = sh.format_grep(grep_host_oracle(
+                sh.read_stream_range(files, 0,
+                                     sh.stream_total_bytes(files)),
+                args.pattern))
+        else:
+            want = sh.format_wordcount_counts(sh.wordcount_host_oracle(
+                sh.read_stream_range(files, 0,
+                                     sh.stream_total_bytes(files))))
+        with open(merged_path, "rb") as f:
+            got = f.read()
+        if got != want:
+            print("shardrun: PARITY FAILURE vs sequential oracle",
+                  file=sys.stderr)
+            return 2
+        print("shardrun: parity OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,39 @@
+"""Shard-worker process entry point (``mr/shardworker.py``).
+
+Spawned by ``shardrun`` with cwd=workdir and the coordinator socket in
+``DSI_MR_SOCKET``; every engine knob arrives over the wire in the shard
+assignment, so the process needs no app argument.  Commits a
+trace-<pid> file at exit when ``DSI_TRACE_DIR`` is inherited.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default=".")
+    p.add_argument("--progress-s", type=float, default=None,
+                   help="ShardProgress heartbeat cadence, seconds")
+    p.add_argument("--shard-timeout", type=float, default=None,
+                   help="mirror of the coordinator's presumed-dead "
+                        "silence (informational on the worker side)")
+    args = p.parse_args(argv)
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr.shardworker import shard_worker_loop
+
+    kw = {"workdir": args.workdir}
+    if args.progress_s is not None:
+        kw["shard_progress_s"] = args.progress_s
+    if args.shard_timeout is not None:
+        kw["shard_timeout_s"] = args.shard_timeout
+    # Tracing: DSI_TRACE_DIR (inherited from shardrun) arms the global
+    # tracer with a durable atexit flush; chaos/fault kills flush
+    # explicitly before os._exit (ckpt/fault.py).
+    shard_worker_loop(JobConfig(**kw))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

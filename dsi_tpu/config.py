@@ -69,5 +69,37 @@ class JobConfig:
     # completions are journaled and a restarted coordinator resumes the job.
     journal_path: str = ""
 
+    # ── streaming-shard jobs (mr/shards.py) ──
+
+    # Attempt presumed-dead silence, seconds: a shard attempt that has not
+    # sent a progress RPC for this long is marked dead and the shard is
+    # re-queued with a resume hint.  Progress-based, unlike task_timeout_s
+    # (shards are long-running; assignment-age timeouts would kill every
+    # healthy big shard).
+    shard_timeout_s: float = 10.0
+
+    # Speculative backup dispatch (Dean & Ghemawat §3.6).  An idle worker
+    # asking for work when no shard is untouched may be handed a BACKUP
+    # attempt of a shard whose newest attempt has been silent longer than
+    # max(spec_k * p99(that worker's contact gaps), spec_floor_s) — the
+    # percentile-aware straggler_suspects() signal.  First commit wins.
+    spec_backup: bool = True
+    spec_k: float = 2.0
+    spec_floor_s: float = 2.0
+
+    # Setup grace: an attempt that has not yet sent its first progress
+    # RPC is still constructing its engine (jax init + first compiles,
+    # seconds of legitimate silence) — the silence trigger waits at
+    # least this long for such attempts so fresh attempts don't attract
+    # spurious backups.
+    spec_setup_s: float = 8.0
+
+    # Worker-side progress-RPC cadence while driving a shard, seconds.
+    shard_progress_s: float = 0.5
+
+    # Total attempts allowed per shard (primaries + backups + takeovers)
+    # before the job is declared failed — bounds a poisoned shard.
+    shard_max_attempts: int = 8
+
     def sock(self) -> str:
         return self.socket_path or default_socket_path(self.workdir)

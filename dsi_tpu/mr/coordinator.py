@@ -36,15 +36,36 @@ from dsi_tpu.config import JobConfig
 from dsi_tpu.obs import LatencyHistogram, get_registry
 from dsi_tpu.mr import rpc
 from dsi_tpu.mr.journal import Journal
+from dsi_tpu.mr.shards import ShardSpec
 from dsi_tpu.mr.types import (LOG_COMPLETED, LOG_IN_PROGRESS, LOG_UNTOUCHED,
                               TaskStatus)
+from dsi_tpu.utils.atomicio import fsync_dir
 from dsi_tpu.utils.tracing import log_event
 
 
 class Coordinator:
-    """Owns all job state; hands out tasks on pull (mr/coordinator.go:14-25)."""
+    """Owns all job state; hands out tasks on pull (mr/coordinator.go:14-25).
 
-    def __init__(self, files: List[str], n_reduce: int, config: JobConfig | None = None):
+    **Shard mode** (``shard_plan`` given): the coordinator is a shard
+    scheduler for the streaming engines (ISSUE 15 — the speculative-
+    execution loop the PR-9 telemetry armed).  Each :class:`ShardSpec`
+    is a cursor-range task a worker drives as a resumable step object;
+    the coordinator tracks ATTEMPTS per shard (primary / takeover /
+    backup), presumes an attempt dead when its progress RPCs go silent
+    past ``shard_timeout_s`` (re-queueing the shard with a resume hint
+    pointing at the best checkpoint chain), speculatively hands an idle
+    worker a BACKUP attempt of a shard whose newest attempt is silent
+    past the percentile-aware suspect threshold (Dean & Ghemawat §3.6),
+    and arbitrates FIRST-COMMIT-WINS: the first ``CommitShard`` RPC for
+    a shard durably renames that attempt's output and journals the
+    commit record (shard id + attempt id + output CRC32) under the
+    lock — every later attempt is told it lost and reaps its partials.
+    """
+
+    def __init__(self, files: List[str], n_reduce: int,
+                 config: JobConfig | None = None,
+                 shard_plan: Optional[List[ShardSpec]] = None,
+                 shard_opts: Optional[dict] = None):
         self.config = config or JobConfig(n_reduce=n_reduce)
         self.files = list(files)
         self.n_map = len(files)
@@ -61,6 +82,38 @@ class Coordinator:
         self._map_ready = list(range(self.n_map))
         self._reduce_ready = list(range(n_reduce))
         self.mu = threading.Lock()
+        # ── shard-scheduler state (shard mode only; all guarded by mu) ──
+        self.shard_plan = list(shard_plan) if shard_plan else None
+        self.shard_opts = dict(shard_opts or {})
+        self.n_shards = len(self.shard_plan) if self.shard_plan else 0
+        if self.shard_plan:
+            self.n_map = 0  # shard jobs have no map/reduce phases
+            self.n_reduce = 0
+            self._map_ready = []
+            self._reduce_ready = []
+        self._shards: Dict[int, dict] = {}
+        self._shard_ready: list[int] = []
+        self.job_failed = False
+        #: Speculation counters — the differential harness's evidence
+        #: surface (``spec_stats()``).  duplicate_commits counts journal
+        #: double-commits and MUST stay 0; commit_losses counts attempts
+        #: that finished second (normal when a backup races the primary).
+        self._spec = {"backup_dispatches": 0, "requeues": 0, "commits": 0,
+                      "commit_losses": 0, "duplicate_commits": 0,
+                      "resumed_attempts": 0, "failed_attempts": 0,
+                      "resume_cursors": {}}
+        #: assignment→commit walls of committed shards — the "normal
+        #: shard duration" reference the slow-progress backup trigger
+        #: compares against (§3.6: back up what takes abnormally long).
+        self._commit_walls: list[float] = []
+        if self.shard_plan:
+            for spec in self.shard_plan:
+                self._shards[spec.sid] = {
+                    "spec": spec, "status": LOG_UNTOUCHED,
+                    "attempts": {}, "next_aid": 0, "committed": None,
+                    "backups": 0}
+            self._shard_ready = list(range(self.n_shards))
+            heapq.heapify(self._shard_ready)
         # Worker liveness (observability + the speculative-execution
         # hook): last-contact time per WorkerId — every RPC carrying an
         # id refreshes it — and which worker holds each in-progress
@@ -82,7 +135,10 @@ class Coordinator:
         # (mr/coordinator.go:70-77,99-106) — a per-task Timer thread melts
         # at ~10^4 tasks (~0.4 ms spawn each, thousands of live threads);
         # the heap is O(log n) per assignment and one thread total.
-        self._deadlines: list[tuple[float, str, int]] = []
+        # Entries: (due, "map"|"reduce", task_id) or, in shard mode,
+        # (due, "shard", sid, attempt_id) — progress-based, re-armed by
+        # the watchdog while the attempt keeps phoning home.
+        self._deadlines: list[tuple] = []
         self._deadline_cv = threading.Condition(self.mu)
         self._closing = False
         self._monitor = threading.Thread(target=self._watchdog,
@@ -103,9 +159,11 @@ class Coordinator:
         resuming = bool(self.config.journal_path
                         and os.path.exists(self.config.journal_path))
         if not resuming:
+            prefixes = ("mr-out-", "mr-shard-out-") if self.shard_plan \
+                else ("mr-out-",)
             try:
                 stale = [n for n in os.listdir(self.config.workdir)
-                         if n.startswith("mr-out-")]
+                         if n.startswith(prefixes)]
             except OSError:
                 stale = []
             for name in stale:  # ALL partitions, incl. a previous job's
@@ -119,7 +177,7 @@ class Coordinator:
         self._journal: Optional[Journal] = None
         if self.config.journal_path:
             self._journal = Journal(self.config.journal_path, self.files,
-                                    self.n_reduce)
+                                    self.n_reduce, n_shards=self.n_shards)
             done_maps, done_reduces = self._journal.replay()
             for t in done_maps:
                 if self.map_log[t] != LOG_COMPLETED:
@@ -129,6 +187,19 @@ class Coordinator:
                 if self.reduce_log[t] != LOG_COMPLETED:
                     self.reduce_log[t] = LOG_COMPLETED
                     self.c_reduce += 1
+            # Shard commits replay as COMMITTED: the journal record was
+            # written only after the output file's durable rename, so
+            # the shard's output exists and must never be re-run.
+            for sid, (aid, crc) in self._journal.shard_commits.items():
+                shard = self._shards.get(sid)
+                if shard is not None and shard["committed"] is None:
+                    shard["committed"] = (aid, crc)
+                    shard["status"] = LOG_COMPLETED
+            if self._journal.shard_commits:
+                self._shard_ready = [
+                    s for s in self._shard_ready
+                    if self._shards[s]["committed"] is None]
+                heapq.heapify(self._shard_ready)
             self._journal.open()
 
     # ---- RPC handlers (the wire API, mr/coordinator.go:27-114) ----
@@ -212,6 +283,198 @@ class Coordinator:
                 log_event("duplicate_completion", kind="reduce", task=t)
         return {}
 
+    # ---- shard-scheduler RPC handlers (shard mode, mr/shards.py) ----
+
+    def request_shard(self, args: dict) -> dict:
+        """Assign a shard attempt: an untouched/re-queued shard first
+        (primary or takeover — a takeover carries a resume hint at the
+        best known checkpoint chain), else a speculative BACKUP attempt
+        of the stalest suspect shard (Dean & Ghemawat §3.6), else
+        WAITING/DONE."""
+        wid = str(args.get("WorkerId") or "")
+        reply: dict = {"TaskStatus": int(TaskStatus.WAITING)}
+        now = time.monotonic()
+        with self.mu:
+            if self.shard_plan is None:
+                return {"TaskStatus": int(TaskStatus.DONE)}
+            if wid:
+                self._touch(wid)
+            if self.job_failed or all(
+                    shard["committed"] is not None
+                    for shard in self._shards.values()):
+                reply["TaskStatus"] = int(TaskStatus.DONE)
+                return reply
+            assignment = None
+            sid = self._pop_untouched_shard()
+            if sid is not None:
+                shard = self._shards[sid]
+                kind = "takeover" if shard["attempts"] else "primary"
+                assignment = self._new_attempt(sid, wid, kind, now)
+            elif self.config.spec_backup:
+                assignment = self._maybe_backup(wid, now)
+            if assignment is None:
+                return reply
+            sid, aid, shard, att = assignment
+            spec = shard["spec"]
+            reply.update({
+                "TaskStatus": int(TaskStatus.SHARD), "Shard": sid,
+                "Attempt": aid, "Start": spec.start, "End": spec.end,
+                "Files": self.files, "NShards": self.n_shards,
+                "ResumeFrom": att["resume_from"],
+                "Knobs": self.shard_opts.get("knobs", {}),
+                "CkptRoot": self._shard_ckpt_root(),
+                "OutPart": self._shard_part_path(sid, aid),
+            })
+            log_event("assign", kind="shard", task=sid, attempt=aid,
+                      attempt_kind=att["kind"], worker=wid or None,
+                      resume_from=att["resume_from"])
+        return reply
+
+    def shard_progress(self, args: dict) -> dict:
+        """Attempt heartbeat: refreshes liveness (the watchdog's
+        presumed-dead signal is *progress* silence, not RPC silence) and
+        carries the attempt's confirmed-step count, its durable
+        checkpoint count (the resume-hint ranking), and — once, after a
+        takeover/backup restore — the resume cursor the differential
+        harness asserts on.  The reply's ``Cancel`` tells a loser to
+        stop and reap (first-commit-wins)."""
+        wid = str(args.get("WorkerId") or "")
+        sid = int(args.get("Shard", -1))
+        aid = int(args.get("Attempt", -1))
+        now = time.monotonic()
+        with self.mu:
+            if wid:
+                self._touch(wid)
+            shard = self._shards.get(sid)
+            att = shard["attempts"].get(aid) if shard is not None else None
+            if att is None:
+                return {"Cancel": True}
+            att["last_progress"] = now
+            att["confirmed"] = int(args.get("Confirmed", 0) or 0)
+            att["ckpts"] = int(args.get("Ckpts", 0) or 0)
+            # "Progressed" means REAL steps retired, not merely an RPC:
+            # the first advance slice pays the engine's jax compiles,
+            # and the setup-grace window must cover exactly that.
+            if att["confirmed"] > 0 or att["ckpts"] > 0:
+                att["progressed"] = True
+            rc = args.get("ResumeCursor")
+            if rc and not att["resume_cursor"]:
+                att["resume_cursor"] = int(rc)
+                self._spec["resumed_attempts"] += 1
+                self._spec["resume_cursors"][f"{sid}.a{aid}"] = int(rc)
+            cancel = shard["committed"] is not None or att["cancelled"]
+            return {"Cancel": bool(cancel)}
+
+    def commit_shard(self, args: dict) -> dict:
+        """FIRST-COMMIT-WINS, under the lock: the first attempt to
+        report a durably written partial wins — its file is renamed to
+        the shard's final output, the commit record (shard + attempt +
+        CRC32) is journaled, and every other live attempt is flagged
+        for cancellation.  Later commits are told they lost and reap
+        their partials; a dead-presumed attempt that was actually just
+        slow may still win (liveness never gates commits)."""
+        wid = str(args.get("WorkerId") or "")
+        sid = int(args.get("Shard", -1))
+        aid = int(args.get("Attempt", -1))
+        crc = int(args.get("Crc", 0) or 0)
+        with self.mu:
+            if wid:
+                self._touch(wid)
+            shard = self._shards.get(sid)
+            if shard is None:
+                return {"Win": False}
+            if shard["committed"] is not None:
+                self._spec["commit_losses"] += 1
+                if shard["committed"][0] == aid:
+                    # The winner re-reporting would double-journal:
+                    # MUST stay 0 (the harness gates on it).
+                    self._spec["duplicate_commits"] += 1
+                log_event("shard_commit_lose", kind="shard", task=sid,
+                          attempt=aid, winner=shard["committed"][0],
+                          worker=wid or None)
+                return {"Win": False}
+            part = self._shard_part_path(sid, aid)
+            final = self._shard_out_path(sid)
+            try:
+                os.replace(part, final)
+                fsync_dir(os.path.dirname(final) or ".")
+            except OSError as e:
+                log_event("shard_commit_missing", kind="shard", task=sid,
+                          attempt=aid, error=str(e))
+                return {"Win": False, "Error": f"partial missing: {e}"}
+            if self._journal is not None:
+                self._journal.record_shard(sid, aid, crc)
+            shard["committed"] = (aid, crc)
+            shard["status"] = LOG_COMPLETED
+            self._spec["commits"] += 1
+            # Reap sibling partials: an attempt killed between its
+            # durable partial write and its commit RPC can never report
+            # again, and its orphan .part must not outlive the shard.
+            prefix = os.path.basename(final) + ".a"
+            try:
+                for name in os.listdir(os.path.dirname(final) or "."):
+                    if name.startswith(prefix) and name.endswith(".part"):
+                        os.remove(os.path.join(
+                            os.path.dirname(final), name))
+            except OSError:
+                pass
+            for oaid, oatt in shard["attempts"].items():
+                if oaid != aid:
+                    oatt["cancelled"] = True
+            att = shard["attempts"].get(aid)
+            if att is not None:
+                now = time.monotonic()
+                att["last_progress"] = now
+                # The slow-progress backup trigger's reference: how
+                # long a NORMAL shard takes, assignment to commit.
+                self._commit_walls.append(now - att["assigned"])
+            log_event("shard_commit", kind="shard", task=sid, attempt=aid,
+                      crc=crc, worker=wid or None,
+                      resume_cursor=att["resume_cursor"] if att else 0)
+            get_registry().set_gauge("dsi_shard_commits",
+                                     self._spec["commits"])
+            return {"Win": True}
+
+    def shard_failed(self, args: dict) -> dict:
+        """An attempt reporting it cannot finish (host-path routing,
+        engine error): mark it dead and re-queue the shard with a
+        resume hint — bounded by ``shard_max_attempts``."""
+        wid = str(args.get("WorkerId") or "")
+        sid = int(args.get("Shard", -1))
+        aid = int(args.get("Attempt", -1))
+        with self.mu:
+            if wid:
+                self._touch(wid)
+            shard = self._shards.get(sid)
+            att = shard["attempts"].get(aid) if shard is not None else None
+            if att is not None and not att["dead"] and not att["cancelled"]:
+                att["dead"] = True
+                self._spec["failed_attempts"] += 1
+                log_event("shard_failed", kind="shard", task=sid,
+                          attempt=aid, worker=wid or None,
+                          reason=str(args.get("Reason", "") or ""))
+                self._requeue_shard_locked(sid)
+        return {}
+
+    def spec_stats(self) -> dict:
+        """Speculation-counter snapshot — the differential harness's
+        and the bench row's evidence surface."""
+        with self.mu:
+            out = {k: (dict(v) if isinstance(v, dict) else v)
+                   for k, v in self._spec.items()}
+            out["shards"] = self.n_shards
+            out["job_failed"] = self.job_failed
+            out["committed"] = sum(
+                1 for shard in self._shards.values()
+                if shard["committed"] is not None)
+            out["total_attempts"] = sum(
+                shard["next_aid"] for shard in self._shards.values())
+            out["winning_attempts"] = {
+                str(sid): shard["committed"][0]
+                for sid, shard in self._shards.items()
+                if shard["committed"] is not None}
+        return out
+
     # ---- internals ----
 
     def _touch(self, wid: str) -> None:
@@ -223,6 +486,191 @@ class Coordinator:
             self._hb_hist.setdefault(
                 wid, LatencyHistogram()).record(now - prev)
         self._worker_seen[wid] = now
+
+    def _classify(self, wid: str, now: float):
+        """``(heartbeat_age_s, p99_s, presumed)`` for a worker —
+        percentile-aware: silence beyond 2x the worker's OWN p99
+        contact gap reads as a dead worker (its cadence stopped);
+        silence still within cadence norms reads as a slow task — the
+        case the backup dispatcher should split rather than abandon.
+        No gap data yet → unknown, never a guess.  Caller holds
+        ``self.mu``."""
+        seen = self._worker_seen.get(wid)
+        hb_age = round(now - seen, 3) if seen is not None else None
+        h = self._hb_hist.get(wid)
+        hb_p99 = (round(h.percentile(0.99), 3)
+                  if h is not None and h.count else None)
+        presumed = "unknown"
+        if hb_age is not None and hb_p99 is not None:
+            presumed = "dead" if hb_age > 2 * hb_p99 else "slow-task"
+        return hb_age, hb_p99, presumed
+
+    # ---- shard-scheduler internals (caller holds self.mu) ----
+
+    def _shard_ckpt_root(self) -> str:
+        return (self.shard_opts.get("ckpt_root")
+                or os.path.join(os.path.abspath(self.config.workdir),
+                                ".shards"))
+
+    def _shard_out_path(self, sid: int) -> str:
+        return os.path.join(os.path.abspath(self.config.workdir),
+                            f"mr-shard-out-{sid}")
+
+    def _shard_part_path(self, sid: int, aid: int) -> str:
+        return self._shard_out_path(sid) + f".a{aid}.part"
+
+    def _pop_untouched_shard(self) -> Optional[int]:
+        while self._shard_ready:
+            sid = heapq.heappop(self._shard_ready)
+            if self._shards[sid]["status"] == LOG_UNTOUCHED:
+                return sid
+        return None
+
+    def _new_attempt(self, sid: int, wid: str, kind: str, now: float):
+        """Create + arm one attempt; takeovers/backups carry the best
+        known checkpoint chain as their resume hint."""
+        shard = self._shards[sid]
+        aid = shard["next_aid"]
+        shard["next_aid"] = aid + 1
+        att = {"worker": wid, "kind": kind, "assigned": now,
+               "last_progress": now, "progressed": False, "confirmed": 0,
+               "ckpts": 0, "resume_cursor": 0, "dead": False,
+               "cancelled": False,
+               "resume_from": (self._best_resume_from(shard)
+                               if kind != "primary" else None)}
+        shard["attempts"][aid] = att
+        shard["status"] = LOG_IN_PROGRESS
+        self._arm_shard_timeout(sid, aid)
+        return sid, aid, shard, att
+
+    @staticmethod
+    def _best_resume_from(shard: dict) -> Optional[int]:
+        """The attempt whose chain a new attempt should adopt: most
+        durable checkpoints wins (dead attempts count — their chains
+        are on disk; that is the whole point of resuming a killed
+        shard), newest attempt breaking ties."""
+        best = None
+        for aid, att in shard["attempts"].items():
+            if att["ckpts"] <= 0:
+                continue
+            if best is None or (att["ckpts"], aid) > best[1]:
+                best = (aid, (att["ckpts"], aid))
+        return best[0] if best is not None else None
+
+    def _maybe_backup(self, wid: str, now: float):
+        """Speculative dispatch: hand this idle worker a BACKUP attempt
+        of the worst suspect shard.  Two triggers, both percentile-
+        aware (§3.6 — back up remaining in-progress work when it is
+        abnormally SILENT or abnormally SLOW):
+
+        * **silent** — the newest live attempt's progress-RPC silence
+          exceeds ``max(spec_k * p99(its worker's contact gaps),
+          spec_floor_s)``; an attempt that has never reported progress
+          is still in engine setup (jax init + compiles) and gets at
+          least ``spec_setup_s`` of grace;
+        * **slow** — the attempt is heartbeating but its total age
+          exceeds ``spec_k`` times the LONGEST committed shard's
+          assignment→commit wall (only armed once a reference wall
+          exists — early in the job nothing is "abnormal" yet).
+
+        At most two live attempts per shard; never backs a worker up
+        with itself."""
+        ref_wall = max(self._commit_walls) if self._commit_walls else None
+        best = None
+        best_age = 0.0
+        best_reason = ""
+        for sid, shard in self._shards.items():
+            if shard["committed"] is not None \
+                    or shard["status"] != LOG_IN_PROGRESS:
+                continue
+            live = [(aid, a) for aid, a in shard["attempts"].items()
+                    if not a["dead"] and not a["cancelled"]]
+            if not live or len(live) >= 2:
+                continue
+            if shard["next_aid"] >= self.config.shard_max_attempts:
+                continue
+            aid_f, freshest = max(live,
+                                  key=lambda kv: kv[1]["last_progress"])
+            if freshest["worker"] == wid:
+                continue
+            age = now - freshest["last_progress"]
+            total_age = now - freshest["assigned"]
+            h = self._hb_hist.get(freshest["worker"])
+            p99 = h.percentile(0.99) if h is not None and h.count else 0.0
+            thr = max(self.config.spec_k * p99, self.config.spec_floor_s)
+            if not freshest["progressed"]:
+                thr = max(thr, self.config.spec_setup_s)
+            silent = age > thr
+            slow = (ref_wall is not None and freshest["progressed"]
+                    and total_age > self.config.spec_k * ref_wall)
+            if not (silent or slow):
+                continue
+            if total_age > best_age:
+                best, best_age = (sid, aid_f, freshest), total_age
+                best_reason = "silent" if silent else "slow"
+        if best is None:
+            return None
+        sid, aid_f, freshest = best
+        shard = self._shards[sid]
+        assignment = self._new_attempt(sid, wid, "backup", now)
+        shard["backups"] += 1
+        self._spec["backup_dispatches"] += 1
+        hb_age, hb_p99, presumed = self._classify(freshest["worker"], now)
+        get_registry().set_gauge("dsi_shard_backup_dispatches",
+                                 self._spec["backup_dispatches"])
+        log_event("backup_dispatch", kind="shard", task=sid,
+                  attempt=assignment[1], straggler_attempt=aid_f,
+                  straggler_worker=freshest["worker"] or None,
+                  backup_worker=wid or None, reason=best_reason,
+                  attempt_age_s=round(best_age, 3),
+                  heartbeat_age_s=hb_age, heartbeat_p99_s=hb_p99,
+                  presumed=presumed,
+                  resume_from=assignment[3]["resume_from"])
+        print(f"coordinator: backup dispatch shard {sid}: attempt "
+              f"a{aid_f} (worker={freshest['worker'] or '?'}) "
+              f"{best_reason} for {best_age:.3f}s presumed={presumed}; "
+              f"backup a{assignment[1]} -> {wid or '?'} resume_from="
+              f"{assignment[3]['resume_from']}", file=sys.stderr)
+        return assignment
+
+    def _requeue_shard_locked(self, sid: int) -> None:
+        """Back to the ready heap with a resume hint — unless a live
+        attempt remains (a backup is still running: it IS the retry),
+        the shard already committed, or the attempt budget is spent
+        (job fails loudly rather than looping a poisoned shard)."""
+        shard = self._shards[sid]
+        if shard["committed"] is not None:
+            return
+        if any(not a["dead"] and not a["cancelled"]
+               for a in shard["attempts"].values()):
+            return
+        if shard["next_aid"] >= self.config.shard_max_attempts:
+            self.job_failed = True
+            log_event("shard_exhausted", kind="shard", task=sid,
+                      attempts=shard["next_aid"])
+            print(f"coordinator: shard {sid} failed "
+                  f"{shard['next_aid']} attempts; job failed",
+                  file=sys.stderr)
+            return
+        # The resume hint is recomputed at assignment time
+        # (_new_attempt → _best_resume_from), so requeueing records
+        # nothing here beyond readiness.
+        shard["status"] = LOG_UNTOUCHED
+        heapq.heappush(self._shard_ready, sid)
+        self._spec["requeues"] += 1
+        get_registry().set_gauge("dsi_shard_requeues",
+                                 self._spec["requeues"])
+
+    def _arm_shard_timeout(self, sid: int, aid: int) -> None:
+        """Progress-based deadline for one attempt: the watchdog
+        re-arms while progress RPCs keep landing, and presumes the
+        attempt dead only after ``shard_timeout_s`` of silence.
+        Caller holds ``self.mu``."""
+        entry = (time.monotonic() + self.config.shard_timeout_s,
+                 "shard", sid, aid)
+        heapq.heappush(self._deadlines, entry)
+        if self._deadlines[0] is entry:
+            self._deadline_cv.notify()
 
     @staticmethod
     def _pop_untouched(ready: list[int], log: list[int]) -> Optional[int]:
@@ -265,11 +713,16 @@ class Coordinator:
                     self._deadline_cv.wait()
                     continue
                 now = time.monotonic()
-                due, kind, task_id = self._deadlines[0]
+                entry = self._deadlines[0]
+                due, kind = entry[0], entry[1]
                 if due > now:
                     self._deadline_cv.wait(timeout=due - now)
                     continue
                 heapq.heappop(self._deadlines)
+                if kind == "shard":
+                    self._expire_shard_attempt(entry[2], entry[3], now)
+                    continue
+                task_id = entry[2]
                 log = self.map_log if kind == "map" else self.reduce_log
                 if log[task_id] == LOG_IN_PROGRESS:
                     log[task_id] = LOG_UNTOUCHED
@@ -277,27 +730,13 @@ class Coordinator:
                         self._map_ready if kind == "map"
                         else self._reduce_ready, task_id)
                     wid = self._task_worker.pop((kind, task_id), "")
-                    seen = self._worker_seen.get(wid)
-                    hb_age = (round(now - seen, 3)
-                              if seen is not None else None)
                     ages = {w: round(now - t, 3)
                             for w, t in self._worker_seen.items()}
                     get_registry().set_gauge(
                         "mr_worker_heartbeat_age_s", ages)
-                    # Percentile-aware classification: silence beyond
-                    # 2× the worker's own p99 contact gap reads as a
-                    # dead worker (its cadence stopped, not just this
-                    # task); silence still within cadence norms reads
-                    # as a slow task — the case a backup dispatcher
-                    # should prefer to split rather than abandon.  No
-                    # gap data yet → unknown, never a guess.
-                    h = self._hb_hist.get(wid)
-                    hb_p99 = (round(h.percentile(0.99), 3)
-                              if h is not None and h.count else None)
-                    presumed = "unknown"
-                    if hb_age is not None and hb_p99 is not None:
-                        presumed = ("dead" if hb_age > 2 * hb_p99
-                                    else "slow-task")
+                    # Percentile-aware classification (_classify):
+                    # "dead" vs "slow-task" vs "unknown".
+                    hb_age, hb_p99, presumed = self._classify(wid, now)
                     get_registry().set_gauge(
                         "mr_worker_heartbeat_hist",
                         {w: hh.snapshot()
@@ -317,18 +756,64 @@ class Coordinator:
                           f" presumed={presumed})",
                           file=sys.stderr)
 
+    def _expire_shard_attempt(self, sid: int, aid: int,
+                              now: float) -> None:
+        """One popped shard deadline: re-arm while the attempt keeps
+        making progress; past ``shard_timeout_s`` of silence, presume
+        it dead (percentile-classified) and re-queue the shard with a
+        resume hint at its best checkpoint chain — resume-from-
+        checkpoint instead of replay-from-zero.  Caller holds
+        ``self.mu`` (via the deadline condvar)."""
+        shard = self._shards.get(sid)
+        att = shard["attempts"].get(aid) if shard is not None else None
+        if (att is None or shard["committed"] is not None or att["dead"]
+                or att["cancelled"]):
+            return
+        idle = now - att["last_progress"]
+        # An attempt that never retired a step is still paying engine
+        # setup (jax init + first compiles): give it the same grace the
+        # backup dispatcher does before presuming it dead.
+        timeout = self.config.shard_timeout_s
+        if not att["progressed"]:
+            timeout = max(timeout, self.config.spec_setup_s)
+        if idle < timeout:
+            entry = (att["last_progress"] + timeout, "shard", sid, aid)
+            heapq.heappush(self._deadlines, entry)
+            return
+        att["dead"] = True
+        hb_age, hb_p99, presumed = self._classify(att["worker"], now)
+        log_event("requeue", kind="shard", task=sid, attempt=aid,
+                  timeout_s=self.config.shard_timeout_s,
+                  worker=att["worker"] or None, idle_s=round(idle, 3),
+                  heartbeat_age_s=hb_age, heartbeat_p99_s=hb_p99,
+                  presumed=presumed,
+                  reason="no progress past shard_timeout_s")
+        print(f"coordinator: requeue shard {sid} attempt a{aid}: no "
+              f"progress for {idle:.3f}s (worker="
+              f"{att['worker'] or '?'} presumed={presumed})",
+              file=sys.stderr)
+        self._requeue_shard_locked(sid)
+
     # ---- lifecycle (mr/coordinator.go:121-160) ----
 
     def serve(self) -> None:
         """Start the RPC server (reference (*Coordinator).server())."""
-        self._server = rpc.RpcServer(self.config.sock(), {
+        methods = {
             "Coordinator.RequestTask": self.request_task,
             # Reference names, [sic] typo preserved as aliases for wire parity:
             "Coordinator.RecieveMapComplete": self.map_complete,
             "Coordinator.RecieveReduceComplete": self.reduce_complete,
             "Coordinator.MapComplete": self.map_complete,
             "Coordinator.ReduceComplete": self.reduce_complete,
-        })
+        }
+        if self.shard_plan is not None:
+            methods.update({
+                "Coordinator.RequestShard": self.request_shard,
+                "Coordinator.ShardProgress": self.shard_progress,
+                "Coordinator.CommitShard": self.commit_shard,
+                "Coordinator.ShardFailed": self.shard_failed,
+            })
+        self._server = rpc.RpcServer(self.config.sock(), methods)
         self._server.start()
 
     def address(self) -> Optional[str]:
@@ -336,8 +821,13 @@ class Coordinator:
         return self._server.address if self._server is not None else None
 
     def done(self) -> bool:
-        """Job-completion poll (mr/coordinator.go:138-142)."""
+        """Job-completion poll (mr/coordinator.go:138-142); in shard
+        mode, every shard committed (or the job declared failed)."""
         with self.mu:
+            if self.shard_plan is not None:
+                return self.job_failed or all(
+                    shard["committed"] is not None
+                    for shard in self._shards.values())
             return self.c_reduce == self.n_reduce
 
     def worker_heartbeat_ages(self) -> Dict[str, float]:

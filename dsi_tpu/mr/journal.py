@@ -29,12 +29,26 @@ from dsi_tpu.utils.atomicio import fsync_dir
 
 
 class Journal:
-    """Append-only completion log with atomic-enough line writes."""
+    """Append-only completion log with atomic-enough line writes.
 
-    def __init__(self, path: str, files: List[str], n_reduce: int):
+    Shard jobs (``mr/shards.py``) ride the same log: a ``shard`` record
+    is the exactly-once COMMIT of one shard's output — it carries the
+    winning attempt id and the committed payload's CRC32, and replay
+    surfaces them via :attr:`shard_commits` so a restarted coordinator
+    never hands the shard out again (its output file was durably
+    renamed before the record was written, the same
+    commit-before-journal order the map/reduce records rely on)."""
+
+    def __init__(self, path: str, files: List[str], n_reduce: int,
+                 n_shards: int = 0):
         self.path = path
         self.files = list(files)
         self.n_reduce = n_reduce
+        self.n_shards = n_shards
+        #: ``{sid: (attempt, crc32)}`` from replay — exactly one entry
+        #: per committed shard (duplicate records would mean the
+        #: first-commit-wins lock failed; replay keeps the FIRST).
+        self.shard_commits: dict = {}
         self._fh: Optional[TextIO] = None
         self._trunc_at: Optional[int] = None  # set by replay()
 
@@ -54,6 +68,7 @@ class Journal:
         early is always SAFE — truncating just stops it being wasteful)."""
         maps: List[int] = []
         reduces: List[int] = []
+        self.shard_commits = {}
         self._trunc_at: Optional[int] = None
         if not os.path.exists(self.path):
             return maps, reduces
@@ -82,14 +97,16 @@ class Journal:
             if not saw_header:  # first non-blank record must be a header
                 if (rec.get("kind") != "header"
                         or rec.get("files") != self.files
-                        or rec.get("n_reduce") != self.n_reduce):
+                        or rec.get("n_reduce") != self.n_reduce
+                        or int(rec.get("n_shards", 0) or 0) != self.n_shards):
                     raise SystemExit(
                         f"journal {self.path} belongs to a different job "
-                        f"(files/n_reduce mismatch); refusing to resume")
+                        f"(files/n_reduce/n_shards mismatch); refusing to "
+                        f"resume")
                 saw_header = True
                 continue
             kind = rec.get("kind")
-            if kind not in ("map", "reduce"):
+            if kind not in ("map", "reduce", "shard"):
                 self._trunc_at = rec_start
                 break
             task = rec.get("task")
@@ -99,11 +116,24 @@ class Journal:
             # __init__ (IndexError) or, if negative, silently mark the WRONG
             # task completed via Python negative indexing into map_log/
             # reduce_log.
-            bound = len(self.files) if kind == "map" else self.n_reduce
+            bound = (len(self.files) if kind == "map"
+                     else self.n_reduce if kind == "reduce"
+                     else self.n_shards)
             if (not isinstance(task, int) or isinstance(task, bool)
                     or not 0 <= task < bound):
                 self._trunc_at = rec_start
                 break
+            if kind == "shard":
+                attempt = rec.get("attempt")
+                if (not isinstance(attempt, int)
+                        or isinstance(attempt, bool) or attempt < 0):
+                    self._trunc_at = rec_start
+                    break
+                # First record wins; a duplicate here would mean the
+                # first-commit-wins lock failed — keep the winner.
+                self.shard_commits.setdefault(
+                    task, (attempt, int(rec.get("crc", 0) or 0)))
+                continue
             (maps if kind == "map" else reduces).append(task)
         return maps, reduces
 
@@ -142,12 +172,23 @@ class Journal:
         # shared durable-write discipline, utils/atomicio.py) closes it.
         fsync_dir(os.path.dirname(os.path.abspath(self.path)) or ".")
         if size == 0:  # empty counts as fresh: a torn header must be rewritten
-            self._write({"kind": "header", "files": self.files,
-                         "n_reduce": self.n_reduce})
+            header = {"kind": "header", "files": self.files,
+                      "n_reduce": self.n_reduce}
+            if self.n_shards:
+                header["n_shards"] = self.n_shards
+            self._write(header)
 
     def record(self, kind: str, task: int) -> None:
         if self._fh is not None:
             self._write({"kind": kind, "task": task})
+
+    def record_shard(self, sid: int, attempt: int, crc: int) -> None:
+        """The exactly-once shard commit record (winning attempt + the
+        committed output's CRC32) — written AFTER the output file's
+        durable rename, under the coordinator's lock."""
+        if self._fh is not None:
+            self._write({"kind": "shard", "task": sid,
+                         "attempt": attempt, "crc": int(crc)})
 
     def _write(self, rec: dict) -> None:
         assert self._fh is not None

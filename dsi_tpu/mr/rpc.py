@@ -90,7 +90,31 @@ _TRANSIENT_DIAL_ERRNOS = frozenset({
     errno.ECONNABORTED, errno.EINTR,
 })
 _DIAL_ATTEMPTS = 6
-_DIAL_BACKOFF_S = 0.05  # doubled per attempt: ~1.6 s worst-case total
+_DIAL_BACKOFF_S = 0.05  # base; doubled per attempt with jitter below
+#: Jitter fraction: each sleep is ``base * 2^i * (1 + J*u)`` with
+#: ``u ~ U[0,1)`` — a restarting coordinator's whole fleet must not
+#: retry in lockstep (the synchronized-retry thundering herd the fixed
+#: doubling schedule produced: every worker that failed the same
+#: accept-queue race re-dialed at exactly the same instants).
+_DIAL_JITTER = 0.5
+
+
+def dial_backoff_schedule(attempts: int = _DIAL_ATTEMPTS,
+                          base: float = _DIAL_BACKOFF_S,
+                          jitter: float = _DIAL_JITTER,
+                          rng=None) -> list[float]:
+    """The ``attempts - 1`` sleep durations between dial attempts:
+    jittered exponential backoff.  ``rng`` is a 0-arg callable in
+    [0, 1) (default ``random.random``) — injectable so the unit test
+    pins the schedule envelope exactly.  Worst case
+    ``sum(base * 2^i * (1 + jitter))``: ~2.3 s at the defaults, the
+    give-up bound before :class:`CoordinatorGone`."""
+    if rng is None:
+        import random
+
+        rng = random.random
+    return [base * (2 ** i) * (1.0 + jitter * rng())
+            for i in range(max(0, attempts - 1))]
 
 
 def _canonical_body(method: str, args: dict) -> bytes:
@@ -354,16 +378,19 @@ def _dial(kind: str, target, socket_path: str,
     A busy coordinator (full accept backlog → EAGAIN, listener race →
     ECONNREFUSED) must not be mistaken for a dead one: losing a worker to a
     transient dial error silently shrinks the fleet for the rest of the job.
-    Retries ``_DIAL_ATTEMPTS`` times with doubling backoff, then gives up
-    with :class:`CoordinatorGone`.  Non-transient errors (ENOENT: socket
-    file gone — the coordinator exited and we are on the reference's
-    log.Fatal path, mr/worker.go:176-178) raise immediately.  Connect
-    *timeouts* are deliberately not retried: a host that silently drops
-    SYNs has already cost one full ``timeout``, and retrying would turn
-    that into ``_DIAL_ATTEMPTS`` times as long.
+    Retries ``_DIAL_ATTEMPTS`` times with JITTERED exponential backoff
+    (:func:`dial_backoff_schedule` — the former fixed doubling sleep
+    synchronized a whole fleet's retries after a coordinator restart),
+    then gives up with :class:`CoordinatorGone`.  Non-transient errors
+    (ENOENT: socket file gone — the coordinator exited and we are on
+    the reference's log.Fatal path, mr/worker.go:176-178) raise
+    immediately.  Connect *timeouts* are deliberately not retried: a
+    host that silently drops SYNs has already cost one full
+    ``timeout``, and retrying would turn that into ``_DIAL_ATTEMPTS``
+    times as long.
     """
     family = socket.AF_INET if kind == "tcp" else socket.AF_UNIX
-    delay = _DIAL_BACKOFF_S
+    delays = dial_backoff_schedule()
     for attempt in range(_DIAL_ATTEMPTS):
         sock = socket.socket(family, socket.SOCK_STREAM)
         sock.settimeout(timeout)
@@ -375,8 +402,7 @@ def _dial(kind: str, target, socket_path: str,
             transient = e.errno in _TRANSIENT_DIAL_ERRNOS
             if not transient or attempt == _DIAL_ATTEMPTS - 1:
                 raise CoordinatorGone(f"dialing {socket_path}: {e}") from e
-            time.sleep(delay)
-            delay *= 2
+            time.sleep(delays[attempt])
     raise AssertionError("unreachable")
 
 

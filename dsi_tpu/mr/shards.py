@@ -1,0 +1,365 @@
+"""Streaming-shard plans: cursor-range tasks over the engines' byte stream.
+
+The control plane's task unit has always been "one input file" (the
+reference's nMap, ``mr/coordinator.go:152``); the streaming engines'
+unit is "the whole stream".  Speculative execution (Dean & Ghemawat
+§3.6) needs something in between: a **shard** — one cursor range
+``[start, end)`` of the concatenated input stream — small enough to
+re-run or back up, large enough to amortize engine setup.  This module
+owns the shard geometry and the pieces of the protocol that are pure
+functions of the filesystem (no jax anywhere: the coordinator imports
+it):
+
+* :func:`plan_shards` — split ``stream_files(files)``' byte stream into
+  ``n`` newline-aligned ranges.  Alignment matters twice over: the
+  wordcount cutter never splits a token across a non-letter boundary,
+  and the grep engine's ``batch_lines`` counts per *line* — a shard
+  edge inside a line would double- or zero-count it.  A ``\\n`` edge is
+  safe for every engine (files are already joined by ``\\n`` in
+  ``stream_files``, so file boundaries are natural cuts).
+* :func:`shard_blocks` — the byte-exact slice ``[start, end)`` of that
+  stream as a block iterator, seeking instead of reading the prefix.
+  Feeding it to an engine makes every engine cursor (checkpoint
+  offsets, ``skip_stream`` resumes) shard-relative — the existing
+  crash-resume machinery works unchanged inside a shard.
+* :func:`adopt_chain` — the cross-attempt checkpoint handoff: copy the
+  newest complete chain of a dead/straggling attempt's store into a NEW
+  attempt's (empty) store directory.  Attempts deliberately never share
+  a live checkpoint directory — each writes under its own
+  ``a<attempt>`` dir with an ``ATTEMPT`` marker, so two concurrent
+  attempts of one shard can never cross-restore; adoption is the one
+  sanctioned flow, and it validates the marker + the engine-side
+  ``input_range`` identity before any byte is trusted.
+* :func:`wordcount_host_oracle` / the ``merge_*``/``format_*`` helpers
+  — the deterministic shard-output codecs and the sequential ground
+  truth the differential harness byte-compares against.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+#: Marker file naming the attempt a shard-checkpoint directory belongs
+#: to; ``adopt_chain`` refuses to copy into a directory already owned by
+#: a different live attempt.
+ATTEMPT_MARKER = "ATTEMPT"
+
+_CHAIN_FILE_RE = re.compile(
+    r"^(manifest|state|delta)-\d{6}\.(json|npz)(\.crc32)?$")
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """One cursor-range task: ``[start, end)`` over the concatenated
+    ``stream_files(files)`` byte stream (files joined by single ``\\n``
+    separators)."""
+
+    sid: int
+    start: int
+    end: int
+
+    @property
+    def size(self) -> int:
+        return self.end - self.start
+
+
+def stream_total_bytes(files: Sequence[str]) -> int:
+    """Length of ``stream_files(files)``' byte stream: file bytes plus
+    one ``\\n`` separator between adjacent files."""
+    if not files:
+        return 0
+    return sum(os.path.getsize(f) for f in files) + (len(files) - 1)
+
+
+def _file_segments(files: Sequence[str]) -> List[Tuple[int, int, str]]:
+    """``(global_start, global_end, path)`` per file — separators live
+    in the 1-byte gaps between consecutive segments."""
+    segs = []
+    pos = 0
+    for i, p in enumerate(files):
+        if i:
+            pos += 1  # the separator byte
+        size = os.path.getsize(p)
+        segs.append((pos, pos + size, p))
+        pos += size
+    return segs
+
+
+def read_stream_range(files: Sequence[str], start: int, end: int,
+                      block_bytes: int = 4 << 20) -> Iterator[bytes]:
+    """The byte-exact slice ``[start, end)`` of ``stream_files(files)``'
+    stream, seeking to ``start`` instead of reading the prefix."""
+    if end <= start:
+        return
+    for seg_start, seg_end, path in _file_segments(files):
+        # Separator byte immediately before this file, if in range —
+        # checked BEFORE the end-of-range break: a range ending exactly
+        # at a file boundary still owns the separator at seg_start - 1
+        # (the guard is false for fully-before-start segments).
+        if seg_start > 0 and start <= seg_start - 1 < end:
+            yield b"\n"
+        if seg_start >= end:
+            break
+        if seg_end <= start:
+            continue
+        lo = max(start, seg_start) - seg_start
+        hi = min(end, seg_end) - seg_start
+        if hi <= lo:
+            continue
+        with open(path, "rb") as f:
+            f.seek(lo)
+            remaining = hi - lo
+            while remaining:
+                b = f.read(min(block_bytes, remaining))
+                if not b:
+                    break
+                remaining -= len(b)
+                yield b
+
+
+def shard_blocks(files: Sequence[str], spec: ShardSpec,
+                 block_bytes: int = 4 << 20) -> Iterator[bytes]:
+    """Block iterator for one shard — :func:`read_stream_range` over the
+    spec's cursor range."""
+    return read_stream_range(files, spec.start, spec.end, block_bytes)
+
+
+def _align_to_newline(files: Sequence[str], pos: int, total: int,
+                      window: int = 1 << 16) -> int:
+    """Smallest cut ``c >= pos`` with ``stream[c-1] == \\n`` (or
+    ``total`` when no newline follows).  A cut right after a newline is
+    safe for every engine: no token and no line straddles it."""
+    if pos <= 0:
+        return 0
+    if pos >= total:
+        return total
+    scan = pos - 1
+    while scan < total:
+        chunk = b"".join(read_stream_range(files, scan,
+                                           min(scan + window, total)))
+        nl = chunk.find(b"\n")
+        if nl >= 0:
+            return scan + nl + 1
+        scan += len(chunk)
+        if not chunk:
+            break
+    return total
+
+
+def plan_shards(files: Sequence[str], n_shards: int) -> List[ShardSpec]:
+    """Split the stream into up to ``n_shards`` newline-aligned cursor
+    ranges covering ``[0, total)`` exactly.  Nominal equal-size
+    boundaries are pushed forward to the next newline; boundaries that
+    collapse together (a huge single line) merge their shards — the
+    plan never returns an empty shard."""
+    total = stream_total_bytes(files)
+    if total <= 0 or n_shards <= 0:
+        return []
+    cuts = [0]
+    for i in range(1, n_shards):
+        c = _align_to_newline(files, i * total // n_shards, total)
+        if c > cuts[-1] and c < total:
+            cuts.append(c)
+    cuts.append(total)
+    return [ShardSpec(sid, s, e)
+            for sid, (s, e) in enumerate(zip(cuts, cuts[1:]))]
+
+
+# ── cross-attempt checkpoint adoption ──────────────────────────────────
+
+
+def write_attempt_marker(ckpt_dir: str, sid: int, attempt: int) -> None:
+    """Stamp ``ckpt_dir`` as owned by (shard, attempt).  Written through
+    the durable path BEFORE the engine's first save, so ownership is
+    never in doubt for a later adoption."""
+    from dsi_tpu.utils.atomicio import write_bytes_durable
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    write_bytes_durable(
+        os.path.join(ckpt_dir, ATTEMPT_MARKER),
+        json.dumps({"shard": sid, "attempt": attempt},
+                   sort_keys=True).encode("utf-8"))
+
+
+def read_attempt_marker(ckpt_dir: str) -> Optional[Dict]:
+    try:
+        with open(os.path.join(ckpt_dir, ATTEMPT_MARKER),
+                  encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def adopt_chain(src_dir: str, dst_dir: str, sid: int,
+                attempt: int) -> bool:
+    """Copy a dead/straggling attempt's checkpoint chain files into a
+    fresh attempt's directory so the new attempt resumes from the old
+    one's last checkpoint instead of replaying the shard from zero.
+
+    Refusals (False, nothing copied): no source chain; source marker
+    for a DIFFERENT shard (attempt dirs are per-shard — cross-shard
+    adoption would be caught again by the engine's ``input_range``
+    identity, but refusing here is cheaper and louder); destination
+    already owned by another attempt with chain files present.  The
+    copy lands before the destination marker, so a crash mid-adopt
+    leaves a directory the next adoption can overwrite."""
+    src_marker = read_attempt_marker(src_dir)
+    if src_marker is not None and int(src_marker.get("shard", -1)) != sid:
+        return False
+    try:
+        names = [n for n in os.listdir(src_dir) if _CHAIN_FILE_RE.match(n)]
+    except OSError:
+        return False
+    if not names:
+        return False
+    dst_marker = read_attempt_marker(dst_dir)
+    if dst_marker is not None and int(dst_marker.get("attempt", -1)) != attempt:
+        return False
+    os.makedirs(dst_dir, exist_ok=True)
+    for n in os.listdir(dst_dir):  # a half-adopted previous try
+        if _CHAIN_FILE_RE.match(n):
+            try:
+                os.remove(os.path.join(dst_dir, n))
+            except OSError:
+                pass
+    for n in names:
+        try:
+            shutil.copy2(os.path.join(src_dir, n), os.path.join(dst_dir, n))
+        except OSError:
+            return False  # torn source (GC race): caller starts fresh
+    write_attempt_marker(dst_dir, sid, attempt)
+    return True
+
+
+def find_best_chain(shard_dir: str,
+                    exclude_aid: Optional[int] = None) -> Optional[str]:
+    """The sibling attempt directory (``a<id>`` under one shard's
+    checkpoint root) holding the longest chain — highest manifest seq
+    wins (= most saves; content is verified later by the engine's CRC'd
+    load, this scan only picks a candidate).  The coordinator's resume
+    hint covers checkpoints it was TOLD about; this covers the window
+    where an attempt checkpointed and died before its next heartbeat."""
+    manifest_re = re.compile(r"^manifest-(\d{6})\.json$")
+    best = None
+    try:
+        names = os.listdir(shard_dir)
+    except OSError:
+        return None
+    for name in names:
+        if not name.startswith("a"):
+            continue
+        try:
+            aid = int(name[1:])
+        except ValueError:
+            continue
+        if exclude_aid is not None and aid == exclude_aid:
+            continue
+        adir = os.path.join(shard_dir, name)
+        try:
+            seqs = [int(m.group(1)) for n in os.listdir(adir)
+                    if (m := manifest_re.match(n))]
+        except OSError:
+            continue
+        if not seqs:
+            continue
+        key = (max(seqs), aid)
+        if best is None or key > best[1]:
+            best = (adir, key)
+    return best[0] if best is not None else None
+
+
+def reap_attempt_dir(ckpt_dir: str) -> None:
+    """Remove a cancelled/lost attempt's checkpoint directory — the
+    loser's partial state must not survive to confuse a later adoption
+    scan.  Never raises (reaping is best-effort hygiene)."""
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+# ── shard output codecs + the sequential oracle ────────────────────────
+
+
+def format_wordcount(result: Dict[str, tuple]) -> bytes:
+    """Deterministic bytes for a wordcount result ``{word: (count,
+    part)}`` — sorted ``"word count\\n"`` lines, the app output shape."""
+    return "".join(f"{w} {c}\n" for w, (c, _p) in
+                   sorted(result.items())).encode("utf-8")
+
+
+def parse_wordcount(payload: bytes) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for line in payload.decode("utf-8").splitlines():
+        if not line:
+            continue
+        w, _, c = line.rpartition(" ")
+        out[w] = int(c)
+    return out
+
+
+def merge_wordcount(payloads: Iterable[bytes]) -> bytes:
+    """Merge per-shard wordcount outputs by summing counts — shards
+    partition the stream at token-safe cuts, so the sum IS the
+    whole-stream count and the merged bytes match the oracle's."""
+    total: Dict[str, int] = {}
+    for payload in payloads:
+        for w, c in parse_wordcount(payload).items():
+            total[w] = total.get(w, 0) + c
+    return "".join(f"{w} {c}\n"
+                   for w, c in sorted(total.items())).encode("utf-8")
+
+
+def format_grep(result) -> bytes:
+    """Deterministic bytes for a grep shard: the sum-mergeable fields of
+    ``GrepStreamResult`` (per-shard top-k is exact per shard but not
+    globally mergeable, so the merged artifact omits it)."""
+    return json.dumps({"lines": result.lines, "matched": result.matched,
+                       "occurrences": result.occurrences,
+                       "hist": list(result.hist)},
+                      sort_keys=True).encode("utf-8")
+
+
+def merge_grep(payloads: Iterable[bytes]) -> bytes:
+    tot = {"lines": 0, "matched": 0, "occurrences": 0, "hist": None}
+    for payload in payloads:
+        d = json.loads(payload)
+        for k in ("lines", "matched", "occurrences"):
+            tot[k] += int(d[k])
+        h = [int(x) for x in d["hist"]]
+        tot["hist"] = (h if tot["hist"] is None
+                       else [a + b for a, b in zip(tot["hist"], h)])
+    tot["hist"] = tot["hist"] or []
+    return json.dumps(tot, sort_keys=True).encode("utf-8")
+
+
+def wordcount_host_oracle(blocks: Iterable[bytes]) -> Dict[str, int]:
+    """Sequential ground truth with the engine's exact tokenization
+    (ASCII letter runs) — the differential harness's byte-compare
+    oracle, shard-free by construction."""
+    counts: Dict[str, int] = {}
+    carry = b""
+    letters = re.compile(rb"[A-Za-z]+")
+
+    def eat(buf: bytes, final: bool) -> bytes:
+        tail = b""
+        if not final:
+            m = re.search(rb"[A-Za-z]*\Z", buf)
+            tail = m.group(0) if m else b""
+            buf = buf[:len(buf) - len(tail)]
+        for w in letters.findall(buf):
+            key = w.decode("ascii")
+            counts[key] = counts.get(key, 0) + 1
+        return tail
+
+    for b in blocks:
+        carry = eat(carry + b, final=False)
+    eat(carry, final=True)
+    return counts
+
+
+def format_wordcount_counts(counts: Dict[str, int]) -> bytes:
+    return "".join(f"{w} {c}\n"
+                   for w, c in sorted(counts.items())).encode("utf-8")

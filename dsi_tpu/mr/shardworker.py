@@ -1,0 +1,247 @@
+"""Shard worker: drives cursor-range shards as resumable step objects.
+
+The classic worker (``mr/worker.py``) executes tasks as run-to-completion
+function calls; a shard worker drives its assignment as an
+:class:`~dsi_tpu.parallel.stepobj.EngineStep` — ``advance_slice`` a few
+steps, ``checkpoint()`` on a wall-clock cadence through the engine's own
+``ckpt/`` chain, phone a ``ShardProgress`` heartbeat home (which is also
+where a speculative loser learns it was cancelled), and finally race
+``CommitShard`` under the coordinator's first-commit-wins lock:
+
+* the attempt's output is written durably to a PRIVATE partial file
+  (``mr-shard-out-<sid>.a<aid>.part``) before the commit RPC — the
+  coordinator renames the winner's partial to the final output and
+  journals the commit record, so the data-plane commit and the
+  control-plane record can never name different bytes;
+* a loser (reply ``Win: false``, or ``Cancel`` on a heartbeat) aborts
+  the engine, removes its partial, and reaps its checkpoint-chain
+  directory — speculative execution must leave no litter;
+* a takeover/backup assignment (``ResumeFrom``) ADOPTS the named
+  attempt's chain (``mr/shards.adopt_chain``) and resumes the engine
+  from its last checkpoint — a killed worker's shard continues from the
+  cursor, not from zero; the restore's ``resume_cursor`` is reported on
+  every heartbeat so the harness can assert the resume really happened.
+
+Chaos (``DSI_CHAOS_WORKER_KILL``, ``ckpt/fault.py``) fires at the same
+task boundaries as the classic loop; ``DSI_SHARD_SLOW_S`` injects a
+per-slice sleep — the scriptable straggler for the backup-dispatch
+A/B bench and the CI smoke.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import zlib
+from typing import Optional
+
+from dsi_tpu.ckpt.fault import chaos_kill_point
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr import rpc
+from dsi_tpu.mr import shards as sh
+from dsi_tpu.mr.types import TaskStatus
+from dsi_tpu.utils.atomicio import atomic_write
+
+#: advance() turns between straggler-sleep/checkpoint/heartbeat checks.
+ADVANCES_PER_SLICE = 4
+
+
+def _slow_s() -> float:
+    """``DSI_SHARD_SLOW_S``: per-slice sleep, the injected straggler."""
+    try:
+        return float(os.environ.get("DSI_SHARD_SLOW_S", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def _build_step(engine: str, files, spec: sh.ShardSpec, ckpt_dir: str,
+                resume: bool, knobs: dict):
+    """Construct the engine step over the shard's block slice.  The
+    ``input_range`` identity tag means an adopted chain from any OTHER
+    cursor range refuses to restore (range-relative cursors must never
+    cross ranges)."""
+    blocks = sh.shard_blocks(files, spec)
+    common = dict(checkpoint_dir=ckpt_dir,
+                  checkpoint_every=int(knobs.get("ckpt_every", 32) or 32),
+                  resume=resume,
+                  input_range=(spec.start, spec.end),
+                  chunk_bytes=int(knobs.get("chunk_bytes", 1 << 20)),
+                  depth=knobs.get("depth"),
+                  device_accumulate=bool(knobs.get("device_accumulate",
+                                                   False)))
+    if engine == "grep":
+        from dsi_tpu.parallel.grepstream import GrepStep
+
+        return GrepStep(blocks, str(knobs.get("pattern", "")), **common)
+    if engine != "wordcount":
+        raise ValueError(f"unknown shard engine: {engine!r}")
+    from dsi_tpu.parallel.streaming import WordcountStep
+
+    return WordcountStep(blocks, n_reduce=int(knobs.get("n_reduce", 10)),
+                         **common)
+
+
+def _reap_attempt(part_path: str, ckpt_dir: str) -> None:
+    """Remove a lost/cancelled/failed attempt's partial output and its
+    checkpoint-chain directory — best-effort hygiene."""
+    for p in (part_path,):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    sh.reap_attempt_dir(ckpt_dir)
+
+
+def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
+                      sock: str) -> None:
+    """Drive ONE shard attempt end to end (module docstring).  Raises
+    :class:`rpc.CoordinatorGone` through to the caller's loop exit."""
+    sid = int(reply["Shard"])
+    aid = int(reply["Attempt"])
+    spec = sh.ShardSpec(sid, int(reply["Start"]), int(reply["End"]))
+    files = list(reply["Files"])
+    knobs = dict(reply.get("Knobs") or {})
+    engine = str(knobs.get("engine", "wordcount"))
+    ckpt_root = str(reply["CkptRoot"])
+    part_path = str(reply["OutPart"])
+    ckpt_dir = os.path.join(ckpt_root, f"shard-{sid}", f"a{aid}")
+    resume_from = reply.get("ResumeFrom")
+    shard_dir = os.path.join(ckpt_root, f"shard-{sid}")
+    resume = False
+    if resume_from is not None:
+        src = os.path.join(shard_dir, f"a{int(resume_from)}")
+        resume = sh.adopt_chain(src, ckpt_dir, sid, aid)
+    if not resume and aid > 0:
+        # No (usable) hinted chain: scan the sibling attempt dirs — an
+        # attempt that checkpointed and died before its next heartbeat
+        # left a chain the coordinator never heard about.
+        src = sh.find_best_chain(shard_dir, exclude_aid=aid)
+        if src is not None:
+            resume = sh.adopt_chain(src, ckpt_dir, sid, aid)
+    sh.write_attempt_marker(ckpt_dir, sid, aid)
+
+    def call(method: str, args: dict):
+        args = dict(args)
+        args.update({"WorkerId": worker_id, "Shard": sid, "Attempt": aid})
+        return rpc.call(sock, method, args)
+
+    def report_failed(reason: str) -> None:
+        try:
+            call("Coordinator.ShardFailed", {"Reason": reason})
+        except rpc.CoordinatorGone:
+            pass
+
+    slow = _slow_s()
+    ckpt_secs = float(knobs.get("ckpt_secs", 1.0) or 1.0)
+    try:
+        step = _build_step(engine, files, spec, ckpt_dir, resume, knobs)
+    except Exception as e:  # noqa: BLE001 — attempt fails, worker lives
+        report_failed(f"setup: {type(e).__name__}: {e}")
+        _reap_attempt(part_path, ckpt_dir)
+        return
+    restore = step.restore()
+    resume_cursor = int(restore.get("resume_cursor", 0) or 0)
+    ckpts = 0
+    cancelled = False
+    last_ckpt = time.monotonic()
+    try:
+        # First heartbeat the moment setup (jax init + compiles)
+        # finishes: it ends the coordinator's setup-grace window, so
+        # silence from here on means a real stall, not a compile.
+        ok, prep = call("Coordinator.ShardProgress",
+                        {"Confirmed": 0, "Ckpts": ckpts,
+                         "ResumeCursor": resume_cursor})
+        if ok and prep and prep.get("Cancel"):
+            cancelled = True
+        last_prog = time.monotonic()
+        while not cancelled and step.phase == "running":
+            took = step.advance_slice(ADVANCES_PER_SLICE)
+            if slow > 0:
+                time.sleep(slow)
+            now = time.monotonic()
+            if (step.phase == "running" and took
+                    and now - last_ckpt >= ckpt_secs):
+                if step.checkpoint():
+                    ckpts += 1
+                last_ckpt = now
+            if now - last_prog >= cfg.shard_progress_s:
+                last_prog = now
+                ok, prep = call("Coordinator.ShardProgress",
+                                {"Confirmed": step.confirmed,
+                                 "Ckpts": ckpts,
+                                 "ResumeCursor": resume_cursor})
+                if ok and prep and prep.get("Cancel"):
+                    cancelled = True
+                    break
+            if not took:
+                break
+    except rpc.CoordinatorGone:
+        step.abort()
+        raise
+    except Exception as e:  # noqa: BLE001 — engine died: fail the attempt
+        report_failed(f"engine: {type(e).__name__}: {e}")
+        _reap_attempt(part_path, ckpt_dir)
+        return
+    if cancelled:
+        # First-commit-wins loser: stop mid-flight, leave nothing.
+        step.abort()
+        _reap_attempt(part_path, ckpt_dir)
+        return
+    # Terminal either way now — close() releases the engine's resources
+    # (checkpoint-writer thread, stats copy-out); skipping it leaked one
+    # CommitWorker thread per completed attempt in a long-lived worker.
+    result = step.close()
+    if step.phase != "done" or result is None:
+        report_failed(step.phase)
+        _reap_attempt(part_path, ckpt_dir)
+        return
+    payload = (sh.format_grep(result) if engine == "grep"
+               else sh.format_wordcount(result))
+    with atomic_write(part_path, mode="wb") as f:
+        f.write(payload)
+    crc = zlib.crc32(payload)
+    chaos_kill_point("pre-commit")
+    try:
+        ok, rep = call("Coordinator.CommitShard",
+                       {"Crc": crc, "Confirmed": step.confirmed,
+                        "ResumeCursor": resume_cursor})
+    except rpc.CoordinatorGone:
+        raise
+    if not ok or rep is None or not rep.get("Win"):
+        _reap_attempt(part_path, ckpt_dir)
+    else:
+        # Winner: the committed output carries everything the chain
+        # held — the chain is dead weight on the shared fs now.
+        sh.reap_attempt_dir(ckpt_dir)
+
+
+def shard_worker_loop(config: Optional[JobConfig] = None) -> None:
+    """The shard worker's pull loop — the ``worker_loop`` shape over
+    ``RequestShard``: chaos boundary, request, drive, repeat; exits on
+    DONE or a dead coordinator."""
+    cfg = config or JobConfig()
+    sock = cfg.sock()
+    worker_id = f"w{os.getpid()}"
+    shards_done = 0
+    while True:
+        chaos_kill_point("shard")
+        try:
+            ok, reply = rpc.call(sock, "Coordinator.RequestShard",
+                                 {"WorkerId": worker_id})
+        except rpc.CoordinatorGone as e:
+            if shards_done == 0 or isinstance(e, rpc.AuthError):
+                print(f"shardworker: coordinator unreachable: {e}",
+                      file=sys.stderr)
+            break
+        if not ok or reply is None \
+                or reply.get("TaskStatus") == int(TaskStatus.DONE):
+            break
+        if reply.get("TaskStatus") != int(TaskStatus.SHARD):
+            time.sleep(cfg.wait_sleep_s)
+            continue
+        try:
+            run_shard_attempt(reply, cfg, worker_id, sock)
+        except rpc.CoordinatorGone:
+            break
+        shards_done += 1

@@ -19,12 +19,17 @@ class KeyValue(NamedTuple):
 
 
 class TaskStatus(enum.IntEnum):
-    """Wire-level task status (mr/rpc.go:23: 0 map, 1 reduce, 2 wait, 3 done)."""
+    """Wire-level task status (mr/rpc.go:23: 0 map, 1 reduce, 2 wait, 3 done).
+
+    ``SHARD`` extends the protocol for streaming-shard jobs
+    (``mr/shards.py``): the assignment names a cursor range + attempt
+    instead of a file — values 0-3 keep their reference meaning."""
 
     MAP = 0
     REDUCE = 1
     WAITING = 2
     DONE = 3
+    SHARD = 4
 
 
 # Task-log states inside the coordinator (mr/coordinator.go:16: 0 never

@@ -222,7 +222,15 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
         except rpc.CoordinatorGone:
             return False
 
+    # Chaos injection (DSI_CHAOS_WORKER_KILL=p[,seed], ckpt/fault.py): a
+    # real os._exit with probability p at every task boundary, so
+    # kill/recovery grids are deterministic and scriptable.  Imported
+    # HERE, not at module top: the control plane stays importable on a
+    # bare interpreter (the ckpt package init pulls numpy).
+    from dsi_tpu.ckpt.fault import chaos_kill_point
+
     while True:
+        chaos_kill_point("task")
         try:
             ok, reply = rpc.call(sock, "Coordinator.RequestTask",
                                  {"TaskNumber": 0, "WorkerId": worker_id})

@@ -481,13 +481,14 @@ class GrepStep(EngineStep):
                  checkpoint_every: Optional[int] = None,
                  checkpoint_async: Optional[bool] = None,
                  checkpoint_delta: Optional[bool] = None,
-                 resume: bool = False, line_sink=None):
+                 resume: bool = False, line_sink=None,
+                 input_range: Optional[Tuple[int, int]] = None):
         super().__init__()
         _grep_setup(self, blocks, pattern, mesh, chunk_bytes, depth, aot,
                     device_accumulate, sync_every, mesh_shards, topk,
                     bins, pipeline_stats, checkpoint_dir,
                     checkpoint_every, checkpoint_async, checkpoint_delta,
-                    resume, line_sink)
+                    resume, line_sink, input_range)
 
 
 def grep_streaming(
@@ -569,7 +570,7 @@ def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
                 device_accumulate, sync_every, mesh_shards, topk, bins,
                 pipeline_stats, checkpoint_dir, checkpoint_every,
                 checkpoint_async, checkpoint_delta, resume,
-                line_sink=None):
+                line_sink=None, input_range=None):
     """The engine body behind :class:`GrepStep`: full setup (resume
     restore included) ending with the pipeline armed and the lifecycle
     hooks attached to ``step``."""
@@ -648,10 +649,16 @@ def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
     ck_delta = checkpoint_delta_default(checkpoint_delta)
     cand_mark = [0]  # non-dacc delta watermark into the cand_h append log
     if checkpoint_dir:
-        ck_store = CheckpointStore(checkpoint_dir, "grep", {
-            "n_dev": n_dev, "chunk_bytes": chunk_bytes,
-            "pattern": pattern, "bins": bins, "topk": topk,
-            "device_accumulate": bool(device_accumulate)})
+        # input_range = the shard scheduler's cursor range: part of the
+        # chain identity so a shard attempt can never restore another
+        # range's (range-relative) cursors (mr/shards.py).
+        ident = {"n_dev": n_dev, "chunk_bytes": chunk_bytes,
+                 "pattern": pattern, "bins": bins, "topk": topk,
+                 "device_accumulate": bool(device_accumulate)}
+        if input_range is not None:
+            ident["input_range"] = [int(input_range[0]),
+                                    int(input_range[1])]
+        ck_store = CheckpointStore(checkpoint_dir, "grep", ident)
         ck_policy = CheckpointPolicy(checkpoint_every)
         offsets = []
         stats.update({"ckpt_saves": 0, "ckpt_s": 0.0,
@@ -1193,13 +1200,14 @@ class IndexerStep(EngineStep):
                  checkpoint_every: Optional[int] = None,
                  checkpoint_async: Optional[bool] = None,
                  checkpoint_delta: Optional[bool] = None,
-                 resume: bool = False, keep_services: bool = False):
+                 resume: bool = False, keep_services: bool = False,
+                 input_range: Optional[Tuple[int, int]] = None):
         super().__init__()
         _indexer_setup(self, docs, mesh, n_reduce, max_word_len, u_cap,
                        depth, device_accumulate, sync_every, mesh_shards,
                        topk, stats, checkpoint_dir, checkpoint_every,
                        checkpoint_async, checkpoint_delta, resume,
-                       keep_services)
+                       keep_services, input_range)
 
     def _next_rung(self) -> bool:
         self._pipe.end()
@@ -1281,10 +1289,17 @@ def _indexer_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
                    depth, device_accumulate, sync_every, mesh_shards,
                    topk, stats, checkpoint_dir, checkpoint_every,
                    checkpoint_async, checkpoint_delta, resume,
-                   keep_services=False):
+                   keep_services=False, input_range=None):
     """The engine body behind :class:`IndexerStep`: corpus-wide setup,
     then ``begin_rung`` (the former per-rung ``run``) arms the pipeline
-    and attaches the lifecycle hooks to ``step``."""
+    and attaches the lifecycle hooks to ``step``.
+
+    ``input_range`` is the shard scheduler's cursor range in DOC
+    ordinals (the wave walks' cursor unit, mr/shards.py): the engine
+    drives ``docs[start:end]`` and the range joins the chain identity,
+    so two attempts over different ranges can never cross-restore."""
+    if input_range is not None:
+        docs = docs[int(input_range[0]):int(input_range[1])]
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -1330,10 +1345,14 @@ def _indexer_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
         # CRC is part of the job identity: same count + same total with
         # shuffled lengths must refuse, not silently misalign waves.
         lens_crc = zlib.crc32(np.asarray(doc_lens, np.int64).tobytes())
-        ck_store = CheckpointStore(checkpoint_dir, "indexer", {
-            "n_dev": n_dev, "n_reduce": n_reduce, "u_cap": u_cap,
-            "n_docs": n_real, "doc_lens_crc32": lens_crc,
-            "topk": topk, "device_accumulate": bool(device_accumulate)})
+        ident = {"n_dev": n_dev, "n_reduce": n_reduce, "u_cap": u_cap,
+                 "n_docs": n_real, "doc_lens_crc32": lens_crc,
+                 "topk": topk,
+                 "device_accumulate": bool(device_accumulate)}
+        if input_range is not None:
+            ident["input_range"] = [int(input_range[0]),
+                                    int(input_range[1])]
+        ck_store = CheckpointStore(checkpoint_dir, "indexer", ident)
         if resume:
             loaded = ck_store.load_latest_chain()
             if loaded is not None:

@@ -133,6 +133,34 @@ class EngineStep:
             self._fail()
             raise
 
+    def advance_slice(self, k: int) -> int:
+        """Up to ``k`` turns of the crank; returns the turns taken
+        (0 when the engine is already finished/routed).  The shared
+        time-multiplexing primitive: the serving daemon drives each
+        resident grep job one slice per scheduler pass, and a shard
+        worker drives its shard one slice per progress heartbeat —
+        both ride the same step objects."""
+        n = 0
+        while n < k and self.advance():
+            n += 1
+        return n
+
+    def abort(self) -> None:
+        """Cancel a running engine WITHOUT driving the remaining input
+        — the speculative loser's path (first-commit-wins told it to
+        stop): tear the pipeline down, release every resource, leave
+        the object terminal with no result.  Idempotent; a no-op once
+        the engine left the running phase."""
+        if self._phase != "running":
+            return
+        try:
+            if self._pipe is not None:
+                self._pipe.end()
+        finally:
+            self._release()
+        self.result = None
+        self._phase = "cancelled"
+
     def confirm(self) -> int:
         """Retire every in-flight record; returns the confirmed count.
         After this the engine sits at a consistent boundary."""

@@ -589,14 +589,15 @@ class WordcountStep(EngineStep):
                  checkpoint_delta: Optional[bool] = None,
                  resume: bool = False,
                  wire_upload: Optional[bool] = None,
-                 device_batches=None):
+                 device_batches=None,
+                 input_range: Optional[Tuple[int, int]] = None):
         super().__init__()
         _wordcount_setup(self, blocks, mesh, n_reduce, chunk_bytes,
                          max_word_len, u_cap, aot, on_attempt, depth,
                          pipeline_stats, device_accumulate, sync_every,
                          mesh_shards, checkpoint_dir, checkpoint_every,
                          checkpoint_async, checkpoint_delta, resume,
-                         wire_upload, device_batches)
+                         wire_upload, device_batches, input_range)
 
 
 def wordcount_streaming(
@@ -615,6 +616,7 @@ def wordcount_streaming(
         checkpoint_delta: Optional[bool] = None,
         resume: bool = False,
         wire_upload: Optional[bool] = None,
+        input_range: Optional[Tuple[int, int]] = None,
 ) -> Optional[Dict[str, Tuple[int, int]]]:
     """Exact whole-stream word counts with bounded memory, pipelined.
 
@@ -737,7 +739,7 @@ def wordcount_streaming(
         checkpoint_every=checkpoint_every,
         checkpoint_async=checkpoint_async,
         checkpoint_delta=checkpoint_delta, resume=resume,
-        wire_upload=wire_upload).close()
+        wire_upload=wire_upload, input_range=input_range).close()
 
 
 def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
@@ -745,7 +747,8 @@ def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
                      pipeline_stats, device_accumulate, sync_every,
                      mesh_shards, checkpoint_dir, checkpoint_every,
                      checkpoint_async, checkpoint_delta, resume,
-                     wire_upload=None, device_batches=None):
+                     wire_upload=None, device_batches=None,
+                     input_range=None):
     """The engine body behind :class:`WordcountStep`: full setup
     (``resume=True`` chain restore included) ending with the pipeline
     armed and the lifecycle hooks attached to ``step``."""
@@ -824,10 +827,18 @@ def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
     host_delta = HostDeltaLog()  # non-dacc delta log: trimmed copies of
     # the pulled (packed, nus) steps, bounded like the device logs
     if checkpoint_dir:
-        ck_store = CheckpointStore(checkpoint_dir, "wordcount", {
-            "n_dev": n_dev, "n_reduce": n_reduce,
-            "chunk_bytes": chunk_bytes,
-            "device_accumulate": bool(device_accumulate)})
+        # ``input_range`` (the shard scheduler's cursor range,
+        # mr/shards.py) is part of the chain identity: a chain written
+        # while driving shard [a, b) must refuse to restore into an
+        # attempt driving any other range — cursors are range-relative,
+        # so a cross-range restore would silently misalign the stream.
+        ident = {"n_dev": n_dev, "n_reduce": n_reduce,
+                 "chunk_bytes": chunk_bytes,
+                 "device_accumulate": bool(device_accumulate)}
+        if input_range is not None:
+            ident["input_range"] = [int(input_range[0]),
+                                    int(input_range[1])]
+        ck_store = CheckpointStore(checkpoint_dir, "wordcount", ident)
         ck_policy = CheckpointPolicy(checkpoint_every)
         offsets = []
         stats.update({"ckpt_saves": 0, "ckpt_s": 0.0,

@@ -276,13 +276,14 @@ class TfidfStep(_EngineStep):
                  checkpoint_every: Optional[int] = None,
                  checkpoint_async: Optional[bool] = None,
                  checkpoint_delta: Optional[bool] = None,
-                 resume: bool = False):
+                 resume: bool = False,
+                 input_range: Optional[tuple] = None):
         super().__init__()
         _tfidf_setup(self, docs, mesh, n_reduce, max_word_len, u_cap,
                      partitions, packed, device_accumulate, sync_every,
                      mesh_shards, wave_stats, depth, checkpoint_dir,
                      checkpoint_every, checkpoint_async,
-                     checkpoint_delta, resume)
+                     checkpoint_delta, resume, input_range)
 
     def _next_rung(self) -> bool:
         self._pipe.end()
@@ -400,10 +401,17 @@ def _tfidf_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
                  partitions, packed, device_accumulate, sync_every,
                  mesh_shards, wave_stats, depth, checkpoint_dir,
                  checkpoint_every, checkpoint_async, checkpoint_delta,
-                 resume):
+                 resume, input_range=None):
     """The engine body behind :class:`TfidfStep`: corpus-wide setup,
     then ``begin_rung`` (the former per-rung ``run``) arms the pipeline
-    and attaches the lifecycle hooks to ``step``."""
+    and attaches the lifecycle hooks to ``step``.
+
+    ``input_range`` is the shard scheduler's cursor range in DOC
+    ordinals (mr/shards.py): drive ``docs[start:end]`` and tag the
+    chain identity with the range so attempts over different ranges
+    can never cross-restore."""
+    if input_range is not None:
+        docs = docs[int(input_range[0]):int(input_range[1])]
     if mesh is None:
         mesh = default_mesh()
     n_dev = mesh.devices.size
@@ -447,12 +455,15 @@ def _tfidf_setup(step, docs, mesh, n_reduce, max_word_len, u_cap,
         # CRC is part of the job identity: same count + same total with
         # shuffled lengths must refuse, not silently misalign waves.
         lens_crc = zlib.crc32(np.asarray(doc_lens, np.int64).tobytes())
-        ck_store = CheckpointStore(checkpoint_dir, "tfidf", {
-            "n_dev": n_dev, "n_reduce": n_reduce, "u_cap": u_cap,
-            "n_docs": n_real, "doc_lens_crc32": lens_crc,
-            "partitions": (sorted(int(p) for p in partitions)
-                           if partitions is not None else None),
-            "device_accumulate": bool(device_accumulate)})
+        ident = {"n_dev": n_dev, "n_reduce": n_reduce, "u_cap": u_cap,
+                 "n_docs": n_real, "doc_lens_crc32": lens_crc,
+                 "partitions": (sorted(int(p) for p in partitions)
+                                if partitions is not None else None),
+                 "device_accumulate": bool(device_accumulate)}
+        if input_range is not None:
+            ident["input_range"] = [int(input_range[0]),
+                                    int(input_range[1])]
+        ck_store = CheckpointStore(checkpoint_dir, "tfidf", ident)
         if resume:
             loaded = ck_store.load_latest_chain()
             if loaded is not None:

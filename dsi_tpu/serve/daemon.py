@@ -531,17 +531,17 @@ class ServeDaemon:
                                             f"{type(e).__name__}: {e}")
                             self._persist(job)
                     worked = True
-            # A bounded slice of every step-object job.
+            # A bounded slice of every step-object job — the same
+            # ``advance_slice`` primitive the shard workers drive their
+            # cursor-range shards with (parallel/stepobj.py).
             for jid, rec in resident.items():
                 if rec["kind"] != "step":
                     continue
                 step = rec["step"]
                 try:
-                    for _ in range(8):
-                        if not step.advance():
-                            break
-                        rec["advanced"] += 1
-                        worked = True
+                    took = step.advance_slice(8)
+                    rec["advanced"] += took
+                    worked = worked or took > 0
                 except Exception as e:  # noqa: BLE001
                     with self._wake:
                         if self._resident.pop(jid, None) is not None:

@@ -86,6 +86,18 @@ GATES: List[Tuple[str, str, float]] = [
     # the numeric rule, so the bool carries the gate).
     ("plan_zero_copy", "bool", 0.0),
     ("plan_intermediate_bytes", "lower", 0.50),
+    # Speculative execution (ISSUE 15): the *_mbps/*_parity patterns
+    # above already gate both arms' throughput and oracle parity.
+    # Exactly-once is a BOOL gate (the plan_zero_copy precedent: the
+    # healthy old duplicate-commit count is 0, which the numeric rule
+    # reads as "unknown" and never gates — the bool regresses on
+    # true→false, i.e. the first duplicate commit ever seen);
+    # backup_fired/resumed regress when they stop happening at all
+    # (1→0 = the dispatcher or the chain adoption went dark; a 2→1
+    # count wobble stays under the 90% threshold).
+    ("spec_exactly_once", "bool", 0.0),
+    ("spec_backup_fired", "higher", 0.90),
+    ("spec_resumed", "higher", 0.90),
 ]
 
 

@@ -188,6 +188,17 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["plan_intermediate_bytes"] == 0
         assert v["plan_staged_intermediate_bytes"] > 0
         assert v["plan_staged_mbps"] > 0
+    # The speculative-execution A/B row (ISSUE 15): measured XOR
+    # skipped; a measured row carries both arms' throughput, the
+    # backup-fired evidence, and the zero-duplicate-commit invariant
+    # (first-commit-wins), each arm parity-gated in its subprocess.
+    assert ("spec_skipped" in v) != ("spec_backup_mbps" in v)
+    if "spec_backup_mbps" in v:
+        assert v["spec_parity"] is True
+        assert v["spec_nobackup_mbps"] > 0
+        assert v["spec_backup_fired"] >= 1
+        assert v["spec_duplicate_commits"] == 0
+        assert v["spec_exactly_once"] is True
 
 
 def test_engine_phase_dicts_come_from_the_registry(tmp_path):

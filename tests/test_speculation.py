@@ -1,0 +1,614 @@
+"""Speculative execution (ISSUE 15): shard plans, the scheduler's
+backup/first-commit-wins protocol, chaos injection, dial backoff, and
+the differential chaos harness.
+
+Layers, cheapest first:
+
+* pure-geometry units — newline-aligned shard plans, byte-exact stream
+  slices, the merge/oracle codecs, chain adoption;
+* scheduler units — the coordinator's shard handlers driven directly
+  (no RPC server, no jax): assignment, setup grace, both backup
+  triggers, presumed-dead requeue with resume hints, first-commit-wins
+  arbitration, journal replay;
+* the PR-9 detection half on its own (satellite): straggler_suspects
+  ranking and dead/slow-task classification under synthetic heartbeat
+  histories;
+* satellites — jittered dial-backoff schedule + give-up bound, chaos
+  knob determinism + a REAL ``os._exit`` subprocess;
+* the differential chaos harness (slow) — a real ``shardrun`` fleet
+  with a forced straggler AND a real mid-shard worker kill: backup
+  fires, exactly one commit per shard, the killed shard resumes from
+  its checkpoint (cursor > 0), output byte-identical to the
+  sequential oracle.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr import rpc
+from dsi_tpu.mr import shards as sh
+from dsi_tpu.mr.coordinator import Coordinator
+from dsi_tpu.mr.types import TaskStatus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_corpus(path, lines=400, words=12, vocab=37):
+    rows = []
+    for i in range(lines):
+        rows.append(" ".join(
+            "w" + chr(ord("a") + (i * words + j) % vocab) * 3
+            for j in range(words)))
+    data = ("\n".join(rows) + "\n").encode()
+    with open(path, "wb") as f:
+        f.write(data)
+    return data
+
+
+# ── shard geometry ─────────────────────────────────────────────────────
+
+
+def test_plan_covers_stream_newline_aligned(tmp_path):
+    p1 = str(tmp_path / "a.txt")
+    p2 = str(tmp_path / "b.txt")
+    write_corpus(p1, lines=100)
+    write_corpus(p2, lines=57)
+    files = [p1, p2]
+    total = sh.stream_total_bytes(files)
+    whole = b"".join(sh.read_stream_range(files, 0, total))
+    assert len(whole) == total
+    plan = sh.plan_shards(files, 5)
+    assert plan[0].start == 0 and plan[-1].end == total
+    for a, b in zip(plan, plan[1:]):
+        assert a.end == b.start
+        assert whole[b.start - 1:b.start] == b"\n"  # token/line safe cut
+    # slices reassemble byte-exactly (separators included)
+    got = b"".join(b"".join(sh.shard_blocks(files, spec, block_bytes=777))
+                   for spec in plan)
+    assert got == whole
+    assert all(spec.size > 0 for spec in plan)
+
+
+def test_read_stream_range_owns_trailing_separator(tmp_path):
+    # Regression: a range ending exactly one byte past a file boundary
+    # must include the inter-file separator byte — dropping it made the
+    # slice one byte short and desynced grep's line counts at shard
+    # edges that land on a separator.
+    p1 = str(tmp_path / "a.txt")
+    p2 = str(tmp_path / "b.txt")
+    with open(p1, "wb") as f:
+        f.write(b"hello\n")
+    with open(p2, "wb") as f:
+        f.write(b"world\n")
+    files = [p1, p2]
+    total = sh.stream_total_bytes(files)
+    whole = b"".join(sh.read_stream_range(files, 0, total))
+    assert whole == b"hello\n\nworld\n"
+    # every split point reassembles exactly, incl. cut==7 (separator)
+    for cut in range(total + 1):
+        left = b"".join(sh.read_stream_range(files, 0, cut))
+        right = b"".join(sh.read_stream_range(files, cut, total))
+        assert left + right == whole, cut
+
+
+def test_plan_merges_boundaries_inside_giant_line(tmp_path):
+    p = str(tmp_path / "one.txt")
+    with open(p, "wb") as f:
+        f.write(b"x" * 5000 + b"\n" + b"tail line\n")
+    plan = sh.plan_shards([p], 4)
+    # every nominal cut inside the 5000-byte line collapses forward to
+    # the single newline; no empty shard survives
+    assert [s.size > 0 for s in plan] == [True] * len(plan)
+    assert len(plan) <= 2
+
+
+def test_wordcount_oracle_and_merge(tmp_path):
+    p = str(tmp_path / "c.txt")
+    data = write_corpus(p, lines=60)
+    counts = sh.wordcount_host_oracle([data])
+    import re
+
+    naive = {}
+    for w in re.findall(r"[A-Za-z]+", data.decode()):
+        naive[w] = naive.get(w, 0) + 1
+    assert counts == naive
+    # shard-and-merge equals the whole-stream oracle
+    plan = sh.plan_shards([p], 3)
+    parts = []
+    for spec in plan:
+        c = sh.wordcount_host_oracle(sh.shard_blocks([p], spec))
+        parts.append(sh.format_wordcount_counts(c))
+    assert sh.merge_wordcount(parts) == sh.format_wordcount_counts(counts)
+
+
+def test_adopt_chain_rules(tmp_path):
+    src = str(tmp_path / "shard-0" / "a0")
+    dst = str(tmp_path / "shard-0" / "a1")
+    os.makedirs(src)
+    for n in ("manifest-000001.json", "state-000001.npz",
+              "state-000001.npz.crc32"):
+        with open(os.path.join(src, n), "wb") as f:
+            f.write(b"payload")
+    sh.write_attempt_marker(src, 0, 0)
+    # wrong shard refuses
+    assert not sh.adopt_chain(src, dst, sid=7, attempt=1)
+    assert sh.adopt_chain(src, dst, sid=0, attempt=1)
+    assert sorted(os.listdir(dst)) == sorted(
+        ["manifest-000001.json", "state-000001.npz",
+         "state-000001.npz.crc32", sh.ATTEMPT_MARKER,
+         sh.ATTEMPT_MARKER + ".crc32"])
+    assert sh.read_attempt_marker(dst) == {"shard": 0, "attempt": 1}
+    # a directory owned by another attempt refuses
+    assert not sh.adopt_chain(src, dst, sid=0, attempt=2)
+    # empty source refuses
+    empty = str(tmp_path / "shard-0" / "a3")
+    os.makedirs(empty)
+    assert not sh.adopt_chain(empty, str(tmp_path / "shard-0" / "a4"),
+                              sid=0, attempt=4)
+
+
+def test_find_best_chain_picks_longest(tmp_path):
+    root = str(tmp_path / "shard-2")
+    for aid, seqs in ((0, (1, 2)), (1, (1, 2, 3)), (2, ())):
+        d = os.path.join(root, f"a{aid}")
+        os.makedirs(d)
+        for s in seqs:
+            with open(os.path.join(d, f"manifest-{s:06d}.json"),
+                      "wb") as f:
+                f.write(b"{}")
+    assert sh.find_best_chain(root) == os.path.join(root, "a1")
+    assert sh.find_best_chain(root, exclude_aid=1) == \
+        os.path.join(root, "a0")
+
+
+# ── scheduler units (handlers driven directly, no jax) ─────────────────
+
+
+def mk_shard_coord(tmp_path, n_shards=2, journal=True, **cfg_kw):
+    p = str(tmp_path / "in.txt")
+    write_corpus(p, lines=200)
+    plan = sh.plan_shards([p], n_shards)
+    kw = dict(workdir=str(tmp_path), spec_floor_s=0.05,
+              shard_timeout_s=5.0, spec_setup_s=8.0)
+    kw.update(cfg_kw)
+    if journal:
+        kw["journal_path"] = str(tmp_path / "shards.journal")
+    cfg = JobConfig(n_reduce=0, **kw)
+    c = Coordinator([p], 0, cfg, shard_plan=plan,
+                    shard_opts={"knobs": {"engine": "wordcount"}})
+    return c, plan
+
+
+def progress(c, r, confirmed=1, ckpts=0, cursor=0, wid=None):
+    return c.shard_progress({"WorkerId": wid or "wX",
+                             "Shard": r["Shard"], "Attempt": r["Attempt"],
+                             "Confirmed": confirmed, "Ckpts": ckpts,
+                             "ResumeCursor": cursor})
+
+
+def commit(c, r, crc=1, payload=b"a 1\n", wid=None):
+    with open(r["OutPart"], "wb") as f:
+        f.write(payload)
+    return c.commit_shard({"WorkerId": wid or "wX", "Shard": r["Shard"],
+                           "Attempt": r["Attempt"], "Crc": crc})
+
+
+def test_assigns_shards_then_waits(tmp_path):
+    c, plan = mk_shard_coord(tmp_path)
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        r1 = c.request_shard({"WorkerId": "w2"})
+        assert {r0["TaskStatus"], r1["TaskStatus"]} == \
+            {int(TaskStatus.SHARD)}
+        assert {r0["Shard"], r1["Shard"]} == {0, 1}
+        assert r0["End"] > r0["Start"] >= 0
+        assert r0["ResumeFrom"] is None
+        # both shards in flight, attempts fresh: setup grace holds any
+        # speculation back even past the floor
+        time.sleep(0.1)
+        assert c.request_shard({"WorkerId": "w3"})["TaskStatus"] == \
+            int(TaskStatus.WAITING)
+    finally:
+        c.close()
+
+
+def test_backup_fires_on_progress_silence(tmp_path):
+    c, plan = mk_shard_coord(tmp_path)
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        r1 = c.request_shard({"WorkerId": "w2"})
+        # both attempts past setup (real steps retired)…
+        progress(c, r0, confirmed=3, ckpts=1, wid="w1")
+        progress(c, r1, confirmed=3, wid="w2")
+        # …then w1 goes silent past the floor while w2 keeps beating
+        time.sleep(0.12)
+        progress(c, r1, confirmed=4, wid="w2")
+        rb = c.request_shard({"WorkerId": "w3"})
+        assert rb["TaskStatus"] == int(TaskStatus.SHARD)
+        assert rb["Shard"] == r0["Shard"]
+        assert rb["ResumeFrom"] == r0["Attempt"]  # adopt w1's chain
+        s = c.spec_stats()
+        assert s["backup_dispatches"] == 1
+        # a worker never backs itself up: w1 asking again gets WAITING
+        # (its own shard is the only candidate)
+        progress(c, r1, confirmed=5, wid="w2")
+        assert c.request_shard({"WorkerId": "w2"})["TaskStatus"] == \
+            int(TaskStatus.WAITING)
+    finally:
+        c.close()
+
+
+def test_backup_fires_on_slow_progress(tmp_path):
+    c, plan = mk_shard_coord(tmp_path, spec_floor_s=30.0, spec_k=2.0)
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        r1 = c.request_shard({"WorkerId": "w2"})
+        progress(c, r0, confirmed=1, wid="w1")
+        assert commit(c, r1, wid="w2")["Win"]  # ref wall ~= 0
+        time.sleep(0.1)
+        progress(c, r0, confirmed=2, wid="w1")  # heartbeating, not silent
+        rb = c.request_shard({"WorkerId": "w2"})
+        assert rb["TaskStatus"] == int(TaskStatus.SHARD)
+        assert rb["Shard"] == r0["Shard"]
+    finally:
+        c.close()
+
+
+def test_first_commit_wins_loser_cancelled(tmp_path):
+    c, plan = mk_shard_coord(tmp_path)
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        r1 = c.request_shard({"WorkerId": "w2"})
+        progress(c, r0, confirmed=3, ckpts=1, wid="w1")
+        time.sleep(0.12)
+        rb = c.request_shard({"WorkerId": "w3"})
+        assert rb["Shard"] == r0["Shard"]
+        # backup commits first -> wins; primary loses and is told so
+        assert commit(c, rb, crc=42, wid="w3")["Win"]
+        assert os.path.exists(os.path.join(
+            str(tmp_path), f"mr-shard-out-{r0['Shard']}"))
+        assert not commit(c, r0, crc=42, wid="w1")["Win"]
+        assert progress(c, r0, wid="w1")["Cancel"]
+        assert commit(c, r1, wid="w2")["Win"]
+        assert c.done()
+        s = c.spec_stats()
+        assert s["commits"] == 2
+        assert s["commit_losses"] == 1
+        assert s["duplicate_commits"] == 0
+        assert s["winning_attempts"][str(r0["Shard"])] == rb["Attempt"]
+    finally:
+        c.close()
+
+
+def test_winner_recommit_counts_as_duplicate(tmp_path):
+    # The invariant the harness gates on is MEASURABLE: a double commit
+    # from the winning attempt increments duplicate_commits.
+    c, plan = mk_shard_coord(tmp_path, n_shards=1)
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        assert commit(c, r0, wid="w1")["Win"]
+        assert not commit(c, r0, wid="w1")["Win"]
+        assert c.spec_stats()["duplicate_commits"] == 1
+    finally:
+        c.close()
+
+
+def test_dead_attempt_requeued_with_resume_hint(tmp_path):
+    # speculation off: the watchdog's presumed-dead requeue must stand
+    # on its own (a backup would otherwise cover the silence first)
+    c, plan = mk_shard_coord(tmp_path, n_shards=1, shard_timeout_s=0.15,
+                             spec_backup=False)
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        progress(c, r0, confirmed=4, ckpts=2, wid="w1")
+        deadline = time.monotonic() + 3.0
+        r2 = None
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            r2 = c.request_shard({"WorkerId": "w2"})
+            if r2["TaskStatus"] == int(TaskStatus.SHARD):
+                break
+        assert r2 is not None \
+            and r2["TaskStatus"] == int(TaskStatus.SHARD)
+        assert r2["Shard"] == r0["Shard"]
+        assert r2["ResumeFrom"] == r0["Attempt"]
+        s = c.spec_stats()
+        assert s["requeues"] == 1
+        # a resumed attempt reports its restore cursor
+        progress(c, r2, confirmed=1, cursor=4096, wid="w2")
+        s = c.spec_stats()
+        assert s["resumed_attempts"] == 1
+        assert s["resume_cursors"][
+            f"{r2['Shard']}.a{r2['Attempt']}"] == 4096
+    finally:
+        c.close()
+
+
+def test_shard_failed_requeues_and_exhaustion_fails_job(tmp_path):
+    c, plan = mk_shard_coord(tmp_path, n_shards=1, shard_max_attempts=2)
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        c.shard_failed({"WorkerId": "w1", "Shard": r0["Shard"],
+                        "Attempt": r0["Attempt"], "Reason": "hostpath"})
+        r1 = c.request_shard({"WorkerId": "w2"})
+        assert r1["TaskStatus"] == int(TaskStatus.SHARD)
+        c.shard_failed({"WorkerId": "w2", "Shard": r1["Shard"],
+                        "Attempt": r1["Attempt"], "Reason": "hostpath"})
+        # budget spent: job fails instead of looping the poisoned shard
+        assert c.request_shard({"WorkerId": "w3"})["TaskStatus"] == \
+            int(TaskStatus.DONE)
+        assert c.spec_stats()["job_failed"]
+        assert c.done()
+    finally:
+        c.close()
+
+
+def test_journal_replays_shard_commits(tmp_path):
+    c, plan = mk_shard_coord(tmp_path)
+    p = c.files[0]
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        assert commit(c, r0, crc=7, wid="w1")["Win"]
+    finally:
+        c.close()
+    cfg = JobConfig(n_reduce=0, workdir=str(tmp_path),
+                    journal_path=str(tmp_path / "shards.journal"))
+    c2 = Coordinator([p], 0, cfg, shard_plan=plan, shard_opts={})
+    try:
+        s = c2.spec_stats()
+        assert s["committed"] == 1
+        assert s["winning_attempts"][str(r0["Shard"])] == r0["Attempt"]
+        assert not c2.done()  # the other shard still needs running
+        r = c2.request_shard({"WorkerId": "w9"})
+        assert r["TaskStatus"] == int(TaskStatus.SHARD)
+        assert r["Shard"] != r0["Shard"]
+    finally:
+        c2.close()
+
+
+def test_journal_refuses_different_shard_plan(tmp_path):
+    c, plan = mk_shard_coord(tmp_path, n_shards=2)
+    p = c.files[0]
+    c.close()
+    cfg = JobConfig(n_reduce=0, workdir=str(tmp_path),
+                    journal_path=str(tmp_path / "shards.journal"))
+    with pytest.raises(SystemExit):
+        Coordinator([p], 0, cfg,
+                    shard_plan=sh.plan_shards([p], 3), shard_opts={})
+
+
+# ── PR-9 detection half on its own (satellite) ─────────────────────────
+
+
+def synth_worker(c, wid, gaps, silent_for):
+    """Install a synthetic heartbeat history: ``gaps`` are the contact
+    gaps (seconds) recorded into the worker's histogram; the worker's
+    last contact is ``silent_for`` seconds ago."""
+    from dsi_tpu.obs import LatencyHistogram
+
+    h = LatencyHistogram()
+    for g in gaps:
+        h.record(g)
+    with c.mu:
+        c._hb_hist[wid] = h
+        c._worker_seen[wid] = time.monotonic() - silent_for
+
+
+def test_straggler_suspects_ranking(tmp_path):
+    c, _ = mk_shard_coord(tmp_path, journal=False)
+    try:
+        # chatty worker gone quiet: p99 ~0.01, silent 30 s >> threshold
+        synth_worker(c, "chatty", [0.01] * 50, silent_for=30.0)
+        # slow-cadence worker: p99 ~20 s, silent 30 s < 2*p99=40 s
+        synth_worker(c, "slowpoll", [20.0] * 50, silent_for=30.0)
+        # healthy: silent 0.1 s
+        synth_worker(c, "healthy", [0.01] * 50, silent_for=0.1)
+        suspects = c.straggler_suspects(k=2.0)
+        assert "chatty" in suspects
+        assert "slowpoll" not in suspects
+        assert "healthy" not in suspects
+        assert suspects["chatty"] == pytest.approx(30.0, abs=1.0)
+        # the threshold floor: with no gap history, task_timeout_s rules
+        synth_worker(c, "nogaps", [], silent_for=30.0)
+        assert "nogaps" in c.straggler_suspects(k=2.0)
+    finally:
+        c.close()
+
+
+def test_presumed_classification(tmp_path):
+    c, _ = mk_shard_coord(tmp_path, journal=False)
+    try:
+        now = time.monotonic()
+        synth_worker(c, "deadish", [0.01] * 50, silent_for=5.0)
+        synth_worker(c, "slowtask", [4.0] * 50, silent_for=5.0)
+        with c.mu:
+            age_d, p99_d, presumed_d = c._classify("deadish", now)
+            age_s, p99_s, presumed_s = c._classify("slowtask", now)
+            _, _, presumed_u = c._classify("neverseen", now)
+        assert presumed_d == "dead" and age_d > 2 * p99_d
+        assert presumed_s == "slow-task" and age_s <= 2 * p99_s
+        assert presumed_u == "unknown"
+    finally:
+        c.close()
+
+
+# ── dial backoff satellite ─────────────────────────────────────────────
+
+
+def test_dial_backoff_schedule_pinned():
+    # zero jitter draw: the exact doubling ladder
+    lo = rpc.dial_backoff_schedule(rng=lambda: 0.0)
+    assert lo == pytest.approx([0.05, 0.10, 0.20, 0.40, 0.80])
+    # max jitter draw: every delay within (1 + _DIAL_JITTER)x, never less
+    hi = rpc.dial_backoff_schedule(rng=lambda: 0.999999)
+    for base, jit in zip(lo, hi):
+        assert base <= jit <= base * (1.0 + rpc._DIAL_JITTER) + 1e-9
+    # give-up bound: the whole retry budget stays under ~2.5 s
+    assert sum(hi) < 2.5
+    assert len(lo) == rpc._DIAL_ATTEMPTS - 1
+
+
+def test_dial_gives_up_after_attempt_budget(monkeypatch):
+    attempts = []
+
+    class FakeSock:
+        def settimeout(self, t):
+            pass
+
+        def connect(self, target):
+            attempts.append(target)
+            raise OSError(errno.ECONNREFUSED, "refused")
+
+        def close(self):
+            pass
+
+    sleeps = []
+    monkeypatch.setattr(rpc.socket, "socket",
+                        lambda *a, **k: FakeSock())
+    monkeypatch.setattr(rpc.time, "sleep", sleeps.append)
+    with pytest.raises(rpc.CoordinatorGone):
+        rpc._dial("unix", "/nonexistent/sock", "/nonexistent/sock", 1.0)
+    assert len(attempts) == rpc._DIAL_ATTEMPTS
+    assert len(sleeps) == rpc._DIAL_ATTEMPTS - 1
+    for i, s in enumerate(sleeps):  # jittered exponential envelope
+        base = rpc._DIAL_BACKOFF_S * (2 ** i)
+        assert base <= s <= base * (1.0 + rpc._DIAL_JITTER) + 1e-9
+
+
+def test_dial_nontransient_raises_immediately(monkeypatch):
+    attempts = []
+
+    class FakeSock:
+        def settimeout(self, t):
+            pass
+
+        def connect(self, target):
+            attempts.append(target)
+            raise OSError(errno.ENOENT, "no such socket")
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(rpc.socket, "socket",
+                        lambda *a, **k: FakeSock())
+    with pytest.raises(rpc.CoordinatorGone):
+        rpc._dial("unix", "/gone", "/gone", 1.0)
+    assert len(attempts) == 1
+
+
+# ── chaos knob satellite ───────────────────────────────────────────────
+
+
+def test_chaos_spec_parse_and_determinism():
+    from dsi_tpu.ckpt.fault import chaos_decision, parse_chaos_spec
+
+    assert parse_chaos_spec("0.25") == (0.25, 0)
+    assert parse_chaos_spec("0.25,42") == (0.25, 42)
+    assert parse_chaos_spec("bogus") == (0.0, 0)
+    assert parse_chaos_spec("1.5") == (0.0, 0)  # out of range = off
+    # deterministic: same (seed, index, draw) -> same decision; the
+    # sequence varies across indices so a fleet doesn't die in lockstep
+    seq_a = [chaos_decision(0.3, 42, "0", d) for d in range(1, 20)]
+    seq_b = [chaos_decision(0.3, 42, "0", d) for d in range(1, 20)]
+    seq_c = [chaos_decision(0.3, 42, "1", d) for d in range(1, 20)]
+    assert seq_a == seq_b
+    assert seq_a != seq_c
+    assert any(seq_a) and not all(seq_a)
+
+
+def test_chaos_kill_point_real_exit(tmp_path):
+    from dsi_tpu.ckpt.fault import CHAOS_EXIT
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    prog = ("from dsi_tpu.ckpt.fault import chaos_kill_point\n"
+            "chaos_kill_point('task')\n"
+            "print('survived')\n")
+    env["DSI_CHAOS_WORKER_KILL"] = "1.0,7"
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == CHAOS_EXIT
+    assert "CHAOS" in r.stderr
+    env["DSI_CHAOS_WORKER_KILL"] = "0.0"
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0 and "survived" in r.stdout
+
+
+def test_classic_worker_loop_has_chaos_boundary():
+    # the knob is wired at the classic worker's task boundary too
+    import inspect
+
+    from dsi_tpu.mr import worker
+
+    assert "chaos_kill_point" in inspect.getsource(worker.worker_loop)
+
+
+# ── the differential chaos harness (acceptance criteria) ───────────────
+
+
+def test_differential_chaos_harness(tmp_path):
+    """Forced straggler AND a real mid-shard worker kill: the backup
+    dispatcher fires, every shard commits exactly once (zero duplicate
+    commits), the killed shard's takeover resumes from a checkpoint
+    (cursor > 0), and the merged output is byte-identical to the
+    sequential oracle (shardrun --check exits 0)."""
+    corpus = str(tmp_path / "corpus.txt")
+    import random
+
+    rnd = random.Random(11)
+    vocab = ["".join(rnd.choice("abcdefghijklmnop") for _ in range(4))
+             for _ in range(300)]
+    with open(corpus, "w") as f:
+        for _ in range(16000):
+            f.write(" ".join(rnd.choice(vocab) for _ in range(8)) + "\n")
+    wd = str(tmp_path / "wd")
+    stats_json = str(tmp_path / "stats.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DSI_MR_SOCKET"] = str(tmp_path / "mr.sock")
+    # 1-device CPU workers: the harness's 8-vdev XLA_FLAGS would shrink
+    # every shard to ~one step, starving the kill/straggler windows.
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, "-m", "dsi_tpu.cli.shardrun",
+           "--workers", "3", "--shards", "3", "--workdir", wd,
+           "--chunk-bytes", "32768", "--ckpt-secs", "0.05",
+           "--progress-s", "0.1", "--spec-floor", "2.0",
+           "--shard-timeout", "8",
+           "--slow-worker", "0:1.2",          # the forced straggler
+           "--fault-worker", "1:mid-fold:6",  # the REAL os._exit kill
+           "--check", "--stats-json", stats_json, corpus]
+    r = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                       text=True, timeout=420)
+    assert r.returncode == 0, f"rc={r.returncode}\n{r.stderr[-3000:]}"
+    assert "parity OK" in r.stderr
+    with open(stats_json, encoding="utf-8") as f:
+        s = json.load(f)
+    assert s["commits"] == s["shards"] == 3
+    assert s["duplicate_commits"] == 0
+    assert s["backup_dispatches"] >= 1, r.stderr[-3000:]
+    # the kill really happened (FAULT_EXIT path) and somebody resumed
+    # from a durable checkpoint rather than replaying from zero
+    assert "FAULT: injected crash" in r.stderr
+    assert s["resumed_attempts"] >= 1, r.stderr[-3000:]
+    assert any(v > 0 for v in s["resume_cursors"].values())
+    # exactly one commit record per shard in the journal
+    shard_records = {}
+    with open(os.path.join(wd, "shards.journal"), encoding="utf-8") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "shard":
+                shard_records[rec["task"]] = \
+                    shard_records.get(rec["task"], 0) + 1
+    assert shard_records == {0: 1, 1: 1, 2: 1}
+    # losers reaped their partials: no .part litter survives
+    assert not [n for n in os.listdir(wd) if n.endswith(".part")]
