@@ -1708,8 +1708,13 @@ def run_plan_row() -> dict:
     ``--stats-json``), ``plan_intermediate_bytes`` (host-crossing
     handoff bytes of the chained run — MUST be 0, the ``plan_zero_copy``
     bool gates it) vs ``plan_staged_intermediate_bytes`` (the full
-    materialization), parity-gated by byte-comparing the two runs'
-    mr-out-* sets.  Runs in fresh subprocesses on 1-device CPU under
+    materialization), parity-gated by byte-comparing the runs'
+    mr-out-* sets.  ISSUE 16 adds a third arm: the PIPELINED chained
+    run (``--pipeline`` — the wordcount consumes sealed relay buffers
+    while the grep still produces) reporting ``plan_pipelined_mbps``
+    and the attributed overlap wall ``plan_overlap_s``, byte-parity
+    gated against both other arms.  Runs in fresh subprocesses on
+    1-device CPU under
     ``DSI_AOT_FRESH=1`` like the other stream rows (the attributed
     persisted-AOT-load flake stays out of bench rounds), so it is
     chip-independent and rides every verdict branch.  Measured keys XOR
@@ -1755,6 +1760,8 @@ def run_plan_row() -> dict:
                "--workdir", wd, "--stats-json", sj, corpus_path]
         if mode == "staged":
             cmd.insert(-1, "--staged")
+        elif mode == "pipelined":
+            cmd.insert(-1, "--pipeline")
         r = subprocess.run(cmd, env=env,
                            cwd=os.path.dirname(os.path.abspath(__file__)),
                            capture_output=True, text=True, timeout=budget)
@@ -1767,6 +1774,7 @@ def run_plan_row() -> dict:
     try:
         chained, wd_c = one("chained")
         staged, wd_s = one("staged")
+        pipelined, wd_p = one("pipelined")
     except Exception as e:
         return {"plan_skipped": f"plan row failed: "
                                 f"{type(e).__name__}: {e}"}
@@ -1780,21 +1788,26 @@ def run_plan_row() -> dict:
         return sorted(got)
 
     try:
-        parity = outset(wd_c) == outset(wd_s)
+        want = outset(wd_s)
+        parity = outset(wd_c) == want and outset(wd_p) == want
     except OSError as e:
         return {"plan_skipped": f"missing chain output: {e}"}
     if not parity:
-        return {"plan_skipped": "chained vs staged parity mismatch "
-                                "(throughput suppressed)",
+        return {"plan_skipped": "chained/pipelined vs staged parity "
+                                "mismatch (throughput suppressed)",
                 "plan_parity": False}
     inter_c = int(chained.get("plan_intermediate_bytes", -1))
     inter_s = int(staged.get("plan_intermediate_bytes", 0))
     chained_s = float(chained.get("plan_s", 0.0)) or 1e-9
     staged_s = float(staged.get("plan_s", 0.0)) or 1e-9
+    pipe_s = float(pipelined.get("plan_s", 0.0)) or 1e-9
     row = {"plan_mb": round(total_mb, 2), "plan_parity": True,
            "plan_zero_copy": inter_c == 0,
            "plan_chained_mbps": round(total_mb / chained_s, 2),
            "plan_staged_mbps": round(total_mb / staged_s, 2),
+           "plan_pipelined_mbps": round(total_mb / pipe_s, 2),
+           "plan_overlap_s": float(pipelined.get("plan_overlap_s",
+                                                 0.0)),
            "plan_intermediate_bytes": inter_c,
            "plan_staged_intermediate_bytes": inter_s,
            "plan_stage_walls": chained.get("plan_stage_walls", {})}
@@ -1802,7 +1815,9 @@ def run_plan_row() -> dict:
         f"{row['plan_chained_mbps']} MB/s ({chained_s:.2f}s, "
         f"{inter_c} host bytes between stages) vs staged "
         f"{row['plan_staged_mbps']} MB/s ({staged_s:.2f}s, "
-        f"{inter_s} host bytes)")
+        f"{inter_s} host bytes); pipelined "
+        f"{row['plan_pipelined_mbps']} MB/s ({pipe_s:.2f}s, "
+        f"{row['plan_overlap_s']:.2f}s overlapped)")
     return row
 
 
@@ -1817,9 +1832,17 @@ def run_spec_row() -> dict:
     first-commit-wins gate), and ``spec_resumed`` (attempts that
     restored a checkpoint chain).  Each arm is parity-gated against the
     sequential host oracle by ``shardrun --check`` (exit 2 = mismatch,
-    throughput suppressed).  Chip-independent (1-device CPU workers),
-    measured keys XOR ``spec_skipped``.  ``DSI_BENCH_SPEC_MB`` (default
-    4; 0 disables) sizes it."""
+    throughput suppressed).  ISSUE 16 adds a third arm under the SAME
+    injected straggler: ``--resplit`` (dynamic re-split — the
+    straggler's remaining range splits into sub-shards for the idle
+    workers instead of one full-range backup), reporting
+    ``spec_resplit_mbps`` / ``spec_resplits`` / ``spec_subshards``;
+    its duplicate commits fold into the same must-be-0 gate.  The
+    re-split trigger is load-dependent, so that arm skips honestly
+    (``spec_resplit_skipped``) when no re-split fired, without
+    suppressing the backup half.  Chip-independent (1-device CPU
+    workers), measured keys XOR ``spec_skipped``.  ``DSI_BENCH_SPEC_MB``
+    (default 4; 0 disables) sizes it."""
     mb = env_float("DSI_BENCH_SPEC_MB", 4.0)
     if mb <= 0:
         return {"spec_skipped": "disabled (DSI_BENCH_SPEC_MB=0)"}
@@ -1861,6 +1884,8 @@ def run_spec_row() -> dict:
                "--check", "--stats-json", sj, corpus_path]
         if mode == "nobackup":
             cmd.insert(-1, "--no-spec")
+        elif mode == "resplit":
+            cmd.insert(-1, "--resplit")
         r = subprocess.run(cmd, env=e,
                            cwd=os.path.dirname(os.path.abspath(__file__)),
                            capture_output=True, text=True,
@@ -1879,8 +1904,15 @@ def run_spec_row() -> dict:
     except Exception as e:
         return {"spec_skipped": f"spec row failed: "
                                 f"{type(e).__name__}: {e}"}
+    resplit, resplit_skip = None, None
+    try:
+        resplit = one("resplit")
+    except Exception as e:
+        resplit_skip = (f"resplit arm failed: "
+                        f"{type(e).__name__}: {e}")
     dup = (int(backup.get("duplicate_commits", 0))
-           + int(nobackup.get("duplicate_commits", 0)))
+           + int(nobackup.get("duplicate_commits", 0))
+           + int((resplit or {}).get("duplicate_commits", 0)))
     backup_s = float(backup.get("wall_s", 0.0)) or 1e-9
     nobackup_s = float(nobackup.get("wall_s", 0.0)) or 1e-9
     row = {"spec_mb": round(total_mb, 2), "spec_parity": True,
@@ -1895,11 +1927,30 @@ def run_spec_row() -> dict:
            "spec_exactly_once": dup == 0,
            "spec_resumed": int(backup.get("resumed_attempts", 0)),
            "spec_commit_losses": int(backup.get("commit_losses", 0))}
+    if resplit is not None and not int(resplit.get("resplits", 0)):
+        resplit_skip = ("no re-split fired (straggler finished or "
+                        "remainder under the split floor — backup "
+                        "fallback ran)")
+    if resplit_skip is not None:
+        row["spec_resplit_skipped"] = resplit_skip
+    else:
+        resplit_s = float(resplit.get("wall_s", 0.0)) or 1e-9
+        row.update({
+            "spec_resplit_mbps": round(total_mb / resplit_s, 2),
+            "spec_resplits": int(resplit["resplits"]),
+            "spec_subshards": int(resplit.get("subshard_dispatches",
+                                              0))})
     log(f"spec row: {total_mb:.1f} MB, slow shard injected — backup "
         f"{row['spec_backup_mbps']} MB/s ({backup_s:.2f}s, "
         f"{row['spec_backup_fired']} backups, {row['spec_resumed']} "
         f"resumed) vs no-backup {row['spec_nobackup_mbps']} MB/s "
         f"({nobackup_s:.2f}s); duplicate commits {dup}")
+    if "spec_resplit_mbps" in row:
+        log(f"spec row resplit arm: {row['spec_resplit_mbps']} MB/s "
+            f"({resplit_s:.2f}s, {row['spec_resplits']} resplits -> "
+            f"{row['spec_subshards']} sub-shards)")
+    else:
+        log(f"spec row resplit arm skipped: {row['spec_resplit_skipped']}")
     return row
 
 

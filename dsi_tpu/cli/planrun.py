@@ -12,11 +12,24 @@ last COMPLETED stage (``--resume``), never from zero.
 Chains:
   grep-wc   — grep → word count over exactly the matching lines;
               writes the word counts as mr-out-<r> files in --workdir.
+  grep-grep — grep → grep: a narrowing filter cascade (lines with
+              --pattern, of those, lines with --pattern2); writes
+              plan-grep.json with the final match counts.
+  wc-topk   — word count → top-k highest-count words (host reduction
+              over the full table); writes plan-topk.json.
   indexer   — indexer → df-top-k (k-row snapshot off the resident df
               table) → per-term postings join; writes plan-join.json.
 
+Elastic execution (ISSUE 16): ``--pipeline`` overlaps a grep→wordcount
+pair (the wordcount consumes relay buffers as they SEAL while the grep
+is still producing; strict/staged stays the bit-parity oracle);
+``--stage-shards K`` runs a file-backed source stage as K concurrent
+newline-aligned shard attempts merged through the deterministic shard
+codecs.
+
 Usage:
     python -m dsi_tpu.cli.planrun --chain grep-wc --pattern PAT
+        [--pattern2 PAT] [--pipeline] [--stage-shards K]
         [--staged] [--chunk-bytes B] [--devices D] [--pipeline-depth K]
         [--device-accumulate] [--sync-every K] [--mesh-shards N]
         [--nreduce N] [--u-cap U] [--topk K] [--aot]
@@ -42,10 +55,23 @@ def _positive_int(s: str) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("files", nargs="+")
-    p.add_argument("--chain", choices=("grep-wc", "indexer"),
+    p.add_argument("--chain",
+                   choices=("grep-wc", "grep-grep", "wc-topk",
+                            "indexer"),
                    default="grep-wc")
     p.add_argument("--pattern", default=None,
-                   help="literal grep pattern (required for grep-wc)")
+                   help="literal grep pattern (required for grep-wc "
+                        "and grep-grep)")
+    p.add_argument("--pattern2", default=None,
+                   help="second-stage literal pattern (required for "
+                        "grep-grep)")
+    p.add_argument("--pipeline", action="store_true",
+                   help="overlap a grep→wordcount pair: stage N+1 "
+                        "consumes sealed relay buffers while stage N "
+                        "still produces (chained mode only)")
+    p.add_argument("--stage-shards", type=int, default=0,
+                   help="run a file-backed source stage as K "
+                        "concurrent shard attempts (0 = off)")
     p.add_argument("--staged", action="store_true",
                    help="run the HOST-materialization baseline: every "
                         "inter-stage intermediate is pulled to the host "
@@ -83,8 +109,13 @@ def main(argv=None) -> int:
 
     if args.resume and not args.checkpoint_dir:
         p.error("--resume requires --checkpoint-dir")
-    if args.chain == "grep-wc" and not args.pattern:
-        p.error("--chain grep-wc requires --pattern")
+    if args.chain in ("grep-wc", "grep-grep") and not args.pattern:
+        p.error(f"--chain {args.chain} requires --pattern")
+    if args.chain == "grep-grep" and not args.pattern2:
+        p.error("--chain grep-grep requires --pattern2")
+    if args.pipeline and args.staged:
+        p.error("--pipeline is chained-mode only (staged execution "
+                "stays strictly sequential: it is the parity oracle)")
 
     if args.trace_dir:
         from dsi_tpu.obs import configure_tracing
@@ -97,8 +128,9 @@ def main(argv=None) -> int:
 
     from dsi_tpu.ckpt import CheckpointMismatch
     from dsi_tpu.parallel.shuffle import default_mesh
-    from dsi_tpu.plan import (PlanHostPath, grep_wordcount_plan,
-                              indexer_join_plan, run_plan)
+    from dsi_tpu.plan import (PlanHostPath, grep_cascade_plan,
+                              grep_wordcount_plan, indexer_join_plan,
+                              run_plan, wordcount_topk_plan)
 
     mesh = default_mesh(args.devices)
     defaults = dict(chunk_bytes=args.chunk_bytes,
@@ -113,6 +145,12 @@ def main(argv=None) -> int:
         if args.chain == "grep-wc":
             return grep_wordcount_plan(args.pattern, paths=args.files,
                                        **defaults)
+        if args.chain == "grep-grep":
+            return grep_cascade_plan(args.pattern, args.pattern2,
+                                     paths=args.files, **defaults)
+        if args.chain == "wc-topk":
+            return wordcount_topk_plan(args.topk, paths=args.files,
+                                       **defaults)
         docs = []
         for path in args.files:
             with open(path, "rb") as f:
@@ -123,7 +161,8 @@ def main(argv=None) -> int:
     try:
         res = run_plan(build(), mesh=mesh, staged=args.staged,
                        checkpoint_dir=args.checkpoint_dir,
-                       resume=args.resume, stats=stats)
+                       resume=args.resume, pipelined=args.pipeline,
+                       stage_shards=args.stage_shards, stats=stats)
     except CheckpointMismatch as e:
         print(f"planrun: {e}", file=sys.stderr)
         return 1
@@ -153,6 +192,25 @@ def main(argv=None) -> int:
         print(f"planrun: grep lines={g.lines} matched={g.matched} "
               f"occurrences={g.occurrences}", file=sys.stderr)
         write_partitioned_output(res.final, args.nreduce, args.workdir)
+    elif args.chain == "grep-grep":
+        stages = {name: {"lines": r.lines, "matched": r.matched,
+                         "occurrences": r.occurrences}
+                  for name, r in res.results.items()}
+        path = os.path.join(args.workdir, "plan-grep.json")
+        # dsicheck: allow[raw-write] report artifact, not durable state
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(stages, f, sort_keys=True, indent=1)
+        g2 = res.final
+        print(f"planrun: cascade matched={g2.matched} "
+              f"occurrences={g2.occurrences} -> {path}", file=sys.stderr)
+    elif args.chain == "wc-topk":
+        path = os.path.join(args.workdir, "plan-topk.json")
+        # dsicheck: allow[raw-write] report artifact, not durable state
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"topk": [[int(c), w] for c, w in res.final]},
+                      f, sort_keys=True, indent=1)
+        print(f"planrun: top-{len(res.final)} words -> {path}",
+              file=sys.stderr)
     else:
         out = {w: {"df": df, "part": part, "docs": list(docs)}
                for w, (df, part, docs) in res.final.items()}
@@ -177,10 +235,16 @@ def main(argv=None) -> int:
         flush_tracing_report(args.trace_dir, "planrun")
 
     if args.check:
-        twin = run_plan(build(), mesh=mesh, staged=not args.staged)
+        # The twin runs the OTHER handoff mode under the SAME shard
+        # fan-out: stage-sharded grep merges zero the order-sensitive
+        # topk sample, so parity only holds shard-geometry-to-like.
+        twin = run_plan(build(), mesh=mesh, staged=not args.staged,
+                        stage_shards=args.stage_shards)
         ok = twin.final == res.final
         if args.chain == "grep-wc":
             ok = ok and twin.results["grep"] == res.results["grep"]
+        elif args.chain == "grep-grep":
+            ok = ok and twin.results == res.results
         if not ok:
             print("planrun: PARITY FAILURE chained vs staged",
                   file=sys.stderr)

@@ -18,6 +18,13 @@ Chaos/straggler injection for grids and the bench A/B:
 * ``DSI_CHAOS_WORKER_KILL=p[,seed]`` passes through to every worker
   (each stamped with ``DSI_CHAOS_WORKER_INDEX`` for determinism).
 
+``--resplit`` arms dynamic straggler re-split (ISSUE 16): instead of
+one whole-range backup, the coordinator cuts the straggler's REMAINING
+cursor range (from its live reported cursor) into newline-aligned
+sub-shards and fans them out to idle workers — each sub-range is its
+own first-commit-wins race, and the merge consumes the coordinator's
+``final_outputs()`` (full-range file, or sub-range files in order).
+
 ``--check`` runs the sequential host oracle over the whole input and
 byte-compares the merged output.  ``--stats-json`` dumps the
 coordinator's ``spec_stats()`` (backup_dispatches, requeues, commits,
@@ -70,6 +77,12 @@ def main(argv=None) -> int:
     p.add_argument("--no-spec", action="store_true",
                    help="disable speculative backup dispatch (the "
                         "bench A/B's control arm)")
+    p.add_argument("--resplit", action="store_true",
+                   help="dynamic straggler re-split: cut a straggling "
+                        "attempt's REMAINING range into sub-shards for "
+                        "idle workers instead of one whole-range backup")
+    p.add_argument("--resplit-ways", type=int, default=2,
+                   help="sub-shard count per re-split (default 2)")
     p.add_argument("--journal", default="",
                    help="commit journal (default <workdir>/shards."
                         "journal; exactly-once needs it)")
@@ -134,6 +147,8 @@ def main(argv=None) -> int:
                     shard_timeout_s=args.shard_timeout,
                     spec_backup=not args.no_spec,
                     spec_floor_s=args.spec_floor,
+                    spec_resplit=args.resplit,
+                    spec_resplit_ways=args.resplit_ways,
                     shard_progress_s=args.progress_s)
     coord = Coordinator(files, 0, cfg, shard_plan=plan,
                         shard_opts={"knobs": knobs})
@@ -197,6 +212,9 @@ def main(argv=None) -> int:
     finally:
         run_stats = coord.spec_stats()
         run_stats["wall_s"] = round(time.monotonic() - t0, 3)
+        # A re-split shard commits as SUB-RANGE files, not one full-
+        # range file: the coordinator knows the committed layout.
+        out_paths = coord.final_outputs()
         coord.close()
         for w in workers:
             if w.poll() is None:
@@ -217,8 +235,7 @@ def main(argv=None) -> int:
         from dsi_tpu.utils.atomicio import atomic_write
 
         payloads = []
-        for spec in plan:
-            path = os.path.join(workdir, f"mr-shard-out-{spec.sid}")
+        for path in out_paths:
             try:
                 with open(path, "rb") as f:
                     payloads.append(f.read())
@@ -258,6 +275,12 @@ def main(argv=None) -> int:
           f"{run_stats.get('requeues', 0)} requeues, "
           f"{run_stats.get('duplicate_commits', 0)} duplicate commits, "
           f"wall {run_stats.get('wall_s')}s", file=sys.stderr)
+    if run_stats.get("resplits"):
+        print(f"shardrun: {run_stats['resplits']} resplits -> "
+              f"{run_stats.get('subshard_dispatches', 0)} sub-shard "
+              f"dispatches, {run_stats.get('subshard_commits', 0)} "
+              f"sub commits, {run_stats.get('split_shards', 0)} shards "
+              f"resolved split", file=sys.stderr)
     if rc != 0:
         return rc
 

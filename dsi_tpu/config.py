@@ -94,6 +94,20 @@ class JobConfig:
     # spurious backups.
     spec_setup_s: float = 8.0
 
+    # Dynamic re-split (the elastic-dataflow half of §3.5/§3.6): when
+    # the straggler triggers fire on a splittable shard, split the slow
+    # attempt's REMAINING cursor range (from its live confirmed cursor)
+    # into newline-aligned sub-shards for idle workers instead of
+    # racing one whole-range backup.  First commit wins PER SUB-RANGE;
+    # the straggler keeps running and still wins the whole shard if it
+    # commits before every sub-range has.
+    spec_resplit: bool = False
+    # How many ways the remaining range is split.
+    spec_resplit_ways: int = 2
+    # Remainders smaller than this fall back to a plain backup — a
+    # sub-shard must amortize one engine setup.
+    spec_resplit_min_bytes: int = 1 << 16
+
     # Worker-side progress-RPC cadence while driving a shard, seconds.
     shard_progress_s: float = 0.5
 

@@ -199,6 +199,44 @@ class DeviceRelay:
             yield self._sealed.pop(0)
             self._sealed_lens.pop(0)
 
+    def take_sealed(self) -> List:
+        """Pop the currently SEALED buffers (append order) WITHOUT
+        sealing the open accumulation buffer — the pipelined driver's
+        seal-driven handoff: the consumer takes these while the
+        producer keeps appending into the open tail.  Call
+        :meth:`finish` then take once more when the producer is done."""
+        out: List = []
+        while self._sealed:
+            out.append(self._sealed.pop(0))
+            self._sealed_lens.pop(0)
+        return out
+
+    def finish(self) -> None:
+        """Seal the open tail: the producer has appended its last byte,
+        so the final partial buffer becomes consumable."""
+        if self._acc is not None:
+            self._seal()
+
+    def host_blocks(self) -> Iterator[bytes]:
+        """Destructively materialize every buffer as per-row byte
+        blocks — the counted host-fallback consumption path for a
+        downstream engine with no device-batch input mode (the
+        grep→grep cascade).  Rows hold whole newline-terminated lines,
+        so the blocks are a valid line stream in any order; the pull
+        is charged to ``plan_intermediate_bytes`` like any other
+        host-crossing handoff."""
+        if self._acc is not None:
+            self._seal()
+        while self._sealed:
+            buf = self._sealed.pop(0)
+            lens = self._sealed_lens.pop(0)
+            host = np.asarray(buf)
+            self.stats["plan_intermediate_bytes"] += int(lens.sum())
+            for r in range(host.shape[0]):
+                k = int(lens[r])
+                if k:
+                    yield host[r, :k].tobytes()
+
     # ── durability (the stage-commit payload) ──
 
     def capture(self) -> Dict[str, np.ndarray]:

@@ -101,7 +101,12 @@ class Coordinator:
         self._spec = {"backup_dispatches": 0, "requeues": 0, "commits": 0,
                       "commit_losses": 0, "duplicate_commits": 0,
                       "resumed_attempts": 0, "failed_attempts": 0,
+                      "resplits": 0, "subshard_dispatches": 0,
+                      "subshard_commits": 0,
                       "resume_cursors": {}}
+        #: Dispatchable sub-shards of re-split shards: (sid, k) heap,
+        #: lazily invalidated like the shard heap.
+        self._sub_ready: list[tuple] = []
         #: assignment→commit walls of committed shards — the "normal
         #: shard duration" reference the slow-progress backup trigger
         #: compares against (§3.6: back up what takes abnormally long).
@@ -111,7 +116,7 @@ class Coordinator:
                 self._shards[spec.sid] = {
                     "spec": spec, "status": LOG_UNTOUCHED,
                     "attempts": {}, "next_aid": 0, "committed": None,
-                    "backups": 0}
+                    "backups": 0, "subs": None}
             self._shard_ready = list(range(self.n_shards))
             heapq.heapify(self._shard_ready)
         # Worker liveness (observability + the speculative-execution
@@ -200,6 +205,29 @@ class Coordinator:
                     s for s in self._shard_ready
                     if self._shards[s]["committed"] is None]
                 heapq.heapify(self._shard_ready)
+            # Re-split records replay as live sub-shard state: the
+            # ranges partition the shard exactly, so the remaining work
+            # IS the uncommitted subs — the full range is never
+            # re-queued once a re-split was journaled (the dead
+            # straggler's chain still serves sub 0 via adoption).
+            for sid, ranges in self._journal.resplits.items():
+                shard = self._shards.get(sid)
+                if shard is None or shard["committed"] is not None:
+                    continue
+                self._make_subs(sid, ranges, parent_chain=None)
+                shard["status"] = LOG_IN_PROGRESS
+            for (sid, k), (aid, crc) in \
+                    self._journal.subshard_commits.items():
+                shard = self._shards.get(sid)
+                subs = shard["subs"] if shard is not None else None
+                sub = subs.get(k) if subs else None
+                if sub is not None and sub["committed"] is None:
+                    sub["committed"] = (aid, crc)
+                    sub["status"] = LOG_COMPLETED
+            for shard in self._shards.values():
+                if shard["committed"] is None \
+                        and self._split_resolved(shard):
+                    shard["status"] = LOG_COMPLETED
             self._journal.open()
 
     # ---- RPC handlers (the wire API, mr/coordinator.go:27-114) ----
@@ -300,7 +328,7 @@ class Coordinator:
             if wid:
                 self._touch(wid)
             if self.job_failed or all(
-                    shard["committed"] is not None
+                    self._shard_resolved(shard)
                     for shard in self._shards.values()):
                 reply["TaskStatus"] = int(TaskStatus.DONE)
                 return reply
@@ -310,7 +338,15 @@ class Coordinator:
                 shard = self._shards[sid]
                 kind = "takeover" if shard["attempts"] else "primary"
                 assignment = self._new_attempt(sid, wid, kind, now)
-            elif self.config.spec_backup:
+            if assignment is None:
+                pick = self._pop_untouched_sub()
+                if pick is not None:
+                    return self._assign_sub(pick[0], pick[1], wid, now)
+            if assignment is None and self.config.spec_resplit:
+                pick = self._maybe_resplit(wid, now)
+                if pick is not None:
+                    return self._assign_sub(pick[0], pick[1], wid, now)
+            if assignment is None and self.config.spec_backup:
                 assignment = self._maybe_backup(wid, now)
             if assignment is None:
                 return reply
@@ -341,17 +377,25 @@ class Coordinator:
         wid = str(args.get("WorkerId") or "")
         sid = int(args.get("Shard", -1))
         aid = int(args.get("Attempt", -1))
+        sub = int(args.get("Sub", -1))
         now = time.monotonic()
         with self.mu:
             if wid:
                 self._touch(wid)
             shard = self._shards.get(sid)
-            att = shard["attempts"].get(aid) if shard is not None else None
+            owner = shard
+            if shard is not None and sub >= 0:
+                owner = (shard["subs"] or {}).get(sub)
+            att = owner["attempts"].get(aid) if owner is not None else None
             if att is None:
                 return {"Cancel": True}
             att["last_progress"] = now
             att["confirmed"] = int(args.get("Confirmed", 0) or 0)
             att["ckpts"] = int(args.get("Ckpts", 0) or 0)
+            # The attempt's LIVE confirmed-byte cursor (reported from
+            # the first retired step, not only after a checkpoint) —
+            # the re-split trigger cuts the remainder from here.
+            att["cursor"] = int(args.get("Cursor", 0) or 0)
             # "Progressed" means REAL steps retired, not merely an RPC:
             # the first advance slice pays the engine's jax compiles,
             # and the setup-grace window must cover exactly that.
@@ -361,8 +405,10 @@ class Coordinator:
             if rc and not att["resume_cursor"]:
                 att["resume_cursor"] = int(rc)
                 self._spec["resumed_attempts"] += 1
-                self._spec["resume_cursors"][f"{sid}.a{aid}"] = int(rc)
-            cancel = shard["committed"] is not None or att["cancelled"]
+                key = f"{sid}.s{sub}.a{aid}" if sub >= 0 else f"{sid}.a{aid}"
+                self._spec["resume_cursors"][key] = int(rc)
+            cancel = att["cancelled"] or owner["committed"] is not None \
+                or self._shard_resolved(shard)
             return {"Cancel": bool(cancel)}
 
     def commit_shard(self, args: dict) -> dict:
@@ -372,16 +418,37 @@ class Coordinator:
         CRC32) is journaled, and every other live attempt is flagged
         for cancellation.  Later commits are told they lost and reap
         their partials; a dead-presumed attempt that was actually just
-        slow may still win (liveness never gates commits)."""
+        slow may still win (liveness never gates commits).
+
+        Re-split arbitration (``Sub >= 0`` commits a SUB-range): each
+        sub-range is its own first-commit-wins race journaled as a
+        ``subshard`` record; once EVERY sub has committed the shard is
+        resolved "split" and the full-range straggler is cancelled.
+        Conversely a full-range commit landing while any sub is still
+        open WINS the whole shard (the straggler outran the split) and
+        every sub is cancelled and its outputs reaped — either way
+        exactly one committed copy of every byte survives."""
         wid = str(args.get("WorkerId") or "")
         sid = int(args.get("Shard", -1))
         aid = int(args.get("Attempt", -1))
+        sub = int(args.get("Sub", -1))
         crc = int(args.get("Crc", 0) or 0)
         with self.mu:
             if wid:
                 self._touch(wid)
             shard = self._shards.get(sid)
             if shard is None:
+                return {"Win": False}
+            if sub >= 0:
+                return self._commit_sub_locked(shard, sid, sub, aid, crc,
+                                               wid)
+            if shard["committed"] is None and self._split_resolved(shard):
+                # The subs got there first: the full-range straggler
+                # lost to the split as a whole.
+                self._spec["commit_losses"] += 1
+                log_event("shard_commit_lose", kind="shard", task=sid,
+                          attempt=aid, winner="split",
+                          worker=wid or None)
                 return {"Win": False}
             if shard["committed"] is not None:
                 self._spec["commit_losses"] += 1
@@ -410,10 +477,12 @@ class Coordinator:
             # Reap sibling partials: an attempt killed between its
             # durable partial write and its commit RPC can never report
             # again, and its orphan .part must not outlive the shard.
-            prefix = os.path.basename(final) + ".a"
+            prefixes = (os.path.basename(final) + ".a",
+                        os.path.basename(final) + ".s")
             try:
                 for name in os.listdir(os.path.dirname(final) or "."):
-                    if name.startswith(prefix) and name.endswith(".part"):
+                    if name.startswith(prefixes) \
+                            and name.endswith(".part"):
                         os.remove(os.path.join(
                             os.path.dirname(final), name))
             except OSError:
@@ -421,6 +490,22 @@ class Coordinator:
             for oaid, oatt in shard["attempts"].items():
                 if oaid != aid:
                     oatt["cancelled"] = True
+            if shard["subs"]:
+                # The straggler outran its own split: cancel every sub
+                # attempt and reap any sub output already renamed — the
+                # full-range file is now THE copy of these bytes.
+                for k, sd in shard["subs"].items():
+                    sd["status"] = LOG_COMPLETED  # no further dispatch
+                    for satt in sd["attempts"].values():
+                        satt["cancelled"] = True
+                    for p in (self._sub_out_path(sid, k),):
+                        try:
+                            os.remove(p)
+                        except OSError:
+                            pass
+                log_event("resplit_overrun", kind="shard", task=sid,
+                          attempt=aid,
+                          subs=sorted(shard["subs"]))
             att = shard["attempts"].get(aid)
             if att is not None:
                 now = time.monotonic()
@@ -442,18 +527,26 @@ class Coordinator:
         wid = str(args.get("WorkerId") or "")
         sid = int(args.get("Shard", -1))
         aid = int(args.get("Attempt", -1))
+        sub = int(args.get("Sub", -1))
         with self.mu:
             if wid:
                 self._touch(wid)
             shard = self._shards.get(sid)
-            att = shard["attempts"].get(aid) if shard is not None else None
+            owner = shard
+            if shard is not None and sub >= 0:
+                owner = (shard["subs"] or {}).get(sub)
+            att = owner["attempts"].get(aid) if owner is not None else None
             if att is not None and not att["dead"] and not att["cancelled"]:
                 att["dead"] = True
                 self._spec["failed_attempts"] += 1
                 log_event("shard_failed", kind="shard", task=sid,
                           attempt=aid, worker=wid or None,
+                          sub=(sub if sub >= 0 else None),
                           reason=str(args.get("Reason", "") or ""))
-                self._requeue_shard_locked(sid)
+                if sub >= 0:
+                    self._requeue_sub_locked(sid, sub)
+                else:
+                    self._requeue_shard_locked(sid)
         return {}
 
     def spec_stats(self) -> dict:
@@ -473,7 +566,34 @@ class Coordinator:
                 str(sid): shard["committed"][0]
                 for sid, shard in self._shards.items()
                 if shard["committed"] is not None}
+            out["subshards"] = sum(
+                len(shard["subs"] or {})
+                for shard in self._shards.values())
+            out["split_shards"] = sum(
+                1 for shard in self._shards.values()
+                if shard["committed"] is None
+                and self._split_resolved(shard))
+            out["resolved"] = sum(
+                1 for shard in self._shards.values()
+                if self._shard_resolved(shard))
         return out
+
+    def final_outputs(self) -> List[str]:
+        """The job's committed output files in stream order: each
+        shard's full-range file, or — for a shard resolved by re-split
+        — its sub-range files in sub order (sub ranges partition the
+        shard in order, so concatenation order is preserved).  Only
+        complete once :meth:`done` is True."""
+        with self.mu:
+            out: List[str] = []
+            for sid in sorted(self._shards):
+                shard = self._shards[sid]
+                if shard["committed"] is not None:
+                    out.append(self._shard_out_path(sid))
+                elif shard["subs"]:
+                    out.extend(self._sub_out_path(sid, k)
+                               for k in sorted(shard["subs"]))
+            return out
 
     # ---- internals ----
 
@@ -534,7 +654,7 @@ class Coordinator:
         shard["next_aid"] = aid + 1
         att = {"worker": wid, "kind": kind, "assigned": now,
                "last_progress": now, "progressed": False, "confirmed": 0,
-               "ckpts": 0, "resume_cursor": 0, "dead": False,
+               "ckpts": 0, "cursor": 0, "resume_cursor": 0, "dead": False,
                "cancelled": False,
                "resume_from": (self._best_resume_from(shard)
                                if kind != "primary" else None)}
@@ -556,6 +676,25 @@ class Coordinator:
             if best is None or (att["ckpts"], aid) > best[1]:
                 best = (aid, (att["ckpts"], aid))
         return best[0] if best is not None else None
+
+    def _setup_grace_s(self) -> float:
+        """Grace for an attempt that has never progressed: it is still
+        paying engine setup (jax init + first compiles), and N cold
+        attempts SERIALIZE their compiles when workers share few cores
+        — so the expected setup wall is N times the single-attempt
+        grace.  Scaling by the live never-progressed attempt count is
+        self-correcting: as attempts start progressing the count (and
+        the grace) shrinks back to ``spec_setup_s``."""
+        n_setup = 0
+        for shard in self._shards.values():
+            for atts in ([shard["attempts"]]
+                         + [s["attempts"] for s in
+                            (shard["subs"] or {}).values()]):
+                for a in atts.values():
+                    if (not a["dead"] and not a["cancelled"]
+                            and not a["progressed"]):
+                        n_setup += 1
+        return self.config.spec_setup_s * max(1, n_setup)
 
     def _maybe_backup(self, wid: str, now: float):
         """Speculative dispatch: hand this idle worker a BACKUP attempt
@@ -583,6 +722,10 @@ class Coordinator:
             if shard["committed"] is not None \
                     or shard["status"] != LOG_IN_PROGRESS:
                 continue
+            if shard["subs"]:
+                # A re-split shard's remaining work is its subs: a
+                # whole-range backup would redo bytes the subs own.
+                continue
             live = [(aid, a) for aid, a in shard["attempts"].items()
                     if not a["dead"] and not a["cancelled"]]
             if not live or len(live) >= 2:
@@ -599,7 +742,7 @@ class Coordinator:
             p99 = h.percentile(0.99) if h is not None and h.count else 0.0
             thr = max(self.config.spec_k * p99, self.config.spec_floor_s)
             if not freshest["progressed"]:
-                thr = max(thr, self.config.spec_setup_s)
+                thr = max(thr, self._setup_grace_s())
             silent = age > thr
             slow = (ref_wall is not None and freshest["progressed"]
                     and total_age > self.config.spec_k * ref_wall)
@@ -641,6 +784,10 @@ class Coordinator:
         shard = self._shards[sid]
         if shard["committed"] is not None:
             return
+        if shard["subs"]:
+            # The subs partition the whole range: they ARE the retry of
+            # a re-split shard; never re-queue the full range.
+            return
         if any(not a["dead"] and not a["cancelled"]
                for a in shard["attempts"].values()):
             return
@@ -671,6 +818,299 @@ class Coordinator:
         heapq.heappush(self._deadlines, entry)
         if self._deadlines[0] is entry:
             self._deadline_cv.notify()
+
+    # ---- re-split internals (caller holds self.mu) ----
+
+    @staticmethod
+    def _split_resolved(shard: dict) -> bool:
+        """Every sub-range of a re-split shard committed — the split as
+        a whole resolved the shard."""
+        subs = shard.get("subs")
+        return bool(subs) and all(s["committed"] is not None
+                                  for s in subs.values())
+
+    def _shard_resolved(self, shard: dict) -> bool:
+        """A shard needs no further work: its full range committed, or
+        its re-split's sub-ranges all committed."""
+        return shard["committed"] is not None \
+            or self._split_resolved(shard)
+
+    def _sub_out_path(self, sid: int, k: int) -> str:
+        return self._shard_out_path(sid) + f".s{k}"
+
+    def _sub_part_path(self, sid: int, k: int, aid: int) -> str:
+        return self._sub_out_path(sid, k) + f".a{aid}.part"
+
+    def _make_subs(self, sid: int, ranges, parent_chain) -> None:
+        """Materialize a re-split's sub-shard state and queue every
+        sub for dispatch.  ``parent_chain`` names the straggler attempt
+        whose checkpoint chain sub 0 (the prefix covering the
+        straggler's confirmed progress) adopts."""
+        shard = self._shards[sid]
+        subs = {}
+        for k, (s, e) in enumerate(ranges):
+            subs[k] = {"spec": (int(s), int(e)),
+                       "status": LOG_UNTOUCHED, "attempts": {},
+                       "next_aid": 0, "committed": None,
+                       "parent_chain": (parent_chain if k == 0 else None)}
+            heapq.heappush(self._sub_ready, (sid, k))
+        shard["subs"] = subs
+
+    def _pop_untouched_sub(self) -> Optional[tuple]:
+        while self._sub_ready:
+            sid, k = heapq.heappop(self._sub_ready)
+            shard = self._shards[sid]
+            if shard["committed"] is not None:
+                continue  # the full-range commit overran the split
+            sub = (shard["subs"] or {}).get(k)
+            if sub is not None and sub["status"] == LOG_UNTOUCHED:
+                return sid, k
+        return None
+
+    def _assign_sub(self, sid: int, k: int, wid: str, now: float) -> dict:
+        """Create one sub-shard attempt and build its assignment reply:
+        ``Start``/``End`` are the sub-range the attempt READS;
+        ``TagStart``/``TagEnd`` are the parent shard's range — the
+        checkpoint-chain identity tag sub 0 needs to adopt the
+        straggler's chain (a chain's cursors are range-relative, and
+        the parent's prefix IS sub 0's stream)."""
+        shard = self._shards[sid]
+        sub = shard["subs"][k]
+        aid = sub["next_aid"]
+        sub["next_aid"] = aid + 1
+        att = {"worker": wid, "kind": "sub", "assigned": now,
+               "last_progress": now, "progressed": False, "confirmed": 0,
+               "ckpts": 0, "cursor": 0, "resume_cursor": 0, "dead": False,
+               "cancelled": False,
+               "resume_from": (self._best_resume_from(sub)
+                               if sub["attempts"] else None)}
+        sub["attempts"][aid] = att
+        sub["status"] = LOG_IN_PROGRESS
+        self._arm_sub_timeout(sid, k, aid)
+        self._spec["subshard_dispatches"] += 1
+        spec = shard["spec"]
+        s, e = sub["spec"]
+        reply = {"TaskStatus": int(TaskStatus.SHARD), "Shard": sid,
+                 "Sub": k, "Attempt": aid, "Start": s, "End": e,
+                 "TagStart": spec.start, "TagEnd": spec.end,
+                 "Files": self.files, "NShards": self.n_shards,
+                 "ResumeFrom": att["resume_from"],
+                 "ParentChain": sub["parent_chain"],
+                 "Knobs": self.shard_opts.get("knobs", {}),
+                 "CkptRoot": self._shard_ckpt_root(),
+                 "OutPart": self._sub_part_path(sid, k, aid)}
+        log_event("assign", kind="subshard", task=sid, sub=k,
+                  attempt=aid, worker=wid or None, start=s, end=e,
+                  resume_from=att["resume_from"],
+                  parent_chain=sub["parent_chain"])
+        return reply
+
+    def _arm_sub_timeout(self, sid: int, k: int, aid: int) -> None:
+        entry = (time.monotonic() + self.config.shard_timeout_s,
+                 "sub", sid, k, aid)
+        heapq.heappush(self._deadlines, entry)
+        if self._deadlines[0] is entry:
+            self._deadline_cv.notify()
+
+    def _maybe_resplit(self, wid: str, now: float) -> Optional[tuple]:
+        """Dynamic re-split — the elastic alternative to a whole-range
+        backup: when a shard's single live attempt trips the same
+        percentile-aware silent/slow triggers as ``_maybe_backup``, cut
+        the REMAINDER of its range (from the attempt's live reported
+        cursor, newline-aligned) into sub-shards, journal the split,
+        and hand the first sub to this idle worker.  The straggler is
+        NOT cancelled: it keeps racing its own split, and
+        first-commit-wins arbitrates (``commit_shard``).  Returns a
+        dispatchable ``(sid, k)`` or None — None also when the
+        remainder is too small to amortize an engine setup
+        (``spec_resplit_min_bytes``), in which case the caller's backup
+        path still covers the shard.  ONE split level: a sub-shard is
+        never re-split, only re-queued."""
+        from dsi_tpu.mr.shards import split_remaining
+        from dsi_tpu.obs import span
+
+        ref_wall = max(self._commit_walls) if self._commit_walls else None
+        best = None
+        best_age = 0.0
+        best_reason = ""
+        for sid, shard in self._shards.items():
+            if shard["committed"] is not None or shard["subs"] \
+                    or shard["status"] != LOG_IN_PROGRESS:
+                continue
+            live = [(aid, a) for aid, a in shard["attempts"].items()
+                    if not a["dead"] and not a["cancelled"]]
+            if len(live) != 1:
+                continue  # a backup already races it; don't also split
+            aid_f, freshest = live[0]
+            if freshest["worker"] == wid:
+                continue
+            age = now - freshest["last_progress"]
+            total_age = now - freshest["assigned"]
+            h = self._hb_hist.get(freshest["worker"])
+            p99 = h.percentile(0.99) if h is not None and h.count else 0.0
+            thr = max(self.config.spec_k * p99, self.config.spec_floor_s)
+            if not freshest["progressed"]:
+                thr = max(thr, self._setup_grace_s())
+            silent = age > thr
+            slow = (ref_wall is not None and freshest["progressed"]
+                    and total_age > self.config.spec_k * ref_wall)
+            if not (silent or slow):
+                continue
+            if total_age > best_age:
+                best, best_age = (sid, aid_f, freshest), total_age
+                best_reason = "silent" if silent else "slow"
+        if best is None:
+            return None
+        sid, aid_f, freshest = best
+        shard = self._shards[sid]
+        ranges = split_remaining(
+            self.files, shard["spec"], freshest["cursor"],
+            self.config.spec_resplit_ways,
+            self.config.spec_resplit_min_bytes)
+        if ranges is None:
+            return None
+        if self._journal is not None:
+            # Journaled BEFORE any dispatch: a crash between this record
+            # and the first sub assignment replays into exactly this
+            # sub-shard state, never a half-split shard.
+            self._journal.record_resplit(sid, ranges)
+        parent = aid_f if freshest["ckpts"] > 0 else None
+        self._make_subs(sid, ranges, parent_chain=parent)
+        self._spec["resplits"] += 1
+        hb_age, hb_p99, presumed = self._classify(freshest["worker"], now)
+        get_registry().set_gauge("dsi_shard_resplits",
+                                 self._spec["resplits"])
+        with span("resplit", lane="control", task=sid):
+            log_event("resplit_dispatch", kind="shard", task=sid,
+                      straggler_attempt=aid_f,
+                      straggler_worker=freshest["worker"] or None,
+                      reason=best_reason, cursor=freshest["cursor"],
+                      ranges=[[int(s), int(e)] for s, e in ranges],
+                      parent_chain=parent,
+                      attempt_age_s=round(best_age, 3),
+                      heartbeat_age_s=hb_age, heartbeat_p99_s=hb_p99,
+                      presumed=presumed)
+        print(f"coordinator: re-split shard {sid}: attempt a{aid_f} "
+              f"(worker={freshest['worker'] or '?'}) {best_reason} for "
+              f"{best_age:.3f}s presumed={presumed}; cursor="
+              f"{freshest['cursor']} -> {len(ranges)} sub-shards "
+              f"{[(int(s), int(e)) for s, e in ranges]}",
+              file=sys.stderr)
+        return self._pop_untouched_sub()
+
+    def _commit_sub_locked(self, shard: dict, sid: int, k: int,
+                           aid: int, crc: int, wid: str) -> dict:
+        """First-commit-wins for ONE sub-range (caller holds the lock):
+        rename, journal the ``subshard`` record, cancel sub siblings;
+        when this was the last open sub, the shard resolves "split" and
+        the full-range straggler is cancelled."""
+        sub = (shard["subs"] or {}).get(k)
+        if sub is None:
+            return {"Win": False}
+        if shard["committed"] is not None or sub["committed"] is not None:
+            self._spec["commit_losses"] += 1
+            if sub["committed"] is not None \
+                    and sub["committed"][0] == aid:
+                self._spec["duplicate_commits"] += 1
+            log_event("subshard_commit_lose", kind="shard", task=sid,
+                      sub=k, attempt=aid, worker=wid or None)
+            return {"Win": False}
+        part = self._sub_part_path(sid, k, aid)
+        final = self._sub_out_path(sid, k)
+        try:
+            os.replace(part, final)
+            fsync_dir(os.path.dirname(final) or ".")
+        except OSError as e:
+            log_event("shard_commit_missing", kind="shard", task=sid,
+                      sub=k, attempt=aid, error=str(e))
+            return {"Win": False, "Error": f"partial missing: {e}"}
+        if self._journal is not None:
+            self._journal.record_subshard(sid, k, aid, crc)
+        sub["committed"] = (aid, crc)
+        sub["status"] = LOG_COMPLETED
+        self._spec["subshard_commits"] += 1
+        prefix = os.path.basename(final) + ".a"
+        try:
+            for name in os.listdir(os.path.dirname(final) or "."):
+                if name.startswith(prefix) and name.endswith(".part"):
+                    os.remove(os.path.join(
+                        os.path.dirname(final), name))
+        except OSError:
+            pass
+        for oaid, oatt in sub["attempts"].items():
+            if oaid != aid:
+                oatt["cancelled"] = True
+        att = sub["attempts"].get(aid)
+        if att is not None:
+            att["last_progress"] = time.monotonic()
+        resolved = self._split_resolved(shard)
+        if resolved:
+            shard["status"] = LOG_COMPLETED
+            for fatt in shard["attempts"].values():
+                fatt["cancelled"] = True
+        log_event("subshard_commit", kind="shard", task=sid, sub=k,
+                  attempt=aid, crc=crc, worker=wid or None,
+                  resolved=bool(resolved))
+        get_registry().set_gauge("dsi_subshard_commits",
+                                 self._spec["subshard_commits"])
+        return {"Win": True}
+
+    def _requeue_sub_locked(self, sid: int, k: int) -> None:
+        shard = self._shards[sid]
+        sub = (shard["subs"] or {}).get(k)
+        if sub is None or sub["committed"] is not None \
+                or shard["committed"] is not None:
+            return
+        if any(not a["dead"] and not a["cancelled"]
+               for a in sub["attempts"].values()):
+            return
+        if sub["next_aid"] >= self.config.shard_max_attempts:
+            self.job_failed = True
+            log_event("shard_exhausted", kind="shard", task=sid, sub=k,
+                      attempts=sub["next_aid"])
+            print(f"coordinator: shard {sid} sub {k} failed "
+                  f"{sub['next_aid']} attempts; job failed",
+                  file=sys.stderr)
+            return
+        sub["status"] = LOG_UNTOUCHED
+        heapq.heappush(self._sub_ready, (sid, k))
+        self._spec["requeues"] += 1
+        get_registry().set_gauge("dsi_shard_requeues",
+                                 self._spec["requeues"])
+
+    def _expire_sub_attempt(self, sid: int, k: int, aid: int,
+                            now: float) -> None:
+        """The sub-shard twin of :meth:`_expire_shard_attempt`: re-arm
+        while the sub attempt keeps progressing, else presume it dead
+        and re-queue the sub-range."""
+        shard = self._shards.get(sid)
+        sub = (shard["subs"] or {}).get(k) if shard is not None else None
+        att = sub["attempts"].get(aid) if sub is not None else None
+        if (att is None or shard["committed"] is not None
+                or sub["committed"] is not None or att["dead"]
+                or att["cancelled"]):
+            return
+        idle = now - att["last_progress"]
+        timeout = self.config.shard_timeout_s
+        if not att["progressed"]:
+            timeout = max(timeout, self._setup_grace_s())
+        if idle < timeout:
+            entry = (att["last_progress"] + timeout, "sub", sid, k, aid)
+            heapq.heappush(self._deadlines, entry)
+            return
+        att["dead"] = True
+        hb_age, hb_p99, presumed = self._classify(att["worker"], now)
+        log_event("requeue", kind="subshard", task=sid, sub=k,
+                  attempt=aid, timeout_s=self.config.shard_timeout_s,
+                  worker=att["worker"] or None, idle_s=round(idle, 3),
+                  heartbeat_age_s=hb_age, heartbeat_p99_s=hb_p99,
+                  presumed=presumed,
+                  reason="no progress past shard_timeout_s")
+        print(f"coordinator: requeue shard {sid} sub {k} attempt "
+              f"a{aid}: no progress for {idle:.3f}s (worker="
+              f"{att['worker'] or '?'} presumed={presumed})",
+              file=sys.stderr)
+        self._requeue_sub_locked(sid, k)
 
     @staticmethod
     def _pop_untouched(ready: list[int], log: list[int]) -> Optional[int]:
@@ -722,6 +1162,10 @@ class Coordinator:
                 if kind == "shard":
                     self._expire_shard_attempt(entry[2], entry[3], now)
                     continue
+                if kind == "sub":
+                    self._expire_sub_attempt(entry[2], entry[3],
+                                             entry[4], now)
+                    continue
                 task_id = entry[2]
                 log = self.map_log if kind == "map" else self.reduce_log
                 if log[task_id] == LOG_IN_PROGRESS:
@@ -771,11 +1215,11 @@ class Coordinator:
             return
         idle = now - att["last_progress"]
         # An attempt that never retired a step is still paying engine
-        # setup (jax init + first compiles): give it the same grace the
-        # backup dispatcher does before presuming it dead.
+        # setup (jax init + first compiles): give it the concurrency-
+        # scaled setup grace before presuming it dead.
         timeout = self.config.shard_timeout_s
         if not att["progressed"]:
-            timeout = max(timeout, self.config.spec_setup_s)
+            timeout = max(timeout, self._setup_grace_s())
         if idle < timeout:
             entry = (att["last_progress"] + timeout, "shard", sid, aid)
             heapq.heappush(self._deadlines, entry)
@@ -826,7 +1270,7 @@ class Coordinator:
         with self.mu:
             if self.shard_plan is not None:
                 return self.job_failed or all(
-                    shard["committed"] is not None
+                    self._shard_resolved(shard)
                     for shard in self._shards.values())
             return self.c_reduce == self.n_reduce
 

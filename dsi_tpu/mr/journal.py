@@ -49,6 +49,15 @@ class Journal:
         #: per committed shard (duplicate records would mean the
         #: first-commit-wins lock failed; replay keeps the FIRST).
         self.shard_commits: dict = {}
+        #: ``{sid: [(start, end), ...]}`` from replay — the sub-range
+        #: geometry of every journaled re-split (written BEFORE the
+        #: sub-shards dispatch, so a restart reconstructs the same
+        #: partition the commits below refer to).
+        self.resplits: dict = {}
+        #: ``{(sid, sub): (attempt, crc32)}`` from replay — exactly one
+        #: entry per committed sub-range (first record wins, same rule
+        #: as :attr:`shard_commits`).
+        self.subshard_commits: dict = {}
         self._fh: Optional[TextIO] = None
         self._trunc_at: Optional[int] = None  # set by replay()
 
@@ -69,6 +78,8 @@ class Journal:
         maps: List[int] = []
         reduces: List[int] = []
         self.shard_commits = {}
+        self.resplits = {}
+        self.subshard_commits = {}
         self._trunc_at: Optional[int] = None
         if not os.path.exists(self.path):
             return maps, reduces
@@ -106,7 +117,8 @@ class Journal:
                 saw_header = True
                 continue
             kind = rec.get("kind")
-            if kind not in ("map", "reduce", "shard"):
+            if kind not in ("map", "reduce", "shard", "resplit",
+                            "subshard"):
                 self._trunc_at = rec_start
                 break
             task = rec.get("task")
@@ -133,6 +145,30 @@ class Journal:
                 # first-commit-wins lock failed — keep the winner.
                 self.shard_commits.setdefault(
                     task, (attempt, int(rec.get("crc", 0) or 0)))
+                continue
+            if kind == "resplit":
+                ranges = rec.get("ranges")
+                ok_ranges = (isinstance(ranges, list) and len(ranges) >= 2
+                             and all(isinstance(r, list) and len(r) == 2
+                                     and all(isinstance(x, int)
+                                             and not isinstance(x, bool)
+                                             and x >= 0 for x in r)
+                                     for r in ranges))
+                if not ok_ranges:
+                    self._trunc_at = rec_start
+                    break
+                # First re-split of a shard wins (there is at most one).
+                self.resplits.setdefault(
+                    task, [(int(s), int(e)) for s, e in ranges])
+                continue
+            if kind == "subshard":
+                attempt, sub = rec.get("attempt"), rec.get("sub")
+                if any(not isinstance(v, int) or isinstance(v, bool)
+                       or v < 0 for v in (attempt, sub)):
+                    self._trunc_at = rec_start
+                    break
+                self.subshard_commits.setdefault(
+                    (task, sub), (attempt, int(rec.get("crc", 0) or 0)))
                 continue
             (maps if kind == "map" else reduces).append(task)
         return maps, reduces
@@ -188,6 +224,24 @@ class Journal:
         durable rename, under the coordinator's lock."""
         if self._fh is not None:
             self._write({"kind": "shard", "task": sid,
+                         "attempt": attempt, "crc": int(crc)})
+
+    def record_resplit(self, sid: int, ranges) -> None:
+        """The re-split dispatch record: the full sub-range geometry,
+        written BEFORE any sub-shard is handed out so a restarted
+        coordinator reconstructs the partition the sub-range commits
+        refer to (a resplit with no commits yet simply re-queues its
+        sub-ranges)."""
+        if self._fh is not None:
+            self._write({"kind": "resplit", "task": sid,
+                         "ranges": [[int(s), int(e)] for s, e in ranges]})
+
+    def record_subshard(self, sid: int, sub: int, attempt: int,
+                        crc: int) -> None:
+        """The exactly-once commit record of ONE sub-range — same
+        rename-then-journal order as :meth:`record_shard`."""
+        if self._fh is not None:
+            self._write({"kind": "subshard", "task": sid, "sub": int(sub),
                          "attempt": attempt, "crc": int(crc)})
 
     def _write(self, rec: dict) -> None:

@@ -170,20 +170,68 @@ def plan_shards(files: Sequence[str], n_shards: int) -> List[ShardSpec]:
             for sid, (s, e) in enumerate(zip(cuts, cuts[1:]))]
 
 
+def split_remaining(files: Sequence[str], spec: ShardSpec, cursor: int,
+                    ways: int, min_bytes: int = 1 << 16
+                    ) -> Optional[List[Tuple[int, int]]]:
+    """Sub-shard geometry for a dynamic re-split: partition
+    ``[spec.start, spec.end)`` into a PREFIX ``[start, b0)`` covering
+    the straggler's confirmed progress (``cursor`` is shard-relative
+    bytes; ``b0`` is the next newline-aligned cut at or past it) plus
+    up to ``ways`` newline-aligned splits of the remainder.  Returns
+    None when the remainder is smaller than ``min_bytes`` (a sub-shard
+    must amortize one engine setup — the caller falls back to a plain
+    backup) or when alignment collapses everything into one range (a
+    giant line: nothing to redistribute).
+
+    The ranges partition the shard exactly: every byte of
+    ``[start, end)`` lands in exactly one sub-range, and every cut sits
+    just after a ``\\n`` of the concatenated stream — the same
+    token/line safety argument as :func:`plan_shards`, so per-sub-range
+    results merge to the whole-shard result."""
+    total = stream_total_bytes(files)
+    base = spec.start + max(0, int(cursor))
+    if base >= spec.end:
+        return None
+    b0 = _align_to_newline(files, base, total) if cursor > 0 \
+        else spec.start
+    if b0 >= spec.end or spec.end - b0 < max(int(min_bytes), 2):
+        return None
+    ways = max(2, int(ways))
+    cuts = [b0]
+    for j in range(1, ways):
+        c = _align_to_newline(files, b0 + j * (spec.end - b0) // ways,
+                              total)
+        if cuts[-1] < c < spec.end:
+            cuts.append(c)
+    cuts.append(spec.end)
+    ranges: List[Tuple[int, int]] = []
+    if b0 > spec.start:
+        ranges.append((spec.start, b0))
+    ranges.extend(zip(cuts, cuts[1:]))
+    return ranges if len(ranges) >= 2 else None
+
+
 # ── cross-attempt checkpoint adoption ──────────────────────────────────
 
 
-def write_attempt_marker(ckpt_dir: str, sid: int, attempt: int) -> None:
+def write_attempt_marker(ckpt_dir: str, sid: int, attempt: int,
+                         tag: Optional[Tuple[int, int]] = None) -> None:
     """Stamp ``ckpt_dir`` as owned by (shard, attempt).  Written through
     the durable path BEFORE the engine's first save, so ownership is
-    never in doubt for a later adoption."""
+    never in doubt for a later adoption.  ``tag`` records the
+    ``input_range`` identity the chain was built under — a sub-shard
+    attempt that adopted its parent straggler's chain carries the
+    PARENT's range tag, and a later takeover must reuse that tag or the
+    engine's identity check would refuse the chain."""
     from dsi_tpu.utils.atomicio import write_bytes_durable
 
     os.makedirs(ckpt_dir, exist_ok=True)
+    body = {"shard": sid, "attempt": attempt}
+    if tag is not None:
+        body["tag"] = [int(tag[0]), int(tag[1])]
     write_bytes_durable(
         os.path.join(ckpt_dir, ATTEMPT_MARKER),
-        json.dumps({"shard": sid, "attempt": attempt},
-                   sort_keys=True).encode("utf-8"))
+        json.dumps(body, sort_keys=True).encode("utf-8"))
 
 
 def read_attempt_marker(ckpt_dir: str) -> Optional[Dict]:

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 import zlib
 from typing import Optional
@@ -56,16 +57,21 @@ def _slow_s() -> float:
 
 
 def _build_step(engine: str, files, spec: sh.ShardSpec, ckpt_dir: str,
-                resume: bool, knobs: dict):
+                resume: bool, knobs: dict, tag=None):
     """Construct the engine step over the shard's block slice.  The
     ``input_range`` identity tag means an adopted chain from any OTHER
     cursor range refuses to restore (range-relative cursors must never
-    cross ranges)."""
+    cross ranges).  ``tag`` overrides the tag WITHOUT changing the read
+    range: a re-split's sub 0 reads its sub-range but adopts its parent
+    straggler's chain under the parent's tag — sound because the
+    parent's confirmed prefix is byte-identical to the sub's stream
+    (the sub range IS the parent's prefix up to the cut)."""
     blocks = sh.shard_blocks(files, spec)
     common = dict(checkpoint_dir=ckpt_dir,
                   checkpoint_every=int(knobs.get("ckpt_every", 32) or 32),
                   resume=resume,
-                  input_range=(spec.start, spec.end),
+                  input_range=(tuple(tag) if tag
+                               else (spec.start, spec.end)),
                   chunk_bytes=int(knobs.get("chunk_bytes", 1 << 20)),
                   depth=knobs.get("depth"),
                   device_accumulate=bool(knobs.get("device_accumulate",
@@ -80,6 +86,15 @@ def _build_step(engine: str, files, spec: sh.ShardSpec, ckpt_dir: str,
 
     return WordcountStep(blocks, n_reduce=int(knobs.get("n_reduce", 10)),
                          **common)
+
+
+def _marker_tag(src_dir: str, default):
+    """The ``input_range`` tag the source chain was built under (its
+    attempt marker records it) — a takeover must restore under the SAME
+    tag or the engine's identity check refuses the chain."""
+    m = sh.read_attempt_marker(src_dir)
+    t = m.get("tag") if m else None
+    return (int(t[0]), int(t[1])) if t else default
 
 
 def _reap_attempt(part_path: str, ckpt_dir: str) -> None:
@@ -99,19 +114,28 @@ def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
     :class:`rpc.CoordinatorGone` through to the caller's loop exit."""
     sid = int(reply["Shard"])
     aid = int(reply["Attempt"])
+    sub = int(reply.get("Sub", -1))
     spec = sh.ShardSpec(sid, int(reply["Start"]), int(reply["End"]))
     files = list(reply["Files"])
     knobs = dict(reply.get("Knobs") or {})
     engine = str(knobs.get("engine", "wordcount"))
     ckpt_root = str(reply["CkptRoot"])
     part_path = str(reply["OutPart"])
-    ckpt_dir = os.path.join(ckpt_root, f"shard-{sid}", f"a{aid}")
     resume_from = reply.get("ResumeFrom")
+    # A sub-shard attempt (re-split) lives under the parent shard's
+    # checkpoint root in its own sub directory: shard-<sid>/s<k>/a<aid>.
     shard_dir = os.path.join(ckpt_root, f"shard-{sid}")
+    if sub >= 0:
+        shard_dir = os.path.join(shard_dir, f"s{sub}")
+    ckpt_dir = os.path.join(shard_dir, f"a{aid}")
+    own_tag = (spec.start, spec.end)
+    tag = own_tag
     resume = False
     if resume_from is not None:
         src = os.path.join(shard_dir, f"a{int(resume_from)}")
         resume = sh.adopt_chain(src, ckpt_dir, sid, aid)
+        if resume:
+            tag = _marker_tag(src, own_tag)
     if not resume and aid > 0:
         # No (usable) hinted chain: scan the sibling attempt dirs — an
         # attempt that checkpointed and died before its next heartbeat
@@ -119,11 +143,24 @@ def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
         src = sh.find_best_chain(shard_dir, exclude_aid=aid)
         if src is not None:
             resume = sh.adopt_chain(src, ckpt_dir, sid, aid)
-    sh.write_attempt_marker(ckpt_dir, sid, aid)
+            if resume:
+                tag = _marker_tag(src, own_tag)
+    parent_chain = reply.get("ParentChain")
+    if not resume and sub >= 0 and parent_chain is not None:
+        # Sub 0 of a re-split: adopt the parent STRAGGLER's chain under
+        # the parent's range tag — the parent's confirmed prefix is
+        # byte-identical to this sub-range's stream.
+        src = os.path.join(ckpt_root, f"shard-{sid}",
+                           f"a{int(parent_chain)}")
+        resume = sh.adopt_chain(src, ckpt_dir, sid, aid)
+        if resume:
+            tag = (int(reply["TagStart"]), int(reply["TagEnd"]))
+    sh.write_attempt_marker(ckpt_dir, sid, aid, tag=tag)
 
     def call(method: str, args: dict):
         args = dict(args)
-        args.update({"WorkerId": worker_id, "Shard": sid, "Attempt": aid})
+        args.update({"WorkerId": worker_id, "Shard": sid,
+                     "Attempt": aid, "Sub": sub})
         return rpc.call(sock, method, args)
 
     def report_failed(reason: str) -> None:
@@ -134,14 +171,63 @@ def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
 
     slow = _slow_s()
     ckpt_secs = float(knobs.get("ckpt_secs", 1.0) or 1.0)
+    # Engine setup (jax init + first compiles) serializes for many
+    # seconds when several workers contend for few cores; BOUNDED
+    # liveness beats cover exactly that window so the watchdog's setup
+    # grace measures real silence, not compile contention — and so the
+    # per-worker heartbeat-gap histogram (the percentile that arms the
+    # backup/re-split silent trigger) is not polluted by one giant
+    # setup gap.  A truly hung setup outlives the cap, goes silent,
+    # and is requeued; run-phase liveness stays progress-based.
+    setup_done = threading.Event()
+
+    def _setup_beats() -> None:
+        cap = time.monotonic() + 4.0 * max(cfg.spec_setup_s, 1.0)
+        while not setup_done.wait(max(cfg.shard_progress_s, 0.05)):
+            if time.monotonic() > cap:
+                return
+            try:
+                call("Coordinator.ShardProgress",
+                     {"Confirmed": 0, "Ckpts": 0, "Cursor": 0,
+                      "ResumeCursor": 0})
+            except Exception:  # noqa: BLE001 — liveness only
+                return
+
+    beater = threading.Thread(target=_setup_beats, daemon=True,
+                              name=f"setup-beat-{sid}.a{aid}")
+    beater.start()
     try:
-        step = _build_step(engine, files, spec, ckpt_dir, resume, knobs)
-    except Exception as e:  # noqa: BLE001 — attempt fails, worker lives
-        report_failed(f"setup: {type(e).__name__}: {e}")
-        _reap_attempt(part_path, ckpt_dir)
-        return
-    restore = step.restore()
-    resume_cursor = int(restore.get("resume_cursor", 0) or 0)
+        try:
+            step = _build_step(engine, files, spec, ckpt_dir, resume,
+                               knobs, tag=tag)
+        except Exception as e:  # noqa: BLE001 — attempt fails, worker lives
+            report_failed(f"setup: {type(e).__name__}: {e}")
+            _reap_attempt(part_path, ckpt_dir)
+            return
+        restore = step.restore()
+        resume_cursor = int(restore.get("resume_cursor", 0) or 0)
+        if resume and resume_cursor > spec.size:
+            # The adopted chain's cursor sits PAST this range's end: the
+            # straggler confirmed more bytes after the split was
+            # computed, so the restored state covers bytes beyond this
+            # sub-range — discard the chain and rebuild fresh
+            # (correctness over reuse).
+            step.abort()
+            sh.reap_attempt_dir(ckpt_dir)
+            tag = own_tag
+            sh.write_attempt_marker(ckpt_dir, sid, aid, tag=tag)
+            try:
+                step = _build_step(engine, files, spec, ckpt_dir, False,
+                                   knobs, tag=tag)
+            except Exception as e:  # noqa: BLE001
+                report_failed(f"setup: {type(e).__name__}: {e}")
+                _reap_attempt(part_path, ckpt_dir)
+                return
+            restore = step.restore()
+            resume_cursor = 0
+    finally:
+        setup_done.set()
+        beater.join(timeout=2.0)
     ckpts = 0
     cancelled = False
     last_ckpt = time.monotonic()
@@ -151,6 +237,7 @@ def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
         # silence from here on means a real stall, not a compile.
         ok, prep = call("Coordinator.ShardProgress",
                         {"Confirmed": 0, "Ckpts": ckpts,
+                         "Cursor": step.cursor,
                          "ResumeCursor": resume_cursor})
         if ok and prep and prep.get("Cancel"):
             cancelled = True
@@ -167,9 +254,13 @@ def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
                 last_ckpt = now
             if now - last_prog >= cfg.shard_progress_s:
                 last_prog = now
+                # The LIVE confirmed-byte cursor rides every heartbeat
+                # (from the first retired step, not only after a
+                # checkpoint) — the re-split trigger cuts from here.
                 ok, prep = call("Coordinator.ShardProgress",
                                 {"Confirmed": step.confirmed,
                                  "Ckpts": ckpts,
+                                 "Cursor": step.cursor,
                                  "ResumeCursor": resume_cursor})
                 if ok and prep and prep.get("Cancel"):
                     cancelled = True
@@ -216,6 +307,24 @@ def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
         sh.reap_attempt_dir(ckpt_dir)
 
 
+def _warm_engine() -> None:
+    """Pay the jax platform init and a first tiny compile BEFORE the
+    first ``RequestShard``: when N cold workers serialize their inits
+    on few cores, a cold start paid INSIDE the assignment window reads
+    as ``shard_timeout_s`` of silence and the watchdog requeues a
+    perfectly healthy attempt (observed: three 1-core workers each
+    taking 7-9s to first heartbeat).  Warming outside the window keeps
+    the watchdog measuring the work, not the toolchain."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        jax.jit(lambda x: (x * x).sum())(
+            jnp.ones((8,), jnp.float32)).block_until_ready()
+    except Exception:  # noqa: BLE001 — warmup is best-effort
+        pass
+
+
 def shard_worker_loop(config: Optional[JobConfig] = None) -> None:
     """The shard worker's pull loop — the ``worker_loop`` shape over
     ``RequestShard``: chaos boundary, request, drive, repeat; exits on
@@ -224,6 +333,7 @@ def shard_worker_loop(config: Optional[JobConfig] = None) -> None:
     sock = cfg.sock()
     worker_id = f"w{os.getpid()}"
     shards_done = 0
+    _warm_engine()
     while True:
         chaos_kill_point("shard")
         try:

@@ -153,6 +153,9 @@ PHASE_KEYS = (
     "decode_s", "ingest_wait_s", "ckpt_compress_s",
     # plan layer (ISSUE 14): per-stage walls + stage-commit writes
     "plan_s", "stage_commit_s",
+    # elastic dataflow (ISSUE 16): wall spent with two adjacent stages
+    # advancing concurrently (seal-driven pipelining)
+    "plan_overlap_s",
 )
 
 #: The canonical counter/gauge keys (module docstring) — previously
@@ -190,6 +193,8 @@ COUNTER_KEYS = (
     "plan_intermediate_bytes", "plan_commit_bytes",
     "plan_relay_buffers", "plan_spilled_bytes", "plan_restored_bytes",
     "plan_resumed_stages", "plan_stage_walls",
+    # elastic dataflow (ISSUE 16): pipelined pair + stage-shard fan-out
+    "plan_pipelined", "plan_stage_shards",
 )
 
 #: THE schema: every key an engine scope may carry, under its unified

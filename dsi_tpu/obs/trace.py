@@ -78,7 +78,7 @@ LANES = (
 SPAN_NAMES = frozenset(LANES) | frozenset((
     "wait", "finish", "drain", "append", "hist_fold", "hist_pull",
     "ckpt_capture", "ckpt_commit", "ckpt_save", "ckpt_restore", "task",
-    "decode", "stage_commit",
+    "decode", "stage_commit", "resplit", "stage_overlap",
 ))
 
 _BUFFER_ENV = "DSI_TRACE_BUFFER_EVENTS"

@@ -933,6 +933,7 @@ def _grep_setup(step, blocks, pattern, mesh, chunk_bytes, depth, aot,
 
     feed = skip_stream(blocks, start_offset) if start_offset else blocks
     step._pipe = pipe
+    step._cursor_ref = ck_cursor
     pipe.begin(lambda: batch_lines(feed, n_dev, chunk_bytes,
                                    pool=pool, offsets=offsets))
     step._host_excs = (_LineTooLong,)

@@ -86,6 +86,9 @@ class EngineStep:
         self._restore_info: dict = {}
         self._on_complete = lambda: None
         self._release = lambda: None
+        #: Live confirmed-cursor ref ({"offset": ...}), attached by
+        #: engines that track a byte cursor; None otherwise.
+        self._cursor_ref = None
 
     # ── hooks subclasses may override ──
 
@@ -107,6 +110,16 @@ class EngineStep:
         """Steps retired through their deferred checks so far (current
         rung for the wave walks)."""
         return self._pipe.finished if self._pipe is not None else 0
+
+    @property
+    def cursor(self) -> int:
+        """Confirmed input-byte cursor: the stream-relative offset just
+        past the last CONFIRMED step's batch, live from the first
+        confirmation (NOT only after a durable checkpoint — a young
+        attempt's progress is visible before its first save).  0 for
+        engines that don't track a byte cursor."""
+        ref = self._cursor_ref
+        return int(ref.get("offset", 0)) if ref else 0
 
     def advance(self) -> bool:
         """One turn of the crank; False when there is nothing left to

@@ -1235,6 +1235,7 @@ def _wordcount_setup(step, blocks, mesh, n_reduce, chunk_bytes,
                         thread_name="dsi-stream-batcher", engine="stream")
 
     step._pipe = pipe
+    step._cursor_ref = ck_cursor
     if device_batches is not None:
         pipe.begin(lambda: iter(device_batches))
     else:

@@ -22,8 +22,10 @@ from dsi_tpu.plan.graph import (
     Plan,
     PlanError,
     Stage,
+    grep_cascade_plan,
     grep_wordcount_plan,
     indexer_join_plan,
+    wordcount_topk_plan,
 )
 from dsi_tpu.plan.driver import (
     PlanHostPath,
@@ -38,7 +40,9 @@ __all__ = [
     "PlanHostPath",
     "PlanResult",
     "Stage",
+    "grep_cascade_plan",
     "grep_wordcount_plan",
     "indexer_join_plan",
     "run_plan",
+    "wordcount_topk_plan",
 ]

@@ -60,16 +60,20 @@ class PlanHostPath(RuntimeError):
 class StageOut:
     """One stage's outputs in the driver context: ``result`` (the
     stage's value), ``relay`` (the outgoing byte relay, grep), and
-    ``handoff`` (exported live services, indexer)."""
+    ``handoff`` (exported live services, indexer).  ``relay_spent``
+    marks a relay consumed INSIDE the producing run (the pipelined
+    handoff): its stage manifest carries no relay image, so a resume
+    may trust it only while the consumer's manifest verifies too."""
 
-    __slots__ = ("result", "relay", "handoff", "resumed")
+    __slots__ = ("result", "relay", "handoff", "resumed", "relay_spent")
 
     def __init__(self, result=None, relay=None, handoff=None,
-                 resumed: bool = False):
+                 resumed: bool = False, relay_spent: bool = False):
         self.result = result
         self.relay = relay
         self.handoff = handoff
         self.resumed = resumed
+        self.relay_spent = relay_spent
 
 
 class PlanResult:
@@ -100,6 +104,78 @@ def _drive(step, i: int):
         if not step.advance():
             break
     return step.close()
+
+
+def _drive_many(steps, i: int):
+    """Round-robin the K shard attempts of stage *i* to completion —
+    one ``advance()`` per live step per pass, so the shards' device
+    work interleaves instead of running serially, with the same
+    per-advance fault point as the single-step path."""
+    live = list(steps)
+    while live:
+        nxt = []
+        for st in live:
+            fault_point(f"plan-stage{i}-advance")
+            if st.advance():
+                nxt.append(st)
+        live = nxt
+    return [st.close() for st in steps]
+
+
+def _merge_grep_results(results):
+    """Sum-merge K shard-grep results: lines/matched/occurrences/hist
+    add exactly (shards partition the line stream at newline cuts);
+    per-shard top-k ranks by SHARD-LOCAL line numbers and is not
+    globally mergeable, so the merged result omits it — the
+    ``mr/shards.merge_grep`` precedent."""
+    from dsi_tpu.parallel.grepstream import GrepStreamResult
+
+    hist = None
+    lines = matched = occurrences = 0
+    for r in results:
+        lines += r.lines
+        matched += r.matched
+        occurrences += r.occurrences
+        hist = (list(r.hist) if hist is None
+                else [a + b for a, b in zip(hist, r.hist)])
+    return GrepStreamResult(lines, matched, occurrences,
+                            tuple(hist or ()), ())
+
+
+def _merge_counts(results):
+    """Sum-merge K shard-wordcount results ``{word: (count, part)}``:
+    counts add (token-safe cuts), the partition is a pure function of
+    the word so any shard's value is THE value."""
+    total: Dict = {}
+    for res in results:
+        for w, (c, part) in res.items():
+            prev = total.get(w)
+            total[w] = (c + prev[0] if prev else c, part)
+    return total
+
+
+def _shard_specs(plan: Plan, stage: Stage, stage_shards: int):
+    """The stage's shard plan, or None when sharding doesn't apply: K<2,
+    a non-source stage (its input is an upstream relay, not a byte
+    range), or a ``data`` source (``plan_shards`` geometry is
+    file-backed).  Uses the SAME newline-aligned splitter as the shard
+    scheduler — one geometry, one safety argument."""
+    if stage_shards <= 1 or stage.deps:
+        return None
+    paths = plan.param(stage, "paths")
+    if not paths:
+        return None
+    from dsi_tpu.mr.shards import plan_shards
+
+    specs = plan_shards(list(paths), stage_shards)
+    return specs if len(specs) > 1 else None
+
+
+def _spec_blocks(plan: Plan, stage: Stage, spec):
+    from dsi_tpu.mr.shards import read_stream_range
+
+    return read_stream_range(list(plan.param(stage, "paths")),
+                             spec.start, spec.end)
 
 
 def _stage_store(checkpoint_dir: str, i: int, stage: Stage,
@@ -175,22 +251,37 @@ def _decode_join(arrays: Dict[str, np.ndarray]) -> Dict:
 
 def run_plan(plan: Plan, *, mesh=None, staged: bool = False,
              checkpoint_dir: Optional[str] = None, resume: bool = False,
+             pipelined: bool = False, stage_shards: int = 0,
              stats: Optional[dict] = None) -> PlanResult:
     """Run ``plan`` end to end (module docstring).  ``staged=True`` is
     the host-materialization baseline; results are bit-identical to the
     chained mode by construction.  ``checkpoint_dir`` turns stage
     boundaries into durable commit points; ``resume=True`` skips every
-    stage whose manifest verifies."""
+    stage whose manifest verifies.
+
+    ``pipelined=True`` overlaps a grep→wordcount pair: the wordcount
+    consumes relay buffers as they SEAL, while the grep is still
+    producing (``plan_overlap_s`` attributes the overlapped wall).
+    Chained mode only — staged execution stays strictly sequential and
+    remains the bit-parity oracle.  ``stage_shards=K`` runs a
+    file-backed source stage as K concurrent newline-aligned shard
+    attempts (``mr/shards.plan_shards`` geometry) merged through the
+    deterministic shard codecs."""
     from dsi_tpu.parallel.shuffle import default_mesh
 
     if resume and not checkpoint_dir:
         raise PlanError("resume=True requires checkpoint_dir")
     if mesh is None:
         mesh = default_mesh()
+    pipelined = bool(pipelined) and not staged
+    stage_shards = max(0, int(stage_shards or 0))
     sc = metrics_scope("plan")
     sc.update({"plan_stages": len(plan), "plan_intermediate_bytes": 0,
                "plan_commit_bytes": 0, "plan_resumed_stages": 0,
                "plan_handoff": "host" if staged else "device",
+               "plan_pipelined": int(pipelined),
+               "plan_stage_shards": stage_shards,
+               "plan_overlap_s": 0.0,
                "plan_s": 0.0, "stage_commit_s": 0.0,
                "plan_stage_walls": {}})
     order = plan.ordered()
@@ -208,32 +299,69 @@ def run_plan(plan: Plan, *, mesh=None, staged: bool = False,
                 ctx[stage.name] = _load_commit(plan, stage, meta, arrays,
                                                mesh, staged, sc)
                 completed += 1
+            # A spent-relay manifest (pipelined producer) holds no relay
+            # image: it is only trustworthy while its consumer's
+            # manifest verifies too.  A consumer always sits LATER in
+            # topo order, so a spent producer as the LAST loaded stage
+            # means its consumer is missing — the producer must re-run
+            # as well (resuming it would hand the consumer an empty
+            # relay and silently produce empty counts).
+            while completed > 0 \
+                    and ctx[order[completed - 1].name].relay_spent:
+                del ctx[order[completed - 1].name]
+                completed -= 1
             sc["plan_resumed_stages"] = completed
         else:
             for i, stage in enumerate(order):
                 _stage_store(checkpoint_dir, i, stage, sig,
                              staged).reset()
-    for i, stage in enumerate(order):
-        if i < completed:
+
+    def commit(i: int, stage: Stage, out: StageOut) -> None:
+        with _span("stage_commit", lane="plan", stats=sc,
+                   key="stage_commit_s", stage=stage.name):
+            arrays, meta = _commit_payload(plan, stage, out, staged)
+            store = _stage_store(checkpoint_dir, i, stage, sig, staged)
+            store.save(arrays, meta)
+            sc["plan_commit_bytes"] += store.last_payload_bytes
+        fault_point("post-stage-commit")
+
+    i = completed
+    while i < len(order):
+        stage = order[i]
+        nxt = order[i + 1] if i + 1 < len(order) else None
+        if (pipelined and stage.kind == "grep" and not stage.deps
+                and nxt is not None and nxt.kind == "wordcount"
+                and list(nxt.deps) == [stage.name]):
+            # The fused pair: both stages run interleaved; commits land
+            # afterwards, in plan order, with the grep manifest marked
+            # relay-spent (its buffers were consumed in flight).
+            t0 = time.perf_counter()
+            g_out, w_out, g_wall = _run_pipelined_pair(
+                plan, i, stage, nxt, mesh, sc, stage_shards)
+            ctx[stage.name] = g_out
+            ctx[nxt.name] = w_out
+            sc["plan_stage_walls"][stage.name] = round(g_wall, 4)
+            sc["plan_stage_walls"][nxt.name] = round(
+                time.perf_counter() - t0, 4)
+            if checkpoint_dir:
+                commit(i, stage, g_out)
+                commit(i + 1, nxt, w_out)
+            i += 2
             continue
         t0 = time.perf_counter()
         with _span("plan", stats=sc, key="plan_s", stage=stage.name,
                    kind=stage.kind):
-            out = _run_stage(plan, i, stage, ctx, mesh, staged, sc)
+            out = _run_stage(plan, i, stage, ctx, mesh, staged, sc,
+                             stage_shards)
         ctx[stage.name] = out
         sc["plan_stage_walls"][stage.name] = round(
             time.perf_counter() - t0, 4)
         if checkpoint_dir:
-            with _span("stage_commit", lane="plan", stats=sc,
-                       key="stage_commit_s", stage=stage.name):
-                arrays, meta = _commit_payload(plan, stage, out, staged)
-                store = _stage_store(checkpoint_dir, i, stage, sig,
-                                     staged)
-                store.save(arrays, meta)
-                sc["plan_commit_bytes"] += store.last_payload_bytes
-            fault_point("post-stage-commit")
+            commit(i, stage, out)
+        i += 1
     sc["plan_s"] = round(sc["plan_s"], 4)
     sc["stage_commit_s"] = round(sc["stage_commit_s"], 4)
+    sc["plan_overlap_s"] = round(sc["plan_overlap_s"], 4)
     if stats is not None:
         stats.update(sc)
     results = {name: out.result for name, out in ctx.items()}
@@ -264,26 +392,164 @@ def _source_blocks(plan: Plan, stage: Stage):
     raise PlanError(f"stage {stage.name!r} has neither paths nor data")
 
 
+class _RelayFeed:
+    """Queue-backed ``device_batches`` iterable for the pipelined
+    handoff: the driver ``put``s each buffer the moment the producing
+    relay seals it, and the consuming wordcount's batch feed blocks on
+    the queue instead of on a materialized list.  The driver only
+    advances the consumer while fed-but-unconsumed buffers remain
+    (one pump dispatches exactly one item — ``pipeline.StepPipeline``
+    invariant), so the feed never deadlocks."""
+
+    _DONE = object()
+
+    def __init__(self):
+        import queue
+
+        self._q = queue.Queue()
+
+    def put(self, buf) -> None:
+        self._q.put(buf)
+
+    def close(self) -> None:
+        self._q.put(self._DONE)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                return
+            yield item
+
+
+def _grep_steps(plan: Plan, stage: Stage, relay, mesh, kw,
+                stage_shards: int, ctx: Optional[Dict] = None):
+    """The stage's grep step(s): K shard steps over newline-aligned
+    byte ranges when sharding applies, else one step over the whole
+    source (or the upstream relay's line stream — the cascade)."""
+    from dsi_tpu.parallel.grepstream import GrepStep
+
+    pattern = plan.param(stage, "pattern")
+    topk = int(plan.param(stage, "topk", 16))
+    if stage.deps:
+        up = ctx[stage.deps[0]]
+        src = (up.relay.blocks() if hasattr(up.relay, "blocks")
+               else up.relay.host_blocks())
+        return [GrepStep(src, pattern, mesh=mesh, topk=topk,
+                         line_sink=relay, **kw)], False
+    specs = _shard_specs(plan, stage, stage_shards)
+    if specs is None:
+        return [GrepStep(_source_blocks(plan, stage), pattern, mesh=mesh,
+                         topk=topk, line_sink=relay, **kw)], False
+    return [GrepStep(_spec_blocks(plan, stage, spec), pattern, mesh=mesh,
+                     topk=topk, line_sink=relay, **kw)
+            for spec in specs], True
+
+
+def _run_pipelined_pair(plan: Plan, i: int, g_stage: Stage,
+                        wc_stage: Stage, mesh, sc: dict,
+                        stage_shards: int):
+    """The fused grep→wordcount pair: the wordcount consumes relay
+    buffers as they SEAL while the grep(s) keep producing.  The
+    consumer is only advanced while fed-but-unconsumed buffers exist,
+    so the interleave can never block on an empty feed; wall spent in
+    consumer advances BEFORE the producer finishes is the overlap the
+    pipelining bought (``plan_overlap_s``, ``stage_overlap`` spans)."""
+    from dsi_tpu.device.relay import DeviceRelay
+    from dsi_tpu.parallel.streaming import WordcountStep
+
+    kw = _engine_kw(plan, g_stage)
+    relay = DeviceRelay(mesh, cap=kw["chunk_bytes"], aot=kw["aot"],
+                        stats=sc, spill_bytes=_spill_bytes(plan))
+    gsteps, sharded = _grep_steps(plan, g_stage, relay, mesh, kw,
+                                  stage_shards)
+    wkw = _engine_kw(plan, wc_stage)
+    feed = _RelayFeed()
+    wc = WordcountStep([], mesh=mesh,
+                       n_reduce=int(plan.param(wc_stage, "n_reduce", 10)),
+                       u_cap=int(plan.param(wc_stage, "u_cap", 1 << 12)),
+                       device_batches=feed, **wkw)
+    fed = consumed = 0
+    wc_live = True
+    t0 = time.perf_counter()
+    with _span("plan", stats=sc, key="plan_s", stage=g_stage.name,
+               kind="grep"):
+        live = list(gsteps)
+        while live:
+            nxt = []
+            for st in live:
+                fault_point(f"plan-stage{i}-advance")
+                if st.advance():
+                    nxt.append(st)
+            live = nxt
+            for buf in relay.take_sealed():
+                feed.put(buf)
+                fed += 1
+            if wc_live and consumed < fed:
+                with _span("stage_overlap", lane="plan", stats=sc,
+                           key="plan_overlap_s", stage=wc_stage.name):
+                    while wc_live and consumed < fed:
+                        fault_point(f"plan-stage{i + 1}-advance")
+                        wc_live = wc.advance()
+                        consumed += 1
+        g_results = [st.close() for st in gsteps]
+    g_wall = time.perf_counter() - t0
+    if any(r is None for r in g_results):
+        feed.close()
+        wc.abort()
+        raise PlanHostPath(f"stage {g_stage.name!r}: grep needs the "
+                           f"host path (non-literal pattern or "
+                           f"over-wide line)")
+    g_res = (_merge_grep_results(g_results) if sharded
+             else g_results[0])
+    relay.finish()
+    for buf in relay.take_sealed():
+        feed.put(buf)
+        fed += 1
+    feed.close()
+    with _span("plan", stats=sc, key="plan_s", stage=wc_stage.name,
+               kind="wordcount"):
+        while wc_live:
+            fault_point(f"plan-stage{i + 1}-advance")
+            wc_live = wc.advance()
+        w_res = wc.close()
+    if w_res is None:
+        raise PlanHostPath(f"stage {wc_stage.name!r}: wordcount needs "
+                           f"the host path (non-ASCII or >64-byte "
+                           f"word)")
+    return (StageOut(result=g_res, relay=relay, relay_spent=True),
+            StageOut(result=w_res), g_wall)
+
+
 def _run_stage(plan: Plan, i: int, stage: Stage, ctx: Dict, mesh,
-               staged: bool, sc: dict) -> StageOut:
+               staged: bool, sc: dict, stage_shards: int = 0) -> StageOut:
     kw = _engine_kw(plan, stage)
     if stage.kind == "grep":
         from dsi_tpu.device.relay import DeviceRelay, HostRelay
-        from dsi_tpu.parallel.grepstream import GrepStep
 
         relay = (HostRelay(stats=sc) if staged
                  else DeviceRelay(mesh, cap=kw["chunk_bytes"],
                                   aot=kw["aot"], stats=sc,
                                   spill_bytes=_spill_bytes(plan)))
-        step = GrepStep(_source_blocks(plan, stage),
-                        plan.param(stage, "pattern"), mesh=mesh,
-                        topk=int(plan.param(stage, "topk", 16)),
-                        line_sink=relay, **kw)
-        res = _drive(step, i)
-        if res is None:
+        steps, sharded = _grep_steps(plan, stage, relay, mesh, kw,
+                                     stage_shards, ctx)
+        results = _drive_many(steps, i) if sharded \
+            else [_drive(steps[0], i)]
+        if any(r is None for r in results):
             raise PlanHostPath(f"stage {stage.name!r}: grep needs the "
                                f"host path (non-literal pattern or "
                                f"over-wide line)")
+        if sharded:
+            res = _merge_grep_results(results)
+        else:
+            res = results[0]
+            if stage.deps:
+                # A cascade stage's line numbers follow the relay's
+                # buffer order, which legitimately differs between the
+                # two handoff modes — drop the (line_no, occ) ranks so
+                # staged and chained results stay bit-comparable, the
+                # merge_grep precedent.
+                res = res._replace(topk=())
         return StageOut(result=res, relay=relay)
 
     if stage.kind == "wordcount":
@@ -300,15 +566,38 @@ def _run_stage(plan: Plan, i: int, stage: Stage, ctx: Dict, mesh,
                 step = WordcountStep([], mesh=mesh,
                                      device_batches=up.relay.batches(),
                                      **wc_kw)
-        else:  # a source wordcount (no upstream): plain stream
-            step = WordcountStep(_source_blocks(plan, stage), mesh=mesh,
-                                 **wc_kw)
-        res = _drive(step, i)
-        if res is None:
+            res = _drive(step, i)
+            if res is None:
+                raise PlanHostPath(f"stage {stage.name!r}: wordcount "
+                                   f"needs the host path (non-ASCII or "
+                                   f">64-byte word)")
+            return StageOut(result=res)
+        # A source wordcount (no upstream): plain stream, K shard
+        # attempts when sharding applies.
+        specs = _shard_specs(plan, stage, stage_shards)
+        if specs is None:
+            steps = [WordcountStep(_source_blocks(plan, stage),
+                                   mesh=mesh, **wc_kw)]
+        else:
+            steps = [WordcountStep(_spec_blocks(plan, stage, spec),
+                                   mesh=mesh, **wc_kw)
+                     for spec in specs]
+        results = _drive_many(steps, i) if len(steps) > 1 \
+            else [_drive(steps[0], i)]
+        if any(r is None for r in results):
             raise PlanHostPath(f"stage {stage.name!r}: wordcount needs "
                                f"the host path (non-ASCII or >64-byte "
                                f"word)")
-        return StageOut(result=res)
+        return StageOut(result=results[0] if len(results) == 1
+                        else _merge_counts(results))
+
+    if stage.kind == "top_k":
+        fault_point(f"plan-stage{i}-advance")
+        k = int(plan.param(stage, "topk", 16))
+        counts = ctx[stage.deps[0]].result
+        return StageOut(result=tuple(sorted(
+            ((int(c), w) for w, (c, _p) in counts.items()),
+            key=lambda r: (-r[0], r[1]))[:k]))
 
     if stage.kind == "indexer":
         from dsi_tpu.parallel.grepstream import IndexerStep
@@ -416,15 +705,28 @@ def _commit_payload(plan: Plan, stage: Stage, out: StageOut,
     meta = {"stage": stage.name, "kind": stage.kind}
     if stage.kind == "grep":
         res = out.result
-        arrays = out.relay.capture()
+        if out.relay_spent:
+            # The pipelined producer: its relay was consumed in-flight,
+            # so the manifest carries the scalar result only.  The
+            # paired resume-invalidation in run_plan drops this
+            # manifest whenever its consumer's commit is missing.
+            arrays = {}
+            meta["relay_spent"] = True
+        else:
+            arrays = out.relay.capture()
+            meta["relay_cap"] = int(plan.param(stage, "chunk_bytes",
+                                               1 << 20))
         arrays["g_hist"] = np.array(res.hist, np.int64)
         arrays["g_tot"] = np.array(
             [res.lines, res.matched, res.occurrences], np.int64)
         arrays["g_topk"] = np.array(res.topk, np.int64).reshape(-1, 2)
-        meta["relay_cap"] = int(plan.param(stage, "chunk_bytes", 1 << 20))
         return arrays, meta
     if stage.kind == "wordcount":
         return _encode_counts(out.result), meta
+    if stage.kind == "top_k":
+        arrays = _encode_words([w for _, w in out.result], "t_")
+        arrays["t_df"] = np.array([c for c, _ in out.result], np.int64)
+        return arrays, meta
     if stage.kind == "indexer":
         if staged:
             postings, top = out.result
@@ -477,6 +779,9 @@ def _load_commit(plan: Plan, stage: Stage, meta: Dict, arrays: Dict,
             int(tot[0]), int(tot[1]), int(tot[2]),
             tuple(int(x) for x in arrays["g_hist"]),
             tuple((int(a), int(b)) for a, b in arrays["g_topk"]))
+        if meta.get("relay_spent"):
+            return StageOut(result=res, relay=None, resumed=True,
+                            relay_spent=True)
         if "hbytes" in arrays:
             relay = HostRelay.restore(arrays, stats=sc)
         else:
@@ -485,6 +790,10 @@ def _load_commit(plan: Plan, stage: Stage, meta: Dict, arrays: Dict,
         return StageOut(result=res, relay=relay, resumed=True)
     if stage.kind == "wordcount":
         return StageOut(result=_decode_counts(arrays), resumed=True)
+    if stage.kind == "top_k":
+        top = tuple(zip((int(c) for c in arrays.get("t_df", ())),
+                        _decode_words(arrays, "t_")))
+        return StageOut(result=top, resumed=True)
     if stage.kind == "indexer":
         if staged:
             join_like = _decode_join(arrays)

@@ -24,6 +24,12 @@ Stage kinds (what the driver knows how to run):
   indexer's resident :class:`DeviceTopK` (no drain-to-host).
 * ``postings_join`` — per-term postings lookup for an upstream df_topk's
   terms (selective decode, not the full materialization).
+* ``top_k``         — k highest-count words of an upstream wordcount's
+  result (count desc, word asc) — a host reduction over an
+  already-host value, no engine.
+* A ``grep`` stage MAY itself have a grep dep (the grep→grep cascade):
+  it consumes the upstream relay's line stream instead of a byte
+  source and re-greps it with its own pattern.
 
 A plan is VALIDATED at build time (unique names, known deps, acyclic)
 and serializes to a :meth:`Plan.signature` — the job identity its stage
@@ -39,7 +45,8 @@ import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 #: The stage kinds plan/driver.py can run.
-STAGE_KINDS = ("grep", "wordcount", "indexer", "df_topk", "postings_join")
+STAGE_KINDS = ("grep", "wordcount", "indexer", "df_topk", "postings_join",
+               "top_k")
 
 #: Stage params carrying bulk payloads: identity-hashed, never inlined
 #: into the signature.
@@ -164,6 +171,30 @@ def grep_wordcount_plan(pattern: str, *, paths: Optional[Sequence[str]]
     g = p.add(Stage("grep", "grep", pattern=pattern, paths=paths,
                     data=data))
     p.add(Stage("wc", "wordcount", deps=[g.name]))
+    return p
+
+
+def grep_cascade_plan(pattern1: str, pattern2: str, *,
+                      paths: Optional[Sequence[str]] = None,
+                      data: Optional[bytes] = None, **defaults) -> Plan:
+    """grep → grep: stage 2 re-greps exactly the lines stage 1 matched
+    (a narrowing filter chain — "lines with A, of those, lines with
+    B"), the relay's line stream standing in for the byte source."""
+    p = Plan("grep-grep", **defaults)
+    g1 = p.add(Stage("grep1", "grep", pattern=pattern1, paths=paths,
+                     data=data))
+    p.add(Stage("grep2", "grep", deps=[g1.name], pattern=pattern2))
+    return p
+
+
+def wordcount_topk_plan(k: int = 16, *,
+                        paths: Optional[Sequence[str]] = None,
+                        data: Optional[bytes] = None, **defaults) -> Plan:
+    """wordcount → top-k: stage 2 is a host reduction picking the k
+    highest-count words of the full count table."""
+    p = Plan("wc-topk", **defaults)
+    w = p.add(Stage("wc", "wordcount", paths=paths, data=data))
+    p.add(Stage("topk", "top_k", deps=[w.name], topk=k))
     return p
 
 

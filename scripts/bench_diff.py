@@ -98,6 +98,15 @@ GATES: List[Tuple[str, str, float]] = [
     ("spec_exactly_once", "bool", 0.0),
     ("spec_backup_fired", "higher", 0.90),
     ("spec_resumed", "higher", 0.90),
+    # Elastic dataflow (ISSUE 16): the *_mbps pattern above already
+    # gates plan_pipelined_mbps and spec_resplit_mbps; the re-split
+    # evidence counters regress when they stop happening at all
+    # (1→0 = the trigger or the sub-shard dispatcher went dark — the
+    # spec_backup_fired precedent).  plan_overlap_s stays info-only:
+    # more overlap is better only relative to the stage walls, and the
+    # pipelined throughput gate already owns that trade.
+    ("spec_resplits", "higher", 0.90),
+    ("spec_subshards", "higher", 0.90),
 ]
 
 

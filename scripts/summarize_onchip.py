@@ -80,11 +80,18 @@ def _bench_line(path: str) -> str:
             "plan_mb", "plan_chained_mbps", "plan_staged_mbps",
             "plan_intermediate_bytes", "plan_staged_intermediate_bytes",
             "plan_zero_copy", "plan_parity",
+            # The elastic pipelined arm (ISSUE 16): stage-overlap
+            # execution of the same chain, with the attributed
+            # overlap wall.
+            "plan_pipelined_mbps", "plan_overlap_s",
             # The speculative-execution A/B (ISSUE 15): backup dispatch
             # against an injected slow shard, first-commit-wins gated.
             "spec_mb", "spec_backup_mbps", "spec_nobackup_mbps",
             "spec_backup_fired", "spec_duplicate_commits",
             "spec_exactly_once", "spec_resumed", "spec_parity",
+            # The dynamic re-split arm (ISSUE 16): the straggler's
+            # remaining range split across idle workers.
+            "spec_resplit_mbps", "spec_resplits", "spec_subshards",
             "tpu_error")
     parts = [f"{k}={d[k]}" for k in keys if k in d]
     phases = d.get("phases")

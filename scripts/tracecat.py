@@ -358,6 +358,54 @@ def plan(events, metas, out) -> bool:
     return True
 
 
+def elastic(events, metas, out) -> bool:
+    """Elastic dataflow (ISSUE 16): the seal-driven stage-overlap wall
+    (``stage_overlap`` spans + ``plan_overlap_s``) and the dynamic
+    re-split control events — which shard split, at what cursor, into
+    which sub-ranges, and how each sub-range race resolved."""
+    tot = _span_totals(events, ("stage_overlap", "resplit"))
+    rows = []
+    for meta in metas:
+        engines = (meta.get("registry") or {}).get("engines") or {}
+        ph = engines.get("plan") or {}
+        kv = {k: ph[k] for k in ("plan_pipelined", "plan_stage_shards",
+                                 "plan_overlap_s") if k in ph}
+        if kv.get("plan_pipelined") or kv.get("plan_stage_shards"):
+            rows.append((meta.get("_file", "?"), kv))
+    splits = [e for e in events if e.get("ph") == "I"
+              and e.get("name") == "resplit_dispatch"]
+    subs = {}
+    for e in events:
+        if e.get("ph") == "I" and e.get("name") in ("subshard_commit",
+                                                    "subshard_commit_lose"):
+            key = (e.get("task"), e.get("sub"))
+            subs.setdefault(key, []).append(e)
+    if not (tot or rows or splits or subs):
+        return False
+    if "stage_overlap" in tot:
+        t, n = tot["stage_overlap"]
+        print(f"  {'stage_overlap':<20} total={t:.3f}s count={n}",
+              file=out)
+    for fname, kv in rows:
+        print(f"  plan [{fname}]: " + " ".join(
+            f"{k}={v}" for k, v in kv.items()), file=out)
+    for e in splits:
+        print(f"  resplit shard {e.get('task')} @ {e.get('ts', 0):.3f}s"
+              f" reason={e.get('reason')} cursor={e.get('cursor')}"
+              f" straggler=a{e.get('straggler_attempt')}"
+              f" ranges={e.get('ranges')}", file=out)
+    for (task, sub), es in sorted(subs.items(),
+                                  key=lambda kv: (str(kv[0][0]),
+                                                  str(kv[0][1]))):
+        wins = sum(1 for e in es if e["name"] == "subshard_commit")
+        loses = len(es) - wins
+        resolved = any(e.get("resolved") for e in es)
+        print(f"  sub {task}.s{sub}: commits={wins} losses={loses}"
+              + (" [shard resolved split]" if resolved else ""),
+              file=out)
+    return True
+
+
 def histograms(metas, out) -> bool:
     """The stage latency percentile table (obs/hist.py) embedded in
     each trace's registry snapshot."""
@@ -439,6 +487,8 @@ def main(argv=None) -> int:
                        lambda o: wire(events, metas, o)),
                       ("plan layer",
                        lambda o: plan(events, metas, o)),
+                      ("elastic dataflow",
+                       lambda o: elastic(events, metas, o)),
                       ("stage latency histograms",
                        lambda o: histograms(metas, o))):
         buf = io.StringIO()

@@ -188,6 +188,11 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["plan_intermediate_bytes"] == 0
         assert v["plan_staged_intermediate_bytes"] > 0
         assert v["plan_staged_mbps"] > 0
+        # The elastic pipelined arm (ISSUE 16) rides the measured plan
+        # row: same chain run with stage overlap, parity-gated against
+        # the same staged oracle, plus the attributed overlap wall.
+        assert v["plan_pipelined_mbps"] > 0
+        assert v["plan_overlap_s"] >= 0
     # The speculative-execution A/B row (ISSUE 15): measured XOR
     # skipped; a measured row carries both arms' throughput, the
     # backup-fired evidence, and the zero-duplicate-commit invariant
@@ -199,6 +204,16 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["spec_backup_fired"] >= 1
         assert v["spec_duplicate_commits"] == 0
         assert v["spec_exactly_once"] is True
+        # The dynamic re-split arm (ISSUE 16) rides the measured spec
+        # row under its own measured-XOR-skipped gate (the trigger is
+        # load-dependent; a no-fire run skips honestly).  A measured
+        # arm carries the dispatch evidence, and its duplicate commits
+        # are already folded into spec_duplicate_commits above.
+        assert ("spec_resplit_skipped" in v) != ("spec_resplit_mbps"
+                                                 in v)
+        if "spec_resplit_mbps" in v:
+            assert v["spec_resplits"] >= 1
+            assert v["spec_subshards"] >= 2
 
 
 def test_engine_phase_dicts_come_from_the_registry(tmp_path):

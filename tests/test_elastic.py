@@ -1,0 +1,518 @@
+"""Elastic-dataflow tests (ISSUE 16).
+
+What they pin, per the elastic-executor contract:
+
+* ``split_remaining`` sub-shard geometry: the ranges partition the
+  shard's cursor range EXACTLY, every cut sits just after a ``\\n`` of
+  the concatenated stream (the ``plan_shards`` token/line safety
+  argument), the straggler's confirmed prefix becomes sub 0, and the
+  PR-15 separator-at-range-end regression holds on sub-ranges too;
+* the forced re-split state machine, driven through the coordinator's
+  RPC handlers with no jax: trigger → journaled split → sub dispatch →
+  per-sub first-commit-wins → shard resolves "split" (or the straggler
+  outruns its own split and the subs are reaped) — duplicate commits
+  stay 0 throughout, and the whole split state survives a journal
+  replay;
+* the pipelined plan executor: grep→wordcount overlap × stage-shards ×
+  mesh stays bit-identical to the staged oracle, attributes a nonzero
+  overlap wall, and crash-resumes from a fault injected mid-overlap;
+* the two new stage kinds (grep→grep cascade, wordcount→top-k) match
+  their staged twins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+import pytest
+
+from dsi_tpu.config import JobConfig
+from dsi_tpu.mr import shards as sh
+from dsi_tpu.mr.coordinator import Coordinator
+from dsi_tpu.mr.types import TaskStatus
+
+
+def write_corpus(path, lines=200, words=12, vocab=37):
+    rows = []
+    for i in range(lines):
+        rows.append(" ".join(
+            "w" + chr(ord("a") + (i * words + j) % vocab) * 3
+            for j in range(words)))
+    data = ("\n".join(rows) + "\n").encode()
+    with open(path, "wb") as f:
+        f.write(data)
+    return data
+
+
+# ── sub-shard geometry (pure functions, no jax) ───────────────────────
+
+
+def _assert_partition(files, spec, ranges):
+    """Ranges cover [spec.start, spec.end) exactly, in order, and every
+    interior cut sits just after a newline of the concatenated stream."""
+    total = sh.stream_total_bytes(files)
+    whole = b"".join(sh.read_stream_range(files, 0, total))
+    assert ranges[0][0] == spec.start
+    assert ranges[-1][1] == spec.end
+    for (s0, e0), (s1, e1) in zip(ranges, ranges[1:]):
+        assert e0 == s1
+        assert whole[s1 - 1:s1] == b"\n"  # token/line-safe cut
+    got = b"".join(b"".join(sh.read_stream_range(files, s, e))
+                   for s, e in ranges)
+    assert got == whole[spec.start:spec.end]
+
+
+def test_split_remaining_partitions_exactly(tmp_path):
+    p1 = str(tmp_path / "a.txt")
+    p2 = str(tmp_path / "b.txt")
+    write_corpus(p1, lines=60)
+    write_corpus(p2, lines=41)
+    files = [p1, p2]
+    spec = sh.plan_shards(files, 2)[1]  # nonzero start
+    for cursor in (0, 1, 97, spec.size // 2):
+        ranges = sh.split_remaining(files, spec, cursor, ways=3,
+                                    min_bytes=64)
+        assert ranges is not None, cursor
+        _assert_partition(files, spec, ranges)
+        # prefix sub iff the straggler had confirmed progress that
+        # aligned past the shard start
+        if cursor == 0:
+            assert ranges[0] == (spec.start, ranges[0][1])
+            assert len(ranges) == 3
+        else:
+            b0 = ranges[0][1] if ranges[0][0] == spec.start else None
+            assert b0 is not None and b0 >= spec.start + cursor
+
+
+def test_split_remaining_newline_alignment_at_split_point(tmp_path):
+    p = str(tmp_path / "c.txt")
+    write_corpus(p, lines=80)
+    spec = sh.plan_shards([p], 1)[0]
+    data = open(p, "rb").read()
+    # a cursor in the middle of a line: the prefix boundary must be
+    # pushed forward to just past the NEXT newline, never mid-token
+    cursor = data.index(b"\n") + 5
+    ranges = sh.split_remaining([p], spec, cursor, ways=2, min_bytes=64)
+    assert ranges is not None
+    b0 = ranges[0][1]
+    assert b0 > cursor
+    assert data[b0 - 1:b0] == b"\n"
+    _assert_partition([p], spec, ranges)
+
+
+def test_split_remaining_refusals(tmp_path):
+    p = str(tmp_path / "d.txt")
+    write_corpus(p, lines=40)
+    spec = sh.plan_shards([p], 1)[0]
+    # cursor at / past the end: nothing left to redistribute
+    assert sh.split_remaining([p], spec, spec.size, 2, 64) is None
+    assert sh.split_remaining([p], spec, spec.size + 99, 2, 64) is None
+    # remainder under the amortization floor falls back to a backup
+    assert sh.split_remaining([p], spec, 0, 2,
+                              min_bytes=spec.size + 1) is None
+    # a giant single line collapses every cut: nothing to split
+    g = str(tmp_path / "giant.txt")
+    with open(g, "wb") as f:
+        f.write(b"x" * 4000 + b"\n")
+    gspec = sh.plan_shards([g], 1)[0]
+    assert sh.split_remaining([g], gspec, 0, 4, min_bytes=2) is None
+
+
+def test_subrange_separator_at_range_end_regression(tmp_path):
+    # The PR-15 regression re-run on SUB-ranges: a sub-range boundary
+    # landing on the inter-file separator byte must keep the slice
+    # byte-exact.  Exhaustive over every cursor of a tiny two-file
+    # stream: whenever a split applies, the sub-slices reassemble the
+    # remainder exactly — separator bytes included.
+    p1 = str(tmp_path / "a.txt")
+    p2 = str(tmp_path / "b.txt")
+    with open(p1, "wb") as f:
+        f.write(b"hello\n")
+    with open(p2, "wb") as f:
+        f.write(b"world\n")
+    files = [p1, p2]
+    total = sh.stream_total_bytes(files)
+    whole = b"".join(sh.read_stream_range(files, 0, total))
+    assert whole == b"hello\n\nworld\n"
+    spec = sh.ShardSpec(0, 0, total)
+    for cursor in range(total):
+        ranges = sh.split_remaining(files, spec, cursor, ways=2,
+                                    min_bytes=2)
+        if ranges is None:
+            continue
+        _assert_partition(files, spec, ranges)
+
+
+def test_subrange_wordcount_merge_matches_oracle(tmp_path):
+    # Token safety of the sub-shard cuts, end to end: per-sub-range
+    # counts merge to the whole-shard oracle.
+    p = str(tmp_path / "e.txt")
+    data = write_corpus(p, lines=70)
+    spec = sh.plan_shards([p], 1)[0]
+    ranges = sh.split_remaining([p], spec, 333, ways=3, min_bytes=64)
+    assert ranges is not None and len(ranges) >= 3
+    parts = [sh.format_wordcount_counts(sh.wordcount_host_oracle(
+        sh.read_stream_range([p], s, e))) for s, e in ranges]
+    assert sh.merge_wordcount(parts) == \
+        sh.format_wordcount_counts(sh.wordcount_host_oracle([data]))
+
+
+# ── forced re-split state machine (handlers direct, no jax) ──────────
+
+
+def mk_coord(tmp_path, n_shards=2, journal=True, **cfg_kw):
+    p = str(tmp_path / "in.txt")
+    write_corpus(p, lines=200)
+    plan = sh.plan_shards([p], n_shards)
+    kw = dict(workdir=str(tmp_path), spec_floor_s=0.05,
+              shard_timeout_s=5.0, spec_setup_s=8.0, spec_resplit=True,
+              spec_resplit_ways=2, spec_resplit_min_bytes=64)
+    kw.update(cfg_kw)
+    if journal:
+        kw["journal_path"] = str(tmp_path / "shards.journal")
+    cfg = JobConfig(n_reduce=0, **kw)
+    c = Coordinator([p], 0, cfg, shard_plan=plan,
+                    shard_opts={"knobs": {"engine": "wordcount"}})
+    return c, plan
+
+
+def beat(c, r, confirmed=1, ckpts=0, cursor=0, wid=None):
+    return c.shard_progress({"WorkerId": wid or "wX",
+                             "Shard": r["Shard"], "Attempt": r["Attempt"],
+                             "Sub": r.get("Sub", -1),
+                             "Confirmed": confirmed, "Ckpts": ckpts,
+                             "Cursor": cursor, "ResumeCursor": 0})
+
+
+def commit(c, r, crc=1, payload=b"a 1\n", wid=None):
+    with open(r["OutPart"], "wb") as f:
+        f.write(payload)
+    return c.commit_shard({"WorkerId": wid or "wX", "Shard": r["Shard"],
+                           "Sub": r.get("Sub", -1),
+                           "Attempt": r["Attempt"], "Crc": crc})
+
+
+def force_resplit(c, plan, cursor=600):
+    """Drive the coordinator to a fired re-split: w1 straggles on shard
+    0 with ``cursor`` confirmed bytes and a checkpoint, w2 commits
+    shard 1 then idles into the re-split trigger.  Returns (straggler
+    assignment, first sub assignment)."""
+    r0 = c.request_shard({"WorkerId": "w1"})
+    r1 = c.request_shard({"WorkerId": "w2"})
+    assert {r0["Shard"], r1["Shard"]} == {0, 1}
+    if r0["Shard"] != 0:
+        r0, r1 = r1, r0
+    beat(c, r0, confirmed=3, ckpts=1, cursor=cursor, wid="w1")
+    assert commit(c, r1, wid="w2")["Win"]
+    time.sleep(0.12)  # past the floor: w1 is silent, w2 idles
+    rs = c.request_shard({"WorkerId": "w2"})
+    assert rs["TaskStatus"] == int(TaskStatus.SHARD)
+    assert rs.get("Sub") is not None, rs
+    return r0, rs
+
+
+def test_resplit_fires_and_dispatches_subs(tmp_path):
+    c, plan = mk_coord(tmp_path)
+    try:
+        r0, rs = force_resplit(c, plan, cursor=600)
+        spec = plan[0]
+        # sub 0 is the straggler's confirmed prefix: it adopts the
+        # parent chain and carries the PARENT's range identity tag
+        assert rs["Sub"] == 0
+        assert rs["Start"] == spec.start and rs["End"] > rs["Start"]
+        assert rs["End"] >= spec.start + 600  # newline-aligned past cursor
+        assert (rs["TagStart"], rs["TagEnd"]) == (spec.start, spec.end)
+        assert rs["ParentChain"] == r0["Attempt"]
+        s = c.spec_stats()
+        assert s["resplits"] == 1
+        assert s["backup_dispatches"] == 0  # resplit preempted backup
+        assert s["subshards"] == 3  # prefix + 2-way remainder
+        assert s["subshard_dispatches"] == 1
+        # the remaining subs dispatch to other idle workers, in order,
+        # partitioning the shard exactly
+        ra = c.request_shard({"WorkerId": "w3"})
+        rb = c.request_shard({"WorkerId": "w4"})
+        assert (ra["Sub"], rb["Sub"]) == (1, 2)
+        assert rs["End"] == ra["Start"] and ra["End"] == rb["Start"]
+        assert rb["End"] == spec.end
+        for r in (ra, rb):
+            assert r["ParentChain"] is None
+        # the split was journaled BEFORE dispatch
+        recs = [json.loads(l) for l in
+                open(str(tmp_path / "shards.journal"))]
+        assert any(r.get("kind") == "resplit" and r["task"] == 0
+                   for r in recs)
+    finally:
+        c.close()
+
+
+def test_sub_commits_resolve_split_and_cancel_straggler(tmp_path):
+    c, plan = mk_coord(tmp_path)
+    try:
+        r0, rs = force_resplit(c, plan)
+        ra = c.request_shard({"WorkerId": "w3"})
+        rb = c.request_shard({"WorkerId": "w4"})
+        for i, (r, w) in enumerate(((rs, "w2"), (ra, "w3"))):
+            assert commit(c, r, crc=10 + i, wid=w)["Win"]
+            # split not yet resolved: the straggler keeps racing
+            assert not beat(c, r0, confirmed=4, cursor=700,
+                            wid="w1")["Cancel"]
+        assert commit(c, rb, crc=12, wid="w4")["Win"]
+        # the last sub commit resolved the shard: straggler cancelled,
+        # its late full-range commit loses WITHOUT counting a duplicate
+        assert beat(c, r0, confirmed=5, cursor=800, wid="w1")["Cancel"]
+        assert not commit(c, r0, wid="w1")["Win"]
+        assert c.done()
+        s = c.spec_stats()
+        assert s["split_shards"] == 1 and s["resolved"] == 2
+        assert s["subshard_commits"] == 3
+        assert s["duplicate_commits"] == 0
+        assert s["commit_losses"] == 1
+        # final outputs: sub files in k order, then shard 1's full file
+        outs = c.final_outputs()
+        base = os.path.join(str(tmp_path), "mr-shard-out-0")
+        assert outs == [base + ".s0", base + ".s1", base + ".s2",
+                        os.path.join(str(tmp_path), "mr-shard-out-1")]
+        assert all(os.path.exists(o) for o in outs)
+    finally:
+        c.close()
+
+
+def test_full_range_commit_overruns_open_split(tmp_path):
+    c, plan = mk_coord(tmp_path)
+    try:
+        r0, rs = force_resplit(c, plan)
+        ra = c.request_shard({"WorkerId": "w3"})
+        assert commit(c, rs, wid="w2")["Win"]  # one sub in, split open
+        # the straggler outruns its own split: full-range commit wins
+        # the WHOLE shard while any sub is still uncommitted
+        assert commit(c, r0, crc=77, wid="w1")["Win"]
+        assert c.done()
+        # the losing sub's committed output was reaped — exactly one
+        # committed copy of every byte survives
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "mr-shard-out-0.s0"))
+        assert c.final_outputs() == [
+            os.path.join(str(tmp_path), "mr-shard-out-0"),
+            os.path.join(str(tmp_path), "mr-shard-out-1")]
+        # a late sub commit loses and counts no duplicate
+        assert not commit(c, ra, wid="w3")["Win"]
+        s = c.spec_stats()
+        assert s["duplicate_commits"] == 0
+        assert s["split_shards"] == 0
+        assert s["winning_attempts"]["0"] == r0["Attempt"]
+    finally:
+        c.close()
+
+
+def test_small_remainder_falls_back_to_backup(tmp_path):
+    c, plan = mk_coord(tmp_path, spec_resplit_min_bytes=1 << 30)
+    try:
+        r0 = c.request_shard({"WorkerId": "w1"})
+        r1 = c.request_shard({"WorkerId": "w2"})
+        beat(c, r0, confirmed=3, ckpts=1, cursor=600,
+             wid="w1" if r0["Shard"] == 0 else "w2")
+        beat(c, r1, confirmed=3, ckpts=1, cursor=600,
+             wid="w2" if r0["Shard"] == 0 else "w1")
+        time.sleep(0.12)
+        rb = c.request_shard({"WorkerId": "w3"})
+        # remainder under the split floor: a plain full-range backup
+        # covers the shard instead
+        assert rb["TaskStatus"] == int(TaskStatus.SHARD)
+        assert rb.get("Sub") is None
+        s = c.spec_stats()
+        assert s["resplits"] == 0 and s["subshards"] == 0
+        assert s["backup_dispatches"] == 1
+    finally:
+        c.close()
+
+
+def test_journal_replays_split_state(tmp_path):
+    c, plan = mk_coord(tmp_path)
+    p = c.files[0]
+    try:
+        r0, rs = force_resplit(c, plan)
+        ra = c.request_shard({"WorkerId": "w3"})
+        assert commit(c, rs, crc=5, wid="w2")["Win"]
+    finally:
+        c.close()
+    # a fresh coordinator on the same journal: the split replays as
+    # live sub-shard state — committed sub preserved, the rest (and
+    # NEVER the full range) dispatchable
+    cfg = JobConfig(n_reduce=0, workdir=str(tmp_path),
+                    journal_path=str(tmp_path / "shards.journal"),
+                    spec_resplit=True, spec_resplit_ways=2,
+                    spec_resplit_min_bytes=64)
+    c2 = Coordinator([p], 0, cfg, shard_plan=plan, shard_opts={})
+    try:
+        s = c2.spec_stats()
+        assert s["subshards"] == 3
+        assert s["committed"] == 1  # shard 1's full-range commit
+        assert not c2.done()
+        picks = [c2.request_shard({"WorkerId": f"w{i}"})
+                 for i in range(5, 8)]
+        subs = sorted(r["Sub"] for r in picks
+                      if r["TaskStatus"] == int(TaskStatus.SHARD))
+        assert subs == [1, 2]  # sub 0 replayed committed; no full range
+        for r in picks:
+            if r["TaskStatus"] == int(TaskStatus.SHARD):
+                assert commit(c2, r, wid="wZ")["Win"]
+        assert c2.done()
+        assert c2.spec_stats()["duplicate_commits"] == 0
+    finally:
+        c2.close()
+
+
+# ── pipelined plan executor (jax) ─────────────────────────────────────
+
+
+jax = pytest.importorskip("jax")
+
+from dsi_tpu.ckpt.fault import FaultInjected, reset_faults  # noqa: E402
+from dsi_tpu.parallel.shuffle import default_mesh  # noqa: E402
+from dsi_tpu.plan import (grep_cascade_plan, grep_wordcount_plan,  # noqa: E402
+                          run_plan, wordcount_topk_plan)
+
+MESH = None
+
+
+def mesh():
+    global MESH
+    if MESH is None:
+        MESH = default_mesh(8)
+    return MESH
+
+
+def plan_corpus(n=420):
+    lines = []
+    for i in range(n):
+        if i % 3 == 0:
+            lines.append(f"the quick w{i % 29} fox likes the pond")
+        else:
+            lines.append(f"unrelated filler row{i} content")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def gw_plan(tmp_path, **kw):
+    p = tmp_path / "corpus.txt"
+    if not p.exists():
+        p.write_bytes(plan_corpus())
+    kw.setdefault("chunk_bytes", 1 << 9)
+    return grep_wordcount_plan("the", paths=[str(p)], **kw)
+
+
+@pytest.mark.parametrize("shards,mesh_shards", [
+    (0, None),
+    (3, None),
+    (3, 8),
+])
+def test_pipelined_chain_parity_grid(tmp_path, shards, mesh_shards):
+    kw = dict(mesh_shards=mesh_shards)
+    st_p, st_s = {}, {}
+    pipe = run_plan(gw_plan(tmp_path, **kw), mesh=mesh(),
+                    pipelined=True, stage_shards=shards, stats=st_p)
+    # the oracle twin: strictly sequential staged execution under the
+    # SAME shard geometry (sharded grep merges zero the order-sensitive
+    # topk sample, so parity holds shard-geometry-to-like)
+    staged = run_plan(gw_plan(tmp_path, **kw), mesh=mesh(),
+                      staged=True, stage_shards=shards, stats=st_s)
+    assert pipe.results["grep"] == staged.results["grep"]
+    assert pipe.final == staged.final
+    assert len(pipe.final) > 0
+    assert st_p["plan_pipelined"] == 1
+    assert st_p["plan_stage_shards"] == shards
+    assert st_p["plan_intermediate_bytes"] == 0  # still device-resident
+    # the overlap the pipelining bought is attributed: sealed buffers
+    # were consumed while the producer still ran
+    assert st_p["plan_overlap_s"] > 0
+    assert st_s["plan_pipelined"] == 0
+
+
+def test_pipelined_crash_resume_mid_overlap(tmp_path, monkeypatch):
+    want = run_plan(gw_plan(tmp_path), mesh=mesh()).final
+    ck = str(tmp_path / "ck")
+    # the consumer's 2nd advance happens INSIDE the stage_overlap
+    # window, while the producer is still mid-stream
+    monkeypatch.setenv("DSI_FAULT_POINT", "plan-stage1-advance")
+    monkeypatch.setenv("DSI_FAULT_STEP", "2")
+    monkeypatch.setenv("DSI_FAULT_MODE", "raise")
+    reset_faults()
+    with pytest.raises(FaultInjected):
+        run_plan(gw_plan(tmp_path), mesh=mesh(), pipelined=True,
+                 checkpoint_dir=ck)
+    monkeypatch.delenv("DSI_FAULT_POINT")
+    monkeypatch.delenv("DSI_FAULT_STEP")
+    monkeypatch.delenv("DSI_FAULT_MODE")
+    st: dict = {}
+    res = run_plan(gw_plan(tmp_path), mesh=mesh(), pipelined=True,
+                   checkpoint_dir=ck, resume=True, stats=st)
+    assert res.final == want
+    # nothing usable could have committed mid-overlap: the spent-relay
+    # rule re-runs the producer rather than feeding an empty relay
+    assert st["plan_resumed_stages"] == 0
+
+
+def test_staged_never_pipelines(tmp_path):
+    st: dict = {}
+    run_plan(gw_plan(tmp_path), mesh=mesh(), staged=True,
+             pipelined=True, stats=st)
+    assert st["plan_pipelined"] == 0
+    assert st["plan_overlap_s"] == 0
+
+
+# ── the two new stage kinds ──────────────────────────────────────────
+
+
+def cascade_corpus(n=300):
+    lines = []
+    for i in range(n):
+        if i % 4 == 0:
+            lines.append(f"alpha beta row{i}")   # matches both stages
+        elif i % 4 == 1:
+            lines.append(f"alpha only row{i}")   # first stage only
+        else:
+            lines.append(f"nothing here row{i}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def test_grep_cascade_parity_and_narrowing():
+    data = cascade_corpus()
+    plan = grep_cascade_plan("alpha", "beta", data=data,
+                             chunk_bytes=1 << 9)
+    chained = run_plan(plan, mesh=mesh())
+    staged = run_plan(grep_cascade_plan("alpha", "beta", data=data,
+                                        chunk_bytes=1 << 9),
+                      mesh=mesh(), staged=True)
+    assert chained.results == staged.results
+    g1, g2 = chained.results["grep1"], chained.results["grep2"]
+    assert g1.matched == 150   # every alpha line
+    assert g2.matched == 75    # narrowed to alpha∩beta
+    assert g2.lines == g1.matched  # stage 2 reads ONLY stage-1 matches
+
+
+def test_wordcount_topk_parity_and_order(tmp_path):
+    p = tmp_path / "wc.txt"
+    p.write_bytes(plan_corpus())
+    for shards in (0, 3):
+        plan = wordcount_topk_plan(5, paths=[str(p)],
+                                   chunk_bytes=1 << 9)
+        chained = run_plan(plan, mesh=mesh(), stage_shards=shards)
+        staged = run_plan(wordcount_topk_plan(5, paths=[str(p)],
+                                              chunk_bytes=1 << 9),
+                          mesh=mesh(), staged=True, stage_shards=shards)
+        assert chained.final == staged.final
+        assert len(chained.final) == 5
+        counts = [c for c, _w in chained.final]
+        assert counts == sorted(counts, reverse=True)
+        # deterministic tie-break: (-count, word)
+        assert list(chained.final) == sorted(
+            chained.final, key=lambda r: (-r[0], r[1]))
+        # five words tie at the top (280 each — the alphabetic
+        # tokenizer folds "row123" to "row"); the word tie-break
+        # orders them alphabetically
+        assert list(chained.final) == [
+            (280, "content"), (280, "filler"), (280, "row"),
+            (280, "the"), (280, "unrelated")]
