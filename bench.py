@@ -106,6 +106,14 @@ Environment knobs:
                           vs spec_nobackup_mbps, spec_backup_fired,
                           spec_duplicate_commits (must be 0), each arm
                           parity-gated vs the sequential oracle.
+  DSI_BENCH_NET_MB        size of the network-data-plane A/B row
+                          (default 4; 0 disables): the same multi-file
+                          wordcount with shuffle over localhost TCP and
+                          private per-worker workdirs (mrrun --net) vs
+                          the shared-directory plane — net_shuffle_mbps
+                          vs net_fs_mbps, plus net_ratio (raw/wire
+                          through the line codec) and locality_hits,
+                          each arm parity-gated vs the oracle.
   DSI_BENCH_FRAMEWORK_MB  corpus size for the distributed N-worker row
                           (default 48; 0 disables it; auto-shrunk so its
                           oracle pass costs ~100 s on a slow box, skipped
@@ -1954,6 +1962,110 @@ def run_spec_row() -> dict:
     return row
 
 
+def run_net_row() -> dict:
+    """The network-data-plane A/B (ISSUE 17 satellite): the SAME
+    multi-file wordcount job run twice in fresh ``mrrun`` fleets —
+    shuffle over localhost TCP with per-worker PRIVATE workdirs
+    (``--net``: ``net_shuffle_mbps``) vs the shared-directory data
+    plane (``net_fs_mbps``).  Both arms are parity-gated against the
+    sequential oracle by ``mrrun --check`` (exit 2 = mismatch, row
+    suppressed).  The net arm also reports ``net_ratio`` (raw/wire —
+    the PR-13 line codec's leverage on the shuffle link, gated >= 1.5
+    by the acceptance bar) and ``locality_hits`` (reduce tasks placed
+    on the host already holding their biggest input share).
+    Chip-independent (host-backend CPU workers), measured keys XOR
+    ``net_skipped``.  ``DSI_BENCH_NET_MB`` (default 4; 0 disables)
+    sizes it."""
+    mb = env_float("DSI_BENCH_NET_MB", 4.0)
+    if mb <= 0:
+        return {"net_skipped": "disabled (DSI_BENCH_NET_MB=0)"}
+    budget = env_float("DSI_BENCH_NET_TIMEOUT", 300.0)
+    import shutil
+
+    ndir = os.path.join(WORKDIR, "net-row")
+    shutil.rmtree(ndir, ignore_errors=True)
+    os.makedirs(ndir)
+    # Several input files: multiple map producers spread across the
+    # workers, so the net arm's shuffle really crosses the wire (one
+    # file would let locality placement make every fetch local).
+    n_files = 4
+    paths, total = [], 0
+    for fi in range(n_files):
+        path = os.path.join(ndir, f"corpus-{fi}.txt")
+        with open(path, "w") as f:
+            i = 0
+            written = 0
+            while written < mb * 1e6 / n_files:
+                line = (" ".join(
+                    "net" + chr(ord("a") + (fi + i + j) % 23) * 2
+                    for j in range(9)) + "\n")
+                f.write(line)
+                written += len(line)
+                i += 1
+        total += os.path.getsize(path)
+        paths.append(path)
+    total_mb = total / 1e6
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1-device CPU workers
+    env["DSI_AOT_FRESH"] = "1"
+    # mrrun's children run with cwd=workdir: keep the package importable
+    # there even when it is not installed (the test-sandbox case).
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+
+    def one(mode: str) -> tuple:
+        wd = os.path.join(ndir, mode)
+        os.makedirs(wd, exist_ok=True)
+        sj = os.path.join(ndir, f"{mode}.stats.json")
+        e = dict(env)
+        e["DSI_MR_SOCKET"] = os.path.join(ndir, f"{mode}.sock")
+        cmd = [sys.executable, "-m", "dsi_tpu.cli.mrrun",
+               "--workers", "2", "--nreduce", "4", "--workdir", wd,
+               "--check", "--stats-json", sj]
+        if mode == "net":
+            cmd.append("--net")
+        cmd += ["wc"] + paths
+        t0 = time.perf_counter()
+        r = subprocess.run(cmd, env=e,
+                           cwd=os.path.dirname(os.path.abspath(__file__)),
+                           capture_output=True, text=True,
+                           timeout=budget)
+        dt = time.perf_counter() - t0
+        if r.returncode == 2:
+            raise RuntimeError(f"{mode} arm parity mismatch")
+        if r.returncode != 0:
+            raise RuntimeError(f"{mode} mrrun rc={r.returncode}: "
+                               f"{r.stderr[-300:]}")
+        stats = {}
+        if os.path.exists(sj):
+            with open(sj, encoding="utf-8") as f:
+                stats = json.load(f)
+        return dt, stats
+
+    try:
+        net_s, net = one("net")
+        fs_s, _fs = one("fs")
+    except Exception as e:
+        return {"net_skipped": f"net row failed: "
+                               f"{type(e).__name__}: {e}"}
+    row = {"net_mb": round(total_mb, 2), "net_parity": True,
+           "net_shuffle_mbps": round(total_mb / (net_s or 1e-9), 2),
+           "net_fs_mbps": round(total_mb / (fs_s or 1e-9), 2),
+           "net_ratio": float(net.get("net_ratio", 0.0)),
+           "net_fetches": int(net.get("net_fetches", 0)),
+           "net_local_reads": int(net.get("net_local_reads", 0)),
+           "locality_hits": int(net.get("locality_hits", 0)),
+           "net_refetches": int(net.get("net_refetches", 0))}
+    log(f"net row: {total_mb:.1f} MB over {n_files} files — shuffle/TCP "
+        f"{row['net_shuffle_mbps']} MB/s ({net_s:.2f}s, "
+        f"{row['net_fetches']} fetches + {row['net_local_reads']} "
+        f"local, codec ratio {row['net_ratio']}, "
+        f"{row['locality_hits']} locality hits) vs shared-dir "
+        f"{row['net_fs_mbps']} MB/s ({fs_s:.2f}s)")
+    return row
+
+
 def run_native_oracle_row(files, oracle_out, total_mb, native_ok,
                           fw_oracle_mbps) -> dict:
     """Sequential run of the SAME C++ task bodies the native-backend
@@ -2337,6 +2449,17 @@ def main() -> None:
                                   f"{type(e).__name__}: {e}")
     else:
         fw["spec_skipped"] = f"budget {budget_s:.0f}s < 60s"
+    # The network-data-plane shuffle-over-TCP A/B row (ISSUE 17):
+    # chip-independent (mrrun subprocess fleets on 1-device CPU),
+    # rides every branch.
+    if budget_s >= 60 or "DSI_BENCH_NET_MB" in os.environ:
+        try:
+            fw.update(run_net_row())
+        except Exception as e:
+            fw["net_skipped"] = (f"net row failed: "
+                                 f"{type(e).__name__}: {e}")
+    else:
+        fw["net_skipped"] = f"budget {budget_s:.0f}s < 60s"
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,

@@ -23,7 +23,11 @@ those bugs would hide:
 * ``mid-commit``   — in the commit writer, after the capture
   materialized but before the payload/manifest pair lands (a
   half-written delta or image must LOSE to the previous complete
-  chain — the newest-valid-wins walk's async edge).
+  chain — the newest-valid-wins walk's async edge);
+* ``mid-serve``    — in the partition server, after the FIRST chunk of
+  a streamed fetch hits the socket (the consumer sees a half-sent
+  payload and a dead peer — the network data plane's re-fetch-from-
+  replacement trigger, ISSUE 17).
 
 Knobs (all read per call, so a subprocess inherits them from its env):
 
@@ -55,6 +59,12 @@ FAULT_EXIT = 87
 #: boundary-kill in the same run.
 CHAOS_EXIT = 88
 
+#: The ENGINE-level points — the crash-resume parity grid
+#: (tests/test_checkpoint.py) parametrizes over exactly this tuple, so
+#: points that fire outside an engine run (``mid-serve`` in the
+#: partition server, the plan layer's ``plan-stage<i>-advance``) are
+#: deliberately not listed; they fire by name through
+#: :func:`fault_point` all the same.
 FAULT_POINTS = ("post-dispatch", "mid-fold", "pre-sync", "post-ckpt",
                 "mid-capture", "mid-commit")
 

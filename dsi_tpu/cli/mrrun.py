@@ -51,6 +51,16 @@ def main(argv=None) -> int:
                         "mrrun warns when resuming implicitly without it")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="whole-job wall budget, seconds")
+    p.add_argument("--net", action="store_true",
+                   help="NET data plane (ISSUE 17): per-worker PRIVATE "
+                        "workdirs, worker-served shuffle over localhost "
+                        "TCP, coordinator control plane on TCP — the "
+                        "share-nothing harness (no worker reads any "
+                        "other process's directory)")
+    p.add_argument("--stats-json", default="",
+                   help="dump the coordinator's net_stats() (net mode) "
+                        "— the CI smoke's and bench row's evidence "
+                        "surface")
     p.add_argument("--check", action="store_true",
                    help="run the sequential oracle and verify parity")
     p.add_argument("--trace-dir", default=None,
@@ -70,6 +80,10 @@ def main(argv=None) -> int:
     if os.sep in app or app.endswith(".py"):
         app = os.path.abspath(app)  # workers run with cwd=workdir
     journal = os.path.abspath(args.journal) if args.journal else ""
+    if args.net and journal:
+        p.error("--net does not support --journal (the location "
+                "registry is in-memory; a restarted coordinator cannot "
+                "know where spooled partitions live)")
     if args.resume:
         if not journal:
             p.error("--resume requires --journal")
@@ -108,6 +122,17 @@ def main(argv=None) -> int:
                 os.remove(os.path.join(workdir, name))
             except OSError:
                 pass
+
+    if args.net:
+        rc = _net_job(args, workdir, files, app, env)
+        if args.trace_dir:
+            from dsi_tpu.obs import flush_tracing, trace_event
+
+            trace_event("mrrun.exit", rc=rc, net=1)
+            flush_tracing()
+        if rc != 0:
+            return rc
+        return _parity_check(args, workdir, files) if args.check else 0
 
     # Children run WITH cwd=workdir — the reference's data plane is "the
     # working directory" (mr-X-Y / mr-out-R relative paths), same as the
@@ -223,31 +248,196 @@ def main(argv=None) -> int:
     if rc != 0:
         return rc
     if args.check:
-        from dsi_tpu.mr.plugin import load_plugin
-        from dsi_tpu.mr.sequential import run_sequential
-
-        # Oracle twins: fault-injecting / device apps check against their
-        # deterministic host equivalents (scripts/test_mr.sh:32-43).
-        oracle_app = {"crash": "nocrash", "tpu_wc": "wc",
-                      "tpu_indexer": "indexer",
-                      "tpu_grep": "grep"}.get(args.app, args.app)
-        mapf, reducef = load_plugin(oracle_app)
-        oracle_out = os.path.join(workdir, "mr-correct.txt")
-        run_sequential(mapf, reducef, files, oracle_out)
-        got: list = []
-        for r in range(args.nreduce):
-            path = os.path.join(workdir, f"mr-out-{r}")
-            if os.path.exists(path):
-                with open(path, encoding="utf-8") as f:
-                    got.extend(l for l in f if l.strip())
-        with open(oracle_out, encoding="utf-8") as f:
-            want = sorted(l for l in f if l.strip())
-        if sorted(got) != want:
-            print("mrrun: PARITY FAILURE vs sequential oracle",
-                  file=sys.stderr)
-            return 2
-        print("mrrun: parity OK", file=sys.stderr)
+        return _parity_check(args, workdir, files)
     return 0
+
+
+def _parity_check(args, workdir: str, files: list) -> int:
+    """Run the sequential oracle and byte-compare the merged mr-out-*
+    lines (sort mr-out-* | grep ., test-mr.sh:52-53)."""
+    from dsi_tpu.mr.plugin import load_plugin
+    from dsi_tpu.mr.sequential import run_sequential
+
+    # Oracle twins: fault-injecting / device apps check against their
+    # deterministic host equivalents (scripts/test_mr.sh:32-43).
+    oracle_app = {"crash": "nocrash", "tpu_wc": "wc",
+                  "tpu_indexer": "indexer",
+                  "tpu_grep": "grep"}.get(args.app, args.app)
+    mapf, reducef = load_plugin(oracle_app)
+    oracle_out = os.path.join(workdir, "mr-correct.txt")
+    run_sequential(mapf, reducef, files, oracle_out)
+    got: list = []
+    for r in range(args.nreduce):
+        path = os.path.join(workdir, f"mr-out-{r}")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                got.extend(l for l in f if l.strip())
+    with open(oracle_out, encoding="utf-8") as f:
+        want = sorted(l for l in f if l.strip())
+    if sorted(got) != want:
+        print("mrrun: PARITY FAILURE vs sequential oracle",
+              file=sys.stderr)
+        return 2
+    print("mrrun: parity OK", file=sys.stderr)
+    return 0
+
+
+def _net_job(args, workdir: str, files: list, app: str,
+             env: dict) -> int:
+    """The share-nothing job (``--net``): coordinator in-process on
+    localhost TCP, each worker in its own PRIVATE workdir serving its
+    spool over a partition server, the shuffle and the final output
+    collection both over the stream transport.
+
+    The driver fetches each ``mr-out-<r>`` the moment its completion
+    registers a location, verifying the completion CRC; a dead server
+    at THAT stage triggers ``refetch_reduce`` (the reduce re-executes
+    on a fresh worker — lingering workers left the task loop, so one is
+    spawned) and, transitively, ``Coordinator.FetchFailed`` re-executes
+    any lost producers.  Exit asserts share-nothing really held: the
+    shared workdir carries only driver-written outputs."""
+    import shutil
+    import zlib
+
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr.coordinator import Coordinator
+    from dsi_tpu.net.fetch import FetchFailure, fetch_partition
+    from dsi_tpu.utils.atomicio import atomic_write
+
+    cfg = JobConfig(n_reduce=args.nreduce, workdir=workdir,
+                    socket_path="tcp:127.0.0.1:0",
+                    task_timeout_s=args.task_timeout,
+                    net_shuffle=True)
+    coord = Coordinator(files, args.nreduce, cfg)
+    coord.serve()
+    env = dict(env)
+    env["DSI_MR_SOCKET"] = coord.address()
+    # Workers run with cwd=their private dir; make the package
+    # importable there even when not installed (the test-sandbox case).
+    import dsi_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    worker_cmd = [sys.executable, "-m", "dsi_tpu.cli.mrworker",
+                  "--backend", args.backend, app]
+
+    def spawn(i: int, clean: bool = False):
+        wdir = os.path.join(workdir, f"worker-{i}")
+        os.makedirs(wdir, exist_ok=True)
+        we = dict(env)
+        we["DSI_NET_SPOOL"] = wdir
+        we["DSI_CHAOS_WORKER_INDEX"] = str(i)
+        if clean:
+            for k in ("DSI_CHAOS_WORKER_KILL", "DSI_FAULT_POINT",
+                      "DSI_FAULT_STEP"):
+                we.pop(k, None)
+        return subprocess.Popen(worker_cmd, env=we, cwd=wdir)
+
+    t0 = time.monotonic()
+    deadline = t0 + args.timeout
+    procs = {i: spawn(i) for i in range(args.workers)}
+    next_idx = args.workers
+    fetched: set = set()
+    respawn_budget = max(16, 2 * (len(files) + args.nreduce))
+    rc = 0
+    try:
+        while True:
+            if time.monotonic() > deadline:
+                print("mrrun: job exceeded --timeout; killing",
+                      file=sys.stderr)
+                rc = 1
+                break
+            # Fetch outputs AS they commit — while producers of a
+            # possible re-execution round are still in their task loop.
+            for r, (a, name, crc) in sorted(
+                    coord.output_locations().items()):
+                if r in fetched:
+                    continue
+                try:
+                    raw = fetch_partition(a, name,
+                                          timeout=cfg.net_fetch_timeout_s)
+                    if crc and zlib.crc32(raw) != crc:
+                        raise FetchFailure(
+                            -1, a, name,
+                            ValueError("output crc mismatch"))
+                except FetchFailure as e:
+                    print(f"mrrun: output fetch failed ({e})",
+                          file=sys.stderr)
+                    coord.refetch_reduce(r)
+                    if respawn_budget <= 0:
+                        rc = 1
+                    else:
+                        respawn_budget -= 1
+                        procs[next_idx] = spawn(next_idx, clean=True)
+                        next_idx += 1
+                    break
+                with atomic_write(os.path.join(workdir, f"mr-out-{r}"),
+                                  mode="wb") as f:
+                    f.write(raw)
+                fetched.add(r)
+            if rc:
+                break
+            if coord.done() and len(fetched) == args.nreduce:
+                break
+            for i, w in list(procs.items()):
+                if w.poll() is not None and w.returncode != 0 \
+                        and not coord.done():
+                    if respawn_budget <= 0:
+                        print("mrrun: workers failing repeatedly; "
+                              "giving up", file=sys.stderr)
+                        rc = 1
+                        break
+                    respawn_budget -= 1
+                    procs[i] = spawn(i, clean=True)
+            if rc:
+                break
+            time.sleep(0.2)
+    finally:
+        run_stats = coord.net_stats()
+        run_stats["wall_s"] = round(time.monotonic() - t0, 3)
+        run_stats["workers_spawned"] = next_idx
+        coord.close()
+        for w in procs.values():
+            if w.poll() is None:
+                w.terminate()
+        for w in procs.values():
+            try:
+                w.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                w.kill()
+
+    # Share-nothing assertion: nothing but DRIVER-written artifacts may
+    # exist in the shared workdir — a stray mr-X-Y intermediate there
+    # means some worker fell back to the shared-directory data plane.
+    leaked = [n for n in os.listdir(workdir)
+              if n.startswith("mr-")
+              and not n.startswith(("mr-out-", "mr-correct", "mr.sock"))]
+    if leaked:
+        print(f"mrrun: SHARE-NOTHING VIOLATION: shared workdir has "
+              f"{sorted(leaked)}", file=sys.stderr)
+        rc = rc or 1
+    if rc == 0:
+        # The private spools carried the job; reap them (retention GC
+        # would otherwise hold gigabytes for an hour).
+        for i in range(next_idx):
+            shutil.rmtree(os.path.join(workdir, f"worker-{i}"),
+                          ignore_errors=True)
+    if args.stats_json:
+        import json
+
+        # dsicheck: allow[raw-write] bench/CI parse surface, not durable state
+        with open(args.stats_json, "w", encoding="utf-8") as f:
+            json.dump(run_stats, f, sort_keys=True, indent=1)
+    print(f"mrrun: net data plane: {run_stats['net_fetches']} fetches "
+          f"({run_stats['net_local_reads']} local), "
+          f"{run_stats['net_bytes_raw']}B raw / "
+          f"{run_stats['net_bytes_wire']}B wire "
+          f"(ratio {run_stats['net_ratio']}), "
+          f"{run_stats['locality_hits']} locality hits, "
+          f"{run_stats['net_fetch_failures']} fetch failures, "
+          f"{run_stats['net_refetches']} refetches", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

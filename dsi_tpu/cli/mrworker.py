@@ -2,7 +2,12 @@
 
 Reference: ``main/mrworker.go:19-28`` — argv is one plugin; load its
 Map/Reduce, then run the worker loop.  Extended with ``--backend=tpu``
-(the BASELINE.json north-star flag) routing execution to the JAX backend.
+(the BASELINE.json north-star flag) routing execution to the JAX backend,
+and with the NET data plane (ISSUE 17): when ``DSI_NET_SPOOL`` is set
+(by ``mrrun --net``) the worker boots a partition server over that
+private spool directory, runs the loop in net mode, and LINGERS after
+the job completes so consumers can still fetch its spooled bytes — the
+driver terminates it once every output is safely fetched.
 
 Usage: python -m dsi_tpu.cli.mrworker [--backend host|tpu] <app-name-or-path.py>
 """
@@ -10,6 +15,8 @@ Usage: python -m dsi_tpu.cli.mrworker [--backend host|tpu] <app-name-or-path.py>
 from __future__ import annotations
 
 import argparse
+import os
+import time
 
 from dsi_tpu.config import JobConfig
 from dsi_tpu.mr.plugin import load_plugin
@@ -41,7 +48,28 @@ def main(argv=None) -> int:
         from dsi_tpu.backends.native import NativeTaskRunner
 
         runner = NativeTaskRunner.for_app(args.app)
-    worker_loop(mapf, reducef, cfg, task_runner=runner)
+    spool = os.environ.get("DSI_NET_SPOOL")
+    partsrv = None
+    if spool:
+        from dsi_tpu.net import PartitionServer
+
+        cfg = JobConfig(backend=args.backend, net_shuffle=True)
+        partsrv = PartitionServer(
+            spool, bind=os.environ.get("DSI_NET_BIND", ""),
+            retention_s=cfg.net_spool_retention_s,
+            codec=cfg.net_codec)
+        partsrv.start()
+    try:
+        worker_loop(mapf, reducef, cfg, task_runner=runner,
+                    partsrv=partsrv)
+        if partsrv is not None:
+            # Linger: the job is done but the driver may not have
+            # fetched this spool's outputs yet — serve until killed.
+            while True:
+                time.sleep(3600)
+    finally:
+        if partsrv is not None:
+            partsrv.close()
     return 0
 
 

@@ -91,6 +91,13 @@ def main(argv=None) -> int:
     p.add_argument("--fault-worker", default="",
                    help="I:POINT[:STEP] — DSI_FAULT_POINT kill for "
                         "worker I")
+    p.add_argument("--hosts", action="store_true",
+                   help="NET data plane (ISSUE 17): per-worker PRIVATE "
+                        "workdirs, coordinator control plane on "
+                        "localhost TCP, committed shard outputs served "
+                        "from each worker's spool and fetched by the "
+                        "driver over the stream transport — the share-"
+                        "nothing multi-host shape on one machine")
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--check", action="store_true",
                    help="byte-compare the merged output vs the "
@@ -105,6 +112,9 @@ def main(argv=None) -> int:
     os.makedirs(workdir, exist_ok=True)
     files = [os.path.abspath(f) for f in args.files]
     n_shards = args.shards or 2 * args.workers
+    if args.hosts and args.resplit:
+        p.error("--hosts does not support --resplit (the sub-range "
+                "merge reads committed files from a shared directory)")
     journal = os.path.abspath(args.journal) if args.journal \
         else os.path.join(workdir, "shards.journal")
 
@@ -142,26 +152,43 @@ def main(argv=None) -> int:
         if not args.pattern:
             p.error("--engine grep requires --pattern")
         knobs["pattern"] = args.pattern
-    cfg = JobConfig(workdir=workdir, socket_path=env["DSI_MR_SOCKET"],
+    cfg = JobConfig(workdir=workdir,
+                    socket_path=("tcp:127.0.0.1:0" if args.hosts
+                                 else env["DSI_MR_SOCKET"]),
                     journal_path=journal,
                     shard_timeout_s=args.shard_timeout,
                     spec_backup=not args.no_spec,
                     spec_floor_s=args.spec_floor,
                     spec_resplit=args.resplit,
                     spec_resplit_ways=args.resplit_ways,
-                    shard_progress_s=args.progress_s)
+                    shard_progress_s=args.progress_s,
+                    net_shuffle=args.hosts)
     coord = Coordinator(files, 0, cfg, shard_plan=plan,
                         shard_opts={"knobs": knobs})
     coord.serve()
+    if args.hosts:
+        # Workers dial the coordinator's REAL TCP port, not a path.
+        env["DSI_MR_SOCKET"] = coord.address()
 
     slow = _parse_worker_knob(args.slow_worker, "--slow-worker") \
         if args.slow_worker else None
     fault = _parse_worker_knob(args.fault_worker, "--fault-worker") \
         if args.fault_worker else None
 
+    def worker_dir(i: int) -> str:
+        """--hosts: each worker's PRIVATE workdir (cwd + spool); the
+        shared-dir plane runs every worker in the job workdir."""
+        if not args.hosts:
+            return workdir
+        wdir = os.path.join(workdir, f"worker-{i}")
+        os.makedirs(wdir, exist_ok=True)
+        return wdir
+
     def worker_env(i: int) -> dict:
         we = dict(env)
         we["DSI_CHAOS_WORKER_INDEX"] = str(i)
+        if args.hosts:
+            we["DSI_NET_SPOOL"] = worker_dir(i)
         if slow is not None and i == slow[0]:
             we["DSI_SHARD_SLOW_S"] = slow[1]
         if fault is not None and i == fault[0]:
@@ -176,16 +203,81 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     deadline = t0 + args.timeout
     workers = [subprocess.Popen(worker_cmd, env=worker_env(i),
-                                cwd=workdir)
+                                cwd=worker_dir(i))
                for i in range(args.workers)]
     envs = [worker_env(i) for i in range(args.workers)]
+    dirs = [worker_dir(i) for i in range(args.workers)]
+    next_idx = args.workers
     # A worker that died crashed (chaos/fault kill) is respawned WITHOUT
     # its kill knobs — the grid's "the fleet recovers" arm; budget keeps
     # a truly broken setup from spinning.
     respawn_budget = max(8, 2 * len(plan))
+    fetched: set = set()
+    net_io: dict = {}  # driver-side fetch attribution (hosts mode)
     rc = 0
+
+    def fetch_committed() -> bool:
+        """--hosts: pull each newly committed shard's bytes from the
+        winner's spool into the shared workdir the moment its location
+        registers (the merge below then reads the exact same paths the
+        shared-dir plane commits to).  A dead server means the only
+        copy is gone: ``refetch_shard`` forgets the commit and a
+        REPLACEMENT worker re-executes the producer — lingering
+        workers left the request loop, so one is spawned (clean env:
+        the chaos/fault knobs that killed the original stay off).
+        Returns False when the respawn budget is exhausted."""
+        nonlocal next_idx, respawn_budget
+        import zlib
+
+        from dsi_tpu.net.fetch import FetchFailure, fetch_partition
+        from dsi_tpu.utils.atomicio import atomic_write
+
+        for sid, (a, name, crc) in sorted(
+                coord.final_locations().items()):
+            if sid in fetched:
+                continue
+            try:
+                raw = fetch_partition(a, name, stats=net_io,
+                                      timeout=cfg.net_fetch_timeout_s)
+                if crc and zlib.crc32(raw) != crc:
+                    raise FetchFailure(sid, a, name,
+                                       ValueError("crc mismatch"))
+            except FetchFailure as e:
+                print(f"shardrun: shard {sid} output fetch failed "
+                      f"({e}); re-executing", file=sys.stderr)
+                coord.refetch_shard(sid)
+                if respawn_budget <= 0:
+                    print("shardrun: workers failing repeatedly; "
+                          "giving up", file=sys.stderr)
+                    return False
+                respawn_budget -= 1
+                i = next_idx
+                next_idx += 1
+                clean = {k: v for k, v in worker_env(i).items()
+                         if k not in ("DSI_FAULT_POINT",
+                                      "DSI_FAULT_STEP",
+                                      "DSI_CHAOS_WORKER_KILL")}
+                envs.append(clean)
+                dirs.append(worker_dir(i))
+                workers.append(subprocess.Popen(worker_cmd, env=clean,
+                                                cwd=dirs[i]))
+                return True
+            with atomic_write(os.path.join(workdir,
+                                           f"mr-shard-out-{sid}"),
+                              mode="wb") as f:
+                f.write(raw)
+            fetched.add(sid)
+        return True
+
     try:
-        while not coord.done():
+        while True:
+            if args.hosts and not fetch_committed():
+                rc = 1
+                break
+            if coord.done() and (not args.hosts
+                                 or len(fetched) == len(plan)
+                                 or coord.spec_stats()["job_failed"]):
+                break
             if time.monotonic() > deadline:
                 print("shardrun: job exceeded --timeout; killing",
                       file=sys.stderr)
@@ -205,12 +297,22 @@ def main(argv=None) -> int:
                                           "DSI_FAULT_STEP",
                                           "DSI_CHAOS_WORKER_KILL")}
                     workers[i] = subprocess.Popen(worker_cmd, env=clean,
-                                                  cwd=workdir)
+                                                  cwd=dirs[i])
             if rc:
                 break
             time.sleep(0.1)
     finally:
         run_stats = coord.spec_stats()
+        if args.hosts:
+            run_stats.update(coord.net_stats())
+            # The shard plane's only remote reads are the DRIVER's
+            # output fetches — fold their attribution in.
+            for k in ("net_fetches", "net_local_reads", "net_bytes_raw",
+                      "net_bytes_wire", "net_fetch_failures"):
+                run_stats[k] = run_stats.get(k, 0) + net_io.get(k, 0)
+            wire = run_stats["net_bytes_wire"]
+            run_stats["net_ratio"] = round(
+                run_stats["net_bytes_raw"] / wire, 3) if wire else 0.0
         run_stats["wall_s"] = round(time.monotonic() - t0, 3)
         # A re-split shard commits as SUB-RANGE files, not one full-
         # range file: the coordinator knows the committed layout.
@@ -231,6 +333,22 @@ def main(argv=None) -> int:
         rc = 1
 
     merged_path = os.path.join(workdir, args.out)
+    if rc == 0 and args.hosts:
+        # Share-nothing audit: the ONLY job artifacts in the shared
+        # workdir must be the ones the DRIVER fetched and wrote — a
+        # worker-written mr-* / .part / .shards entry here means some
+        # path escaped the private per-worker dirs and the run silently
+        # leaned on the shared-directory assumption again.
+        expect = {f"mr-shard-out-{sid}" for sid in fetched}
+        leaked = [n for n in os.listdir(workdir)
+                  if (n.startswith("mr-") or n.endswith(".part")
+                      or n == ".shards")
+                  and n not in expect and n != args.out]
+        if leaked:
+            print("shardrun: SHARE-NOTHING VIOLATION: worker artifacts "
+                  f"in shared workdir: {sorted(leaked)[:8]}",
+                  file=sys.stderr)
+            rc = 1
     if rc == 0:
         from dsi_tpu.utils.atomicio import atomic_write
 
@@ -257,6 +375,10 @@ def main(argv=None) -> int:
 
             shutil.rmtree(os.path.join(workdir, ".shards"),
                           ignore_errors=True)
+            if args.hosts:
+                # Spools served their purpose once the merge is durable.
+                for d in dirs:
+                    shutil.rmtree(d, ignore_errors=True)
 
     if args.stats_json:
         # dsicheck: allow[raw-write] bench/CI parse surface, not durable state

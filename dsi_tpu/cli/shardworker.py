@@ -20,6 +20,9 @@ def main(argv=None) -> int:
                    help="mirror of the coordinator's presumed-dead "
                         "silence (informational on the worker side)")
     args = p.parse_args(argv)
+    import os
+    import time
+
     from dsi_tpu.config import JobConfig
     from dsi_tpu.mr.shardworker import shard_worker_loop
 
@@ -28,10 +31,34 @@ def main(argv=None) -> int:
         kw["shard_progress_s"] = args.progress_s
     if args.shard_timeout is not None:
         kw["shard_timeout_s"] = args.shard_timeout
+    # NET data plane (ISSUE 17, ``shardrun --hosts``): DSI_NET_SPOOL
+    # names this worker's PRIVATE spool dir — boot a partition server
+    # over it, advertise its address on every RPC, and LINGER after the
+    # job so the driver can still fetch committed outputs; the driver
+    # terminates the process once everything is fetched.
+    spool = os.environ.get("DSI_NET_SPOOL")
+    partsrv = None
+    if spool:
+        from dsi_tpu.net import PartitionServer
+
+        kw["net_shuffle"] = True
+        cfg0 = JobConfig(**kw)
+        partsrv = PartitionServer(
+            spool, bind=os.environ.get("DSI_NET_BIND", ""),
+            retention_s=cfg0.net_spool_retention_s,
+            codec=cfg0.net_codec)
+        partsrv.start()
     # Tracing: DSI_TRACE_DIR (inherited from shardrun) arms the global
     # tracer with a durable atexit flush; chaos/fault kills flush
     # explicitly before os._exit (ckpt/fault.py).
-    shard_worker_loop(JobConfig(**kw))
+    try:
+        shard_worker_loop(JobConfig(**kw), partsrv=partsrv)
+        if partsrv is not None:
+            while True:
+                time.sleep(3600)
+    finally:
+        if partsrv is not None:
+            partsrv.close()
     return 0
 
 

@@ -115,5 +115,31 @@ class JobConfig:
     # before the job is declared failed — bounds a poisoned shard.
     shard_max_attempts: int = 8
 
+    # ── network data plane (dsi_tpu/net, ISSUE 17) ──
+
+    # Worker-served shuffle: workers spool partitions to a PRIVATE local
+    # dir and serve them over TCP; reducers/consumers fetch via
+    # net/fetch.py instead of reading a shared directory.  Off = the
+    # reference's shared-filesystem data plane.
+    net_shuffle: bool = False
+
+    # Partition-server bind address for this worker ("" = tcp:127.0.0.1:0,
+    # an OS-assigned loopback port; multi-host fleets set a real host and
+    # DSI_MR_SECRET).  Env override: DSI_NET_BIND.
+    net_bind: str = ""
+
+    # Shuffle payloads cross the wire through the PR-13 line codec
+    # (ops/wirecodec.pack_kv) when it shrinks them; raw otherwise.
+    net_codec: bool = True
+
+    # Fetch dial/stream timeout, seconds (per fetch attempt; the dial
+    # itself retries transient errors through dial_backoff_schedule).
+    net_fetch_timeout_s: float = 30.0
+
+    # Spool entries untouched this long are aged out at partition-server
+    # boot (dead-task spools from kill-9'd predecessors; the serve
+    # daemon's retention discipline).
+    net_spool_retention_s: float = 3600.0
+
     def sock(self) -> str:
         return self.socket_path or default_socket_path(self.workdir)

@@ -127,6 +127,28 @@ class Coordinator:
         # coordinator.go:70-77).
         self._worker_seen: Dict[str, float] = {}
         self._task_worker: Dict[tuple, str] = {}
+        # ── network data plane (dsi_tpu/net, ISSUE 17) ──
+        # In net mode workers serve their spooled partitions over TCP;
+        # the coordinator is the location registry (Dean & Ghemawat
+        # §3.1: "the locations of these buffered pairs ... are passed
+        # back to the master, who is responsible for forwarding these
+        # locations to the reduce workers") and re-executes completed
+        # map tasks whose server died (§3.4).
+        self.net = bool(self.config.net_shuffle)
+        #: worker id → its partition-server address (from every RPC).
+        self._net_addrs: Dict[str, str] = {}
+        #: map task → producer's partition-server address.
+        self._map_locs: Dict[int, str] = {}
+        #: map task → per-reduce-partition byte sizes (locality shares).
+        self._map_sizes: Dict[int, List[int]] = {}
+        #: reduce task → (addr, name, crc) of the committed output.
+        self._out_locs: Dict[int, tuple] = {}
+        #: Net-plane counters (schema: obs/registry.COUNTER_KEYS).
+        self._net_counters = {
+            "net_fetches": 0, "net_local_reads": 0, "net_bytes_raw": 0,
+            "net_bytes_wire": 0, "net_ratio": 0.0,
+            "net_fetch_failures": 0, "net_refetches": 0,
+            "locality_hits": 0}
         # Per-worker contact-GAP histograms (obs/hist.py): every RPC
         # records the gap since the worker's previous contact, so a
         # requeue can compare the stale worker's current silence to its
@@ -238,9 +260,12 @@ class Coordinator:
         reply = {"TaskStatus": int(TaskStatus.WAITING), "NMap": self.n_map,
                  "CMap": 0, "NReduce": self.n_reduce, "CReduce": 0, "Filename": ""}
         wid = str(args.get("WorkerId") or "")
+        addr = str(args.get("Addr") or "")
         with self.mu:
             if wid:
                 self._touch(wid)
+                if addr:
+                    self._net_addrs[wid] = addr
             if self.c_map < self.n_map:
                 tba = self._pop_untouched(self._map_ready, self.map_log)
                 if tba is None:
@@ -256,13 +281,21 @@ class Coordinator:
                     log_event("assign", kind="map", task=tba,
                               file=self.files[tba], worker=wid or None)
             elif self.c_reduce < self.n_reduce:  # map barrier passed (:79)
-                tba = self._pop_untouched(self._reduce_ready, self.reduce_log)
+                tba = self._pick_reduce_locked(addr) if self.net \
+                    else self._pop_untouched(self._reduce_ready,
+                                             self.reduce_log)
                 if tba is None:
                     reply["TaskStatus"] = int(TaskStatus.WAITING)
                 else:
                     self.reduce_log[tba] = LOG_IN_PROGRESS
                     reply["TaskStatus"] = int(TaskStatus.REDUCE)
                     reply["CReduce"] = tba
+                    if self.net:
+                        # §3.1: the master forwards the buffered pairs'
+                        # locations to the reduce worker.
+                        reply["Net"] = True
+                        reply["MapLocs"] = {str(m): a for m, a
+                                            in self._map_locs.items()}
                     self._arm_timeout(tba, "reduce")  # :99-106
                     if wid:
                         self._task_worker[("reduce", tba)] = wid
@@ -277,6 +310,7 @@ class Coordinator:
         the unique-transition counting fix."""
         t = int(args["TaskNumber"])
         wid = str(args.get("WorkerId") or "")
+        addr = str(args.get("Addr") or "")
         with self.mu:
             if wid:
                 self._touch(wid)
@@ -284,6 +318,14 @@ class Coordinator:
             if self.map_log[t] != LOG_COMPLETED:  # fix: count first completion only
                 self.map_log[t] = LOG_COMPLETED
                 self.c_map += 1
+                if addr:
+                    # Location registry (§3.1): this producer serves
+                    # mr-<t>-* from its spool; the per-partition byte
+                    # sizes feed the locality-share placement policy.
+                    self._map_locs[t] = addr
+                    sizes = args.get("PartSizes")
+                    if isinstance(sizes, list):
+                        self._map_sizes[t] = [int(x) for x in sizes]
                 if self._journal is not None:
                     self._journal.record("map", t)
                 log_event("complete", kind="map", task=t, c_map=self.c_map,
@@ -296,6 +338,7 @@ class Coordinator:
         """Reference: RecieveReduceComplete [sic] (mr/coordinator.go:35-41)."""
         t = int(args["TaskNumber"])
         wid = str(args.get("WorkerId") or "")
+        addr = str(args.get("Addr") or "")
         with self.mu:
             if wid:
                 self._touch(wid)
@@ -303,6 +346,13 @@ class Coordinator:
             if self.reduce_log[t] != LOG_COMPLETED:
                 self.reduce_log[t] = LOG_COMPLETED
                 self.c_reduce += 1
+                if addr:
+                    # Net mode: mr-out-<t> lives in the reducer's spool;
+                    # the driver fetches it by this location.
+                    self._out_locs[t] = (addr,
+                                         str(args.get("Name") or ""),
+                                         int(args.get("Crc", 0) or 0))
+                self._absorb_net_locked(args)
                 if self._journal is not None:
                     self._journal.record("reduce", t)
                 log_event("complete", kind="reduce", task=t,
@@ -310,6 +360,66 @@ class Coordinator:
             else:
                 log_event("duplicate_completion", kind="reduce", task=t)
         return {}
+
+    def fetch_failed(self, args: dict) -> dict:
+        """Re-fetch-from-replacement (§3.4): a reducer could not fetch
+        ``mr-<Map>-<Reduce>`` from its producer's partition server (the
+        server died, or died mid-stream).  The completed map task is
+        reset to UNTOUCHED — ``c_map`` drops below ``n_map``, so the map
+        barrier RE-ENGAGES and the task re-executes on a live worker
+        (its completion re-registers a replacement location); the
+        reporting reducer's task is re-queued to run after the barrier
+        reopens.  Unique-transition counting absorbs the duplicate
+        completion a slow original could still send."""
+        m = int(args.get("Map", -1))
+        r = int(args.get("Reduce", -1))
+        wid = str(args.get("WorkerId") or "")
+        with self.mu:
+            if wid:
+                self._touch(wid)
+            self._net_counters["net_fetch_failures"] += 1
+            requeued_map = False
+            if 0 <= m < self.n_map and self.map_log[m] == LOG_COMPLETED:
+                self.map_log[m] = LOG_UNTOUCHED
+                self.c_map -= 1  # the map barrier re-engages
+                heapq.heappush(self._map_ready, m)
+                self._map_locs.pop(m, None)
+                self._map_sizes.pop(m, None)
+                self._net_counters["net_refetches"] += 1
+                requeued_map = True
+            if 0 <= r < self.n_reduce \
+                    and self.reduce_log[r] == LOG_IN_PROGRESS:
+                self.reduce_log[r] = LOG_UNTOUCHED
+                heapq.heappush(self._reduce_ready, r)
+                self._task_worker.pop(("reduce", r), None)
+            log_event("fetch_failed", kind="net", task=r, map_task=m,
+                      worker=wid or None,
+                      addr=str(args.get("Addr") or "") or None,
+                      requeued_map=requeued_map)
+            if requeued_map:
+                print(f"coordinator: fetch of mr-{m}-{r} failed "
+                      f"(producer server gone); re-executing map {m}",
+                      file=sys.stderr)
+        return {"Requeued": requeued_map}
+
+    def _absorb_net_locked(self, args: dict) -> None:
+        """Fold one completion RPC's per-task net-attribution deltas
+        into the job-wide counters.  Caller holds ``self.mu``."""
+        found = False
+        for wire, key in (("NetFetches", "net_fetches"),
+                          ("NetLocal", "net_local_reads"),
+                          ("NetRaw", "net_bytes_raw"),
+                          ("NetWire", "net_bytes_wire"),
+                          ("NetFailures", "net_fetch_failures")):
+            v = args.get(wire)
+            if v is not None:
+                self._net_counters[key] += int(v)
+                found = True
+        if found:
+            wire_n = self._net_counters["net_bytes_wire"]
+            self._net_counters["net_ratio"] = round(
+                self._net_counters["net_bytes_raw"] / wire_n, 3) \
+                if wire_n else 0.0
 
     # ---- shard-scheduler RPC handlers (shard mode, mr/shards.py) ----
 
@@ -320,6 +430,7 @@ class Coordinator:
         of the stalest suspect shard (Dean & Ghemawat §3.6), else
         WAITING/DONE."""
         wid = str(args.get("WorkerId") or "")
+        addr = str(args.get("Addr") or "")
         reply: dict = {"TaskStatus": int(TaskStatus.WAITING)}
         now = time.monotonic()
         with self.mu:
@@ -327,13 +438,15 @@ class Coordinator:
                 return {"TaskStatus": int(TaskStatus.DONE)}
             if wid:
                 self._touch(wid)
+                if addr:
+                    self._net_addrs[wid] = addr
             if self.job_failed or all(
                     self._shard_resolved(shard)
                     for shard in self._shards.values()):
                 reply["TaskStatus"] = int(TaskStatus.DONE)
                 return reply
             assignment = None
-            sid = self._pop_untouched_shard()
+            sid = self._pop_untouched_shard(wid)
             if sid is not None:
                 shard = self._shards[sid]
                 kind = "takeover" if shard["attempts"] else "primary"
@@ -342,7 +455,12 @@ class Coordinator:
                 pick = self._pop_untouched_sub()
                 if pick is not None:
                     return self._assign_sub(pick[0], pick[1], wid, now)
-            if assignment is None and self.config.spec_resplit:
+            if assignment is None and self.config.spec_resplit \
+                    and not self.net:
+                # Re-split is a shared-directory optimization: its
+                # sub-range merge reads committed files in place.  Net
+                # mode covers stragglers with whole-range backups
+                # (first-commit-wins is location-agnostic).
                 pick = self._maybe_resplit(wid, now)
                 if pick is not None:
                     return self._assign_sub(pick[0], pick[1], wid, now)
@@ -361,6 +479,15 @@ class Coordinator:
                 "CkptRoot": self._shard_ckpt_root(),
                 "OutPart": self._shard_part_path(sid, aid),
             })
+            if self.net:
+                # Share-nothing: the partial and the checkpoint chain
+                # both resolve RELATIVE to the worker's private cwd; a
+                # resume hint only restores when the chain is local
+                # (adopt_chain fails soft otherwise — exactly the case
+                # the locality preference above works to hit).
+                reply["Net"] = True
+                reply["OutPart"] = os.path.basename(reply["OutPart"])
+                reply["CkptRoot"] = ".shards"
             log_event("assign", kind="shard", task=sid, attempt=aid,
                       attempt_kind=att["kind"], worker=wid or None,
                       resume_from=att["resume_from"])
@@ -460,33 +587,52 @@ class Coordinator:
                           attempt=aid, winner=shard["committed"][0],
                           worker=wid or None)
                 return {"Win": False}
-            part = self._shard_part_path(sid, aid)
-            final = self._shard_out_path(sid)
-            try:
-                os.replace(part, final)
-                fsync_dir(os.path.dirname(final) or ".")
-            except OSError as e:
-                log_event("shard_commit_missing", kind="shard", task=sid,
-                          attempt=aid, error=str(e))
-                return {"Win": False, "Error": f"partial missing: {e}"}
+            if self.net:
+                # Net mode: the winner's bytes stay in ITS private
+                # spool; the coordinator records the location (addr +
+                # spool name + CRC) and the driver fetches them over
+                # the stream transport — the §3.1 contract where the
+                # master tracks locations, never the bytes.  Losers
+                # reap their own partials (private dirs; nobody else
+                # can).
+                net_addr = str(args.get("Addr") or "")
+                net_name = str(args.get("Name") or "")
+                if not net_addr or not net_name:
+                    return {"Win": False,
+                            "Error": "net commit needs Addr+Name"}
+                shard["net_loc"] = (net_addr, net_name)
+            else:
+                part = self._shard_part_path(sid, aid)
+                final = self._shard_out_path(sid)
+                try:
+                    os.replace(part, final)
+                    fsync_dir(os.path.dirname(final) or ".")
+                except OSError as e:
+                    log_event("shard_commit_missing", kind="shard",
+                              task=sid, attempt=aid, error=str(e))
+                    return {"Win": False,
+                            "Error": f"partial missing: {e}"}
             if self._journal is not None:
                 self._journal.record_shard(sid, aid, crc)
             shard["committed"] = (aid, crc)
             shard["status"] = LOG_COMPLETED
             self._spec["commits"] += 1
-            # Reap sibling partials: an attempt killed between its
-            # durable partial write and its commit RPC can never report
-            # again, and its orphan .part must not outlive the shard.
-            prefixes = (os.path.basename(final) + ".a",
-                        os.path.basename(final) + ".s")
-            try:
-                for name in os.listdir(os.path.dirname(final) or "."):
-                    if name.startswith(prefixes) \
-                            and name.endswith(".part"):
-                        os.remove(os.path.join(
-                            os.path.dirname(final), name))
-            except OSError:
-                pass
+            if not self.net:
+                # Reap sibling partials: an attempt killed between its
+                # durable partial write and its commit RPC can never
+                # report again, and its orphan .part must not outlive
+                # the shard.
+                prefixes = (os.path.basename(final) + ".a",
+                            os.path.basename(final) + ".s")
+                try:
+                    for name in os.listdir(os.path.dirname(final)
+                                           or "."):
+                        if name.startswith(prefixes) \
+                                and name.endswith(".part"):
+                            os.remove(os.path.join(
+                                os.path.dirname(final), name))
+                except OSError:
+                    pass
             for oaid, oatt in shard["attempts"].items():
                 if oaid != aid:
                     oatt["cancelled"] = True
@@ -595,6 +741,84 @@ class Coordinator:
                                for k in sorted(shard["subs"]))
             return out
 
+    # ---- net-plane driver surface (dsi_tpu/net, ISSUE 17) ----
+
+    def output_locations(self) -> Dict[int, tuple]:
+        """Classic net mode: reduce task → (addr, name, crc) of every
+        committed ``mr-out-<r>`` so far — the driver fetches these over
+        the stream transport as they appear."""
+        with self.mu:
+            return dict(self._out_locs)
+
+    def final_locations(self) -> Dict[int, tuple]:
+        """Shard net mode: sid → (addr, name, crc) of every committed
+        shard output so far (the net twin of :meth:`final_outputs`)."""
+        with self.mu:
+            out: Dict[int, tuple] = {}
+            for sid, shard in self._shards.items():
+                if shard["committed"] is not None \
+                        and shard.get("net_loc"):
+                    aid, crc = shard["committed"]
+                    a, name = shard["net_loc"]
+                    out[sid] = (a, name, crc)
+            return out
+
+    def refetch_reduce(self, r: int) -> bool:
+        """Driver-side re-fetch-from-replacement: the committed
+        ``mr-out-<r>``'s server died before the driver could fetch it.
+        Forget the completion — ``c_reduce`` drops, ``done()`` flips
+        back, and a live worker re-runs the reduce (its inputs are
+        re-fetchable; a lost PRODUCER resurfaces as that re-run's own
+        ``FetchFailed``).  Returns True if re-queued."""
+        with self.mu:
+            if not (0 <= r < self.n_reduce) \
+                    or self.reduce_log[r] != LOG_COMPLETED:
+                return False
+            self.reduce_log[r] = LOG_UNTOUCHED
+            self.c_reduce -= 1
+            heapq.heappush(self._reduce_ready, r)
+            self._out_locs.pop(r, None)
+            self._net_counters["net_refetches"] += 1
+            log_event("refetch", kind="reduce", task=r)
+            print(f"coordinator: output mr-out-{r} unreachable; "
+                  f"re-executing reduce {r}", file=sys.stderr)
+        return True
+
+    def refetch_shard(self, sid: int) -> bool:
+        """Shard-mode re-fetch-from-replacement: the committed copy's
+        server died.  Forget the commit and re-queue the shard — a NEW
+        attempt id runs the first-commit-wins race afresh, so
+        ``duplicate_commits`` (same-attempt double commit) stays
+        structurally 0.  Returns True if re-queued."""
+        with self.mu:
+            shard = self._shards.get(sid)
+            if shard is None or shard["committed"] is None:
+                return False
+            aid, _crc = shard["committed"]
+            shard["committed"] = None
+            shard.pop("net_loc", None)
+            shard["status"] = LOG_UNTOUCHED
+            for att in shard["attempts"].values():
+                att["cancelled"] = True  # every old attempt is stale
+            heapq.heappush(self._shard_ready, sid)
+            self._net_counters["net_refetches"] += 1
+            self._spec["requeues"] += 1
+            log_event("refetch", kind="shard", task=sid,
+                      lost_attempt=aid)
+            print(f"coordinator: shard {sid} output (attempt a{aid}) "
+                  f"unreachable; re-executing", file=sys.stderr)
+        return True
+
+    def net_stats(self) -> dict:
+        """Net-plane counter snapshot (schema-pinned keys) plus the
+        location-registry sizes — the net harness's and bench row's
+        evidence surface."""
+        with self.mu:
+            out = dict(self._net_counters)
+            out["map_locations"] = len(self._map_locs)
+            out["output_locations"] = len(self._out_locs)
+        return out
+
     # ---- internals ----
 
     def _touch(self, wid: str) -> None:
@@ -639,12 +863,72 @@ class Coordinator:
     def _shard_part_path(self, sid: int, aid: int) -> str:
         return self._shard_out_path(sid) + f".a{aid}.part"
 
-    def _pop_untouched_shard(self) -> Optional[int]:
+    def _pop_untouched_shard(self, wid: str = "") -> Optional[int]:
+        if self.net and wid:
+            # Locality preference (net mode): a re-queued shard whose
+            # best checkpoint chain was written by THIS worker resumes
+            # from that chain only here — everywhere else the chain is
+            # unreachable (private workdirs) and the attempt restarts
+            # from zero.  Prefer it; the stale heap entry is lazily
+            # invalidated like any other.
+            for sid in sorted(self._shards):
+                shard = self._shards[sid]
+                if shard["status"] != LOG_UNTOUCHED:
+                    continue
+                best = self._best_resume_from(shard)
+                if best is not None \
+                        and shard["attempts"][best]["worker"] == wid:
+                    self._net_counters["locality_hits"] += 1
+                    log_event("locality_hit", kind="shard", task=sid,
+                              worker=wid)
+                    return sid
         while self._shard_ready:
             sid = heapq.heappop(self._shard_ready)
             if self._shards[sid]["status"] == LOG_UNTOUCHED:
                 return sid
         return None
+
+    # ---- net-plane internals (caller holds self.mu) ----
+
+    def _preferred_host(self, r: int) -> Optional[str]:
+        """The address holding the largest share of reduce partition
+        ``r``'s input bytes (ties: least-loaded first, then address
+        order) — Dean & Ghemawat §3.1 step 4's "takes the location of
+        the input into account" applied to the shuffle."""
+        share: Dict[str, int] = {}
+        for m, a in self._map_locs.items():
+            sizes = self._map_sizes.get(m)
+            n = sizes[r] if sizes and r < len(sizes) else 0
+            share[a] = share.get(a, 0) + n
+        if not share:
+            return None
+        load: Dict[str, int] = {}
+        for w in self._task_worker.values():
+            a = self._net_addrs.get(w)
+            if a:
+                load[a] = load.get(a, 0) + 1
+        addr, top = max(share.items(),
+                        key=lambda kv: (kv[1], -load.get(kv[0], 0),
+                                        kv[0]))
+        return addr if top > 0 else None
+
+    def _pick_reduce_locked(self, addr: str) -> Optional[int]:
+        """Locality-aware reduce assignment: among the untouched reduce
+        tasks prefer one whose preferred host IS the requester — its
+        largest input share becomes local spool reads instead of wire
+        bytes (``locality_hits`` counts these).  Falls back to the
+        reference's lowest-index order; the ready heap's stale entry
+        for a preferred pick is lazily invalidated."""
+        if addr:
+            for r in range(self.n_reduce):
+                if self.reduce_log[r] != LOG_UNTOUCHED:
+                    continue
+                if self._preferred_host(r) == addr:
+                    self._net_counters["locality_hits"] += 1
+                    log_event("locality_hit", kind="reduce", task=r,
+                              addr=addr)
+                    return r
+        return self._pop_untouched(self._reduce_ready, self.reduce_log)
 
     def _new_attempt(self, sid: int, wid: str, kind: str, now: float):
         """Create + arm one attempt; takeovers/backups carry the best
@@ -899,6 +1183,10 @@ class Coordinator:
                  "Knobs": self.shard_opts.get("knobs", {}),
                  "CkptRoot": self._shard_ckpt_root(),
                  "OutPart": self._sub_part_path(sid, k, aid)}
+        if self.net:  # same share-nothing shape as the full-range reply
+            reply["Net"] = True
+            reply["OutPart"] = os.path.basename(reply["OutPart"])
+            reply["CkptRoot"] = ".shards"
         log_event("assign", kind="subshard", task=sid, sub=k,
                   attempt=aid, worker=wid or None, start=s, end=e,
                   resume_from=att["resume_from"],
@@ -1249,6 +1537,7 @@ class Coordinator:
             "Coordinator.RecieveReduceComplete": self.reduce_complete,
             "Coordinator.MapComplete": self.map_complete,
             "Coordinator.ReduceComplete": self.reduce_complete,
+            "Coordinator.FetchFailed": self.fetch_failed,
         }
         if self.shard_plan is not None:
             methods.update({

@@ -76,6 +76,7 @@ import socketserver
 import struct
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict
 
 _LEN = struct.Struct(">I")
@@ -446,5 +447,266 @@ def call(socket_path: str, method: str, args: dict | None = None,
                     "check DSI_MR_SECRET matches the coordinator's")
             return False, None
         return True, resp.get("reply")
+    finally:
+        sock.close()
+
+
+# ---------------------------------------------------------------------------
+# Streaming fetch transport (the network data plane's bulk link).
+#
+# The framed-JSON protocol above tops out at _MAX_FRAME and base64 would tax
+# every byte; shuffle partitions and shard outputs need a raw-bytes path.
+# Wire shape, after the TCP connect:
+#
+#   both sides:  hello = b"DSN" + version byte       (4 bytes, sent eagerly)
+#   client:      framed-JSON request {"method","args"[,"auth"]}  (as above)
+#   server:      framed-JSON header {"ok","size","error"}
+#   server:      chunks  [4-byte len][payload][4-byte CRC32(payload)] ...
+#   server:      trailer [4-byte 0][4-byte CRC32(entire payload)]
+#
+# The eager hello is the version gate the satellite task names: a
+# mixed-version fleet fails in ONE round trip with ProtocolMismatch instead
+# of hanging through the dial backoff schedule — connection-refused (dead
+# server) stays CoordinatorGone, so callers can tell "re-fetch elsewhere"
+# from "this fleet is mis-deployed".  Per-chunk CRCs catch corruption as
+# early as the first bad chunk; the whole-payload trailer catches a server
+# that died mid-serve and a kernel that flushed a truncated tail.
+
+_HELLO_MAGIC = b"DSN"
+PROTOCOL_VERSION = 1
+_STREAM_CHUNK = 256 << 10
+#: Streamed payloads may exceed _MAX_FRAME (shard outputs, relay buffers);
+#: this is the abuse bound, not a design limit.
+_MAX_STREAM = 1 << 30
+
+
+class ProtocolMismatch(CoordinatorGone):
+    """The peer's hello frame carried a different protocol version (or no
+    recognizable hello at all).  A mixed-version fleet can never make
+    progress, so this is fatal like CoordinatorGone — but distinct and
+    LOUD: retrying through the backoff schedule would just hang, and a
+    silent exit looks exactly like end-of-job."""
+
+
+class StreamError(ConnectionError):
+    """A stream fetch failed after a successful dial: server-side error
+    (no such partition), a CRC mismatch, or a peer death mid-stream.  The
+    caller's move is re-fetch from a replacement, not retry here."""
+
+
+def _hello_bytes() -> bytes:
+    return _HELLO_MAGIC + bytes((PROTOCOL_VERSION,))
+
+
+def _check_hello(raw: bytes, peer: str) -> None:
+    if len(raw) != 4 or raw[:3] != _HELLO_MAGIC:
+        raise ProtocolMismatch(
+            f"{peer} did not speak the stream protocol (got {raw!r}); "
+            "is the address really a partition server?")
+    if raw[3] != PROTOCOL_VERSION:
+        raise ProtocolMismatch(
+            f"{peer} speaks stream protocol v{raw[3]}, we speak "
+            f"v{PROTOCOL_VERSION} — mixed-version fleet, upgrade in lockstep")
+
+
+class StreamServer:
+    """Threaded streaming-fetch server: methods return raw ``bytes``.
+
+    Same address forms and auth policy as :class:`RpcServer` (non-loopback
+    TCP without a secret is refused).  ``chunk_hook(i)`` — if given — runs
+    after chunk ``i`` of a response hits the socket; the partition server
+    threads its ``mid-serve`` fault/chaos point through it so tests can
+    kill a server with a half-sent payload on the wire.
+    """
+
+    def __init__(self, address: str,
+                 methods: Dict[str, Callable[[dict], bytes]],
+                 secret: str | None = None,
+                 chunk_hook: Callable[[int], None] | None = None,
+                 chunk_size: int = _STREAM_CHUNK):
+        self.socket_path = address
+        self.methods = dict(methods)
+        self._kind, target = parse_address(address)
+        secret = (secret if secret is not None
+                  else os.environ.get("DSI_MR_SECRET"))
+        if (self._kind == "tcp" and not secret
+                and target[0] not in ("127.0.0.1", "localhost", "::1")):
+            raise ValueError(
+                f"refusing to bind {address!r} without authentication: an "
+                "open partition server serves job bytes to any peer. Set "
+                "DSI_MR_SECRET or bind tcp:127.0.0.1:PORT.")
+        if self._kind == "unix":
+            try:
+                os.remove(address)
+            except OSError:
+                pass
+
+        handler_methods = self.methods
+        replay_guard = _ReplayGuard()
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one fetch per connection
+                try:
+                    self.request.settimeout(60.0)
+                    self.request.sendall(_hello_bytes())
+                    _check_hello(_recv_exact(self.request, 4), "client")
+                    req = _recv_frame(self.request)
+                    if not isinstance(req, dict):
+                        _send_frame(self.request,
+                                    {"ok": False, "size": 0,
+                                     "error": "malformed request frame"})
+                        return
+                    if secret and not _check_auth(secret, req, replay_guard):
+                        _send_frame(self.request,
+                                    {"ok": False, "size": 0,
+                                     "error": "auth failed"})
+                        return
+                    fn = handler_methods.get(req.get("method", ""))
+                    if fn is None:
+                        _send_frame(self.request,
+                                    {"ok": False, "size": 0,
+                                     "error": "no such method: "
+                                              f"{req.get('method')}"})
+                        return
+                    try:
+                        payload = fn(req.get("args") or {})
+                    except Exception as e:  # handler error -> header frame
+                        _send_frame(self.request,
+                                    {"ok": False, "size": 0,
+                                     "error": f"{type(e).__name__}: {e}"})
+                        return
+                    _send_frame(self.request, {"ok": True,
+                                               "size": len(payload),
+                                               "error": None})
+                    for i, off in enumerate(
+                            range(0, len(payload), chunk_size)):
+                        chunk = payload[off:off + chunk_size]
+                        self.request.sendall(
+                            _LEN.pack(len(chunk)) + chunk
+                            + _LEN.pack(zlib.crc32(chunk)))
+                        if chunk_hook is not None:
+                            chunk_hook(i)
+                    self.request.sendall(
+                        _LEN.pack(0) + _LEN.pack(zlib.crc32(payload)))
+                except (ConnectionError, json.JSONDecodeError, OSError):
+                    pass  # client vanished mid-fetch; it re-fetches
+
+        base = (socketserver.ThreadingTCPServer if self._kind == "tcp"
+                else socketserver.ThreadingUnixStreamServer)
+
+        class Server(base):
+            daemon_threads = True
+            allow_reuse_address = True
+            request_queue_size = 128
+
+        self._server = Server(target, Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="dsi-net-stream", daemon=True)
+
+    @property
+    def address(self) -> str:
+        """Dialable address (real port for port 0, reachable host for
+        wildcard binds) — same contract as :attr:`RpcServer.address`."""
+        if self._kind == "tcp":
+            host, port = self._server.server_address[:2]
+            return f"tcp:{_reachable_host(host)}:{port}"
+        return self.socket_path
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def close(self) -> None:
+        if self._thread.is_alive():
+            self._server.shutdown()
+        self._server.server_close()
+        if self._kind == "unix":
+            try:
+                os.remove(self.socket_path)
+            except OSError:
+                pass
+
+
+def stream_fetch(address: str, method: str, args: dict | None = None,
+                 timeout: float = 60.0, secret: str | None = None,
+                 max_bytes: int = _MAX_STREAM) -> bytes:
+    """One streaming fetch: dial (with the transient-error backoff budget),
+    exchange hellos, send the request, receive and CRC-verify the chunked
+    payload.  Raises :class:`CoordinatorGone` when the server cannot be
+    dialed (dead server — re-fetch from a replacement),
+    :class:`ProtocolMismatch` on a version disagreement (mis-deployed
+    fleet — do NOT retry), and :class:`StreamError` on a server-side error
+    or an integrity failure mid-stream (peer died while serving)."""
+    try:
+        kind, target = parse_address(address)
+    except ValueError as e:
+        raise CoordinatorGone(str(e)) from None
+    secret = secret if secret is not None else os.environ.get("DSI_MR_SECRET")
+    sock = _dial(kind, target, address, timeout)
+    try:
+        sock.sendall(_hello_bytes())
+        try:
+            hello = _recv_exact(sock, 4)
+        except ConnectionError:
+            raise StreamError(
+                f"{address} closed before hello — died while accepting")
+        _check_hello(hello, address)
+        req: dict = {"method": method, "args": args or {}}
+        if secret:
+            nonce = os.urandom(16).hex()
+            ts = repr(time.time())
+            req["auth"] = {"nonce": nonce, "ts": ts,
+                           "mac": _auth_mac(secret, nonce, ts,
+                                            _canonical_body(method,
+                                                            args or {}))}
+        try:
+            _send_frame(sock, req)
+            hdr = _recv_frame(sock)
+        except (ConnectionError, json.JSONDecodeError) as e:
+            raise StreamError(f"fetching {method} from {address}: {e}") from e
+        if not isinstance(hdr, dict) or not hdr.get("ok"):
+            err = hdr.get("error") if isinstance(hdr, dict) else "bad header"
+            if err == "auth failed":
+                raise AuthError(
+                    f"stream server at {address} rejected our auth token — "
+                    "check DSI_MR_SECRET matches the fleet's")
+            raise StreamError(f"fetch {method} from {address}: {err}")
+        size = hdr.get("size")
+        if not isinstance(size, int) or size < 0 or size > max_bytes:
+            raise StreamError(f"fetch from {address}: bad size {size!r}")
+        parts: list[bytes] = []
+        got = 0
+        while True:
+            try:
+                (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+                if n == 0:
+                    (want,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+                    payload = b"".join(parts)
+                    if len(payload) != size:
+                        raise StreamError(
+                            f"fetch from {address}: truncated "
+                            f"({len(payload)}/{size} bytes)")
+                    if zlib.crc32(payload) != want:
+                        raise StreamError(
+                            f"fetch from {address}: payload CRC mismatch")
+                    return payload
+                if n > _MAX_FRAME:
+                    raise StreamError(f"fetch from {address}: "
+                                      f"oversized chunk {n}")
+                chunk = _recv_exact(sock, n)
+                (ccrc,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            except ConnectionError as e:
+                if isinstance(e, StreamError):
+                    raise
+                raise StreamError(
+                    f"fetch from {address}: peer died mid-stream "
+                    f"({got}/{size} bytes): {e}") from e
+            if zlib.crc32(chunk) != ccrc:
+                raise StreamError(f"fetch from {address}: chunk CRC "
+                                  f"mismatch at byte {got}")
+            parts.append(chunk)
+            got += n
+            if got > max_bytes:
+                raise StreamError(f"fetch from {address}: payload exceeds "
+                                  f"{max_bytes} bytes")
     finally:
         sock.close()

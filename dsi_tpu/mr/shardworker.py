@@ -109,9 +109,12 @@ def _reap_attempt(part_path: str, ckpt_dir: str) -> None:
 
 
 def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
-                      sock: str) -> None:
+                      sock: str, serve_addr: str | None = None) -> None:
     """Drive ONE shard attempt end to end (module docstring).  Raises
-    :class:`rpc.CoordinatorGone` through to the caller's loop exit."""
+    :class:`rpc.CoordinatorGone` through to the caller's loop exit.
+    ``serve_addr`` is this worker's partition-server address (net mode):
+    a ``Net`` assignment's commit then registers the partial's location
+    instead of relying on a shared-directory rename."""
     sid = int(reply["Shard"])
     aid = int(reply["Attempt"])
     sub = int(reply.get("Sub", -1))
@@ -293,10 +296,17 @@ def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
         f.write(payload)
     crc = zlib.crc32(payload)
     chaos_kill_point("pre-commit")
+    commit_args = {"Crc": crc, "Confirmed": step.confirmed,
+                   "ResumeCursor": resume_cursor}
+    if reply.get("Net") and serve_addr:
+        # NET data plane (ISSUE 17): the partial stays in THIS worker's
+        # private spool; the commit registers its location (the driver
+        # fetches the bytes over the stream transport), so a winner's
+        # part file must outlive the attempt — only losers reap.
+        commit_args["Addr"] = serve_addr
+        commit_args["Name"] = os.path.basename(part_path)
     try:
-        ok, rep = call("Coordinator.CommitShard",
-                       {"Crc": crc, "Confirmed": step.confirmed,
-                        "ResumeCursor": resume_cursor})
+        ok, rep = call("Coordinator.CommitShard", commit_args)
     except rpc.CoordinatorGone:
         raise
     if not ok or rep is None or not rep.get("Win"):
@@ -325,20 +335,27 @@ def _warm_engine() -> None:
         pass
 
 
-def shard_worker_loop(config: Optional[JobConfig] = None) -> None:
+def shard_worker_loop(config: Optional[JobConfig] = None,
+                      partsrv=None) -> None:
     """The shard worker's pull loop — the ``worker_loop`` shape over
     ``RequestShard``: chaos boundary, request, drive, repeat; exits on
-    DONE or a dead coordinator."""
+    DONE or a dead coordinator.  ``partsrv`` (a started
+    :class:`dsi_tpu.net.PartitionServer`) switches to the NET data
+    plane: every RPC advertises the server's address and commits
+    register partial locations instead of shared-directory renames."""
     cfg = config or JobConfig()
     sock = cfg.sock()
     worker_id = f"w{os.getpid()}"
+    serve_addr = partsrv.address if partsrv is not None else None
     shards_done = 0
     _warm_engine()
     while True:
         chaos_kill_point("shard")
+        req = {"WorkerId": worker_id}
+        if serve_addr:
+            req["Addr"] = serve_addr
         try:
-            ok, reply = rpc.call(sock, "Coordinator.RequestShard",
-                                 {"WorkerId": worker_id})
+            ok, reply = rpc.call(sock, "Coordinator.RequestShard", req)
         except rpc.CoordinatorGone as e:
             if shards_done == 0 or isinstance(e, rpc.AuthError):
                 print(f"shardworker: coordinator unreachable: {e}",
@@ -351,7 +368,8 @@ def shard_worker_loop(config: Optional[JobConfig] = None) -> None:
             time.sleep(cfg.wait_sleep_s)
             continue
         try:
-            run_shard_attempt(reply, cfg, worker_id, sock)
+            run_shard_attempt(reply, cfg, worker_id, sock,
+                              serve_addr=serve_addr)
         except rpc.CoordinatorGone:
             break
         shards_done += 1

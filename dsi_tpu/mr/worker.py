@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import zlib
 from typing import Callable, List, Sequence
 
 from dsi_tpu.config import JobConfig
@@ -177,18 +178,33 @@ def run_reduce_task(reducef: ReduceFn, reduce_task: int, n_map: int,
 
 def worker_loop(mapf: MapFn, reducef: ReduceFn,
                 config: JobConfig | None = None,
-                task_runner=None) -> None:
+                task_runner=None, partsrv=None) -> None:
     """The worker's task loop (mr.Worker, worker.go:43-165).
 
     `task_runner`, if given, is an object with run_map/run_reduce methods used
     instead of the host-Python execution above — this is the backend seam the
     TPU path plugs into (backends/tpu.py).
+
+    `partsrv`, if given, is this worker's :class:`dsi_tpu.net.PartitionServer`
+    (already started) and switches the loop to the NET data plane (ISSUE 17):
+    every RPC carries the server's address, map completions register the
+    partition locations + per-partition byte sizes with the coordinator, and
+    a reduce assignment carrying ``Net``/``MapLocs`` shuffles over TCP
+    (``net/fetch.run_reduce_task_net``) instead of reading a shared
+    directory — a failed fetch is reported as ``Coordinator.FetchFailed``
+    (the producer re-executes, §3.4) and the reduce is retried later.
     """
     import sys
 
     cfg = config or JobConfig()
     sock = cfg.sock()
     tasks_done = 0
+    addr = partsrv.address if partsrv is not None else None
+    net_stats = None
+    if partsrv is not None:
+        from dsi_tpu.obs import metrics_scope
+
+        net_stats = metrics_scope("net")
     # Task-latency histogram (obs/hist.py), published as a registry
     # gauge after every task: lands in this process's trace-meta
     # snapshot and any ``/statusz`` peephole, and gives the
@@ -208,19 +224,37 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
     # hook reads the same gauge).  Old coordinators ignore the extra key.
     worker_id = f"w{os.getpid()}"
 
-    def report_complete(method: str, task_number: int) -> bool:
+    def report_complete(method: str, task_number: int,
+                        extra: dict | None = None) -> bool:
         """Completion RPC; False means the loop must exit.  An auth
         rejection is always LOUD — a misconfigured worker must not look
         like a clean end-of-job exit."""
+        args = {"TaskNumber": task_number, "WorkerId": worker_id}
+        if extra:
+            args.update(extra)
         try:
-            rpc.call(sock, method, {"TaskNumber": task_number,
-                                    "WorkerId": worker_id})
+            rpc.call(sock, method, args)
             return True
         except rpc.AuthError as e:
             print(f"mrworker: {e}", file=sys.stderr)
             return False
         except rpc.CoordinatorGone:
             return False
+
+    def net_snapshot() -> dict:
+        return dict(net_stats) if net_stats is not None else {}
+
+    def net_deltas(before: dict) -> dict:
+        """Per-task net-attribution deltas for the completion RPC (the
+        coordinator aggregates job-wide; totals would double-count)."""
+        if net_stats is None:
+            return {}
+        return {wire: int(net_stats.get(k, 0)) - int(before.get(k, 0))
+                for wire, k in (("NetFetches", "net_fetches"),
+                                ("NetLocal", "net_local_reads"),
+                                ("NetRaw", "net_bytes_raw"),
+                                ("NetWire", "net_bytes_wire"),
+                                ("NetFailures", "net_fetch_failures"))}
 
     # Chaos injection (DSI_CHAOS_WORKER_KILL=p[,seed], ckpt/fault.py): a
     # real os._exit with probability p at every task boundary, so
@@ -231,9 +265,11 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
 
     while True:
         chaos_kill_point("task")
+        req = {"TaskNumber": 0, "WorkerId": worker_id}
+        if addr:
+            req["Addr"] = addr
         try:
-            ok, reply = rpc.call(sock, "Coordinator.RequestTask",
-                                 {"TaskNumber": 0, "WorkerId": worker_id})
+            ok, reply = rpc.call(sock, "Coordinator.RequestTask", req)
         except rpc.CoordinatorGone as e:
             # Coordinator exited; the reference worker dies here
             # (worker.go:176-178).  Normal at end-of-job; noteworthy if this
@@ -258,10 +294,68 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
                                  reply["NReduce"], cfg.workdir)
             note_task(sp.elapsed_s)
             tasks_done += 1
+            extra = None
+            if addr:
+                # Register the partition locations (§3.1): this spool
+                # serves mr-<m>-*; the byte sizes feed the locality-
+                # share placement policy.
+                sizes = []
+                for r in range(int(reply["NReduce"])):
+                    try:
+                        sizes.append(os.path.getsize(intermediate_name(
+                            reply["CMap"], r, cfg.workdir)))
+                    except OSError:
+                        sizes.append(0)
+                extra = {"Addr": addr, "PartSizes": sizes}
             if not report_complete("Coordinator.RecieveMapComplete",
-                                   reply["CMap"]):
+                                   reply["CMap"], extra):
                 break
         elif status == int(TaskStatus.REDUCE):
+            if reply.get("Net") and addr:
+                # NET data plane: shuffle over TCP from the producers'
+                # partition servers (ISSUE 17).
+                from dsi_tpu.net.fetch import (FetchFailure,
+                                               run_reduce_task_net)
+
+                before = net_snapshot()
+                try:
+                    with Span("worker.reduce", task=reply["CReduce"],
+                              net=1) as sp:
+                        out_name = run_reduce_task_net(
+                            reducef, reply["CReduce"],
+                            reply.get("MapLocs") or {},
+                            workdir=cfg.workdir, own_addr=addr,
+                            stats=net_stats,
+                            timeout=cfg.net_fetch_timeout_s)
+                except FetchFailure as e:
+                    # The producer's server is gone: hand the failure
+                    # to the coordinator (it re-executes the map, §3.4)
+                    # and go back to the well — this reduce re-runs
+                    # after the map barrier reopens.
+                    try:
+                        rpc.call(sock, "Coordinator.FetchFailed",
+                                 {"Map": e.task, "Reduce": reply["CReduce"],
+                                  "WorkerId": worker_id, "Addr": e.addr})
+                    except rpc.CoordinatorGone:
+                        break
+                    print(f"mrworker: fetch failed ({e}); reported, "
+                          "retrying later", file=sys.stderr)
+                    continue
+                note_task(sp.elapsed_s)
+                tasks_done += 1
+                extra = net_deltas(before)
+                extra["Addr"] = addr
+                extra["Name"] = out_name
+                try:
+                    with open(os.path.join(cfg.workdir, out_name),
+                              "rb") as f:
+                        extra["Crc"] = zlib.crc32(f.read())
+                except OSError:
+                    extra["Crc"] = 0
+                if not report_complete("Coordinator.RecieveReduceComplete",
+                                       reply["CReduce"], extra):
+                    break
+                continue
             with Span("worker.reduce", task=reply["CReduce"]) as sp:
                 if task_runner is not None:
                     task_runner.run_reduce(reducef, reply["CReduce"],

@@ -1,0 +1,33 @@
+"""Network data plane: worker-served shuffle over TCP (ISSUE 17).
+
+Dean & Ghemawat's data plane is not a shared filesystem: map workers
+write intermediate partitions to LOCAL disk and serve them to reducers
+over RPC, with the master scheduling for locality (OSDI'04 §3.1 step 4)
+and re-executing completed map tasks whose disk died (§3.4).  The
+6.5840 lab contract this repo reproduces punts on that with one shared
+working directory — the single remaining reason the framework is
+one-machine.  This package severs it:
+
+* :mod:`dsi_tpu.net.partsrv` — the worker-side partition server: spools
+  bytes to a PRIVATE local dir through the durable-write path and
+  serves them over the :class:`dsi_tpu.mr.rpc.StreamServer` chunked
+  transport (per-chunk CRC32 + whole-payload trailer, hello-frame
+  version gate).
+* :mod:`dsi_tpu.net.fetch` — the consumer side: CRC-verified streaming
+  fetch with the PR-13 line codec on the wire
+  (``net_bytes_raw``/``net_bytes_wire``/``net_ratio`` attribution, the
+  ``net`` trace lane), plus the reducer that shuffles over TCP instead
+  of reading ``mr-*-<r>`` from a shared directory.
+
+The coordinator half (location registry, locality-aware placement,
+re-fetch-from-replacement via producer re-execution) lives in
+``mr/coordinator.py``; the harness half (``mrrun --net``,
+``shardrun --hosts``, per-process private workdirs) in the CLIs.
+"""
+
+from dsi_tpu.net.partsrv import PartitionServer, reap_spool
+from dsi_tpu.net.fetch import (FetchFailure, fetch_partition,
+                               run_reduce_task_net)
+
+__all__ = ["PartitionServer", "reap_spool", "FetchFailure",
+           "fetch_partition", "run_reduce_task_net"]

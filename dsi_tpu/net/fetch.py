@@ -1,0 +1,156 @@
+"""Consumer-side fetch: CRC-verified shuffle over TCP, attributed.
+
+The reducer half of the network data plane.  :func:`fetch_partition`
+pulls one spooled payload from a producer's partition server (or reads
+it locally when the producer is THIS worker — the locality hit the
+coordinator's placement policy works to maximize) and unwraps the
+one-byte codec flag (``partsrv.CODEC_KV``/``CODEC_RAW``).  Every fetch
+is attributed in the ``net`` trace lane and a ``net`` metrics scope:
+``net_bytes_raw`` (what the consumer got), ``net_bytes_wire`` (what
+crossed the link), ``net_ratio`` (their quotient — the PR-13 codec's
+evidence on this link), ``net_fetches``/``net_local_reads``/
+``net_fetch_failures``.
+
+Failure taxonomy, matching the RPC layer's:
+
+* :class:`dsi_tpu.mr.rpc.ProtocolMismatch` / ``AuthError`` —
+  mis-deployed fleet; NEVER absorbed here, the run must fail loudly.
+* everything else (dead server, mid-stream death, CRC mismatch,
+  server-side missing file) → :class:`FetchFailure`, carrying which
+  producer task's bytes were lost — the caller reports it to the
+  coordinator, which re-executes the producer (§3.4) and the consumer
+  re-fetches from the replacement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+from dsi_tpu.mr import rpc
+from dsi_tpu.net.partsrv import CODEC_KV, CODEC_RAW
+from dsi_tpu.obs import span
+
+
+class FetchFailure(Exception):
+    """A partition fetch failed for reasons a producer re-execution can
+    cure (dead/dying server, torn stream, missing spool entry)."""
+
+    def __init__(self, task: int, addr: str, name: str, cause: Exception):
+        super().__init__(f"fetching {name} from {addr}: {cause}")
+        self.task = task
+        self.addr = addr
+        self.name = name
+        self.cause = cause
+
+
+def _unwrap(payload: bytes) -> bytes:
+    """Strip the codec flag byte; unpack when the producer packed."""
+    flag, body = payload[:1], payload[1:]
+    if flag == CODEC_KV:
+        from dsi_tpu.ops.wirecodec import unpack_kv
+
+        return unpack_kv(body)
+    if flag == CODEC_RAW:
+        return body
+    raise rpc.StreamError(f"unknown codec flag {flag!r}")
+
+
+def _attribute(stats, raw_n: int, wire_n: int, local: bool) -> None:
+    if stats is None:
+        return
+    if local:
+        stats["net_local_reads"] = stats.get("net_local_reads", 0) + 1
+        return
+    stats["net_fetches"] = stats.get("net_fetches", 0) + 1
+    stats["net_bytes_raw"] = stats.get("net_bytes_raw", 0) + raw_n
+    stats["net_bytes_wire"] = stats.get("net_bytes_wire", 0) + wire_n
+    wire = stats["net_bytes_wire"]
+    stats["net_ratio"] = round(stats["net_bytes_raw"] / wire, 3) \
+        if wire else 0.0
+
+
+def fetch_partition(addr: str, name: str, *, stats=None,
+                    own_addr: str | None = None,
+                    local_root: str | None = None,
+                    timeout: float = 30.0,
+                    secret: str | None = None) -> bytes:
+    """One partition's bytes, wherever they live.
+
+    When ``addr`` is our own advertised address the bytes are already in
+    our spool (``local_root``) — read them directly, no socket, counted
+    as ``net_local_reads`` (the §3.1-step-4 locality win).  Otherwise a
+    streaming fetch with the codec flag unwrapped and the raw/wire bytes
+    attributed.  Raises :class:`FetchFailure` (with ``task=-1``; callers
+    that know the producer task re-raise with it filled) on anything a
+    re-execution can cure."""
+    if own_addr is not None and addr == own_addr and local_root:
+        try:
+            with span("net", lane="net", part=name, local=1):
+                with open(os.path.join(local_root, name), "rb") as f:
+                    raw = f.read()
+        except OSError as e:
+            raise FetchFailure(-1, addr, name, e) from e
+        _attribute(stats, len(raw), 0, local=True)
+        return raw
+    try:
+        with span("net", lane="net", part=name, addr=addr):
+            payload = rpc.stream_fetch(addr, "Fetch", {"Name": name},
+                                       timeout=timeout, secret=secret)
+            raw = _unwrap(payload)
+    except (rpc.ProtocolMismatch, rpc.AuthError):
+        raise  # mis-deployed fleet: no replacement will cure it
+    except (rpc.CoordinatorGone, OSError, ValueError) as e:
+        if stats is not None:
+            stats["net_fetch_failures"] = \
+                stats.get("net_fetch_failures", 0) + 1
+        raise FetchFailure(-1, addr, name, e) from e
+    _attribute(stats, len(raw), len(payload), local=False)
+    return raw
+
+
+def run_reduce_task_net(reducef, reduce_task: int, map_locs: Dict,
+                        *, workdir: str = ".",
+                        own_addr: str | None = None,
+                        stats=None, timeout: float = 30.0,
+                        secret: str | None = None) -> str:
+    """One reduce task with the shuffle over TCP.
+
+    ``map_locs`` maps map-task number (possibly a JSON-string key — RPC
+    round-trip) to the producer's partition-server address.  Each
+    ``mr-<m>-<r>`` is fetched from the host that produced it, decoded
+    with the reference's lenient record semantics, then sorted, grouped,
+    reduced, and committed FIRST-WINS to this worker's private workdir
+    (``mr-out-<r>``) exactly like the shared-dir path.  No intermediate
+    GC — the producers' spools are on other machines; retention aging
+    (``partsrv.reap_spool``) owns their lifetime.  Returns the committed
+    output's basename.  Raises :class:`FetchFailure` with the producer
+    map task filled in when any partition cannot be fetched."""
+    from dsi_tpu.mr.types import KeyValue
+    from dsi_tpu.mr.worker import group_and_reduce, output_name
+    from dsi_tpu.utils.atomicio import atomic_write
+
+    intermediate: list = []
+    for m_key in sorted(map_locs, key=lambda k: int(k)):
+        m = int(m_key)
+        name = f"mr-{m}-{reduce_task}"
+        try:
+            raw = fetch_partition(map_locs[m_key], name, stats=stats,
+                                  own_addr=own_addr, local_root=workdir,
+                                  timeout=timeout, secret=secret)
+        except FetchFailure as e:
+            raise FetchFailure(m, e.addr, e.name, e.cause) from e
+        for line in raw.decode("utf-8").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                break  # truncated record: the reference's decoder break
+            intermediate.append(KeyValue(obj["Key"], obj["Value"]))
+    out = output_name(reduce_task, workdir)
+    with atomic_write(out, first_wins=True) as f:
+        group_and_reduce(intermediate, reducef, f)
+    return os.path.basename(out)

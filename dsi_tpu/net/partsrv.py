@@ -1,0 +1,151 @@
+"""Worker-side partition server: spool locally, serve over TCP.
+
+The producer half of the network data plane.  Each worker owns ONE
+private spool directory (its private workdir in the share-nothing
+harness); everything it must make fetchable — map-side shuffle
+partitions, committed shard outputs, reduce outputs — either already
+lives there (the classic ``mr-<m>-<r>``/``mr-out-<r>`` commit paths
+write into the worker's workdir) or is spooled explicitly via
+:meth:`PartitionServer.put` (the durable-write path: temp + fsync +
+rename + CRC32 sidecar).  Consumers fetch by basename over the
+:class:`dsi_tpu.mr.rpc.StreamServer` chunked transport.
+
+Wire codec: when enabled (default), payloads that the PR-13 line codec
+(``ops/wirecodec.pack_kv``) actually shrinks ship packed, prefixed with
+a one-byte flag — ``b"K"`` (packed) or ``b"R"`` (raw) — so the consumer
+never guesses from content.  Exactness never depends on the codec: a
+payload the dictionary does not help ships raw.
+
+Boot hygiene (satellite): a kill-9'd predecessor leaves ``.tmp-*``
+orphans mid-commit and whole dead-task spools nobody will ever fetch.
+:func:`reap_spool` runs at server construction — ``reap_tmp_files``
+plus retention-aged file GC, the serve daemon's ``_boot_hygiene`` /
+``_gc_aged_chains`` discipline scaled down to one flat directory.
+
+Fault injection: the ``mid-serve`` point (``ckpt/fault.py``) and the
+``mid-serve`` chaos boundary both fire after the FIRST chunk of a
+response hits the socket, so a killed server leaves the consumer a
+half-sent payload and a dead peer — the exact failure the coordinator's
+re-fetch-from-replacement machinery must absorb.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Tuple
+
+from dsi_tpu.mr import rpc
+from dsi_tpu.utils.atomicio import reap_tmp_files, write_bytes_durable
+
+#: One-byte wire flags: packed with the line codec vs raw bytes.
+CODEC_KV = b"K"
+CODEC_RAW = b"R"
+
+
+def reap_spool(spool_dir: str,
+               retention_s: float = 3600.0) -> Tuple[int, int]:
+    """Boot hygiene for one spool directory: remove ``.tmp-*`` orphans
+    (``atomic_write`` temps from a writer killed mid-commit) and age out
+    files untouched past ``retention_s`` (dead-task spools — their job
+    finished or was re-executed elsewhere; nothing will fetch them).
+    Returns ``(tmp_reaped, aged_out)``.  Safe only at boot, before this
+    process starts writing — exactly when it is called."""
+    reaped = reap_tmp_files(spool_dir)
+    aged = 0
+    now = time.time()
+    try:
+        names = os.listdir(spool_dir)
+    except OSError:
+        return reaped, 0
+    for name in names:
+        path = os.path.join(spool_dir, name)
+        try:
+            if os.path.isfile(path) and \
+                    now - os.path.getmtime(path) > retention_s:
+                os.remove(path)
+                aged += 1
+        except OSError:
+            pass
+    return reaped, aged
+
+
+class PartitionServer:
+    """Serve one private spool directory's files over the stream
+    transport.
+
+    ``bind`` defaults to ``tcp:127.0.0.1:0`` (an OS-assigned loopback
+    port — the localhost harness); multi-host fleets bind a reachable
+    host and MUST set ``DSI_MR_SECRET`` (the StreamServer refuses
+    non-loopback TCP without it).  :attr:`address` is the dialable
+    form to register with the coordinator.
+    """
+
+    def __init__(self, spool_dir: str, bind: str = "",
+                 secret: str | None = None,
+                 retention_s: float = 3600.0, codec: bool = True):
+        self.spool_dir = os.path.abspath(spool_dir)
+        os.makedirs(self.spool_dir, exist_ok=True)
+        self.boot_reaped, self.boot_aged = reap_spool(self.spool_dir,
+                                                      retention_s)
+        self.codec = codec
+        self.served = 0
+        self._srv = rpc.StreamServer(bind or "tcp:127.0.0.1:0",
+                                     {"Fetch": self._fetch},
+                                     secret=secret,
+                                     chunk_hook=self._chunk_hook)
+
+    # ── spool ──
+
+    def path_of(self, name: str) -> str:
+        """Spool path for ``name``; rejects anything that is not a
+        plain visible basename (path escapes, ``.tmp-*`` temps, CRC
+        sidecars) — the fetch surface must not read outside the
+        spool."""
+        if (not name or name != os.path.basename(name)
+                or name.startswith(".")):
+            raise ValueError(f"bad partition name {name!r}")
+        return os.path.join(self.spool_dir, name)
+
+    def put(self, name: str, data: bytes) -> int:
+        """Spool ``data`` durably under ``name``; returns its CRC32
+        (``write_bytes_durable``: temp + fsync + rename + sidecar)."""
+        return write_bytes_durable(self.path_of(name), data)
+
+    # ── serving ──
+
+    def _chunk_hook(self, chunk_index: int) -> None:
+        # After the first chunk is on the wire: the consumer has the
+        # header + a partial payload when the kill lands.
+        if chunk_index == 0:
+            from dsi_tpu.ckpt.fault import chaos_kill_point, fault_point
+
+            fault_point("mid-serve")
+            chaos_kill_point("mid-serve")
+
+    def _fetch(self, args: dict) -> bytes:
+        name = args.get("Name")
+        if not isinstance(name, str):
+            raise ValueError("Fetch needs a Name")
+        with open(self.path_of(name), "rb") as f:
+            raw = f.read()
+        self.served += 1
+        if self.codec:
+            from dsi_tpu.ops.wirecodec import pack_kv
+
+            packed = pack_kv(raw)
+            if len(packed) < len(raw):
+                return CODEC_KV + packed
+        return CODEC_RAW + raw
+
+    # ── lifecycle ──
+
+    @property
+    def address(self) -> str:
+        return self._srv.address
+
+    def start(self) -> None:
+        self._srv.start()
+
+    def close(self) -> None:
+        self._srv.close()

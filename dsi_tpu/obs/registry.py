@@ -195,6 +195,12 @@ COUNTER_KEYS = (
     "plan_resumed_stages", "plan_stage_walls",
     # elastic dataflow (ISSUE 16): pipelined pair + stage-shard fan-out
     "plan_pipelined", "plan_stage_shards",
+    # network data plane (ISSUE 17, the "net" scope, dsi_tpu/net):
+    # worker-served shuffle attribution — raw vs wire bytes is the
+    # codec's evidence, locality_hits the placement policy's, and
+    # net_refetches the re-fetch-from-replacement machinery's
+    "net_fetches", "net_local_reads", "net_bytes_raw", "net_bytes_wire",
+    "net_ratio", "net_fetch_failures", "net_refetches", "locality_hits",
 )
 
 #: THE schema: every key an engine scope may carry, under its unified

@@ -107,6 +107,15 @@ GATES: List[Tuple[str, str, float]] = [
     # pipelined throughput gate already owns that trade.
     ("spec_resplits", "higher", 0.90),
     ("spec_subshards", "higher", 0.90),
+    # Network data plane (ISSUE 17): the *_mbps/*_parity patterns above
+    # already gate net_shuffle_mbps/net_fs_mbps and net_parity.
+    # net_ratio gates higher-better explicitly (it does not match the
+    # wire_ratio patterns): a drop means shuffle payloads stopped
+    # crossing the link through the line codec.  locality_hits
+    # regresses when placement goes dark entirely (the
+    # spec_backup_fired precedent: 1→0 gates, count wobble does not).
+    ("net_ratio", "higher", 0.10),
+    ("locality_hits", "higher", 0.90),
 ]
 
 

@@ -27,7 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
-def run_bench(tmp_path, extra_env, timeout=300):
+def run_bench(tmp_path, extra_env, timeout=420):
     env = dict(os.environ)
     env.update({
         "DSI_BENCH_FILES": "2",
@@ -82,7 +82,12 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
                                  # plan row at contract-test scale:
                                  # 2 planrun subprocesses (chained +
                                  # staged) over a 1 MB corpus
-                                 "DSI_BENCH_PLAN_MB": "1"})
+                                 "DSI_BENCH_PLAN_MB": "1",
+                                 # net row at contract-test scale: two
+                                 # mrrun fleets per pass — worker boots,
+                                 # not MBs, dominate (hence run_bench's
+                                 # 420 s headroom over the old 300)
+                                 "DSI_BENCH_NET_MB": "1"})
     assert rc == 0
     assert v["metric"] == "wc_cpu_fallback_throughput"
     assert v["platform"] == "cpu"
@@ -214,6 +219,18 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         if "spec_resplit_mbps" in v:
             assert v["spec_resplits"] >= 1
             assert v["spec_subshards"] >= 2
+    # The network-data-plane A/B row (ISSUE 17): measured XOR skipped;
+    # a measured row carries both planes' throughput, the codec's wire
+    # leverage (the >= 1.5 acceptance bar), and the locality evidence,
+    # each arm parity-gated in its subprocess.
+    assert ("net_skipped" in v) != ("net_shuffle_mbps" in v)
+    if "net_shuffle_mbps" in v:
+        assert v["net_parity"] is True
+        assert v["net_fs_mbps"] > 0
+        assert v["net_fetches"] + v["net_local_reads"] > 0
+        assert v["net_ratio"] >= 1.5
+        assert v["locality_hits"] >= 0
+        assert v["net_refetches"] == 0  # no chaos in the bench arm
 
 
 def test_engine_phase_dicts_come_from_the_registry(tmp_path):
@@ -370,7 +387,8 @@ def test_stream_row_disabled_leaves_no_stream_keys(tmp_path):
     rc, v = run_bench(tmp_path, {"DSI_BENCH_TPU_TIMEOUTS": "0",
                                  "DSI_BENCH_DEADLINE_S": "600",
                                  "DSI_BENCH_STREAM_MB": "0",
-                                 "DSI_BENCH_FRAMEWORK_MB": "0"})
+                                 "DSI_BENCH_FRAMEWORK_MB": "0",
+                                 "DSI_BENCH_NET_MB": "1"})
     assert rc == 0
     assert not any(k.startswith("stream_") for k in v)
     assert not any(k.startswith("framework_") for k in v)
