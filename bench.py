@@ -2066,6 +2066,95 @@ def run_net_row() -> dict:
     return row
 
 
+def run_net_pipeline_row() -> dict:
+    """The overlapped-shuffle A/B (ISSUE 18): the SAME reduce-side
+    fetch plan — P partitions spread across S in-process partition
+    servers — pulled twice, serial (window 1: one blocking fetch at a
+    time, the pre-pipeline path) vs pipelined (``FetchPipeline`` at
+    the default window).  Localhost TCP is far too fast for prefetch
+    to show, so every server runs with an injected per-chunk serve
+    latency (``DSI_NET_CHUNK_SLEEP_S`` — the ``chunk_hook`` sleep,
+    identical on BOTH arms); the pipelined arm hides it by keeping
+    several streams in flight, which is exactly the claim
+    ``net_pipelined_mbps``/``net_serial_mbps`` measures.  Parity-gated:
+    both arms must yield byte-identical payload sequences (producer
+    order) or the row is suppressed.  ``net_overlap_s`` (dialer wire
+    time hidden behind the consumer) comes from the pipelined arm's
+    stats.  Chip-independent, measured keys XOR
+    ``net_pipeline_skipped``.  ``DSI_BENCH_NET_PIPE_MB`` (default 2;
+    0 disables) sizes it; ``DSI_BENCH_NET_PIPE_SLEEP`` (default 0.03)
+    is the injected per-chunk latency."""
+    mb = env_float("DSI_BENCH_NET_PIPE_MB", 2.0)
+    if mb <= 0:
+        return {"net_pipeline_skipped":
+                "disabled (DSI_BENCH_NET_PIPE_MB=0)"}
+    sleep_s = env_float("DSI_BENCH_NET_PIPE_SLEEP", 0.03)
+    import shutil
+
+    from dsi_tpu.net.fetch import (DEFAULT_FETCH_WINDOW, FetchPipeline,
+                                   fetch_partition)
+    from dsi_tpu.net.partsrv import PartitionServer
+
+    ndir = os.path.join(WORKDIR, "net-pipe-row")
+    shutil.rmtree(ndir, ignore_errors=True)
+    n_srv, n_part = 4, 8
+    part_bytes = int(mb * 1e6 / n_part)
+    servers = []
+    old = os.environ.get("DSI_NET_CHUNK_SLEEP_S")
+    os.environ["DSI_NET_CHUNK_SLEEP_S"] = str(sleep_s)
+    try:
+        items = []
+        for p in range(n_part):
+            if p < n_srv:
+                srv = PartitionServer(os.path.join(ndir, f"srv-{p}"))
+                srv.start()
+                servers.append(srv)
+            srv = servers[p % n_srv]
+            name = f"mr-{p}-0"
+            line = f"pipe{p:02d} " * 16 + "\n"
+            srv.put(name, (line * (part_bytes // len(line) + 1))
+                    [:part_bytes].encode())
+            items.append((p, srv.address, name))
+        total_mb = n_part * part_bytes / 1e6
+
+        t0 = time.perf_counter()
+        serial = [fetch_partition(a, n) for _, a, n in items]
+        serial_s = time.perf_counter() - t0
+
+        io_b: dict = {}
+        t0 = time.perf_counter()
+        piped = [raw for _, raw in
+                 FetchPipeline(items, window=DEFAULT_FETCH_WINDOW,
+                               stats=io_b)]
+        piped_s = time.perf_counter() - t0
+    except Exception as e:
+        return {"net_pipeline_skipped": f"net pipeline row failed: "
+                                        f"{type(e).__name__}: {e}"}
+    finally:
+        for srv in servers:
+            srv.close()
+        if old is None:
+            os.environ.pop("DSI_NET_CHUNK_SLEEP_S", None)
+        else:
+            os.environ["DSI_NET_CHUNK_SLEEP_S"] = old
+        shutil.rmtree(ndir, ignore_errors=True)
+    if serial != piped:
+        return {"net_pipeline_skipped":
+                "parity mismatch: pipelined payloads != serial"}
+    row = {"net_pipe_mb": round(total_mb, 2),
+           "net_pipeline_parity": True,
+           "net_serial_mbps": round(total_mb / (serial_s or 1e-9), 2),
+           "net_pipelined_mbps": round(total_mb / (piped_s or 1e-9), 2),
+           "net_overlap_s": float(io_b.get("net_overlap_s", 0.0)),
+           "net_fetch_wait_s": float(io_b.get("net_fetch_wait_s", 0.0))}
+    log(f"net pipeline row: {total_mb:.1f} MB over {n_part} partitions "
+        f"x {n_srv} servers ({sleep_s}s/chunk injected) — pipelined "
+        f"(window {DEFAULT_FETCH_WINDOW}) {row['net_pipelined_mbps']} "
+        f"MB/s ({piped_s:.2f}s, overlap {row['net_overlap_s']}s) vs "
+        f"serial {row['net_serial_mbps']} MB/s ({serial_s:.2f}s)")
+    return row
+
+
 def run_native_oracle_row(files, oracle_out, total_mb, native_ok,
                           fw_oracle_mbps) -> dict:
     """Sequential run of the SAME C++ task bodies the native-backend
@@ -2460,6 +2549,17 @@ def main() -> None:
                                  f"{type(e).__name__}: {e}")
     else:
         fw["net_skipped"] = f"budget {budget_s:.0f}s < 60s"
+    # The overlapped-shuffle pipelined-vs-serial fetch A/B row
+    # (ISSUE 18): chip-independent (in-process partition servers with
+    # injected serve latency), rides every branch.
+    if budget_s >= 30 or "DSI_BENCH_NET_PIPE_MB" in os.environ:
+        try:
+            fw.update(run_net_pipeline_row())
+        except Exception as e:
+            fw["net_pipeline_skipped"] = (f"net pipeline row failed: "
+                                          f"{type(e).__name__}: {e}")
+    else:
+        fw["net_pipeline_skipped"] = f"budget {budget_s:.0f}s < 30s"
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,

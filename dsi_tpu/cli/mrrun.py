@@ -57,6 +57,11 @@ def main(argv=None) -> int:
                         "TCP, coordinator control plane on TCP — the "
                         "share-nothing harness (no worker reads any "
                         "other process's directory)")
+    p.add_argument("--fetch-window", type=int, default=0,
+                   help="reduce-side prefetch window (ISSUE 18): fetches "
+                        "in flight + buffered while the consumer decodes; "
+                        "1 = the serial loop bit-identically.  0 (default) "
+                        "defers to DSI_NET_FETCH_WINDOW (default 4)")
     p.add_argument("--stats-json", default="",
                    help="dump the coordinator's net_stats() (net mode) "
                         "— the CI smoke's and bench row's evidence "
@@ -80,10 +85,6 @@ def main(argv=None) -> int:
     if os.sep in app or app.endswith(".py"):
         app = os.path.abspath(app)  # workers run with cwd=workdir
     journal = os.path.abspath(args.journal) if args.journal else ""
-    if args.net and journal:
-        p.error("--net does not support --journal (the location "
-                "registry is in-memory; a restarted coordinator cannot "
-                "know where spooled partitions live)")
     if args.resume:
         if not journal:
             p.error("--resume requires --journal")
@@ -124,7 +125,7 @@ def main(argv=None) -> int:
                 pass
 
     if args.net:
-        rc = _net_job(args, workdir, files, app, env)
+        rc = _net_job(args, workdir, files, app, env, journal)
         if args.trace_dir:
             from dsi_tpu.obs import flush_tracing, trace_event
 
@@ -283,7 +284,7 @@ def _parity_check(args, workdir: str, files: list) -> int:
 
 
 def _net_job(args, workdir: str, files: list, app: str,
-             env: dict) -> int:
+             env: dict, journal: str = "") -> int:
     """The share-nothing job (``--net``): coordinator in-process on
     localhost TCP, each worker in its own PRIVATE workdir serving its
     spool over a partition server, the shuffle and the final output
@@ -307,11 +308,14 @@ def _net_job(args, workdir: str, files: list, app: str,
     cfg = JobConfig(n_reduce=args.nreduce, workdir=workdir,
                     socket_path="tcp:127.0.0.1:0",
                     task_timeout_s=args.task_timeout,
-                    net_shuffle=True)
+                    net_shuffle=True,
+                    journal_path=journal)
     coord = Coordinator(files, args.nreduce, cfg)
     coord.serve()
     env = dict(env)
     env["DSI_MR_SOCKET"] = coord.address()
+    if args.fetch_window > 0:  # CLI twin of DSI_NET_FETCH_WINDOW
+        env["DSI_NET_FETCH_WINDOW"] = str(args.fetch_window)
     # Workers run with cwd=their private dir; make the package
     # importable there even when not installed (the test-sandbox case).
     import dsi_tpu as _pkg
@@ -436,7 +440,11 @@ def _net_job(args, workdir: str, files: list, app: str,
           f"(ratio {run_stats['net_ratio']}), "
           f"{run_stats['locality_hits']} locality hits, "
           f"{run_stats['net_fetch_failures']} fetch failures, "
-          f"{run_stats['net_refetches']} refetches", file=sys.stderr)
+          f"{run_stats['net_refetches']} refetches, "
+          f"window {run_stats.get('net_prefetch_window', 0)} "
+          f"(overlap {run_stats.get('net_overlap_s', 0.0)}s, "
+          f"wait {run_stats.get('net_fetch_wait_s', 0.0)}s)",
+          file=sys.stderr)
     return rc
 
 

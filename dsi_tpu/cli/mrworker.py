@@ -51,9 +51,10 @@ def main(argv=None) -> int:
     spool = os.environ.get("DSI_NET_SPOOL")
     partsrv = None
     if spool:
-        from dsi_tpu.net import PartitionServer
+        from dsi_tpu.net import PartitionServer, fetch_window_from_env
 
-        cfg = JobConfig(backend=args.backend, net_shuffle=True)
+        cfg = JobConfig(backend=args.backend, net_shuffle=True,
+                        net_fetch_window=fetch_window_from_env())
         partsrv = PartitionServer(
             spool, bind=os.environ.get("DSI_NET_BIND", ""),
             retention_s=cfg.net_spool_retention_s,

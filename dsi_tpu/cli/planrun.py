@@ -43,6 +43,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 
 def _positive_int(s: str) -> int:
@@ -50,6 +51,153 @@ def _positive_int(s: str) -> int:
     if v < 1:
         raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
     return v
+
+
+def _plan_spec(args) -> dict:
+    """The plan-rebuild spec (``plan.stagehost.build_plan`` input) this
+    argv describes — the single source both the in-process paths and
+    every ``--hosts`` stage host rebuild the plan from."""
+    return {"chain": args.chain, "pattern": args.pattern,
+            "pattern2": args.pattern2, "files": list(args.files),
+            "chunk_bytes": args.chunk_bytes, "depth": args.pipeline_depth,
+            "device_accumulate": args.device_accumulate,
+            "sync_every": args.sync_every,
+            "mesh_shards": args.mesh_shards, "aot": args.aot,
+            "n_reduce": args.nreduce, "u_cap": args.u_cap,
+            "topk": args.topk, "devices": args.devices}
+
+
+def _run_hosts(args, spec: dict, mesh):
+    """``--hosts``: every stage in its OWN process with a PRIVATE
+    working directory; inter-stage bytes move ONLY over TCP (net-served
+    plan relays, ISSUE 18).  Spawns one ``plan.stagehost`` per stage in
+    topo order (each handed its deps' ``{addr, name, crc}`` from their
+    ready files), then collects every stage's sealed payload over the
+    stream transport to assemble the PlanResult.  Returns
+    ``(PlanResult, stats_dict)``; raises RuntimeError on a stage
+    failure or timeout."""
+    import shutil
+    import subprocess
+
+    from dsi_tpu.obs import metrics_scope
+    from dsi_tpu.plan.driver import PlanResult, _load_commit
+    from dsi_tpu.plan.stagehost import build_plan, fetch_stage_payload
+    from dsi_tpu.utils.atomicio import atomic_write
+
+    plan = build_plan(spec)
+    order = plan.ordered()
+    sc = metrics_scope("plan")
+    sc.update({"plan_stages": len(order), "plan_intermediate_bytes": 0,
+               "plan_commit_bytes": 0, "plan_resumed_stages": 0,
+               "plan_handoff": "net", "plan_pipelined": 0,
+               "plan_stage_shards": max(0, args.stage_shards),
+               "plan_overlap_s": 0.0, "plan_s": 0.0,
+               "plan_stage_walls": {}})
+    net_io = metrics_scope("net")
+    os.makedirs(args.workdir, exist_ok=True)
+    procs: list = []
+    stage_dirs: list = []
+    readies: dict = {}
+    deadline = time.monotonic() + args.timeout
+    try:
+        for i, stage in enumerate(order):
+            sdir = os.path.join(args.workdir, f"stage-{i}")
+            os.makedirs(os.path.join(sdir, "spool"), exist_ok=True)
+            stage_dirs.append(sdir)
+            host_spec = {
+                "plan": spec, "stage_index": i,
+                "stage_shards": max(0, args.stage_shards),
+                "spool": os.path.join(sdir, "spool"),
+                "ready": os.path.join(sdir, "ready.json"),
+                "deps": {d: {"addr": readies[d]["addr"],
+                             "name": readies[d]["name"],
+                             "crc": readies[d]["crc"]}
+                         for d in stage.deps},
+            }
+            spec_path = os.path.join(sdir, "spec.json")
+            with atomic_write(spec_path, mode="w") as f:
+                json.dump(host_spec, f, sort_keys=True)
+            # dsicheck: allow[raw-write] child console capture, not durable state
+            logf = open(os.path.join(sdir, "stage.log"), "wb")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "dsi_tpu.plan.stagehost",
+                 "--spec", spec_path],
+                stdout=logf, stderr=subprocess.STDOUT,
+                env=dict(os.environ))
+            procs.append((proc, logf))
+            ready_path = host_spec["ready"]
+            while not os.path.exists(ready_path):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"stage host {i} ({stage.name}) exited "
+                        f"rc={proc.returncode} before ready — see "
+                        f"{sdir}/stage.log")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"stage host {i} ({stage.name}) not ready "
+                        f"within --timeout {args.timeout}s")
+                time.sleep(0.05)
+            with open(ready_path, "r", encoding="utf-8") as f:
+                readies[stage.name] = json.load(f)
+            r = readies[stage.name]
+            sc["plan_stage_walls"][stage.name] = r.get("stage_wall_s", 0)
+            sc["plan_s"] = round(sc["plan_s"]
+                                 + float(r.get("stage_wall_s", 0)), 4)
+            # The bytes a stage pulled from its predecessors ARE the
+            # inter-stage intermediates — and they crossed only TCP.
+            child_net = r.get("net") or {}
+            sc["plan_intermediate_bytes"] += \
+                int(child_net.get("net_bytes_raw", 0))
+            for k, v in child_net.items():
+                if k in ("net_ratio",):
+                    continue
+                if isinstance(v, (int, float)):
+                    if k == "net_prefetch_window":
+                        net_io[k] = max(int(net_io.get(k, 0) or 0),
+                                        int(v))
+                    else:
+                        net_io[k] = type(v)(net_io.get(k, 0) or 0) + v
+        # Share-nothing audit BEFORE any report artifact lands: sealed
+        # stage payloads must exist ONLY in the private stage spools —
+        # a payload-named file in the SHARED workdir means a stage
+        # leaked its relay past the TCP boundary.
+        leaked = [n for n in os.listdir(args.workdir)
+                  if os.path.isfile(os.path.join(args.workdir, n))
+                  and n.startswith("plan-") and n[5:6].isdigit()]
+        if leaked:
+            raise RuntimeError(
+                f"share-nothing audit failed: stage payload(s) "
+                f"{leaked} in shared workdir {args.workdir}")
+        # Collect: every stage's sealed payload, over TCP, decoded by
+        # the stage-commit codec — the same reconstruction the
+        # checkpoint/resume path uses, so parity holds by construction.
+        ctx = {}
+        for i, stage in enumerate(order):
+            r = readies[stage.name]
+            arrays, meta = fetch_stage_payload(
+                r["addr"], r["name"], int(r.get("crc", 0)),
+                stats=net_io, timeout=args.timeout)
+            ctx[stage.name] = _load_commit(plan, stage, meta, arrays,
+                                           mesh, True, sc)
+        for k in ("net_fetch_wait_s", "net_overlap_s"):
+            if k in net_io:
+                net_io[k] = round(float(net_io[k]), 6)
+        sc.update(net_io)
+        results = {name: out.result for name, out in ctx.items()}
+        res = PlanResult(results, ctx[order[-1].name].result, sc)
+    finally:
+        for proc, logf in procs:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            logf.close()
+    for sdir in stage_dirs:
+        shutil.rmtree(sdir, ignore_errors=True)
+    return res, dict(sc)
 
 
 def main(argv=None) -> int:
@@ -96,6 +244,14 @@ def main(argv=None) -> int:
                         "continue from the last completed stage's "
                         "commit point")
     p.add_argument("--workdir", default=".")
+    p.add_argument("--hosts", action="store_true",
+                   help="net-served plan relays: run every stage in its "
+                        "OWN process with a PRIVATE working directory; "
+                        "inter-stage bytes move only over TCP (the "
+                        "share-nothing harness, audited)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="--hosts per-run deadline: seconds to wait for "
+                        "all stage hosts to come ready")
     p.add_argument("--check", action="store_true",
                    help="also run the OTHER handoff mode (staged vs "
                         "chained) in-process and verify the results "
@@ -116,6 +272,17 @@ def main(argv=None) -> int:
     if args.pipeline and args.staged:
         p.error("--pipeline is chained-mode only (staged execution "
                 "stays strictly sequential: it is the parity oracle)")
+    if args.hosts and args.pipeline:
+        p.error("--hosts runs stages in separate processes; the "
+                "in-process relay overlap (--pipeline) cannot cross "
+                "them")
+    if args.hosts and (args.checkpoint_dir or args.resume):
+        p.error("--hosts has its own commit surface (sealed stage "
+                "payloads); --checkpoint-dir/--resume are the "
+                "in-process stage-manifest path")
+    if args.hosts and args.staged:
+        p.error("--hosts is its own handoff mode (net); --staged is "
+                "the in-process host-materialization baseline")
 
     if args.trace_dir:
         from dsi_tpu.obs import configure_tracing
@@ -128,41 +295,24 @@ def main(argv=None) -> int:
 
     from dsi_tpu.ckpt import CheckpointMismatch
     from dsi_tpu.parallel.shuffle import default_mesh
-    from dsi_tpu.plan import (PlanHostPath, grep_cascade_plan,
-                              grep_wordcount_plan, indexer_join_plan,
-                              run_plan, wordcount_topk_plan)
+    from dsi_tpu.plan import PlanHostPath, run_plan
+    from dsi_tpu.plan.stagehost import build_plan
 
     mesh = default_mesh(args.devices)
-    defaults = dict(chunk_bytes=args.chunk_bytes,
-                    depth=args.pipeline_depth,
-                    device_accumulate=args.device_accumulate,
-                    sync_every=args.sync_every,
-                    mesh_shards=args.mesh_shards, aot=args.aot,
-                    n_reduce=args.nreduce, u_cap=args.u_cap,
-                    topk=args.topk)
+    spec = _plan_spec(args)
 
     def build():
-        if args.chain == "grep-wc":
-            return grep_wordcount_plan(args.pattern, paths=args.files,
-                                       **defaults)
-        if args.chain == "grep-grep":
-            return grep_cascade_plan(args.pattern, args.pattern2,
-                                     paths=args.files, **defaults)
-        if args.chain == "wc-topk":
-            return wordcount_topk_plan(args.topk, paths=args.files,
-                                       **defaults)
-        docs = []
-        for path in args.files:
-            with open(path, "rb") as f:
-                docs.append(f.read())
-        return indexer_join_plan(docs, **defaults)  # topk rides defaults
+        return build_plan(spec)
 
     stats: dict = {}
     try:
-        res = run_plan(build(), mesh=mesh, staged=args.staged,
-                       checkpoint_dir=args.checkpoint_dir,
-                       resume=args.resume, pipelined=args.pipeline,
-                       stage_shards=args.stage_shards, stats=stats)
+        if args.hosts:
+            res, stats = _run_hosts(args, spec, mesh)
+        else:
+            res = run_plan(build(), mesh=mesh, staged=args.staged,
+                           checkpoint_dir=args.checkpoint_dir,
+                           resume=args.resume, pipelined=args.pipeline,
+                           stage_shards=args.stage_shards, stats=stats)
     except CheckpointMismatch as e:
         print(f"planrun: {e}", file=sys.stderr)
         return 1
@@ -170,6 +320,13 @@ def main(argv=None) -> int:
         # The chain contract is device-resident intermediates; a
         # host-path input breaks it loudly — run the standalone engines
         # (wcstream/grepstream) for such inputs.
+        print(f"planrun: {e}", file=sys.stderr)
+        return 1
+    except RuntimeError as e:
+        # --hosts orchestration failures (stage host died, deadline,
+        # share-nothing audit) — loud, nonzero, no partial artifacts.
+        if not args.hosts:
+            raise
         print(f"planrun: {e}", file=sys.stderr)
         return 1
 
@@ -238,18 +395,22 @@ def main(argv=None) -> int:
         # The twin runs the OTHER handoff mode under the SAME shard
         # fan-out: stage-sharded grep merges zero the order-sensitive
         # topk sample, so parity only holds shard-geometry-to-like.
-        twin = run_plan(build(), mesh=mesh, staged=not args.staged,
+        # Against --hosts the twin is the in-process chained run — the
+        # net-served relays must reproduce it bit-identically.
+        twin_staged = False if args.hosts else not args.staged
+        twin = run_plan(build(), mesh=mesh, staged=twin_staged,
                         stage_shards=args.stage_shards)
+        modes = ("hosts vs chained" if args.hosts
+                 else "chained vs staged")
         ok = twin.final == res.final
         if args.chain == "grep-wc":
             ok = ok and twin.results["grep"] == res.results["grep"]
         elif args.chain == "grep-grep":
             ok = ok and twin.results == res.results
         if not ok:
-            print("planrun: PARITY FAILURE chained vs staged",
-                  file=sys.stderr)
+            print(f"planrun: PARITY FAILURE {modes}", file=sys.stderr)
             return 2
-        print("planrun: parity OK (chained == staged)", file=sys.stderr)
+        print(f"planrun: parity OK ({modes})", file=sys.stderr)
     return 0
 
 

@@ -42,6 +42,12 @@ import sys
 import time
 
 
+def _fetch_window() -> int:
+    from dsi_tpu.net.fetch import fetch_window_from_env
+
+    return fetch_window_from_env()
+
+
 def _parse_worker_knob(text: str, what: str):
     i, _, rest = text.partition(":")
     if not rest:
@@ -162,7 +168,8 @@ def main(argv=None) -> int:
                     spec_resplit=args.resplit,
                     spec_resplit_ways=args.resplit_ways,
                     shard_progress_s=args.progress_s,
-                    net_shuffle=args.hosts)
+                    net_shuffle=args.hosts,
+                    net_fetch_window=_fetch_window())
     coord = Coordinator(files, 0, cfg, shard_plan=plan,
                         shard_opts={"knobs": knobs})
     coord.serve()
@@ -229,44 +236,71 @@ def main(argv=None) -> int:
         nonlocal next_idx, respawn_budget
         import zlib
 
-        from dsi_tpu.net.fetch import FetchFailure, fetch_partition
+        from dsi_tpu.net.fetch import (FetchFailure, FetchPipeline,
+                                       fetch_partition)
         from dsi_tpu.utils.atomicio import atomic_write
 
-        for sid, (a, name, crc) in sorted(
-                coord.final_locations().items()):
-            if sid in fetched:
-                continue
-            try:
-                raw = fetch_partition(a, name, stats=net_io,
-                                      timeout=cfg.net_fetch_timeout_s)
-                if crc and zlib.crc32(raw) != crc:
-                    raise FetchFailure(sid, a, name,
-                                       ValueError("crc mismatch"))
-            except FetchFailure as e:
-                print(f"shardrun: shard {sid} output fetch failed "
-                      f"({e}); re-executing", file=sys.stderr)
-                coord.refetch_shard(sid)
-                if respawn_budget <= 0:
-                    print("shardrun: workers failing repeatedly; "
-                          "giving up", file=sys.stderr)
-                    return False
-                respawn_budget -= 1
-                i = next_idx
-                next_idx += 1
-                clean = {k: v for k, v in worker_env(i).items()
-                         if k not in ("DSI_FAULT_POINT",
-                                      "DSI_FAULT_STEP",
-                                      "DSI_CHAOS_WORKER_KILL")}
-                envs.append(clean)
-                dirs.append(worker_dir(i))
-                workers.append(subprocess.Popen(worker_cmd, env=clean,
-                                                cwd=dirs[i]))
-                return True
+        todo = [(sid, loc) for sid, loc in
+                sorted(coord.final_locations().items())
+                if sid not in fetched]
+        if not todo:
+            return True
+
+        def commit(sid, a, name, crc, raw) -> None:
+            if crc and zlib.crc32(raw) != crc:
+                raise FetchFailure(sid, a, name,
+                                   ValueError("crc mismatch"))
             with atomic_write(os.path.join(workdir,
                                            f"mr-shard-out-{sid}"),
                               mode="wb") as f:
                 f.write(raw)
             fetched.add(sid)
+
+        def reexecute(sid, e) -> bool:
+            nonlocal next_idx, respawn_budget
+            print(f"shardrun: shard {sid} output fetch failed "
+                  f"({e}); re-executing", file=sys.stderr)
+            coord.refetch_shard(sid)
+            if respawn_budget <= 0:
+                print("shardrun: workers failing repeatedly; "
+                      "giving up", file=sys.stderr)
+                return False
+            respawn_budget -= 1
+            i = next_idx
+            next_idx += 1
+            clean = {k: v for k, v in worker_env(i).items()
+                     if k not in ("DSI_FAULT_POINT",
+                                  "DSI_FAULT_STEP",
+                                  "DSI_CHAOS_WORKER_KILL")}
+            envs.append(clean)
+            dirs.append(worker_dir(i))
+            workers.append(subprocess.Popen(worker_cmd, env=clean,
+                                            cwd=dirs[i]))
+            return True
+
+        window = cfg.net_fetch_window
+        if window <= 1 or len(todo) == 1:
+            for sid, (a, name, crc) in todo:
+                try:
+                    raw = fetch_partition(a, name, stats=net_io,
+                                          timeout=cfg.net_fetch_timeout_s)
+                    commit(sid, a, name, crc, raw)
+                except FetchFailure as e:
+                    return reexecute(sid, e)
+            return True
+        # Overlapped collection (ISSUE 18): prefetch the committed
+        # shards' payloads while earlier ones CRC-check and write.
+        locs = {sid: loc for sid, loc in todo}
+        pipe = FetchPipeline(
+            [(sid, a, name) for sid, (a, name, crc) in todo],
+            window=window, stats=net_io,
+            timeout=cfg.net_fetch_timeout_s)
+        try:
+            for sid, raw in pipe:
+                a, name, crc = locs[sid]
+                commit(sid, a, name, crc, raw)
+        except FetchFailure as e:
+            return reexecute(e.task, e)
         return True
 
     try:
@@ -310,6 +344,13 @@ def main(argv=None) -> int:
             for k in ("net_fetches", "net_local_reads", "net_bytes_raw",
                       "net_bytes_wire", "net_fetch_failures"):
                 run_stats[k] = run_stats.get(k, 0) + net_io.get(k, 0)
+            for k in ("net_fetch_wait_s", "net_overlap_s"):
+                run_stats[k] = round(run_stats.get(k, 0.0)
+                                     + net_io.get(k, 0.0), 6)
+            run_stats["net_prefetch_window"] = max(
+                run_stats.get("net_prefetch_window", 0),
+                net_io.get("net_prefetch_window", 0),
+                cfg.net_fetch_window)
             wire = run_stats["net_bytes_wire"]
             run_stats["net_ratio"] = round(
                 run_stats["net_bytes_raw"] / wire, 3) if wire else 0.0

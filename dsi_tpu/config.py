@@ -136,6 +136,12 @@ class JobConfig:
     # itself retries transient errors through dial_backoff_schedule).
     net_fetch_timeout_s: float = 30.0
 
+    # Reduce-side prefetch window (ISSUE 18): how many partition fetches
+    # may be in flight or buffered-unconsumed while the consumer decodes.
+    # 1 = the serial fetch→decode loop, bit-identically.  Env override:
+    # DSI_NET_FETCH_WINDOW.
+    net_fetch_window: int = 4
+
     # Spool entries untouched this long are aged out at partition-server
     # boot (dead-task spools from kill-9'd predecessors; the serve
     # daemon's retention discipline).

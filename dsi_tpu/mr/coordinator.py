@@ -148,7 +148,9 @@ class Coordinator:
             "net_fetches": 0, "net_local_reads": 0, "net_bytes_raw": 0,
             "net_bytes_wire": 0, "net_ratio": 0.0,
             "net_fetch_failures": 0, "net_refetches": 0,
-            "locality_hits": 0}
+            "locality_hits": 0,
+            "net_fetch_wait_s": 0.0, "net_overlap_s": 0.0,
+            "net_prefetch_window": 0}
         # Per-worker contact-GAP histograms (obs/hist.py): every RPC
         # records the gap since the worker's previous contact, so a
         # requeue can compare the stale worker's current silence to its
@@ -214,6 +216,19 @@ class Coordinator:
                 if self.reduce_log[t] != LOG_COMPLETED:
                     self.reduce_log[t] = LOG_COMPLETED
                     self.c_reduce += 1
+            # Net mode (ISSUE 18): re-learn the partition location
+            # registry from the journaled completions.  A replayed
+            # address whose server died with the old coordinator is
+            # only advisory — the first reducer to hit it reports
+            # FetchFailed and the producer re-executes (§3.4), exactly
+            # the live-run convergence path.
+            if self.net:
+                for t, a in self._journal.map_locations.items():
+                    self._map_locs.setdefault(t, a)
+                for t, sz in self._journal.map_sizes.items():
+                    self._map_sizes.setdefault(t, list(sz))
+                for t, loc in self._journal.out_locations.items():
+                    self._out_locs.setdefault(t, tuple(loc))
             # Shard commits replay as COMMITTED: the journal record was
             # written only after the output file's durable rename, so
             # the shard's output exists and must never be re-run.
@@ -327,7 +342,12 @@ class Coordinator:
                     if isinstance(sizes, list):
                         self._map_sizes[t] = [int(x) for x in sizes]
                 if self._journal is not None:
-                    self._journal.record("map", t)
+                    extra = None
+                    if addr:  # net mode: journal the location registry
+                        extra = {"addr": addr}
+                        if t in self._map_sizes:
+                            extra["sizes"] = list(self._map_sizes[t])
+                    self._journal.record("map", t, extra)
                 log_event("complete", kind="map", task=t, c_map=self.c_map,
                           worker=wid or None)
             else:
@@ -354,7 +374,12 @@ class Coordinator:
                                          int(args.get("Crc", 0) or 0))
                 self._absorb_net_locked(args)
                 if self._journal is not None:
-                    self._journal.record("reduce", t)
+                    extra = None
+                    if addr:  # net mode: where mr-out-<t> is served from
+                        extra = {"addr": addr,
+                                 "name": str(args.get("Name") or ""),
+                                 "crc": int(args.get("Crc", 0) or 0)}
+                    self._journal.record("reduce", t, extra)
                 log_event("complete", kind="reduce", task=t,
                           c_reduce=self.c_reduce, worker=wid or None)
             else:
@@ -415,6 +440,18 @@ class Coordinator:
             if v is not None:
                 self._net_counters[key] += int(v)
                 found = True
+        for wire, key in (("NetWait", "net_fetch_wait_s"),
+                          ("NetOverlap", "net_overlap_s")):
+            v = args.get(wire)
+            if v is not None:
+                self._net_counters[key] = round(
+                    self._net_counters[key] + float(v), 6)
+                found = True
+        v = args.get("NetWindow")
+        if v is not None:
+            self._net_counters["net_prefetch_window"] = max(
+                self._net_counters["net_prefetch_window"], int(v))
+            found = True
         if found:
             wire_n = self._net_counters["net_bytes_wire"]
             self._net_counters["net_ratio"] = round(

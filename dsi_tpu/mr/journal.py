@@ -58,6 +58,18 @@ class Journal:
         #: entry per committed sub-range (first record wins, same rule
         #: as :attr:`shard_commits`).
         self.subshard_commits: dict = {}
+        #: Net-mode location registry from replay (ISSUE 18): ``map``
+        #: records may carry the producer's partition-server address and
+        #: per-reduce partition sizes; ``reduce`` records the committed
+        #: output's ``(addr, name, crc)``.  LAST record wins — a
+        #: re-executed producer journals a fresh completion with its
+        #: replacement's address.  Advisory: a replayed address pointing
+        #: at a dead server converges through the normal FetchFailure →
+        #: producer re-execution path, so malformed extras are IGNORED
+        #: rather than treated as corruption.
+        self.map_locations: dict = {}
+        self.map_sizes: dict = {}
+        self.out_locations: dict = {}
         self._fh: Optional[TextIO] = None
         self._trunc_at: Optional[int] = None  # set by replay()
 
@@ -80,6 +92,9 @@ class Journal:
         self.shard_commits = {}
         self.resplits = {}
         self.subshard_commits = {}
+        self.map_locations = {}
+        self.map_sizes = {}
+        self.out_locations = {}
         self._trunc_at: Optional[int] = None
         if not os.path.exists(self.path):
             return maps, reduces
@@ -170,7 +185,24 @@ class Journal:
                 self.subshard_commits.setdefault(
                     (task, sub), (attempt, int(rec.get("crc", 0) or 0)))
                 continue
-            (maps if kind == "map" else reduces).append(task)
+            if kind == "map":
+                maps.append(task)
+                addr = rec.get("addr")
+                if isinstance(addr, str) and addr:
+                    self.map_locations[task] = addr
+                    sizes = rec.get("sizes")
+                    if (isinstance(sizes, list)
+                            and all(isinstance(x, int)
+                                    and not isinstance(x, bool)
+                                    and x >= 0 for x in sizes)):
+                        self.map_sizes[task] = [int(x) for x in sizes]
+            else:
+                reduces.append(task)
+                addr = rec.get("addr")
+                if isinstance(addr, str) and addr:
+                    self.out_locations[task] = (
+                        addr, str(rec.get("name") or ""),
+                        int(rec.get("crc", 0) or 0))
         return maps, reduces
 
     # ---- writing ----
@@ -214,9 +246,16 @@ class Journal:
                 header["n_shards"] = self.n_shards
             self._write(header)
 
-    def record(self, kind: str, task: int) -> None:
+    def record(self, kind: str, task: int, extra: dict | None = None) -> None:
+        """One completion record; ``extra`` (net mode) carries the
+        location-registry fields replay() restores — same line, same
+        commit-before-journal order, so fs-mode journals are unchanged
+        byte-for-byte."""
         if self._fh is not None:
-            self._write({"kind": kind, "task": task})
+            rec = {"kind": kind, "task": task}
+            if extra:
+                rec.update(extra)
+            self._write(rec)
 
     def record_shard(self, sid: int, attempt: int, crc: int) -> None:
         """The exactly-once shard commit record (winning attempt + the

@@ -545,51 +545,61 @@ class StreamServer:
         replay_guard = _ReplayGuard()
 
         class Handler(socketserver.BaseRequestHandler):
-            def handle(self) -> None:  # one fetch per connection
+            def handle(self) -> None:  # hello once, then fetches until EOF
                 try:
                     self.request.settimeout(60.0)
                     self.request.sendall(_hello_bytes())
                     _check_hello(_recv_exact(self.request, 4), "client")
-                    req = _recv_frame(self.request)
-                    if not isinstance(req, dict):
-                        _send_frame(self.request,
-                                    {"ok": False, "size": 0,
-                                     "error": "malformed request frame"})
-                        return
-                    if secret and not _check_auth(secret, req, replay_guard):
-                        _send_frame(self.request,
-                                    {"ok": False, "size": 0,
-                                     "error": "auth failed"})
-                        return
-                    fn = handler_methods.get(req.get("method", ""))
-                    if fn is None:
-                        _send_frame(self.request,
-                                    {"ok": False, "size": 0,
-                                     "error": "no such method: "
-                                              f"{req.get('method')}"})
-                        return
-                    try:
-                        payload = fn(req.get("args") or {})
-                    except Exception as e:  # handler error -> header frame
-                        _send_frame(self.request,
-                                    {"ok": False, "size": 0,
-                                     "error": f"{type(e).__name__}: {e}"})
-                        return
-                    _send_frame(self.request, {"ok": True,
-                                               "size": len(payload),
-                                               "error": None})
-                    for i, off in enumerate(
-                            range(0, len(payload), chunk_size)):
-                        chunk = payload[off:off + chunk_size]
-                        self.request.sendall(
-                            _LEN.pack(len(chunk)) + chunk
-                            + _LEN.pack(zlib.crc32(chunk)))
-                        if chunk_hook is not None:
-                            chunk_hook(i)
-                    self.request.sendall(
-                        _LEN.pack(0) + _LEN.pack(zlib.crc32(payload)))
+                    while self._serve_one():
+                        pass
                 except (ConnectionError, json.JSONDecodeError, OSError):
                     pass  # client vanished mid-fetch; it re-fetches
+
+            def _serve_one(self) -> bool:
+                # One request/response round trip on an established
+                # connection.  Returns True to keep the connection open
+                # for the next request (prefetch pipelines reuse the
+                # socket per producer); any error response closes it so
+                # the error cannot desynchronize a pipelined client.
+                req = _recv_frame(self.request)
+                if not isinstance(req, dict):
+                    _send_frame(self.request,
+                                {"ok": False, "size": 0,
+                                 "error": "malformed request frame"})
+                    return False
+                if secret and not _check_auth(secret, req, replay_guard):
+                    _send_frame(self.request,
+                                {"ok": False, "size": 0,
+                                 "error": "auth failed"})
+                    return False
+                fn = handler_methods.get(req.get("method", ""))
+                if fn is None:
+                    _send_frame(self.request,
+                                {"ok": False, "size": 0,
+                                 "error": "no such method: "
+                                          f"{req.get('method')}"})
+                    return False
+                try:
+                    payload = fn(req.get("args") or {})
+                except Exception as e:  # handler error -> header frame
+                    _send_frame(self.request,
+                                {"ok": False, "size": 0,
+                                 "error": f"{type(e).__name__}: {e}"})
+                    return False
+                _send_frame(self.request, {"ok": True,
+                                           "size": len(payload),
+                                           "error": None})
+                for i, off in enumerate(
+                        range(0, len(payload), chunk_size)):
+                    chunk = payload[off:off + chunk_size]
+                    self.request.sendall(
+                        _LEN.pack(len(chunk)) + chunk
+                        + _LEN.pack(zlib.crc32(chunk)))
+                    if chunk_hook is not None:
+                        chunk_hook(i)
+                self.request.sendall(
+                    _LEN.pack(0) + _LEN.pack(zlib.crc32(payload)))
+                return True
 
         base = (socketserver.ThreadingTCPServer if self._kind == "tcp"
                 else socketserver.ThreadingUnixStreamServer)
@@ -626,36 +636,68 @@ class StreamServer:
                 pass
 
 
-def stream_fetch(address: str, method: str, args: dict | None = None,
-                 timeout: float = 60.0, secret: str | None = None,
-                 max_bytes: int = _MAX_STREAM) -> bytes:
-    """One streaming fetch: dial (with the transient-error backoff budget),
-    exchange hellos, send the request, receive and CRC-verify the chunked
-    payload.  Raises :class:`CoordinatorGone` when the server cannot be
-    dialed (dead server — re-fetch from a replacement),
-    :class:`ProtocolMismatch` on a version disagreement (mis-deployed
-    fleet — do NOT retry), and :class:`StreamError` on a server-side error
-    or an integrity failure mid-stream (peer died while serving)."""
-    try:
-        kind, target = parse_address(address)
-    except ValueError as e:
-        raise CoordinatorGone(str(e)) from None
-    secret = secret if secret is not None else os.environ.get("DSI_MR_SECRET")
-    sock = _dial(kind, target, address, timeout)
-    try:
-        sock.sendall(_hello_bytes())
+class StreamConn:
+    """A persistent streaming-fetch connection: dial + hello ONCE, then
+    any number of :meth:`fetch` round trips over the same socket.
+
+    The prefetch pipeline's per-producer connection reuse: a reducer
+    pulling several partitions from one producer pays the dial backoff
+    and hello exchange once instead of per partition.  Each request
+    still carries its own auth nonce (the server's replay guard sees a
+    fresh MAC per fetch), so keep-alive does not weaken the HMAC
+    challenge.  After any error the connection is poisoned (the server
+    closes its end on error responses, so the stream position is
+    unknowable); callers drop it and dial fresh.  Not thread-safe —
+    one dialer thread owns one conn."""
+
+    def __init__(self, address: str, timeout: float = 60.0,
+                 secret: str | None = None):
         try:
-            hello = _recv_exact(sock, 4)
-        except ConnectionError:
+            kind, target = parse_address(address)
+        except ValueError as e:
+            raise CoordinatorGone(str(e)) from None
+        self.address = address
+        self._secret = (secret if secret is not None
+                        else os.environ.get("DSI_MR_SECRET"))
+        self._dead = False
+        self.fetches = 0
+        self._sock = _dial(kind, target, address, timeout)
+        try:
+            self._sock.sendall(_hello_bytes())
+            try:
+                hello = _recv_exact(self._sock, 4)
+            except ConnectionError:
+                raise StreamError(
+                    f"{address} closed before hello — died while accepting")
+            _check_hello(hello, address)
+        except BaseException:
+            self._sock.close()
+            raise
+
+    def fetch(self, method: str, args: dict | None = None,
+              max_bytes: int = _MAX_STREAM) -> bytes:
+        """One request/response round trip.  Raises like
+        :func:`stream_fetch`; any raise poisons the connection."""
+        if self._dead:
             raise StreamError(
-                f"{address} closed before hello — died while accepting")
-        _check_hello(hello, address)
+                f"{self.address}: connection already failed, dial fresh")
+        try:
+            payload = self._fetch(method, args, max_bytes)
+        except BaseException:
+            self._dead = True
+            raise
+        self.fetches += 1
+        return payload
+
+    def _fetch(self, method: str, args: dict | None,
+               max_bytes: int) -> bytes:
+        sock, address = self._sock, self.address
         req: dict = {"method": method, "args": args or {}}
-        if secret:
+        if self._secret:
             nonce = os.urandom(16).hex()
             ts = repr(time.time())
             req["auth"] = {"nonce": nonce, "ts": ts,
-                           "mac": _auth_mac(secret, nonce, ts,
+                           "mac": _auth_mac(self._secret, nonce, ts,
                                             _canonical_body(method,
                                                             args or {}))}
         try:
@@ -708,5 +750,30 @@ def stream_fetch(address: str, method: str, args: dict | None = None,
             if got > max_bytes:
                 raise StreamError(f"fetch from {address}: payload exceeds "
                                   f"{max_bytes} bytes")
-    finally:
-        sock.close()
+
+    def close(self) -> None:
+        self._dead = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "StreamConn":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def stream_fetch(address: str, method: str, args: dict | None = None,
+                 timeout: float = 60.0, secret: str | None = None,
+                 max_bytes: int = _MAX_STREAM) -> bytes:
+    """One streaming fetch: dial (with the transient-error backoff budget),
+    exchange hellos, send the request, receive and CRC-verify the chunked
+    payload, close.  Raises :class:`CoordinatorGone` when the server cannot
+    be dialed (dead server — re-fetch from a replacement),
+    :class:`ProtocolMismatch` on a version disagreement (mis-deployed
+    fleet — do NOT retry), and :class:`StreamError` on a server-side error
+    or an integrity failure mid-stream (peer died while serving)."""
+    with StreamConn(address, timeout=timeout, secret=secret) as conn:
+        return conn.fetch(method, args, max_bytes=max_bytes)

@@ -249,12 +249,20 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
         coordinator aggregates job-wide; totals would double-count)."""
         if net_stats is None:
             return {}
-        return {wire: int(net_stats.get(k, 0)) - int(before.get(k, 0))
-                for wire, k in (("NetFetches", "net_fetches"),
-                                ("NetLocal", "net_local_reads"),
-                                ("NetRaw", "net_bytes_raw"),
-                                ("NetWire", "net_bytes_wire"),
-                                ("NetFailures", "net_fetch_failures"))}
+        out = {wire: int(net_stats.get(k, 0)) - int(before.get(k, 0))
+               for wire, k in (("NetFetches", "net_fetches"),
+                               ("NetLocal", "net_local_reads"),
+                               ("NetRaw", "net_bytes_raw"),
+                               ("NetWire", "net_bytes_wire"),
+                               ("NetFailures", "net_fetch_failures"))}
+        # Overlap attribution (ISSUE 18): wall-second deltas stay float;
+        # the prefetch window is a gauge (coordinator folds it as max).
+        for wire, k in (("NetWait", "net_fetch_wait_s"),
+                        ("NetOverlap", "net_overlap_s")):
+            out[wire] = round(float(net_stats.get(k, 0.0))
+                              - float(before.get(k, 0.0)), 6)
+        out["NetWindow"] = int(net_stats.get("net_prefetch_window", 0))
+        return out
 
     # Chaos injection (DSI_CHAOS_WORKER_KILL=p[,seed], ckpt/fault.py): a
     # real os._exit with probability p at every task boundary, so
@@ -326,7 +334,8 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
                             reply.get("MapLocs") or {},
                             workdir=cfg.workdir, own_addr=addr,
                             stats=net_stats,
-                            timeout=cfg.net_fetch_timeout_s)
+                            timeout=cfg.net_fetch_timeout_s,
+                            window=cfg.net_fetch_window)
                 except FetchFailure as e:
                     # The producer's server is gone: hand the failure
                     # to the coordinator (it re-executes the map, §3.4)

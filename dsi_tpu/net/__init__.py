@@ -26,8 +26,10 @@ re-fetch-from-replacement via producer re-execution) lives in
 """
 
 from dsi_tpu.net.partsrv import PartitionServer, reap_spool
-from dsi_tpu.net.fetch import (FetchFailure, fetch_partition,
+from dsi_tpu.net.fetch import (ConnPool, FetchFailure, FetchPipeline,
+                               fetch_partition, fetch_window_from_env,
                                run_reduce_task_net)
 
-__all__ = ["PartitionServer", "reap_spool", "FetchFailure",
-           "fetch_partition", "run_reduce_task_net"]
+__all__ = ["PartitionServer", "reap_spool", "ConnPool", "FetchFailure",
+           "FetchPipeline", "fetch_partition", "fetch_window_from_env",
+           "run_reduce_task_net"]

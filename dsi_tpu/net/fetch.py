@@ -11,26 +11,66 @@ crossed the link), ``net_ratio`` (their quotient — the PR-13 codec's
 evidence on this link), ``net_fetches``/``net_local_reads``/
 ``net_fetch_failures``.
 
+## Overlapped shuffle (ISSUE 18)
+
+:class:`FetchPipeline` turns the reducer's serial
+fetch→decode→fetch→... loop into a bounded producer/consumer pool:
+``window`` dialer threads pull partitions over per-producer keep-alive
+connections (:class:`dsi_tpu.mr.rpc.StreamConn`) while the consumer
+thread decodes the PREVIOUS partition — the wire time of fetch ``i+1``
+hides behind the decode of fetch ``i``, so the shuffle wall tends to
+``max(slowest producer, decode+sort)`` instead of the serial sum.
+Determinism is structural: raw payloads land in per-item buffers and
+the consumer walks them in submission (producer) order, decoding on ONE
+thread — output bytes are identical at any window, and ``window=1``
+bypasses the pool entirely (today's serial path, bit-identically).
+
+Attribution: ``net_prefetch_window`` (the effective window),
+``net_fetch_wait_s`` (consumer time blocked waiting for bytes the
+dialers hadn't landed yet) and ``net_overlap_s`` (dialer wire time
+hidden behind the consumer's decode — fetch seconds NOT visible as
+waits) make the overlap auditable; serial mode reports 0 overlap by
+construction.
+
 Failure taxonomy, matching the RPC layer's:
 
 * :class:`dsi_tpu.mr.rpc.ProtocolMismatch` / ``AuthError`` —
   mis-deployed fleet; NEVER absorbed here, the run must fail loudly.
 * everything else (dead server, mid-stream death, CRC mismatch,
-  server-side missing file) → :class:`FetchFailure`, carrying which
-  producer task's bytes were lost — the caller reports it to the
-  coordinator, which re-executes the producer (§3.4) and the consumer
-  re-fetches from the replacement.
+  server-side missing file, an unknown codec flag, a torn local spool
+  read) → :class:`FetchFailure`, carrying which producer task's bytes
+  were lost — the caller reports it to the coordinator, which
+  re-executes the producer (§3.4) and the consumer re-fetches from the
+  replacement.  Under the pipeline the FIRST failure wins: in-flight
+  peers are drained, queued fetches are cancelled, and exactly one
+  ``FetchFailure`` (the lowest failed producer) surfaces.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Dict
+import threading
+import time
+from typing import Dict, Iterable, Iterator, List, Tuple
 
 from dsi_tpu.mr import rpc
 from dsi_tpu.net.partsrv import CODEC_KV, CODEC_RAW
 from dsi_tpu.obs import span
+
+#: Default bounded-prefetch window (fetches in flight + buffered but not
+#: yet consumed).  ``DSI_NET_FETCH_WINDOW=1`` degenerates to the serial
+#: fetch→decode loop bit-identically.
+DEFAULT_FETCH_WINDOW = 4
+
+
+def fetch_window_from_env(default: int = DEFAULT_FETCH_WINDOW) -> int:
+    """The ``DSI_NET_FETCH_WINDOW`` knob, clamped to >= 1."""
+    try:
+        w = int(os.environ.get("DSI_NET_FETCH_WINDOW", "") or default)
+    except ValueError:
+        w = default
+    return max(1, w)
 
 
 class FetchFailure(Exception):
@@ -71,50 +111,275 @@ def _attribute(stats, raw_n: int, wire_n: int, local: bool) -> None:
         if wire else 0.0
 
 
+def _count_failure(stats) -> None:
+    if stats is not None:
+        stats["net_fetch_failures"] = \
+            stats.get("net_fetch_failures", 0) + 1
+
+
+class ConnPool:
+    """Per-dialer-thread cache of keep-alive :class:`rpc.StreamConn`
+    objects keyed by producer address.  NOT thread-safe — each dialer
+    owns its own pool, so a producer serving several partitions to one
+    reducer is dialed once per dialer thread, not once per partition."""
+
+    def __init__(self, timeout: float = 30.0, secret: str | None = None):
+        self._timeout = timeout
+        self._secret = secret
+        self._conns: Dict[str, rpc.StreamConn] = {}
+
+    def fetch(self, addr: str, method: str, args: dict) -> bytes:
+        """Fetch over a cached connection, dialing fresh on a miss.  A
+        reused connection that fails with a curable error is retried
+        ONCE on a fresh dial (the cached socket may simply have idled
+        past the server's timeout); a fresh connection's failure
+        propagates — that producer is really gone."""
+        conn = self._conns.pop(addr, None)
+        if conn is not None:
+            try:
+                payload = conn.fetch(method, args)
+            except (rpc.ProtocolMismatch, rpc.AuthError):
+                conn.close()
+                raise  # mis-deployed fleet: a redial cannot cure it
+            except (rpc.CoordinatorGone, OSError):
+                conn.close()  # stale keep-alive; fall through to redial
+            else:
+                self._conns[addr] = conn
+                return payload
+        conn = rpc.StreamConn(addr, timeout=self._timeout,
+                              secret=self._secret)
+        try:
+            payload = conn.fetch(method, args)
+        except BaseException:
+            conn.close()
+            raise
+        self._conns[addr] = conn
+        return payload
+
+    def close(self) -> None:
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()
+
+    def __enter__(self) -> "ConnPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 def fetch_partition(addr: str, name: str, *, stats=None,
                     own_addr: str | None = None,
                     local_root: str | None = None,
                     timeout: float = 30.0,
-                    secret: str | None = None) -> bytes:
+                    secret: str | None = None,
+                    pool: ConnPool | None = None) -> bytes:
     """One partition's bytes, wherever they live.
 
     When ``addr`` is our own advertised address the bytes are already in
     our spool (``local_root``) — read them directly, no socket, counted
     as ``net_local_reads`` (the §3.1-step-4 locality win).  Otherwise a
     streaming fetch with the codec flag unwrapped and the raw/wire bytes
-    attributed.  Raises :class:`FetchFailure` (with ``task=-1``; callers
-    that know the producer task re-raise with it filled) on anything a
-    re-execution can cure."""
+    attributed; ``pool`` (if given) reuses per-producer keep-alive
+    connections instead of dialing per fetch.  Raises
+    :class:`FetchFailure` (with ``task=-1``; callers that know the
+    producer task re-raise with it filled) on anything a re-execution
+    can cure — including a torn local spool read and an unknown codec
+    flag, both counted in ``net_fetch_failures``."""
     if own_addr is not None and addr == own_addr and local_root:
         try:
             with span("net", lane="net", part=name, local=1):
                 with open(os.path.join(local_root, name), "rb") as f:
                     raw = f.read()
         except OSError as e:
+            _count_failure(stats)
             raise FetchFailure(-1, addr, name, e) from e
         _attribute(stats, len(raw), 0, local=True)
         return raw
     try:
         with span("net", lane="net", part=name, addr=addr):
-            payload = rpc.stream_fetch(addr, "Fetch", {"Name": name},
-                                       timeout=timeout, secret=secret)
+            if pool is not None:
+                payload = pool.fetch(addr, "Fetch", {"Name": name})
+            else:
+                payload = rpc.stream_fetch(addr, "Fetch", {"Name": name},
+                                           timeout=timeout, secret=secret)
             raw = _unwrap(payload)
     except (rpc.ProtocolMismatch, rpc.AuthError):
         raise  # mis-deployed fleet: no replacement will cure it
     except (rpc.CoordinatorGone, OSError, ValueError) as e:
-        if stats is not None:
-            stats["net_fetch_failures"] = \
-                stats.get("net_fetch_failures", 0) + 1
+        # rpc.StreamError ⊂ ConnectionError ⊂ OSError, so _unwrap's
+        # unknown-codec-flag raise lands here too — wrapped and counted
+        # like every other curable failure, never a bare StreamError.
+        _count_failure(stats)
         raise FetchFailure(-1, addr, name, e) from e
     _attribute(stats, len(raw), len(payload), local=False)
     return raw
+
+
+class FetchPipeline:
+    """Bounded prefetch pool over :func:`fetch_partition`.
+
+    ``items`` are ``(task, addr, name)`` fetch descriptors in the order
+    the consumer wants their bytes.  Up to ``window`` payloads may be in
+    flight or landed-but-unconsumed at once (a semaphore token is held
+    from claim to consumption, so a slow consumer backpressures the
+    dialers instead of buffering the whole shuffle).  Iterating the
+    pipeline yields ``(task, raw_bytes)`` strictly in submission order —
+    the overlap never reorders the merge.
+
+    Failure: the first dialer error sets the cancel flag; dialers finish
+    (drain) their in-flight fetch and exit without claiming more work;
+    the consumer joins them and re-raises the lowest failed item's error
+    as a :class:`FetchFailure` with its task filled in.
+    ``ProtocolMismatch``/``AuthError`` propagate unwrapped (fatal).
+
+    Attribution lands in ``stats`` under the pipeline's lock:
+    per-fetch scratch scopes merge after each fetch, so the shared
+    ``net`` scope never sees a torn read-modify-write from two dialers.
+    """
+
+    def __init__(self, items: Iterable[Tuple[int, str, str]], *,
+                 window: int = DEFAULT_FETCH_WINDOW, stats=None,
+                 own_addr: str | None = None,
+                 local_root: str | None = None,
+                 timeout: float = 30.0, secret: str | None = None):
+        self._items: List[Tuple[int, str, str]] = list(items)
+        self._window = max(1, int(window))
+        self._stats = stats
+        self._own_addr = own_addr
+        self._local_root = local_root
+        self._timeout = timeout
+        self._secret = secret
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._cancel = threading.Event()
+        self._slots = threading.Semaphore(self._window)
+        self._next = 0
+        self._results: Dict[int, bytes] = {}
+        self._errors: Dict[int, Exception] = {}
+        self._fetch_s = 0.0  # Σ dialer seconds spent fetching
+        self.wait_s = 0.0    # Σ consumer seconds blocked on a fetch
+        self.overlap_s = 0.0  # fetch seconds hidden behind the consumer
+        n = min(self._window, len(self._items))
+        self._threads = [
+            threading.Thread(target=self._dialer, name=f"dsi-fetch-{i}",
+                             daemon=True)
+            for i in range(n)]
+
+    def _merge(self, scratch: dict) -> None:
+        stats = self._stats
+        if stats is None or not scratch:
+            return
+        with self._lock:
+            for k, v in scratch.items():
+                if k == "net_ratio":
+                    continue
+                stats[k] = stats.get(k, 0) + v
+            wire = stats.get("net_bytes_wire", 0)
+            if wire:
+                stats["net_ratio"] = round(
+                    stats.get("net_bytes_raw", 0) / wire, 3)
+
+    def _dialer(self) -> None:
+        with ConnPool(timeout=self._timeout, secret=self._secret) as pool:
+            while True:
+                self._slots.acquire()
+                if self._cancel.is_set():
+                    self._slots.release()
+                    return
+                with self._lock:
+                    if self._next >= len(self._items):
+                        self._slots.release()
+                        return
+                    i = self._next
+                    self._next += 1
+                task, addr, name = self._items[i]
+                scratch: dict = {}
+                t0 = time.perf_counter()
+                try:
+                    raw = fetch_partition(
+                        addr, name, stats=scratch, own_addr=self._own_addr,
+                        local_root=self._local_root, timeout=self._timeout,
+                        secret=self._secret, pool=pool)
+                except Exception as e:
+                    self._merge(scratch)
+                    self._cancel.set()
+                    with self._cond:
+                        self._errors[i] = e
+                        self._cond.notify_all()
+                    return
+                self._merge(scratch)
+                with self._cond:
+                    self._fetch_s += time.perf_counter() - t0
+                    self._results[i] = raw
+                    self._cond.notify_all()
+
+    def _drain(self) -> None:
+        """Cancel queued work and unblock+join every dialer."""
+        self._cancel.set()
+        for _ in self._threads:
+            self._slots.release()
+        for t in self._threads:
+            t.join()
+
+    def _raise_first(self) -> None:
+        i = min(self._errors)
+        task, addr, name = self._items[i]
+        e = self._errors[i]
+        if isinstance(e, FetchFailure):
+            raise FetchFailure(task, e.addr, e.name, e.cause) from e
+        raise e  # ProtocolMismatch / AuthError / programming error
+
+    def __iter__(self) -> Iterator[Tuple[int, bytes]]:
+        for t in self._threads:
+            t.start()
+        try:
+            for i, (task, addr, name) in enumerate(self._items):
+                t0 = time.perf_counter()
+                with self._cond:
+                    # First failure wins: stop waiting as soon as ANY
+                    # dialer errored (peers blocked on the window's
+                    # semaphore would otherwise never land item i) —
+                    # the finally-drain below cancels and joins them.
+                    while i not in self._results and not self._errors:
+                        self._cond.wait(0.05)
+                    if i not in self._results:
+                        self._raise_first()
+                    raw = self._results.pop(i)
+                self.wait_s += time.perf_counter() - t0
+                yield task, raw
+                self._slots.release()
+            self.overlap_s = max(0.0, self._fetch_s - self.wait_s)
+            if self._stats is not None:
+                with self._lock:
+                    self._stats["net_fetch_wait_s"] = self._stats.get(
+                        "net_fetch_wait_s", 0.0) + round(self.wait_s, 6)
+                    self._stats["net_overlap_s"] = self._stats.get(
+                        "net_overlap_s", 0.0) + round(self.overlap_s, 6)
+        finally:
+            self._drain()
+
+
+def _decode_lines(raw: bytes, intermediate: list, kv_type) -> None:
+    """The reference's lenient record decoder — shared by the serial and
+    pipelined paths so their output bytes are identical by construction."""
+    for line in raw.decode("utf-8").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            break  # truncated record: the reference's decoder break
+        intermediate.append(kv_type(obj["Key"], obj["Value"]))
 
 
 def run_reduce_task_net(reducef, reduce_task: int, map_locs: Dict,
                         *, workdir: str = ".",
                         own_addr: str | None = None,
                         stats=None, timeout: float = 30.0,
-                        secret: str | None = None) -> str:
+                        secret: str | None = None,
+                        window: int | None = None) -> str:
     """One reduce task with the shuffle over TCP.
 
     ``map_locs`` maps map-task number (possibly a JSON-string key — RPC
@@ -122,34 +387,49 @@ def run_reduce_task_net(reducef, reduce_task: int, map_locs: Dict,
     ``mr-<m>-<r>`` is fetched from the host that produced it, decoded
     with the reference's lenient record semantics, then sorted, grouped,
     reduced, and committed FIRST-WINS to this worker's private workdir
-    (``mr-out-<r>``) exactly like the shared-dir path.  No intermediate
-    GC — the producers' spools are on other machines; retention aging
-    (``partsrv.reap_spool``) owns their lifetime.  Returns the committed
-    output's basename.  Raises :class:`FetchFailure` with the producer
-    map task filled in when any partition cannot be fetched."""
+    (``mr-out-<r>``) exactly like the shared-dir path.  ``window``
+    (default ``DSI_NET_FETCH_WINDOW``, 4) bounds the prefetch pool;
+    ``window=1`` runs the literal serial fetch→decode loop, so it is
+    bit-identical to the pre-pipeline path AND reports
+    ``net_overlap_s == 0``.  At any window the merge order is the sorted
+    producer order, so ``mr-out-<r>`` bytes are window-invariant.  No
+    intermediate GC — the producers' spools are on other machines;
+    retention aging (``partsrv.reap_spool``) owns their lifetime.
+    Returns the committed output's basename.  Raises
+    :class:`FetchFailure` with the producer map task filled in when any
+    partition cannot be fetched."""
     from dsi_tpu.mr.types import KeyValue
     from dsi_tpu.mr.worker import group_and_reduce, output_name
     from dsi_tpu.utils.atomicio import atomic_write
 
+    if window is None:
+        window = fetch_window_from_env()
+    window = max(1, int(window))
+    m_keys = sorted(map_locs, key=lambda k: int(k))
+    if stats is not None:
+        stats["net_prefetch_window"] = max(
+            stats.get("net_prefetch_window", 0), window)
     intermediate: list = []
-    for m_key in sorted(map_locs, key=lambda k: int(k)):
-        m = int(m_key)
-        name = f"mr-{m}-{reduce_task}"
-        try:
-            raw = fetch_partition(map_locs[m_key], name, stats=stats,
-                                  own_addr=own_addr, local_root=workdir,
-                                  timeout=timeout, secret=secret)
-        except FetchFailure as e:
-            raise FetchFailure(m, e.addr, e.name, e.cause) from e
-        for line in raw.decode("utf-8").splitlines():
-            line = line.strip()
-            if not line:
-                continue
+    if window <= 1 or len(m_keys) <= 1:
+        for m_key in m_keys:
+            m = int(m_key)
+            name = f"mr-{m}-{reduce_task}"
             try:
-                obj = json.loads(line)
-            except json.JSONDecodeError:
-                break  # truncated record: the reference's decoder break
-            intermediate.append(KeyValue(obj["Key"], obj["Value"]))
+                raw = fetch_partition(map_locs[m_key], name, stats=stats,
+                                      own_addr=own_addr, local_root=workdir,
+                                      timeout=timeout, secret=secret)
+            except FetchFailure as e:
+                raise FetchFailure(m, e.addr, e.name, e.cause) from e
+            _decode_lines(raw, intermediate, KeyValue)
+    else:
+        items = [(int(k), map_locs[k], f"mr-{int(k)}-{reduce_task}")
+                 for k in m_keys]
+        pipe = FetchPipeline(items, window=window, stats=stats,
+                             own_addr=own_addr, local_root=workdir,
+                             timeout=timeout, secret=secret)
+        for m, raw in pipe:
+            with span("decode", lane="net", part=f"mr-{m}-{reduce_task}"):
+                _decode_lines(raw, intermediate, KeyValue)
     out = output_name(reduce_task, workdir)
     with atomic_write(out, first_wins=True) as f:
         group_and_reduce(intermediate, reducef, f)

@@ -90,6 +90,15 @@ class PartitionServer:
                                                       retention_s)
         self.codec = codec
         self.served = 0
+        # Injected per-chunk serve latency (seconds) — the bench's
+        # synthetic slow link (ISSUE 18): on localhost the wire is too
+        # fast for fetch pipelining to show, so the A/B row inflates
+        # every chunk's serve time deterministically on BOTH arms.
+        try:
+            self._chunk_sleep_s = float(
+                os.environ.get("DSI_NET_CHUNK_SLEEP_S", "") or 0.0)
+        except ValueError:
+            self._chunk_sleep_s = 0.0
         self._srv = rpc.StreamServer(bind or "tcp:127.0.0.1:0",
                                      {"Fetch": self._fetch},
                                      secret=secret,
@@ -115,6 +124,8 @@ class PartitionServer:
     # ── serving ──
 
     def _chunk_hook(self, chunk_index: int) -> None:
+        if self._chunk_sleep_s > 0.0:
+            time.sleep(self._chunk_sleep_s)
         # After the first chunk is on the wire: the consumer has the
         # header + a partial payload when the kill lands.
         if chunk_index == 0:

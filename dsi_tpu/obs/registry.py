@@ -156,6 +156,9 @@ PHASE_KEYS = (
     # elastic dataflow (ISSUE 16): wall spent with two adjacent stages
     # advancing concurrently (seal-driven pipelining)
     "plan_overlap_s",
+    # overlapped shuffle (ISSUE 18): consumer time blocked on the
+    # prefetch pool vs dialer wire time hidden behind the decode
+    "net_fetch_wait_s", "net_overlap_s",
 )
 
 #: The canonical counter/gauge keys (module docstring) — previously
@@ -201,6 +204,9 @@ COUNTER_KEYS = (
     # net_refetches the re-fetch-from-replacement machinery's
     "net_fetches", "net_local_reads", "net_bytes_raw", "net_bytes_wire",
     "net_ratio", "net_fetch_failures", "net_refetches", "locality_hits",
+    # overlapped shuffle (ISSUE 18): the effective prefetch window
+    # (gauge — 1 means the serial path ran)
+    "net_prefetch_window",
 )
 
 #: THE schema: every key an engine scope may carry, under its unified

@@ -116,6 +116,14 @@ GATES: List[Tuple[str, str, float]] = [
     # spec_backup_fired precedent: 1→0 gates, count wobble does not).
     ("net_ratio", "higher", 0.10),
     ("locality_hits", "higher", 0.90),
+    # Overlapped shuffle (ISSUE 18): the *_mbps/*_parity patterns above
+    # already gate net_pipelined_mbps/net_serial_mbps and
+    # net_pipeline_parity.  net_overlap_s regresses when the prefetch
+    # pool stops hiding wire time at all (the spec_backup_fired
+    # precedent: >0 → ~0 gates, wobble under the 90% threshold does
+    # not); net_fetch_wait_s stays info-only — the throughput gate
+    # already owns that trade.
+    ("net_overlap_s", "higher", 0.90),
 ]
 
 

@@ -92,6 +92,17 @@ def _bench_line(path: str) -> str:
             # The dynamic re-split arm (ISSUE 16): the straggler's
             # remaining range split across idle workers.
             "spec_resplit_mbps", "spec_resplits", "spec_subshards",
+            # The network data plane A/B (ISSUE 17): shuffle over TCP
+            # vs the shared-directory plane, with the line codec's wire
+            # leverage and the locality-placement evidence.
+            "net_mb", "net_shuffle_mbps", "net_fs_mbps", "net_ratio",
+            "net_fetches", "net_local_reads", "locality_hits",
+            "net_refetches", "net_parity",
+            # The overlapped-shuffle A/B (ISSUE 18): pipelined vs
+            # serial reduce-side fetches under injected serve latency,
+            # with the overlap attribution.
+            "net_pipe_mb", "net_pipelined_mbps", "net_serial_mbps",
+            "net_overlap_s", "net_fetch_wait_s", "net_pipeline_parity",
             "tpu_error")
     parts = [f"{k}={d[k]}" for k in keys if k in d]
     phases = d.get("phases")

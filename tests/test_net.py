@@ -444,7 +444,9 @@ def test_fetch_failure_chaos_reexecutes_producer(tmp_path):
     payload on the wire).  The driver's fetch fails, the coordinator
     forgets the commit and a replacement re-executes the producer —
     exactly one WINNING attempt per shard, zero duplicate commits, and
-    the merged output is still byte-identical to the oracle."""
+    the merged output is still byte-identical to the oracle.  Runs with
+    an explicit prefetch window of 4 (ISSUE 18): the chaos converges
+    under the pipeline too, with the same exactly-once guarantees."""
     corpus = str(tmp_path / "corpus.txt")
     write_corpus(corpus)
     wd = str(tmp_path / "wd")
@@ -455,7 +457,9 @@ def test_fetch_failure_chaos_reexecutes_producer(tmp_path):
            "--shard-timeout", "5",
            "--fault-worker", "0:mid-serve",
            "--check", "--stats-json", stats_json, corpus]
-    r = subprocess.run(cmd, env=_env(tmp_path), cwd=REPO,
+    env = _env(tmp_path)
+    env["DSI_NET_FETCH_WINDOW"] = "4"
+    r = subprocess.run(cmd, env=env, cwd=REPO,
                        capture_output=True, text=True, timeout=420)
     assert r.returncode == 0, f"rc={r.returncode}\n{r.stderr[-3000:]}"
     assert "parity OK" in r.stderr
@@ -465,6 +469,7 @@ def test_fetch_failure_chaos_reexecutes_producer(tmp_path):
         s = json.load(f)
     assert s["net_fetch_failures"] >= 1
     assert s["net_refetches"] >= 1
+    assert s["net_prefetch_window"] == 4  # the pipeline really ran
     assert s["duplicate_commits"] == 0
     # re-execution, not duplication: each shard has exactly one WINNER
     assert s["committed"] == s["shards"] == 4

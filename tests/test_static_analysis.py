@@ -130,8 +130,11 @@ def test_tree_is_clean():
     assert unsup == [], "\n".join(f.render() for f in unsup)
     # The suppressed inventory is part of the contract: it only ever
     # changes deliberately, with a reviewed reason next to each site.
+    # 13: +1 for the stage-host console capture (cli/planrun.py — a
+    # subprocess stdout handle held open for the child's lifetime, so
+    # atomic_write's rename-on-close contract cannot apply)
     sup = [f for f in findings if f.suppressed]
-    assert len(sup) <= 12, (
+    assert len(sup) <= 13, (
         "suppression inventory grew suspiciously large — are "
         "annotations being used where a fix belongs?\n"
         + "\n".join(f.render() for f in sup))
