@@ -129,6 +129,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1706,6 +1707,180 @@ def run_serve_row() -> dict:
     return row
 
 
+def _grep_oracle_payload(data: bytes, pattern: str) -> bytes:
+    """The daemon's ``grep.json`` bytes for one tenant, computed with
+    no jax import in this (parent) process: a pure-python replica of
+    ``grep_host_oracle`` (overlapping occurrence counts, unterminated
+    tail counts as a line) serialized exactly as
+    ``ServeDaemon._write_grep_result`` spells it.  The latency row's
+    per-tenant byte-parity ground truth."""
+    pat = pattern.encode("ascii")
+    bins, topk = 8, 16
+    hist = [0] * bins
+    matched = occurrences = line_no = 0
+    cands = []
+    parts = data.split(b"\n")
+    carry = parts.pop()
+    if carry:
+        parts.append(carry)
+    for line in parts:
+        occ, i = 0, line.find(pat)
+        while i >= 0:
+            occ += 1
+            i = line.find(pat, i + 1)
+        hist[min(occ, bins - 1)] += 1
+        if occ:
+            matched += 1
+            occurrences += occ
+            cands.append((line_no, occ))
+        line_no += 1
+    top = sorted(cands, key=lambda r: (-r[1], r[0]))[:topk]
+    return json.dumps(
+        {"lines": line_no, "matched": matched,
+         "occurrences": occurrences, "hist": hist,
+         "topk": [list(r) for r in top]},
+        sort_keys=True).encode("utf-8")
+
+
+def run_serve_latency_row() -> dict:
+    """The serving-QoS latency A/B (ISSUE 19 tentpole): N grep tenants
+    submitted at once to the resident daemon with packed grep lanes
+    (``serve/pack.py`` — up to 8 tenants per device dispatch) versus
+    the SAME N tenants against a daemon running grep as
+    time-multiplexed step objects (``--no-pack-grep``, the pre-packing
+    behaviour).  Per-job latency is the daemon's own clock —
+    ``done_ts - submitted_ts`` from the job journal — and the row
+    reports nearest-rank p50/p99 across tenants for each arm
+    (``serve_pack_p50_s``/``serve_pack_p99_s`` vs
+    ``serve_tmux_p50_s``/``serve_tmux_p99_s``).  Parity bar: every
+    tenant's ``grep.json`` must byte-compare equal to the no-jax host
+    oracle in BOTH arms or the row suppresses its latencies.  Measured
+    keys XOR ``serve_lat_skipped``.  ``DSI_BENCH_SERVE_LAT_TENANTS``
+    (default 64; 0 disables), ``DSI_BENCH_SERVE_LAT_KB`` (per-tenant
+    input, default 24) and ``DSI_BENCH_SERVE_LAT_TIMEOUT`` size it;
+    chip-independent (host subprocesses on the 8-vdev CPU mesh)."""
+    try:
+        tenants = int(os.environ.get("DSI_BENCH_SERVE_LAT_TENANTS", "64"))
+    except ValueError:
+        tenants = 64
+    if tenants <= 0:
+        return {"serve_lat_skipped":
+                "disabled (DSI_BENCH_SERVE_LAT_TENANTS=0)"}
+    per_kb = env_float("DSI_BENCH_SERVE_LAT_KB", 24.0)
+    budget = env_float("DSI_BENCH_SERVE_LAT_TIMEOUT", 300.0)
+    import shutil
+    import tempfile
+
+    from dsi_tpu.serve import client as sv
+
+    sdir = os.path.join(WORKDIR, "serve-lat")
+    shutil.rmtree(sdir, ignore_errors=True)
+    os.makedirs(sdir)
+    files, pats, oracle = [], [], {}
+    for i in range(tenants):
+        # Same pattern LENGTH across tenants (one packed shape group,
+        # the dense-wave case), distinct pattern BYTES per tenant.
+        pat = f"w{i:04d}"
+        lines = []
+        j = 0
+        size = 0
+        want = int(per_kb * 1024)
+        while size < want:
+            line = (f"{pat} " * (j % 4) + f"filler{j % 97} text\n")
+            lines.append(line)
+            size += len(line)
+            j += 1
+        path = os.path.join(sdir, f"g{i}.txt")
+        with open(path, "w") as f:
+            f.writelines(lines)
+        files.append(path)
+        pats.append(pat)
+        with open(path, "rb") as f:
+            oracle[i] = _grep_oracle_payload(f.read(), pat)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+
+    def pctl(lats: list, q: float) -> float:
+        s = sorted(lats)
+        return s[min(len(s) - 1, max(0, int(math.ceil(q * len(s))) - 1))]
+
+    def arm(name: str, packed: bool):
+        """One daemon run: submit every tenant, wait, return (per-job
+        latencies, packed-step count) or raise."""
+        spool = os.path.join(sdir, f"spool-{name}")
+        # AF_UNIX socket paths cap at ~108 bytes; WORKDIR can be deep.
+        sock = os.path.join(tempfile.mkdtemp(prefix="dsi-bench-lat-"),
+                            "s.sock")
+        cmd = [sys.executable, "-m", "dsi_tpu.cli.mrserve",
+               "--spool", spool, "--socket", sock,
+               "--chunk-bytes", "65536",
+               "--max-resident", str(tenants),
+               "--quota-steps", "1000000"]
+        if not packed:
+            cmd.append("--no-pack-grep")
+        proc = subprocess.Popen(
+            cmd, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            sv.wait_ready(sock, timeout=budget)
+            reps = [sv.submit(sock, f"g{i}", [files[i]], app="grep",
+                              pattern=pats[i])
+                    for i in range(tenants)]
+            final = sv.wait(sock, [r["job_id"] for r in reps],
+                            timeout=budget)
+            bad = [j for j, r in final.items() if r["state"] != "done"]
+            if bad:
+                raise RuntimeError(f"{name} arm jobs failed: {bad[:4]}")
+            lats = []
+            for i, rep in enumerate(reps):
+                job = final[rep["job_id"]]
+                lats.append(max(0.0, float(job["done_ts"])
+                                 - float(job["submitted_ts"])))
+                with open(os.path.join(rep["out_dir"], "grep.json"),
+                          "rb") as f:
+                    if f.read() != oracle[i]:
+                        raise AssertionError(
+                            f"{name} arm tenant g{i} parity mismatch")
+            steps = int(sv.ping(sock).get("grep_packed_steps") or 0)
+            try:
+                sv.shutdown(sock)
+                proc.wait(timeout=30)
+            except Exception:
+                proc.kill()
+            return lats, steps
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    try:
+        pack_lats, pack_steps = arm("pack", True)
+        tmux_lats, _ = arm("tmux", False)
+    except AssertionError as e:
+        return {"serve_lat_skipped": f"{e} (latency suppressed)",
+                "serve_lat_parity": False}
+    except Exception as e:
+        return {"serve_lat_skipped": f"latency row failed: "
+                                     f"{type(e).__name__}: {e}"}
+    row = {"serve_lat_tenants": tenants,
+           "serve_lat_kb": round(per_kb, 1),
+           "serve_lat_parity": True,
+           "serve_lat_packed_steps": pack_steps,
+           "serve_pack_p50_s": round(pctl(pack_lats, 0.50), 4),
+           "serve_pack_p99_s": round(pctl(pack_lats, 0.99), 4),
+           "serve_tmux_p50_s": round(pctl(tmux_lats, 0.50), 4),
+           "serve_tmux_p99_s": round(pctl(tmux_lats, 0.99), 4)}
+    log(f"serve latency row: {tenants} grep tenants x {per_kb:.0f} KB — "
+        f"packed p50/p99 {row['serve_pack_p50_s']}/"
+        f"{row['serve_pack_p99_s']}s ({pack_steps} packed steps) vs "
+        f"time-multiplexed p50/p99 {row['serve_tmux_p50_s']}/"
+        f"{row['serve_tmux_p99_s']}s")
+    return row
+
+
 def run_plan_row() -> dict:
     """The plan-layer A/B (ISSUE 14 satellite): one grep→wordcount
     CHAIN with the matching-line intermediate device-resident
@@ -2516,6 +2691,17 @@ def main() -> None:
                                    f"{type(e).__name__}: {e}")
     else:
         fw["serve_skipped"] = f"budget {budget_s:.0f}s < 60s"
+    # The serving-QoS packed-grep latency A/B row (ISSUE 19):
+    # chip-independent (two mrserve subprocesses on the virtual CPU
+    # mesh), rides every branch.
+    if budget_s >= 60 or "DSI_BENCH_SERVE_LAT_TENANTS" in os.environ:
+        try:
+            fw.update(run_serve_latency_row())
+        except Exception as e:
+            fw["serve_lat_skipped"] = (f"serve latency row failed: "
+                                       f"{type(e).__name__}: {e}")
+    else:
+        fw["serve_lat_skipped"] = f"budget {budget_s:.0f}s < 60s"
     # The plan-layer chained-vs-staged A/B row (ISSUE 14):
     # chip-independent (planrun subprocesses on 1-device CPU under
     # DSI_AOT_FRESH=1, the stream rows' hygiene), rides every branch.

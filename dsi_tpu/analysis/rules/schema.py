@@ -22,11 +22,20 @@ assigned from ``metrics_scope(...)``, a parameter/attribute named
 engine key is therefore a one-line schema change in
 ``obs/registry.py`` — which is exactly where the contract test and
 every consumer will see it.
+
+Since ISSUE 19 the rule also closes the serving daemon's /metrics
+surface: any string literal carrying a ``dsi_serve_`` token must name a
+series in ``obs/registry.py SERVE_SERIES`` (a truncated f-string head —
+``f"dsi_serve_tenant_{k}..."`` — passes when it is a prefix of a
+registered series).  Emitting a new serving series without registering
+it is the same drift the stats-key half guards against, with the same
+one-edit fix.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator, Set
 
 from dsi_tpu.analysis.core import (
@@ -37,13 +46,23 @@ from dsi_tpu.analysis.core import (
     dotted,
     self_attr,
 )
-from dsi_tpu.obs.registry import LEGACY_ALIASES, SCHEMA_KEYS
+from dsi_tpu.obs.registry import LEGACY_ALIASES, SCHEMA_KEYS, SERVE_SERIES
 
 #: Identifier spellings that denote an engine stats scope.
 _STATS_NAMES = {"stats", "_stats", "st", "pstats", "wave_stats",
                 "pipeline_stats"}
 
 _ALLOWED = frozenset(SCHEMA_KEYS) | frozenset(LEGACY_ALIASES)
+
+#: A serving-series token inside any string constant; f-string constant
+#: heads truncate at the first interpolation, so a token is judged as a
+#: prefix (``dsi_serve_`` alone — docstrings' ``dsi_serve_*`` prose —
+#: trivially prefixes every series and stays clean).
+_SERVE_TOKEN = re.compile(r"dsi_serve_[a-z0-9_]*")
+
+
+def _serve_token_ok(tok: str) -> bool:
+    return any(s == tok or s.startswith(tok) for s in SERVE_SERIES)
 
 
 def _is_stats_recv(node: ast.AST, scope_names: Set[str],
@@ -95,6 +114,20 @@ class MetricSchemaRule(Rule):
             return key not in _ALLOWED
 
         for node in ast.walk(module.tree):
+            # Serving /metrics series: every dsi_serve_* token in any
+            # string constant (f-string heads included — JoinedStr
+            # parts are Constant nodes) must match SERVE_SERIES.
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                for tok in _SERVE_TOKEN.findall(node.value):
+                    if not _serve_token_ok(tok):
+                        yield Finding(
+                            module.rel, node.lineno, node.col_offset,
+                            self.rule_id,
+                            f"serving series {tok!r} is not in the "
+                            f"registry's SERVE_SERIES — register it in "
+                            f"obs/registry.py or rename to a registered "
+                            f"series")
             # stats["k"] = / += / del  (Store/Del contexts only: reads
             # of foreign dicts named `st` must not be judged)
             if isinstance(node, ast.Subscript) and \

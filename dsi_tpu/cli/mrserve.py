@@ -13,8 +13,9 @@ Usage:
     python -m dsi_tpu.cli.mrserve --spool DIR [--socket PATH]
         [--nreduce N] [--chunk-bytes B] [--devices D]
         [--max-resident K] [--quota-steps Q] [--checkpoint-every K]
-        [--retention-days D] [--statusz-port P] [--trace-dir DIR]
-        [--no-warm]
+        [--max-queue N] [--rate-limit R] [--rate-burst B]
+        [--no-pack-grep] [--retention-days D] [--statusz-port P]
+        [--trace-dir DIR] [--no-warm]
 """
 
 from __future__ import annotations
@@ -54,6 +55,19 @@ def main(argv=None) -> int:
                    help="confirmed packed steps between per-tenant "
                         "snapshots (delta chains; eviction and crash "
                         "recovery both resume from them)")
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="queued jobs past which submissions are SHED "
+                        "with a typed backpressure error (the journal "
+                        "is never written for a shed job)")
+    p.add_argument("--rate-limit", type=float, default=None,
+                   help="per-tenant submit rate (jobs/second, token "
+                        "bucket; default: unlimited)")
+    p.add_argument("--rate-burst", type=int, default=4,
+                   help="token-bucket burst capacity per tenant")
+    p.add_argument("--no-pack-grep", action="store_true",
+                   help="run grep jobs as time-multiplexed step "
+                        "objects instead of packed lanes (the bench "
+                        "row's control arm; env DSI_SERVE_PACK_GREP=0)")
     p.add_argument("--retention-days", type=float, default=14.0,
                    help="age after which a DONE tenant's checkpoint "
                         "chains are garbage-collected at boot (live "
@@ -93,7 +107,9 @@ def main(argv=None) -> int:
         max_resident=args.max_resident, quota_steps=args.quota_steps,
         checkpoint_every=args.checkpoint_every,
         retention_s=args.retention_days * 86400.0,
-        warm=not args.no_warm)
+        warm=not args.no_warm, max_queue=args.max_queue,
+        rate_limit=args.rate_limit, rate_burst=args.rate_burst,
+        pack_grep=False if args.no_pack_grep else None)
 
     def _stop(_sig, _frm):
         daemon.stop()

@@ -6,7 +6,8 @@ daemon's Unix socket, which is the whole point of the resident daemon.
 
 Usage:
     python -m dsi_tpu.cli.mrsubmit --spool DIR --tenant T [--app wc]
-        [--pattern P] [--wait] [--timeout S] inputfiles...
+        [--pattern P] [--priority {0,1,2}] [--retries N]
+        [--wait] [--timeout S] inputfiles...
     python -m dsi_tpu.cli.mrsubmit --spool DIR --status [JOB_ID]
     python -m dsi_tpu.cli.mrsubmit --spool DIR --shutdown
 """
@@ -36,6 +37,15 @@ def main(argv=None) -> int:
     p.add_argument("--nreduce", type=int, default=None,
                    help="must match the daemon's degree (default: the "
                         "daemon's)")
+    p.add_argument("--priority", type=int, choices=(0, 1, 2),
+                   default=None,
+                   help="admission lane: 0 interactive, 1 default, "
+                        "2 batch (strict priority; quota eviction "
+                        "prevents starvation)")
+    p.add_argument("--retries", type=int, default=0,
+                   help="on a backpressure (queue full / rate limited) "
+                        "answer, retry up to N times honoring the "
+                        "daemon's retry-after hint")
     p.add_argument("--wait", action="store_true",
                    help="block until the job finishes; rc 0 only when "
                         "it is done")
@@ -66,7 +76,14 @@ def main(argv=None) -> int:
 
     try:
         rep = client.submit(sock, args.tenant, args.files, app=args.app,
-                            pattern=args.pattern, n_reduce=args.nreduce)
+                            pattern=args.pattern, n_reduce=args.nreduce,
+                            priority=args.priority,
+                            retries=args.retries)
+    except client.ServeBusy as e:
+        print(f"mrsubmit: shed by the daemon: {e} "
+              f"(retry after ~{e.retry_after_s}s, or use --retries)",
+              file=sys.stderr)
+        return 2
     except Exception as e:  # noqa: BLE001 — the CLI reports, rc says it
         print(f"mrsubmit: submit failed: {e}", file=sys.stderr)
         return 1

@@ -73,6 +73,18 @@ def fetch_window_from_env(default: int = DEFAULT_FETCH_WINDOW) -> int:
     return max(1, w)
 
 
+def fetch_window_max_from_env(window: int) -> int:
+    """The ``DSI_NET_FETCH_WINDOW_MAX`` adaptive-widening ceiling,
+    clamped to >= ``window``.  Unset (or malformed) means the ceiling
+    IS the window — adaptation off, exactly yesterday's behavior."""
+    try:
+        mx = int(os.environ.get("DSI_NET_FETCH_WINDOW_MAX", "")
+                 or window)
+    except ValueError:
+        mx = window
+    return max(int(window), mx)
+
+
 class FetchFailure(Exception):
     """A partition fetch failed for reasons a producer re-execution can
     cure (dead/dying server, torn stream, missing spool entry)."""
@@ -236,15 +248,33 @@ class FetchPipeline:
     Attribution lands in ``stats`` under the pipeline's lock:
     per-fetch scratch scopes merge after each fetch, so the shared
     ``net`` scope never sees a torn read-modify-write from two dialers.
+
+    Adaptive widening (ISSUE 19): with ``max_window > window`` the
+    consumer watches its own stall fraction — when, since the last
+    adjustment, it spent more than half its wall blocked in
+    ``wait_s`` (the dialers can't keep up: bandwidth-delay product
+    exceeds the window), the effective window DOUBLES (clamped to
+    ``max_window``): extra semaphore permits are released and extra
+    dialer threads spawned mid-iteration.  Widening only deepens
+    prefetch — consumption order, decode thread, and therefore output
+    bytes are unchanged at any effective window, and a pipeline that
+    never stalls never widens.  ``window_effective`` (also attributed
+    as ``net_prefetch_window``) is the audit trail.
     """
 
     def __init__(self, items: Iterable[Tuple[int, str, str]], *,
                  window: int = DEFAULT_FETCH_WINDOW, stats=None,
                  own_addr: str | None = None,
                  local_root: str | None = None,
-                 timeout: float = 30.0, secret: str | None = None):
+                 timeout: float = 30.0, secret: str | None = None,
+                 max_window: int | None = None):
         self._items: List[Tuple[int, str, str]] = list(items)
         self._window = max(1, int(window))
+        self._max_window = max(self._window,
+                               int(max_window or self._window))
+        if self._window <= 1:
+            self._max_window = self._window  # serial stays serial
+        self.window_effective = self._window
         self._stats = stats
         self._own_addr = own_addr
         self._local_root = local_root
@@ -260,11 +290,44 @@ class FetchPipeline:
         self._fetch_s = 0.0  # Σ dialer seconds spent fetching
         self.wait_s = 0.0    # Σ consumer seconds blocked on a fetch
         self.overlap_s = 0.0  # fetch seconds hidden behind the consumer
+        self._mark_t = 0.0    # widening epoch start (consumer clock)
+        self._mark_wait = 0.0  # wait_s at the epoch start
         n = min(self._window, len(self._items))
         self._threads = [
             threading.Thread(target=self._dialer, name=f"dsi-fetch-{i}",
                              daemon=True)
             for i in range(n)]
+
+    def _maybe_widen(self, now: float) -> None:
+        """Consumer-side widening check, once per consumed item (class
+        docstring).  Runs on the consumer thread only — the effective
+        window is read by nobody else mid-flight."""
+        if self.window_effective >= self._max_window:
+            return
+        with self._lock:
+            remaining = len(self._items) - self._next
+        if remaining <= 0:
+            return  # every fetch already claimed: nothing to deepen
+        elapsed = now - self._mark_t
+        waited = self.wait_s - self._mark_wait
+        if elapsed < 0.01 or waited <= 0.5 * elapsed:
+            return
+        new = min(self._max_window, self.window_effective * 2)
+        delta = new - self.window_effective
+        self.window_effective = new
+        for _ in range(delta):
+            self._slots.release()
+        # More permits deserve more dialers (each blocks on one fetch
+        # at a time), capped by the work left to claim.
+        base = len(self._threads)
+        for j in range(max(0, min(new, len(self._items)) - base)):
+            t = threading.Thread(target=self._dialer,
+                                 name=f"dsi-fetch-w{base + j}",
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+        self._mark_t = now
+        self._mark_wait = self.wait_s
 
     def _merge(self, scratch: dict) -> None:
         stats = self._stats
@@ -333,6 +396,8 @@ class FetchPipeline:
     def __iter__(self) -> Iterator[Tuple[int, bytes]]:
         for t in self._threads:
             t.start()
+        self._mark_t = time.perf_counter()
+        self._mark_wait = 0.0
         try:
             for i, (task, addr, name) in enumerate(self._items):
                 t0 = time.perf_counter()
@@ -346,7 +411,9 @@ class FetchPipeline:
                     if i not in self._results:
                         self._raise_first()
                     raw = self._results.pop(i)
-                self.wait_s += time.perf_counter() - t0
+                now = time.perf_counter()
+                self.wait_s += now - t0
+                self._maybe_widen(now)
                 yield task, raw
                 self._slots.release()
             self.overlap_s = max(0.0, self._fetch_s - self.wait_s)
@@ -356,6 +423,9 @@ class FetchPipeline:
                         "net_fetch_wait_s", 0.0) + round(self.wait_s, 6)
                     self._stats["net_overlap_s"] = self._stats.get(
                         "net_overlap_s", 0.0) + round(self.overlap_s, 6)
+                    self._stats["net_prefetch_window"] = max(
+                        self._stats.get("net_prefetch_window", 0),
+                        self.window_effective)
         finally:
             self._drain()
 
@@ -379,7 +449,8 @@ def run_reduce_task_net(reducef, reduce_task: int, map_locs: Dict,
                         own_addr: str | None = None,
                         stats=None, timeout: float = 30.0,
                         secret: str | None = None,
-                        window: int | None = None) -> str:
+                        window: int | None = None,
+                        max_window: int | None = None) -> str:
     """One reduce task with the shuffle over TCP.
 
     ``map_locs`` maps map-task number (possibly a JSON-string key — RPC
@@ -391,7 +462,10 @@ def run_reduce_task_net(reducef, reduce_task: int, map_locs: Dict,
     (default ``DSI_NET_FETCH_WINDOW``, 4) bounds the prefetch pool;
     ``window=1`` runs the literal serial fetch→decode loop, so it is
     bit-identical to the pre-pipeline path AND reports
-    ``net_overlap_s == 0``.  At any window the merge order is the sorted
+    ``net_overlap_s == 0``.  ``max_window`` (default
+    ``DSI_NET_FETCH_WINDOW_MAX``, = window → off) lets the pipeline
+    widen itself when consumer waits dominate (class docstring).  At
+    any window — widened or not — the merge order is the sorted
     producer order, so ``mr-out-<r>`` bytes are window-invariant.  No
     intermediate GC — the producers' spools are on other machines;
     retention aging (``partsrv.reap_spool``) owns their lifetime.
@@ -405,6 +479,9 @@ def run_reduce_task_net(reducef, reduce_task: int, map_locs: Dict,
     if window is None:
         window = fetch_window_from_env()
     window = max(1, int(window))
+    if max_window is None:
+        max_window = fetch_window_max_from_env(window)
+    max_window = max(window, int(max_window))
     m_keys = sorted(map_locs, key=lambda k: int(k))
     if stats is not None:
         stats["net_prefetch_window"] = max(
@@ -426,7 +503,8 @@ def run_reduce_task_net(reducef, reduce_task: int, map_locs: Dict,
                  for k in m_keys]
         pipe = FetchPipeline(items, window=window, stats=stats,
                              own_addr=own_addr, local_root=workdir,
-                             timeout=timeout, secret=secret)
+                             timeout=timeout, secret=secret,
+                             max_window=max_window)
         for m, raw in pipe:
             with span("decode", lane="net", part=f"mr-{m}-{reduce_task}"):
                 _decode_lines(raw, intermediate, KeyValue)

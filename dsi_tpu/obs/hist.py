@@ -150,6 +150,64 @@ class LatencyHistogram:
         }
 
 
+class KeyedHistograms:
+    """A bounded map of :class:`LatencyHistogram` per dynamic key — the
+    serving daemon's per-TENANT step-latency view (``serve/daemon.py``
+    feeds every packed-step wall to each participating tenant's
+    histogram, and tail-driven eviction asks "whose p99 hurts the pack
+    most").  Unlike :class:`StageHistograms` the key set is unbounded
+    input (tenant ids), so memory is capped: past ``max_keys`` the
+    least-recently-RECORDED key is dropped — a tenant idle long enough
+    to be displaced by thousands of newer ones has no live tail worth
+    evicting on, and a dropped tenant simply re-enters cold.
+    """
+
+    def __init__(self, max_keys: int = 4096):
+        self._max = max(1, int(max_keys))
+        self._h: Dict[str, LatencyHistogram] = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._h)
+
+    def record(self, key: str, seconds: float) -> None:
+        with self._lock:
+            h = self._h.pop(key, None)
+            if h is None:
+                h = LatencyHistogram()
+                while len(self._h) >= self._max:
+                    # dicts iterate in insertion order; re-inserting on
+                    # every record makes the first key the LRU one.
+                    self._h.pop(next(iter(self._h)))
+            self._h[key] = h
+        h.record(seconds)
+
+    def get(self, key: str) -> Optional[LatencyHistogram]:
+        with self._lock:
+            return self._h.get(key)
+
+    def drop(self, key: str) -> None:
+        """Forget one key (a tenant whose jobs are all done)."""
+        with self._lock:
+            self._h.pop(key, None)
+
+    def p99_ms(self, key: str) -> float:
+        h = self.get(key)
+        return round(1e3 * h.percentile(0.99), 4) if h is not None \
+            else 0.0
+
+    def top(self, n: int) -> List[tuple]:
+        """The ``n`` keys with the worst p99, as ``(key, p99_seconds,
+        count)`` tuples sorted worst-first — the eviction policy's and
+        the bounded /metrics emission's read side."""
+        with self._lock:
+            items = list(self._h.items())
+        rows = [(k, h.percentile(0.99), h.count) for k, h in items]
+        rows.sort(key=lambda r: (-r[1], r[0]))
+        return rows[:n]
+
+
 class StageHistograms:
     """One :class:`LatencyHistogram` per hot stage; ``record`` drops
     non-hot names with a single dict miss."""

@@ -186,9 +186,11 @@ COUNTER_KEYS = (
     "ingest_readers", "ingest_blocks", "readahead_hit_pct",
     "wire_upload", "wire_steps", "wire_raw_steps", "wire_packed_bytes",
     "wire_ratio", "ckpt_delta_raw_bytes", "ckpt_compress",
-    # serving daemon (the "serve" scope, serve/pack.py)
+    # serving daemon (the "serve"/"serve_grep" scopes, serve/pack.py):
+    # rung_widens counts grep lanes sticky-widened to the hard-bound
+    # l_cap rung (the per-tenant AOT rung-affinity move, ISSUE 19)
     "packed_steps", "packed_rows", "max_tenants_per_step",
-    "host_fallbacks",
+    "host_fallbacks", "rung_widens",
     # plan layer (the "plan" scope, dsi_tpu/plan + device/relay.py):
     # multi-stage chain accounting — handoff bytes vs commit bytes is
     # the zero-host-round-trip evidence
@@ -216,6 +218,30 @@ SCHEMA_KEYS = PHASE_KEYS + COUNTER_KEYS
 
 #: The engine names the four streaming engines register under.
 ENGINES = ("stream", "tfidf", "grep", "indexer")
+
+#: Every ``dsi_serve_*`` series name the daemon may emit on
+#: ``/metrics`` (``serve/daemon.py _metrics_section``).  Pinned the same
+#: way SCHEMA_KEYS is: the ``metric-schema`` dsicheck rule requires any
+#: ``dsi_serve_``-prefixed string literal in the tree to name (or be a
+#: truncated f-string head of) a series listed here, and the bench
+#: contract test asserts the daemon's emission stays inside this set —
+#: so the serving surface cannot grow an unregistered series, and its
+#: cardinality stays bounded by construction (per-tenant series are
+#: emitted for the top ``DSI_SERVE_METRICS_TENANTS`` tenants only).
+SERVE_SERIES = (
+    "dsi_serve_jobs_total", "dsi_serve_queued", "dsi_serve_resident",
+    "dsi_serve_tenants_total", "dsi_serve_queue_depth",
+    "dsi_serve_shed_total", "dsi_serve_rate_limited_total",
+    "dsi_serve_evictions_p99_total", "dsi_serve_evictions_quota_total",
+    "dsi_serve_packed_steps", "dsi_serve_packed_rows",
+    "dsi_serve_grep_packed_steps", "dsi_serve_grep_packed_rows",
+    "dsi_serve_grep_rung_widens",
+    "dsi_serve_tenant_steps", "dsi_serve_tenant_rows",
+    "dsi_serve_tenant_evictions", "dsi_serve_tenant_resumes",
+    "dsi_serve_tenant_done",
+    "dsi_serve_tenant_resume_gap_seconds",
+    "dsi_serve_tenant_p99_ms",
+)
 
 
 class MetricsScope(dict):

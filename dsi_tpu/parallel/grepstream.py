@@ -453,6 +453,33 @@ def grep_host_oracle(blocks: Iterable[bytes], pattern: str, *,
     return GrepStreamResult(line_no, matched, occurrences, tuple(hist), top)
 
 
+def merge_topk(cands: Iterable[Tuple[int, int]],
+               k: int) -> Tuple[Tuple[int, int], ...]:
+    """Exact global top-k from a union of per-step top-k candidate
+    lists (``(line_no, occurrences)`` pairs, line numbers disjoint
+    across steps).  Exact because any line in the global top-k is, with
+    the same ``k``, necessarily in its own step's top-k: a step holding
+    ``k`` lines that all beat it would beat it globally too.  One
+    definition shared by the packed serving lanes and their tests."""
+    return tuple(sorted(cands, key=lambda r: (-r[1], r[0]))[:k])
+
+
+def grep_pack_fn(n_dev: int, chunk_bytes: int, m: int, l_cap: int, *,
+                 bins: int = GREP_BINS, k: int = DEFAULT_TOPK,
+                 mesh: Mesh):
+    """The compiled packed-grep step for one ``(shape, rung)`` — the
+    serving packer's entry (``serve/pack.py PackedGrepScheduler``) to
+    the per-row grep program.  The kernel body runs per device row
+    under ``shard_map`` with no collectives, so each row may carry a
+    DIFFERENT pattern of the same length ``m``: K tenants whose
+    patterns share a length share one executable and one dispatch.
+    Same persistent-AOT cache entry the streaming engine uses — a
+    daemon and a one-shot CLI warm each other."""
+    return _grep_fn(_grep_examples(n_dev, chunk_bytes, m), n_dev=n_dev,
+                    chunk_bytes=chunk_bytes, m=m, l_cap=l_cap, bins=bins,
+                    k=k, mesh=mesh)
+
+
 class GrepStep(EngineStep):
     """Resumable step object over the streaming grep engine — the
     ``{advance, confirm, checkpoint, restore, close}`` lifecycle

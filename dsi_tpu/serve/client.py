@@ -4,15 +4,34 @@ Every call is one framed-JSON RPC over the daemon's Unix socket
 (``mr/rpc.py`` — dial per call, the 6.5840 idiom), so the client stays
 import-light: submitting a job from a test, the bench's serve row, or a
 shell never pays a jax init.
+
+Backpressure (ISSUE 19): a shed or rate-limited submission comes back
+as a TYPED error — ``error_type == "backpressure"`` with a
+``retry_after_s`` hint — raised here as :class:`ServeBusy` so callers
+can tell "the daemon is protecting itself, try later" from a real
+rejection.  :func:`submit` optionally honors the hint itself with a
+bounded, jittered retry loop (``retries``), which is what the soak's
+thousands of submitting clients use.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import time
 from typing import Dict, List, Optional
 
 from dsi_tpu.mr.rpc import CoordinatorGone, call
+
+
+class ServeBusy(RuntimeError):
+    """The daemon shed the request (queue full or tenant over its
+    submit rate).  ``retry_after_s`` is the daemon's drain-proportional
+    hint — retry after roughly that long (with jitter)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.5):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 def default_socket(spool: str) -> str:
@@ -27,6 +46,9 @@ def _call(socket_path: str, method: str, args: dict,
         raise CoordinatorGone(f"mrserve RPC {method} failed at "
                               f"{socket_path}")
     if reply.get("error"):
+        if reply.get("error_type") == "backpressure":
+            raise ServeBusy(f"mrserve {method}: {reply['error']}",
+                            reply.get("retry_after_s") or 0.5)
         raise RuntimeError(f"mrserve {method}: {reply['error']}")
     return reply
 
@@ -54,16 +76,38 @@ def wait_ready(socket_path: str, timeout: float = 120.0,
 
 def submit(socket_path: str, tenant: str, files: List[str],
            app: str = "wc", pattern: Optional[str] = None,
-           n_reduce: Optional[int] = None) -> dict:
+           n_reduce: Optional[int] = None,
+           priority: Optional[int] = None, retries: int = 0,
+           max_backoff_s: float = 5.0, sleep=time.sleep,
+           rng=None) -> dict:
     """Submit one job; returns ``{"job_id", "out_dir"}`` (the daemon
-    journals the job durably before acking)."""
+    journals the job durably before acking).
+
+    With ``retries`` > 0 a :class:`ServeBusy` answer is retried up to
+    that many times, sleeping the daemon's hint scaled by a uniform
+    [0.5, 1.5) jitter (clamped to ``max_backoff_s``) so a shed burst of
+    clients doesn't re-arrive as the same burst.  ``sleep``/``rng`` are
+    injectable for deterministic tests.  The final ServeBusy (or any
+    other error) propagates."""
     args = {"tenant": tenant, "app": app,
             "files": [os.path.abspath(f) for f in files]}
     if pattern is not None:
         args["pattern"] = pattern
     if n_reduce is not None:
         args["n_reduce"] = int(n_reduce)
-    return _call(socket_path, "Submit", args)
+    if priority is not None:
+        args["priority"] = int(priority)
+    attempts = max(0, int(retries)) + 1
+    for attempt in range(attempts):
+        try:
+            return _call(socket_path, "Submit", args)
+        except ServeBusy as e:
+            if attempt + 1 >= attempts:
+                raise
+            hint = max(0.05, e.retry_after_s)
+            jitter = 0.5 + (rng() if rng is not None else random.random())
+            sleep(min(max_backoff_s, hint * jitter))
+    raise AssertionError("unreachable")  # the loop returns or raises
 
 
 def status(socket_path: str, job_id: Optional[str] = None,

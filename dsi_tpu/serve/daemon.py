@@ -5,18 +5,30 @@ executables, and a spool directory; tenants submit jobs over the
 repo's framed-JSON pull-RPC control plane (``mr/rpc.py`` — the 6.5840
 idiom the reference's coordinator already speaks) and the daemon:
 
-* **journals** every submission durably (``spool/jobs/<id>.json``
-  through ``atomicio.write_bytes_durable``) BEFORE acking it, so a
-  ``kill -9`` at any instant loses no accepted job;
+* **admits by priority** (``serve/qos.py``): three strict FIFO lanes
+  (``mrsubmit --priority``), per-tenant token-bucket rate limits, and a
+  bounded queue — an over-rate or over-bound submission is SHED with a
+  typed backpressure error carrying a retry-after hint (the client's
+  bounded-retry contract), BEFORE any journal write, so shedding never
+  loses an accepted job;
+* **journals** every accepted submission durably
+  (``spool/jobs/<id>.json`` through ``atomicio.write_bytes_durable``)
+  BEFORE acking it, so a ``kill -9`` at any instant loses no accepted
+  job;
 * **packs** word-count tenants into shared device steps
-  (``serve/pack.py``: K tenants ≈ 1 dispatch) and multiplexes other
-  apps as resumable step objects (``parallel/stepobj.py``) on one
-  scheduler thread — a single thread owns all jax work;
-* **evicts** tenants to their delta-checkpoint chains when the
-  resident set is full or a tenant exceeds its step quota while others
-  wait, and resumes them on their next turn (or the tenant's next
-  submission, which re-prioritizes its parked jobs) — ``resume_gap_s``
-  is accounted per tenant;
+  (``serve/pack.py``: K tenants ≈ 1 dispatch) AND grep tenants into
+  shared lane-isolated dispatches (``PackedGrepScheduler``: rows
+  grouped by pattern length, per-tenant sticky ``l_cap`` rung so one
+  tenant's widen never cold-compiles the rest) — everything else runs
+  as resumable step objects (``parallel/stepobj.py``) on one scheduler
+  thread; a single thread owns all jax work;
+* **evicts by tail latency**: when the resident set is full and jobs
+  wait, the victim is the tenant whose p99 packed-step wall
+  (``obs/hist.KeyedHistograms`` fed every step) hurts the pack most —
+  the step-quota rule stays as the fallback when no tenant has a
+  meaningful tail yet.  Parked tenants resume from their
+  delta-checkpoint chains on their next turn (or next submission, which
+  re-prioritizes their parked jobs within their own priority lane);
 * **resumes after a crash**: on boot every journaled job not marked
   done re-enters the queue with ``resume=True``; per-tenant chains
   restore the accumulators and cursors, and the re-run output is
@@ -25,7 +37,11 @@ idiom the reference's coordinator already speaks) and the daemon:
   oracle);
 * **reports**: a ``tenants`` section on ``/statusz`` and labeled
   ``dsi_serve_*`` series on ``/metrics`` via the live-telemetry
-  section hooks (``obs/live.py``).
+  section hooks (``obs/live.py``).  Every emitted series is registered
+  in ``obs/registry.SERVE_SERIES`` (the dsicheck metric-schema rule
+  enforces it), and per-tenant series are CAPPED at
+  ``DSI_SERVE_METRICS_TENANTS`` worst-p99 tenants, so a
+  thousands-of-tenants soak keeps /metrics bounded.
 
 Spool hygiene at boot: ``.tmp-*`` orphans are reaped across the spool
 (``atomicio.reap_tmp_files``), and checkpoint chains of tenants whose
@@ -42,10 +58,11 @@ import re
 import shutil
 import threading
 import time
-from collections import deque
 from typing import Dict, List, Optional
 
 from dsi_tpu.mr.rpc import RpcServer
+from dsi_tpu.obs.hist import KeyedHistograms
+from dsi_tpu.serve import qos
 from dsi_tpu.serve.client import default_socket
 from dsi_tpu.utils.atomicio import (
     read_bytes_verified,
@@ -53,17 +70,26 @@ from dsi_tpu.utils.atomicio import (
     write_bytes_durable,
 )
 
-#: Apps the daemon serves.  ``wc`` rides the packed scheduler; ``grep``
-#: runs as a resumable step object (its kernel is lane-isolated, so
-#: packing it too is a natural follow-up — see DESIGN.md).
+#: Apps the daemon serves.  ``wc`` rides the packed wave scheduler;
+#: ``grep`` rides the packed grep scheduler (lane-isolated rows — see
+#: serve/pack.py) unless ``pack_grep`` is off, in which case it runs as
+#: a time-multiplexed resumable step object (the bench's control arm).
 SERVE_APPS = ("wc", "grep")
 
 _JOB_FIELDS = ("job_id", "tenant", "app", "files", "n_reduce", "out_dir",
-               "pattern", "state", "submitted_ts", "error", "stats")
+               "pattern", "priority", "state", "submitted_ts", "done_ts",
+               "error", "stats")
 
 #: Tenant ids become path components (journal names, chain dirs): a
 #: plain slug, no separators, no leading dot.
 _TENANT_RE = re.compile(r"[A-Za-z0-9_-][A-Za-z0-9._-]{0,63}")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 class ServeDaemon:
@@ -75,7 +101,14 @@ class ServeDaemon:
                  max_resident: int = 8, quota_steps: int = 64,
                  checkpoint_every: Optional[int] = 8,
                  retention_s: float = 14 * 86400.0,
-                 warm: bool = True):
+                 warm: bool = True,
+                 max_queue: int = 1024,
+                 rate_limit: Optional[float] = None,
+                 rate_burst: int = 4,
+                 pack_grep: Optional[bool] = None,
+                 evict_min_samples: int = 8,
+                 metrics_tenants: Optional[int] = None,
+                 clock=time.monotonic):
         self.spool = os.path.abspath(spool)
         self.jobs_dir = os.path.join(self.spool, "jobs")
         self.tenants_dir = os.path.join(self.spool, "tenants")
@@ -95,17 +128,38 @@ class ServeDaemon:
         self.checkpoint_every = checkpoint_every
         self.retention_s = float(retention_s)
         self.warm = warm
+        self.max_queue = max(1, int(max_queue))
+        self.rate_limit = rate_limit
+        self.rate_burst = max(1, int(rate_burst))
+        if pack_grep is None:
+            pack_grep = os.environ.get("DSI_SERVE_PACK_GREP", "1") != "0"
+        self.pack_grep = bool(pack_grep)
+        self.evict_min_samples = max(1, int(evict_min_samples))
+        if metrics_tenants is None:
+            metrics_tenants = _env_int("DSI_SERVE_METRICS_TENANTS", 32)
+        self.metrics_tenants = max(1, int(metrics_tenants))
+        self._clock = clock
 
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._stop = threading.Event()
         self.ready = threading.Event()
         self._jobs: Dict[str, Dict] = {}
-        self._queue: deque = deque()
+        self._queue = qos.PriorityQueue()
         self._resident: Dict[str, Dict] = {}
         self._tenants: Dict[str, Dict] = {}
+        self._buckets: Dict[str, qos.TokenBucket] = {}
+        # Admission/eviction counters.  A plain dict, not an engine
+        # metrics scope: these are control-plane events, surfaced as
+        # dsi_serve_* series (SERVE_SERIES), not step-pipeline stats.
+        self._qos = {"shed": 0, "rate_limited": 0, "evict_p99": 0,
+                     "evict_quota": 0}
+        # Per-tenant packed-step wall distributions — the eviction
+        # policy's evidence and the bounded /metrics tenant selector.
+        self._hist = KeyedHistograms()
         self._seq = 0
         self.packer = None
+        self.grep_packer = None
         self.boot_reaped = 0
         self.boot_gc_chains = 0
 
@@ -185,6 +239,8 @@ class ServeDaemon:
                 job = json.loads(raw)
             except ValueError:
                 continue
+            job.setdefault("priority", qos.DEFAULT_PRIORITY)
+            job.setdefault("done_ts", None)
             self._jobs[job["job_id"]] = job
             self._tenant(job["tenant"])["jobs"] += 1
             try:
@@ -198,7 +254,7 @@ class ServeDaemon:
                 pass
             else:
                 job["state"] = "queued"
-                self._queue.append(job["job_id"])
+                self._queue.push(job["job_id"], job["priority"])
         self._gc_aged_chains()
 
     # ── bookkeeping ──
@@ -245,29 +301,67 @@ class ServeDaemon:
         pattern = args.get("pattern")
         if app == "grep" and not pattern:
             return {"error": "grep needs a pattern"}
+        priority = args.get("priority")
+        if priority is None:
+            priority = qos.DEFAULT_PRIORITY
+        try:
+            priority = int(priority)
+        except (TypeError, ValueError):
+            return {"error": f"invalid priority {priority!r}"}
+        if priority not in qos.PRIORITIES:
+            return {"error": f"invalid priority {priority} "
+                             f"(want one of {qos.PRIORITIES})"}
         with self._wake:
+            # Admission policy, BEFORE the journal write: a shed or
+            # rate-limited submission leaves no spool state, so
+            # backpressure can never manufacture a lost accepted job.
+            if self.rate_limit is not None:
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = qos.TokenBucket(self.rate_limit,
+                                             self.rate_burst,
+                                             clock=self._clock)
+                    self._buckets[tenant] = bucket
+                hint = bucket.take()
+                if hint > 0.0:
+                    self._qos["rate_limited"] += 1
+                    return qos.backpressure_reply(
+                        f"tenant {tenant!r} over submit rate "
+                        f"({self.rate_limit}/s, burst "
+                        f"{self.rate_burst})", hint)
+            queued = len(self._queue)
+            if queued >= self.max_queue:
+                self._qos["shed"] += 1
+                # Deeper backlog → longer hint: drain-proportional,
+                # clamped so clients neither stampede nor stall.
+                hint = max(0.2, min(5.0, 0.005 * queued))
+                return qos.backpressure_reply(
+                    f"queue full ({queued} >= {self.max_queue})", hint)
             jid = f"{tenant}-{self._seq:06d}"
             self._seq += 1
             job = {"job_id": jid, "tenant": tenant, "app": app,
                    "files": files, "n_reduce": n_reduce,
                    "out_dir": os.path.join(self.out_dir, jid),
-                   "pattern": pattern, "state": "queued",
+                   "pattern": pattern, "priority": priority,
+                   "state": "queued",
                    "submitted_ts": round(time.time(), 3),
-                   "error": None, "stats": {}}
+                   "done_ts": None, "error": None, "stats": {}}
             self._persist(job)  # durable BEFORE the ack
             self._jobs[jid] = job
             self._tenant(tenant)["jobs"] += 1
             # "Resume on the next submission": the tenant's PARKED jobs
-            # move to the queue front, then the new one joins the tail.
-            # Parked only — front-loading the tenant's never-run queued
-            # backlog too would let one chatty tenant starve the rest.
+            # move to the front of their own priority lanes, then the
+            # new one joins its lane's tail.  Parked only — and never
+            # across lanes, so a parked batch job cannot cut ahead of
+            # the interactive lane.
             parked = [j for j in self._queue
                       if self._jobs[j]["tenant"] == tenant
                       and self._jobs[j]["state"] == "parked"]
             for j in parked:
                 self._queue.remove(j)
-            self._queue.extendleft(reversed(parked))
-            self._queue.append(jid)
+            for j in reversed(parked):
+                self._queue.push_front(j, self._jobs[j]["priority"])
+            self._queue.push(jid, priority)
             self._wake.notify_all()
         return {"job_id": jid, "out_dir": job["out_dir"]}
 
@@ -289,10 +383,16 @@ class ServeDaemon:
 
     def _rpc_ping(self, args: dict) -> dict:
         with self._lock:
-            return {"ok": True, "pid": os.getpid(),
-                    "ready": self.ready.is_set(),
-                    "queued": len(self._queue),
-                    "resident": len(self._resident)}
+            out = {"ok": True, "pid": os.getpid(),
+                   "ready": self.ready.is_set(),
+                   "queued": len(self._queue),
+                   "resident": len(self._resident),
+                   "shed": self._qos["shed"],
+                   "rate_limited": self._qos["rate_limited"]}
+            if self.grep_packer is not None:
+                out["grep_packed_steps"] = \
+                    self.grep_packer.stats["packed_steps"]
+            return out
 
     def _rpc_shutdown(self, args: dict) -> dict:
         self.stop()
@@ -302,19 +402,33 @@ class ServeDaemon:
 
     def _statusz_section(self) -> str:
         with self._lock:
+            depths = self._queue.depths()
             lines = [f"  queued={len(self._queue)} "
+                     f"depths={'/'.join(map(str, depths))} "
                      f"resident={len(self._resident)} "
-                     f"jobs={len(self._jobs)}"]
+                     f"jobs={len(self._jobs)} "
+                     f"shed={self._qos['shed']} "
+                     f"rate_limited={self._qos['rate_limited']} "
+                     f"evict_p99={self._qos['evict_p99']} "
+                     f"evict_quota={self._qos['evict_quota']}"]
             if self.packer is not None:
                 st = self.packer.stats
                 lines.append(
-                    f"  packed_steps={st['packed_steps']} "
+                    f"  wc packed_steps={st['packed_steps']} "
                     f"packed_rows={st['packed_rows']} "
                     f"max_tenants_per_step={st['max_tenants_per_step']} "
                     f"replays={st['replays']}")
+            if self.grep_packer is not None:
+                st = self.grep_packer.stats
+                lines.append(
+                    f"  grep packed_steps={st['packed_steps']} "
+                    f"packed_rows={st['packed_rows']} "
+                    f"max_tenants_per_step={st['max_tenants_per_step']} "
+                    f"rung_widens={st['rung_widens']} "
+                    f"host_fallbacks={st['host_fallbacks']}")
             for jid, rec in sorted(self._resident.items()):
                 job = self._jobs[jid]
-                if rec["kind"] == "wc":
+                if rec["kind"] in ("wc", "grep"):
                     lane = rec["lane"]
                     live = (f"steps={lane.steps} "
                             f"rows={lane.confirmed_rows} "
@@ -322,11 +436,38 @@ class ServeDaemon:
                 else:
                     live = f"steps={rec['advanced']}"
                 lines.append(f"  tenant={job['tenant']} job={jid} "
-                             f"app={job['app']} {live}")
-            for t, s in sorted(self._tenants.items()):
+                             f"app={job['app']} "
+                             f"prio={job.get('priority')} {live}")
+            # The tenant table is capped like /metrics: worst tails
+            # first, then the rest in name order up to the cap.
+            for t in self._emit_tenants():
+                s = self._tenants[t]
                 kv = " ".join(f"{k}={v}" for k, v in sorted(s.items()))
-                lines.append(f"  tenant={t} {kv}")
+                p99 = self._hist.p99_ms(t)
+                lines.append(f"  tenant={t} p99_ms={p99} {kv}")
+            omitted = len(self._tenants) - \
+                len(self._emit_tenants())
+            if omitted > 0:
+                lines.append(f"  ... {omitted} more tenants (cap "
+                             f"{self.metrics_tenants})")
         return "\n".join(lines)
+
+    def _emit_tenants(self) -> List[str]:
+        """The capped tenant set for /statusz and /metrics: worst-p99
+        tenants first (the ones an operator is hunting), filled with
+        the rest in name order up to ``metrics_tenants``.  Caller holds
+        the lock."""
+        cap = self.metrics_tenants
+        picked = [t for t, _p, _n in self._hist.top(cap)
+                  if t in self._tenants]
+        if len(picked) < cap:
+            seen = set(picked)
+            for t in sorted(self._tenants):
+                if t not in seen:
+                    picked.append(t)
+                    if len(picked) >= cap:
+                        break
+        return picked
 
     def _metrics_section(self) -> str:
         from dsi_tpu.obs.live import _mname
@@ -334,35 +475,58 @@ class ServeDaemon:
         with self._lock:
             L = [f"dsi_serve_jobs_total {len(self._jobs)}",
                  f"dsi_serve_queued {len(self._queue)}",
-                 f"dsi_serve_resident {len(self._resident)}"]
+                 f"dsi_serve_resident {len(self._resident)}",
+                 f"dsi_serve_tenants_total {len(self._tenants)}",
+                 f"dsi_serve_shed_total {self._qos['shed']}",
+                 f"dsi_serve_rate_limited_total "
+                 f"{self._qos['rate_limited']}",
+                 f"dsi_serve_evictions_p99_total "
+                 f"{self._qos['evict_p99']}",
+                 f"dsi_serve_evictions_quota_total "
+                 f"{self._qos['evict_quota']}"]
+            for p, d in zip(qos.PRIORITIES, self._queue.depths()):
+                L.append(f'dsi_serve_queue_depth{{priority="{p}"}} {d}')
             if self.packer is not None:
                 st = self.packer.stats
                 L.append(f"dsi_serve_packed_steps {st['packed_steps']}")
                 L.append(f"dsi_serve_packed_rows {st['packed_rows']}")
-            for t, s in sorted(self._tenants.items()):
+            if self.grep_packer is not None:
+                st = self.grep_packer.stats
+                L.append(f"dsi_serve_grep_packed_steps "
+                         f"{st['packed_steps']}")
+                L.append(f"dsi_serve_grep_packed_rows "
+                         f"{st['packed_rows']}")
+                L.append(f"dsi_serve_grep_rung_widens "
+                         f"{st['rung_widens']}")
+            for t in self._emit_tenants():
+                s = self._tenants[t]
                 lab = f'tenant="{_mname(t)}"'
                 for k in ("steps", "rows", "evictions", "resumes",
                           "done"):
                     L.append(f"dsi_serve_tenant_{k}{{{lab}}} {s[k]}")
                 L.append(f"dsi_serve_tenant_resume_gap_seconds{{{lab}}} "
                          f"{s['resume_gap_s']}")
+                L.append(f"dsi_serve_tenant_p99_ms{{{lab}}} "
+                         f"{self._hist.p99_ms(t)}")
         return "\n".join(L)
 
     # ── scheduler (the one thread that touches jax) ──
 
     def _admit(self) -> bool:
         """Move queued jobs into the resident set (resuming from their
-        chains); returns whether anything was admitted.  Caller holds
-        the lock."""
+        chains), highest priority first; returns whether anything was
+        admitted.  Caller holds the lock."""
         admitted = False
-        while self._queue and len(self._resident) < self.max_resident:
-            jid = self._queue.popleft()
+        while len(self._queue) and \
+                len(self._resident) < self.max_resident:
+            jid = self._queue.pop()
             job = self._jobs[jid]
             try:
                 rec = self._make_runner(job)
             except Exception as e:  # noqa: BLE001 — job fails, daemon lives
                 job["state"] = "failed"
                 job["error"] = f"{type(e).__name__}: {e}"
+                job["done_ts"] = round(time.time(), 3)
                 self._persist(job)
                 continue
             was_parked = job["state"] == "parked"
@@ -389,7 +553,19 @@ class ServeDaemon:
             return {"kind": "wc", "lane": lane,
                     "resume_gap_s": lane.resume_gap_s,
                     "resume_cursor": lane.start_offset}
-        # grep: a resumable step object, time-multiplexed.
+        if self.pack_grep:
+            # grep as a packed lane: rows join shared dispatches keyed
+            # by (pattern length, rung) — the ISSUE-19 tentpole.
+            from dsi_tpu.serve.pack import GrepLane
+
+            lane = GrepLane(job, self.chunk_bytes, ckpt_dir,
+                            checkpoint_every=self.checkpoint_every,
+                            resume=True)
+            return {"kind": "grep", "lane": lane,
+                    "resume_gap_s": lane.resume_gap_s,
+                    "resume_cursor": lane.start_offset}
+        # grep as a resumable step object, time-multiplexed (the
+        # packed-vs-tmux bench row's control arm).
         from dsi_tpu.parallel.grepstream import GrepStep
         from dsi_tpu.parallel.streaming import stream_files
 
@@ -423,6 +599,16 @@ class ServeDaemon:
                          "rows": lane.confirmed_rows,
                          "hostpath": lane.hostpath,
                          "resume_gap_s": lane.resume_gap_s}
+            elif rec["kind"] == "grep":
+                lane = rec["lane"]
+                result = lane.finalize()
+                hostpath = lane.hostpath
+                self._write_grep_result(job, result)
+                stats = {"steps": lane.steps,
+                         "rows": lane.confirmed_rows,
+                         "hostpath": lane.hostpath,
+                         "rung": lane.rung,
+                         "resume_gap_s": lane.resume_gap_s}
             else:
                 step = rec["step"]
                 result = step.close()
@@ -435,15 +621,7 @@ class ServeDaemon:
                     result = grep_host_oracle(stream_files(job["files"]),
                                               job["pattern"])
                     hostpath = True
-                os.makedirs(job["out_dir"], exist_ok=True)
-                payload = json.dumps(
-                    {"lines": result.lines, "matched": result.matched,
-                     "occurrences": result.occurrences,
-                     "hist": list(result.hist),
-                     "topk": [list(r) for r in result.topk]},
-                    sort_keys=True).encode("utf-8")
-                write_bytes_durable(
-                    os.path.join(job["out_dir"], "grep.json"), payload)
+                self._write_grep_result(job, result)
                 stats = {"steps": rec["advanced"]}
         except Exception as e:  # noqa: BLE001 — job fails, daemon lives
             error = f"{type(e).__name__}: {e}"
@@ -451,6 +629,7 @@ class ServeDaemon:
             job["stats"] = stats
             job["state"] = "done" if error is None else "failed"
             job["error"] = error
+            job["done_ts"] = round(time.time(), 3)
             ts = self._tenant(job["tenant"])
             if hostpath:
                 ts["hostpath"] += 1
@@ -460,76 +639,150 @@ class ServeDaemon:
                 ts["rows"] += int(stats.get("rows") or 0)
         self._persist(job)
 
+    @staticmethod
+    def _write_grep_result(job: Dict, result) -> None:
+        """One spelling of the grep output file — the packed lane, the
+        step object, and the host path must serialize identically (the
+        per-tenant byte-parity bar)."""
+        os.makedirs(job["out_dir"], exist_ok=True)
+        payload = json.dumps(
+            {"lines": result.lines, "matched": result.matched,
+             "occurrences": result.occurrences,
+             "hist": list(result.hist),
+             "topk": [list(r) for r in result.topk]},
+            sort_keys=True).encode("utf-8")
+        write_bytes_durable(
+            os.path.join(job["out_dir"], "grep.json"), payload)
+
+    def _rec_steps(self, rec: Dict) -> int:
+        return (rec["lane"].steps_since_resume
+                if rec["kind"] in ("wc", "grep") else rec["advanced"])
+
     def _evict_one(self) -> None:
-        """Park the resident job furthest past its quota so a queued
-        tenant gets a turn — checkpoint to its delta chain, drop the
-        runner, re-queue at the tail.  Caller holds the lock."""
+        """Park one resident job so a queued tenant gets a turn —
+        checkpoint to its delta chain, drop the runner, re-queue in its
+        own priority lane.  Victim choice is TAIL-DRIVEN: among
+        residents past a minimum residency, the tenant whose p99
+        packed-step wall is worst (its rows stall every pack it rides).
+        The step-quota rule is the fallback when no resident has a
+        meaningful tail yet.  Caller holds the lock."""
         victim = None
-        most = -1
+        worst = 0.0
+        min_steps = min(self.quota_steps, self.evict_min_samples)
         for jid, rec in self._resident.items():
-            steps = (rec["lane"].steps_since_resume
-                     if rec["kind"] == "wc" else rec["advanced"])
-            if steps >= self.quota_steps and steps > most:
-                victim, most = jid, steps
+            if self._rec_steps(rec) < min_steps:
+                continue  # too fresh: let it earn a tail first
+            h = self._hist.get(self._jobs[jid]["tenant"])
+            if h is None or h.count < self.evict_min_samples:
+                continue
+            p99 = h.percentile(0.99)
+            if p99 > worst:
+                victim, worst = jid, p99
+        reason = "evict_p99"
+        if victim is None:
+            # Fallback: the original furthest-past-quota rule.
+            most = -1
+            for jid, rec in self._resident.items():
+                steps = self._rec_steps(rec)
+                if steps >= self.quota_steps and steps > most:
+                    victim, most = jid, steps
+            reason = "evict_quota"
         if victim is None:
             return
         rec = self._resident.pop(victim)
         job = self._jobs[victim]
         try:
-            if rec["kind"] == "wc":
+            if rec["kind"] in ("wc", "grep"):
                 rec["lane"].suspend()
             else:
                 rec["step"].suspend()
         except Exception as e:  # noqa: BLE001
             job["state"] = "failed"
             job["error"] = f"evict: {type(e).__name__}: {e}"
+            job["done_ts"] = round(time.time(), 3)
             self._persist(job)
             return
         job["state"] = "parked"
         self._persist(job)
-        self._queue.append(victim)
+        self._queue.push(victim, job.get("priority",
+                                         qos.DEFAULT_PRIORITY))
         self._tenant(job["tenant"])["evictions"] += 1
+        self._qos[reason] += 1
+
+    def _fail_lanes(self, pairs, e: Exception, what: str) -> None:
+        """Fail the jobs riding a packer that threw — the packer error
+        takes out its participants, never the daemon."""
+        with self._wake:
+            for jid, _ln in pairs:
+                rec = self._resident.pop(jid, None)
+                if rec is None:
+                    continue
+                job = self._jobs[jid]
+                job["state"] = "failed"
+                job["error"] = f"{what}: {type(e).__name__}: {e}"
+                job["done_ts"] = round(time.time(), 3)
+                self._persist(job)
 
     def _scheduler(self) -> None:
         from dsi_tpu.parallel.shuffle import default_mesh
-        from dsi_tpu.serve.pack import PackedWcScheduler
+        from dsi_tpu.serve.pack import (PackedGrepScheduler,
+                                        PackedWcScheduler)
 
         self._mesh = default_mesh(self.devices)
         self.packer = PackedWcScheduler(self._mesh, self.chunk_bytes,
                                         self.n_reduce)
+        if self.pack_grep:
+            self.grep_packer = PackedGrepScheduler(self._mesh,
+                                                   self.chunk_bytes)
         if self.warm:
             self.packer.warm()
         self.ready.set()
         while not self._stop.is_set():
             with self._wake:
                 self._admit()
-                if self._queue:
+                if len(self._queue):
                     self._evict_one()
                     self._admit()
                 resident = dict(self._resident)
             worked = False
             # One packed step across every runnable wc lane.  A packer
             # error fails the participating jobs, never the daemon.
+            # The step wall feeds every participant tenant's histogram
+            # — the eviction policy's evidence.
             wc_lanes = [(jid, rec["lane"])
                         for jid, rec in resident.items()
                         if rec["kind"] == "wc" and rec["lane"].runnable]
             if wc_lanes:
+                t0 = time.perf_counter()
                 try:
                     confirmed = self.packer.step(
                         [ln for _, ln in wc_lanes])
+                    wall = time.perf_counter() - t0
+                    for ln in confirmed:
+                        self._hist.record(ln.tenant, wall)
                     worked = bool(confirmed) or any(
                         not ln.runnable for _, ln in wc_lanes)
                 except Exception as e:  # noqa: BLE001
-                    with self._wake:
-                        for jid, _ln in wc_lanes:
-                            rec = self._resident.pop(jid, None)
-                            if rec is None:
-                                continue
-                            job = self._jobs[jid]
-                            job["state"] = "failed"
-                            job["error"] = (f"packed step: "
-                                            f"{type(e).__name__}: {e}")
-                            self._persist(job)
+                    self._fail_lanes(wc_lanes, e, "packed step")
+                    worked = True
+            # One packed grep step over ONE (pattern length, rung)
+            # group — groups rotate across scheduler iterations.
+            grep_lanes = [(jid, rec["lane"])
+                          for jid, rec in resident.items()
+                          if rec["kind"] == "grep"
+                          and rec["lane"].runnable]
+            if grep_lanes:
+                t0 = time.perf_counter()
+                try:
+                    confirmed = self.grep_packer.step(
+                        [ln for _, ln in grep_lanes])
+                    wall = time.perf_counter() - t0
+                    for ln in confirmed:
+                        self._hist.record(ln.tenant, wall)
+                    worked = worked or bool(confirmed) or any(
+                        not ln.runnable for _, ln in grep_lanes)
+                except Exception as e:  # noqa: BLE001
+                    self._fail_lanes(grep_lanes, e, "packed grep step")
                     worked = True
             # A bounded slice of every step-object job — the same
             # ``advance_slice`` primitive the shard workers drive their
@@ -538,9 +791,13 @@ class ServeDaemon:
                 if rec["kind"] != "step":
                     continue
                 step = rec["step"]
+                t0 = time.perf_counter()
                 try:
                     took = step.advance_slice(8)
                     rec["advanced"] += took
+                    if took:
+                        self._hist.record(self._jobs[jid]["tenant"],
+                                          time.perf_counter() - t0)
                     worked = worked or took > 0
                 except Exception as e:  # noqa: BLE001
                     with self._wake:
@@ -548,6 +805,7 @@ class ServeDaemon:
                             job = self._jobs[jid]
                             job["state"] = "failed"
                             job["error"] = f"{type(e).__name__}: {e}"
+                            job["done_ts"] = round(time.time(), 3)
                             self._persist(job)
                     worked = True
             # Retire finished runners: pop under the lock, finalize
@@ -556,7 +814,7 @@ class ServeDaemon:
             with self._wake:
                 for jid, rec in list(self._resident.items()):
                     finished = (not rec["lane"].runnable
-                                if rec["kind"] == "wc"
+                                if rec["kind"] in ("wc", "grep")
                                 else rec["step"].phase != "running")
                     if finished:
                         del self._resident[jid]
@@ -565,7 +823,7 @@ class ServeDaemon:
                 self._finish_job(jid, rec)
                 worked = True
             with self._wake:
-                if not worked and not self._queue:
+                if not worked and not len(self._queue):
                     self._wake.wait(timeout=0.2)
         # Graceful stop: park every resident job so a restart resumes
         # from fresh chains instead of replaying from the last cadence.
@@ -573,7 +831,7 @@ class ServeDaemon:
             for jid, rec in list(self._resident.items()):
                 job = self._jobs[jid]
                 try:
-                    if rec["kind"] == "wc":
+                    if rec["kind"] in ("wc", "grep"):
                         rec["lane"].suspend()
                     else:
                         rec["step"].suspend()
@@ -581,6 +839,7 @@ class ServeDaemon:
                 except Exception as e:  # noqa: BLE001
                     job["state"] = "failed"
                     job["error"] = f"stop: {type(e).__name__}: {e}"
+                    job["done_ts"] = round(time.time(), 3)
                 self._persist(job)
             self._resident.clear()
 

@@ -42,13 +42,35 @@ through the engines' own :class:`~dsi_tpu.ckpt.CheckpointWriter` as a
 delta CHAIN (``HostDeltaLog`` of demuxed step payloads, periodic full
 re-base) — which is what makes tenant eviction cheap and a daemon
 ``kill -9`` resumable with byte-identical output.
+
+Grep packing (ISSUE 19) is the EASY demux case: the grep step program
+(``parallel/grepstream._grep_step_device``) runs per device row under
+``shard_map`` with no collectives, and each row carries its OWN pattern
+operand — so K tenants' rows never mix and each output row (histogram
+extension, top-k candidates, scalars) already belongs to exactly one
+lane.  :class:`PackedGrepScheduler` therefore groups runnable
+:class:`GrepLane` s by ``(pattern length, l_cap rung)`` — rows sharing a
+compiled shape — and fills one ``[n_dev, chunk_bytes]`` dispatch
+round-robin across the group's tenants.  The rung is per-TENANT sticky
+AOT affinity: a lane whose row overflows rung 0's line capacity is
+replayed at the hard-bound rung (``ops/grepk.line_cap_rungs``) and
+STAYS there (persisted in its checkpoint meta), migrating between pack
+groups instead of widening everyone — one tenant's pathological input
+never cold-compiles, or re-runs, the rest of the pack.  Exactness is
+per-ROW: a step confirms each lane's clean prefix of rows (cursor order
+is byte-range order) and requeues the overflowed row and everything
+after it for the lane's next (wider) dispatch; per-lane line-number
+bases are assigned host-side at row-take time, so requeued rows keep
+exact global line numbers and per-tenant output stays byte-identical to
+the tenant running alone — the same parity bar the wc lanes carry.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -424,6 +446,400 @@ class PackedWcScheduler:
         self.stats["packed_steps"] += 1
         self.stats["packed_rows"] += len(picks)
         n_tenants = len({ln.tenant for ln in picks})
+        if n_tenants > self.stats["max_tenants_per_step"]:
+            self.stats["max_tenants_per_step"] = n_tenants
+        return confirmed
+
+
+# ── grep lanes (module docstring: the easy demux case) ─────────────────
+
+
+class _GrepRow(NamedTuple):
+    """One taken-but-unconfirmed lane row: the bytes, their valid
+    length, the host line count, the stream offset just past the row,
+    and the GLOBAL number of its first line.  Assigned once at take
+    time, carried verbatim through requeues — which is why a replayed
+    row's line numbers (the top-k key) cannot drift."""
+
+    row: np.ndarray
+    dlen: int
+    n_lines: int
+    end_off: int
+    base: int
+
+
+class GrepLane:
+    """One tenant grep job's lane in :class:`PackedGrepScheduler`: a
+    newline-aligned row stream cut from its input files, host-side
+    whole-stream accumulators (totals, histogram, exact top-k), a
+    sticky ``l_cap`` rung, and a per-tenant checkpoint chain.
+
+    The accumulators fold per-ROW kernel outputs, so they are
+    snapshot-small (``bins`` ints + ``topk`` pairs): checkpoints are
+    full images, no delta log needed.  A non-literal pattern flips the
+    lane to the host path at construction — the daemon finalizes it on
+    :func:`~dsi_tpu.parallel.grepstream.grep_host_oracle` without the
+    lane ever joining a pack.
+    """
+
+    def __init__(self, job: Dict, chunk_bytes: int, ckpt_dir: str,
+                 checkpoint_every: Optional[int] = None,
+                 resume: bool = True, bins: Optional[int] = None,
+                 topk: Optional[int] = None):
+        from dsi_tpu.ops.grepk import is_literal_pattern, line_cap_rungs
+        from dsi_tpu.parallel.grepstream import (DEFAULT_TOPK, GREP_BINS,
+                                                 batch_lines)
+        from dsi_tpu.parallel.streaming import stream_files
+
+        self.job = job
+        self.tenant = job["tenant"]
+        self.pattern = str(job["pattern"])
+        self.pat = self.pattern.encode("ascii", errors="replace")
+        self.m = len(self.pat)
+        self.chunk_bytes = int(chunk_bytes)
+        self.bins = int(bins if bins is not None else GREP_BINS)
+        self.topk = int(topk if topk is not None else DEFAULT_TOPK)
+        self.rungs = line_cap_rungs(self.chunk_bytes)
+        self.rung = 0                 # sticky per-tenant AOT affinity
+        self.lines = 0
+        self.matched = 0
+        self.occurrences = 0
+        self.hist = [0] * self.bins
+        self.cands: List[Tuple[int, int]] = []
+        self.offsets: List[int] = []
+        self.rows_taken = 0           # index into self.offsets
+        self.confirmed_rows = 0
+        self.steps = 0
+        self.steps_since_resume = 0
+        self.hostpath = not (self.m and is_literal_pattern(self.pattern)
+                             and self.m <= self.chunk_bytes)
+        self.input_done = False
+        self.resume_gap_s = 0.0
+        self.stats: Dict = {}
+        self._held: Deque[_GrepRow] = deque()
+        self._next_base = 0
+        ident = {"tenant": self.tenant, "pattern": self.pattern,
+                 "files": [[os.path.basename(f), os.path.getsize(f)]
+                           for f in job["files"]],
+                 "chunk_bytes": self.chunk_bytes,
+                 "bins": self.bins, "topk": self.topk}
+        self.store = CheckpointStore(ckpt_dir, "serve-grep", ident)
+        self.writer = CheckpointWriter(self.store, self.stats,
+                                       async_=False, delta=False)
+        self.policy = CheckpointPolicy(checkpoint_every)
+        start = 0
+        if resume and not self.hostpath:
+            t0 = time.perf_counter()
+            loaded = self.store.load_latest_chain()
+            if loaded is not None:
+                meta, arrays, _deltas = loaded   # full images: no deltas
+                start = int(meta["cursor"])
+                self.lines = int(meta["lines"])
+                self.matched = int(meta["matched"])
+                self.occurrences = int(meta["occurrences"])
+                self.rung = min(int(meta["rung"]), len(self.rungs) - 1)
+                self.confirmed_rows = int(meta["rows"])
+                self.hist = [int(v) for v in arrays["g_hist"]]
+                self.cands = [(int(r[0]), int(r[1]))
+                              for r in arrays["g_cand"]]
+                self._next_base = self.lines
+                self.resume_gap_s = round(time.perf_counter() - t0, 4)
+        elif not resume:
+            self.store.reset()
+        self.start_offset = start
+        self.cursor = start
+        blocks = stream_files(job["files"])
+        feed = skip_stream(blocks, start) if start else blocks
+        self._rows = batch_lines(feed, 1, self.chunk_bytes,
+                                 offsets=self.offsets)
+
+    # ── the packer-facing surface ──
+
+    @property
+    def runnable(self) -> bool:
+        if self.hostpath:
+            return False
+        return bool(self._held) or not self.input_done
+
+    @property
+    def l_cap(self) -> int:
+        return self.rungs[self.rung]
+
+    def take_row(self) -> Optional[_GrepRow]:
+        """The next unconfirmed row — a requeued one first, else one
+        pulled (and base-numbered) from the stream.  None at end of
+        input or on a host-path flip (a line wider than one row)."""
+        from dsi_tpu.parallel.grepstream import _LineTooLong
+
+        if self._held:
+            return self._held.popleft()
+        try:
+            batch, lens, row_lines = next(self._rows)
+        except StopIteration:
+            self.input_done = True
+            return None
+        except _LineTooLong:
+            self.to_hostpath()
+            return None
+        end = self.start_offset + self.offsets[self.rows_taken]
+        self.rows_taken += 1
+        info = _GrepRow(batch[0], int(lens[0]), int(row_lines[0]), end,
+                        self._next_base)
+        self._next_base += info.n_lines
+        return info
+
+    def requeue(self, rows: List[_GrepRow]) -> None:
+        """Give back a step's unconfirmed suffix, order preserved —
+        the rows the lane's next (wider) dispatch serves first."""
+        self._held.extendleft(reversed(rows))
+
+    def to_hostpath(self) -> None:
+        self.hostpath = True
+        self._held.clear()
+
+    def widen(self) -> bool:
+        """Sticky-escalate to the next ``l_cap`` rung; False at the
+        hard bound (``chunk_bytes + 1`` lines cannot overflow)."""
+        if self.rung + 1 >= len(self.rungs):
+            return False
+        self.rung += 1
+        return True
+
+    def confirm_row(self, info: _GrepRow, hist_row: np.ndarray,
+                    cand_pairs: List[Tuple[int, int]], matched: int,
+                    occurrences: int) -> None:
+        """Fold one clean (non-overflowed) row's kernel outputs and
+        advance the durable cursor to the row's end offset."""
+        from dsi_tpu.parallel.grepstream import merge_topk
+
+        self.cursor = info.end_off
+        self.confirmed_rows += 1
+        self.lines += info.n_lines
+        self.matched += int(matched)
+        self.occurrences += int(occurrences)
+        for b in range(self.bins):
+            self.hist[b] += int(hist_row[b])
+        if cand_pairs:
+            self.cands = list(merge_topk(self.cands + cand_pairs,
+                                         self.topk))
+
+    def note_step(self) -> None:
+        """One packed step confirmed rows for this lane: count it and
+        maybe checkpoint (the wc lanes' cadence discipline)."""
+        self.steps += 1
+        self.steps_since_resume += 1
+        self.policy.note_step()
+        if self.policy.due():
+            self.save_ckpt()
+            self.policy.reset()
+
+    def save_ckpt(self) -> None:
+        meta = {"cursor": self.cursor, "lines": self.lines,
+                "matched": self.matched,
+                "occurrences": self.occurrences,
+                "rung": self.rung, "rows": self.confirmed_rows}
+        cand = np.array(self.cands or np.zeros((0, 2)), dtype=np.int64)
+        parts = [("g_", {"hist": np.array(self.hist, dtype=np.int64),
+                         "cand": cand.reshape(-1, 2)})]
+        self.writer.commit(parts, meta, kind="full")
+
+    def suspend(self) -> None:
+        """Evict: one forced durable snapshot (held rows are simply
+        re-read from the cursor on resume); dead after."""
+        if not self.hostpath:
+            self.save_ckpt()
+        self.writer.drain()
+        self.writer.shutdown()
+
+    def finalize(self):
+        """Job complete: the exact :class:`GrepStreamResult` (host
+        oracle for a hostpath lane — correctness never depends on the
+        kernel)."""
+        from dsi_tpu.parallel.grepstream import (GrepStreamResult,
+                                                 grep_host_oracle,
+                                                 merge_topk)
+        from dsi_tpu.parallel.streaming import stream_files
+
+        if self.hostpath:
+            res = grep_host_oracle(stream_files(self.job["files"]),
+                                   self.pattern, bins=self.bins,
+                                   topk=self.topk)
+        else:
+            res = GrepStreamResult(self.lines, self.matched,
+                                   self.occurrences, tuple(self.hist),
+                                   merge_topk(self.cands, self.topk))
+        self.writer.drain()
+        self.writer.shutdown()
+        return res
+
+
+class PackedGrepScheduler:
+    """Shared grep-step packer over :class:`GrepLane` rows (module
+    docstring).  One instance per daemon; :meth:`step` is one shared
+    dispatch over ONE ``(pattern length, rung)`` group — groups take
+    turns round-robin, so mixed pattern lengths interleave fairly
+    instead of the shortest length starving the rest."""
+
+    def __init__(self, mesh=None, chunk_bytes: int = 1 << 16,
+                 bins: Optional[int] = None, topk: Optional[int] = None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dsi_tpu.parallel.grepstream import DEFAULT_TOPK, GREP_BINS
+        from dsi_tpu.parallel.shuffle import AXIS, default_mesh
+
+        if mesh is None:
+            mesh = default_mesh()
+        self.mesh = mesh
+        self.n_dev = mesh.devices.size
+        self.chunk_bytes = int(chunk_bytes)
+        self.bins = int(bins if bins is not None else GREP_BINS)
+        self.topk = int(topk if topk is not None else DEFAULT_TOPK)
+        self.stats = metrics_scope("serve_grep")
+        self.stats.update({"packed_steps": 0, "packed_rows": 0,
+                           "replays": 0, "rung_widens": 0,
+                           "host_fallbacks": 0, "upload_s": 0.0,
+                           "kernel_s": 0.0, "pull_s": 0.0,
+                           "merge_s": 0.0, "max_tenants_per_step": 0})
+        self._sh_chunk = NamedSharding(mesh, P(AXIS, None))
+        self._sh_row = NamedSharding(mesh, P(AXIS))
+        self._rr = 0
+        self._jax = jax
+
+    def warm(self, m: int, rung: int = 0) -> None:
+        """Compile (or load) one pack shape ahead of need — the boot
+        warm for the common pattern length; every other ``(m, rung)``
+        pays its cold compile once, persisted."""
+        from dsi_tpu.ops.grepk import line_cap_rungs
+        from dsi_tpu.parallel.grepstream import grep_pack_fn
+
+        grep_pack_fn(self.n_dev, self.chunk_bytes, int(m),
+                     line_cap_rungs(self.chunk_bytes)[rung],
+                     bins=self.bins, k=self.topk, mesh=self.mesh)
+
+    # ── one packed step ──
+
+    def _pick_group(self, lanes: List[GrepLane]) -> List[GrepLane]:
+        """The next ``(m, rung)`` group, round-robin over the sorted
+        group keys — a deterministic turn order under churn."""
+        groups: Dict[tuple, List[GrepLane]] = {}
+        for lane in lanes:
+            if lane.runnable:
+                groups.setdefault((lane.m, lane.rung), []).append(lane)
+        if not groups:
+            return []
+        keys = sorted(groups)
+        key = keys[self._rr % len(keys)]
+        self._rr += 1
+        return groups[key]
+
+    def _dispatch(self, chunk_np, pats_np, lens_np, bases_np, m, l_cap):
+        from dsi_tpu.device.table import _quiet_unusable_donation
+        from dsi_tpu.parallel.grepstream import grep_pack_fn
+        from dsi_tpu.utils.jaxcompat import enable_x64
+
+        with _span("upload", stats=self.stats, key="upload_s"):
+            chunk = self._jax.device_put(chunk_np, self._sh_chunk)
+            pats = self._jax.device_put(pats_np, self._sh_chunk)
+            lens = self._jax.device_put(lens_np, self._sh_row)
+            with enable_x64(True):   # keep the u64 bases u64 through it
+                bases = self._jax.device_put(
+                    bases_np.astype(np.uint64), self._sh_row)
+        fn = grep_pack_fn(self.n_dev, self.chunk_bytes, m, l_cap,
+                          bins=self.bins, k=self.topk, mesh=self.mesh)
+        with _span("kernel", stats=self.stats, key="kernel_s"):
+            with _quiet_unusable_donation():
+                hist_ext, cand, scal = fn(chunk, pats, lens, bases)
+        with _span("pull", stats=self.stats, key="pull_s"):
+            return (np.asarray(hist_ext), np.asarray(cand),
+                    np.asarray(scal))
+
+    def step(self, lanes: List[GrepLane]) -> List[GrepLane]:
+        """Pack up to ``n_dev`` pending rows from ONE shape group
+        (round-robin across its tenants; a lone tenant may fill every
+        row) into one dispatch; demux per row, confirm each lane's
+        clean prefix, requeue + sticky-widen on overflow.  Returns the
+        lanes that confirmed rows."""
+        group = self._pick_group(lanes)
+        if not group:
+            return []
+        m, rung = group[0].m, group[0].rung
+        l_cap = group[0].l_cap
+        picks: List[Tuple[GrepLane, _GrepRow]] = []
+        while len(picks) < self.n_dev:
+            progressed = False
+            for lane in group:
+                if len(picks) >= self.n_dev:
+                    break
+                if not lane.runnable:
+                    continue
+                info = lane.take_row()
+                if info is None:
+                    if lane.hostpath:
+                        self.stats["host_fallbacks"] += 1
+                    continue
+                picks.append((lane, info))
+                progressed = True
+            if not progressed:
+                break
+        if not picks:
+            return []
+        chunk_np = np.zeros((self.n_dev, self.chunk_bytes), np.uint8)
+        pats_np = np.zeros((self.n_dev, m), np.uint8)
+        lens_np = np.zeros(self.n_dev, dtype=np.int32)
+        bases_np = np.zeros(self.n_dev, dtype=np.int64)
+        # Idle rows carry slot-0's pattern over an all-zero chunk: a
+        # printable-ASCII pattern cannot match zero padding, so they
+        # contribute nothing (the kernel's padding argument).
+        pats_np[:] = np.frombuffer(picks[0][0].pat, dtype=np.uint8)
+        for slot, (lane, info) in enumerate(picks):
+            chunk_np[slot, :len(info.row)] = info.row
+            pats_np[slot] = np.frombuffer(lane.pat, dtype=np.uint8)
+            lens_np[slot] = info.dlen
+            bases_np[slot] = info.base
+        hist_np, cand_np, scal_np = self._dispatch(
+            chunk_np, pats_np, lens_np, bases_np, m, l_cap)
+        fault_point("post-dispatch")
+        # Per-lane demux: slots in take order ARE byte-range order, so
+        # each lane confirms its clean prefix and requeues the rest.
+        by_lane: Dict[int, List[tuple]] = {}
+        order: List[GrepLane] = []
+        for slot, (lane, info) in enumerate(picks):
+            if id(lane) not in by_lane:
+                by_lane[id(lane)] = []
+                order.append(lane)
+            by_lane[id(lane)].append((slot, info))
+        confirmed: List[GrepLane] = []
+        with _span("merge", stats=self.stats, key="merge_s"):
+            for lane in order:
+                slots = by_lane[id(lane)]
+                n_ok = 0
+                for slot, _info in slots:
+                    if int(scal_np[slot, 2]):
+                        break
+                    n_ok += 1
+                for slot, info in slots[:n_ok]:
+                    n_cand = int(scal_np[slot, 0])
+                    pairs = [((int(cand_np[slot, i, 0]) << 32)
+                              | int(cand_np[slot, i, 1]),
+                              int(cand_np[slot, i, 3]))
+                             for i in range(n_cand)]
+                    lane.confirm_row(info, hist_np[slot],
+                                     pairs, int(scal_np[slot, 3]),
+                                     int(scal_np[slot, 4]))
+                if n_ok < len(slots):
+                    lane.requeue([info for _s, info in slots[n_ok:]])
+                    self.stats["replays"] += 1
+                    if lane.widen():
+                        self.stats["rung_widens"] += 1
+                if n_ok:
+                    confirmed.append(lane)
+        fault_point("mid-fold")
+        for lane in confirmed:
+            lane.note_step()
+        self.stats["packed_steps"] += 1
+        self.stats["packed_rows"] += len(picks)
+        n_tenants = len({ln.tenant for ln, _i in picks})
         if n_tenants > self.stats["max_tenants_per_step"]:
             self.stats["max_tenants_per_step"] = n_tenants
         return confirmed

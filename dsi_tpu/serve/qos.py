@@ -1,0 +1,137 @@
+"""Admission QoS primitives for the serving daemon: priority lanes,
+token-bucket rate limits, and the typed backpressure they produce.
+
+The daemon's control plane was first-come-first-served with an
+unbounded queue: a chatty tenant could park a thousand jobs ahead of
+everyone and the daemon would accept (and durably journal) submissions
+it had no hope of running soon.  ISSUE 19 replaces that with an
+explicit policy, kept here free of daemon state so every rule is a
+deterministic unit test with an injected clock:
+
+* :class:`PriorityQueue` — three strict FIFO lanes (0 = highest).  A
+  higher-priority job always admits before a lower one; within a lane,
+  submission order.  Strictness is deliberate: the anti-starvation
+  valve is the daemon's step-quota eviction (a resident job parks after
+  its quota and re-queues at the tail), not a probabilistic pick.
+* :class:`TokenBucket` — per-tenant submit rate limiting.  ``take()``
+  returns 0.0 on admit or the seconds until a token accrues — the
+  retry-after hint the typed backpressure error carries to the client.
+
+Both answers happen BEFORE the journal write: a shed submission leaves
+no spool state, so load shedding never fabricates a "lost accepted
+job" (the soak's zero-lost invariant counts accepted acks only).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+#: The priority lanes, highest first.  Three is enough to express
+#: "interactive / default / batch" and keeps the /metrics queue-depth
+#: series bounded.
+PRIORITIES = (0, 1, 2)
+DEFAULT_PRIORITY = 1
+
+
+class PriorityQueue:
+    """Strict-priority FIFO lanes over job ids (module docstring).
+
+    Not thread-safe by itself — the daemon serializes access under its
+    own lock, exactly as it did with the plain deque this replaces.
+    """
+
+    def __init__(self):
+        self._lanes: Dict[int, deque] = {p: deque() for p in PRIORITIES}
+
+    def __len__(self) -> int:
+        return sum(len(d) for d in self._lanes.values())
+
+    def __contains__(self, jid: str) -> bool:
+        return any(jid in d for d in self._lanes.values())
+
+    def __iter__(self):
+        """Ids in pop order (priority, then FIFO) — the status surface
+        and the daemon's parked-job scan."""
+        for p in PRIORITIES:
+            yield from self._lanes[p]
+
+    def push(self, jid: str, priority: int = DEFAULT_PRIORITY) -> None:
+        self._lanes[self._clamp(priority)].append(jid)
+
+    def push_front(self, jid: str,
+                   priority: int = DEFAULT_PRIORITY) -> None:
+        """Head of the job's own lane — the "resume on the tenant's
+        next submission" re-prioritization, which must not let a parked
+        batch job cut ahead of the interactive lane."""
+        self._lanes[self._clamp(priority)].appendleft(jid)
+
+    def pop(self) -> Optional[str]:
+        for p in PRIORITIES:
+            if self._lanes[p]:
+                return self._lanes[p].popleft()
+        return None
+
+    def remove(self, jid: str) -> bool:
+        for d in self._lanes.values():
+            try:
+                d.remove(jid)
+                return True
+            except ValueError:
+                continue
+        return False
+
+    def depths(self) -> Tuple[int, ...]:
+        """Per-priority queue depths, lane order — the
+        ``dsi_serve_queue_depth{priority=...}`` gauge's read side."""
+        return tuple(len(self._lanes[p]) for p in PRIORITIES)
+
+    @staticmethod
+    def _clamp(priority) -> int:
+        try:
+            p = int(priority)
+        except (TypeError, ValueError):
+            return DEFAULT_PRIORITY
+        return min(max(p, PRIORITIES[0]), PRIORITIES[-1])
+
+
+class TokenBucket:
+    """One tenant's submit-rate bucket: ``rate`` tokens/second, burst
+    capacity ``burst``, lazily refilled from the injected monotonic
+    ``clock`` (tests pin it; production uses ``time.monotonic``)."""
+
+    def __init__(self, rate: float, burst: int = 4,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(1, int(burst))
+        self._clock = clock
+        self._tokens = float(self.burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def take(self) -> float:
+        """0.0 and a consumed token on admit; else the seconds until
+        one token accrues (the retry-after hint), nothing consumed."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last)
+                               * self.rate)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            if self.rate <= 0.0:
+                return 60.0  # rate 0: effectively shut; a long hint
+            return round((1.0 - self._tokens) / self.rate, 4)
+
+
+def backpressure_reply(msg: str, retry_after_s: float) -> dict:
+    """The one spelling of the typed backpressure RPC error — the
+    client (``serve/client.py ServeBusy``) keys on ``error_type`` and
+    honors the hint, so both sides must agree here."""
+    return {"error": msg, "error_type": "backpressure",
+            "retryable": True,
+            "retry_after_s": round(max(0.0, retry_after_s), 4)}

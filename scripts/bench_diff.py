@@ -67,6 +67,12 @@ GATES: List[Tuple[str, str, float]] = [
     # and *_parity patterns above already gate its throughput and
     # per-tenant parity keys; the warm cost gates lower-better here.
     ("serve_amortized_warm_s", "lower", 1.00),
+    # Serving QoS (ISSUE 19): the packed-grep arm's tail latency is
+    # THE tentpole number — it gates lower-better so a packing or
+    # admission regression that doubles p99 fails the diff (the
+    # *_parity pattern above already gates serve_lat_parity; the tmux
+    # control arm and p50s ride ungated as context).
+    ("serve_pack_p99_s", "lower", 1.00),
     # Compressed wire + parallel ingest (ISSUE 13): codec ratios and
     # the readahead hit rate regress when they DROP (a codec change
     # that stops shrinking the shuffle payload, a pool change that

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Serving-QoS soak (ISSUE 19): N tenants of mixed wc/grep jobs with a
+priority mix hammer one in-process daemon through sustained
+submit/shed/evict/resume churn, and every accepted job must finish
+with byte parity against the host oracle.
+
+The daemon is deliberately under-provisioned — a small admission queue
+(shedding MUST engage), a small resident set and step quota (eviction
+churn), tiny chunks (many steps per tenant) — because the soak's
+contract is QoS under pressure, not throughput:
+
+* zero lost jobs: every ACCEPTED submission reaches ``done`` (shed
+  submissions retry through the typed-backpressure client loop until
+  accepted);
+* shedding engaged: the daemon's shed counter ends >= 1;
+* per-tenant byte parity: wc outputs compare equal to the sequential
+  oracle, grep outputs byte-compare equal to the ``grep_host_oracle``
+  payload — including hostpath (non-literal pattern) tenants;
+* bounded telemetry: the ``dsi_serve_*`` metrics text stays capped by
+  ``metrics_tenants``, independent of N.
+
+Usage: python scripts/serve_soak.py [--tenants 64] [--timeout S]
+Prints one JSON summary line; rc 0 only when every assertion holds.
+CI runs ``--tenants 64`` as a smoke; the ``slow``-marked pytest soak
+runs ``run_soak(1000)`` in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mk_corpus(path: str, tag: str, i: int, grep_pat: str = None) -> None:
+    """~4 KB of small lines; grep tenants get their pattern planted on
+    a deterministic subset of lines with varying occurrence counts."""
+    lines = []
+    j, size = 0, 0
+    while size < 4096:
+        if grep_pat is not None and j % 3 != 2:
+            line = (grep_pat + " ") * (j % 4) + f"x{(i * 31 + j) % 211}\n"
+        else:
+            line = f"{tag}w{(i * 31 + j) % 223:03d} t{j % 17}\n"
+        lines.append(line)
+        size += len(line)
+        j += 1
+    with open(path, "w") as f:
+        f.writelines(lines)
+
+
+def _wc_oracle(files) -> list:
+    from dsi_tpu.apps import wc
+    from dsi_tpu.mr.sequential import run_sequential
+
+    out = files[0] + ".oracle"
+    run_sequential(wc.Map, wc.Reduce, files, out)
+    with open(out, encoding="utf-8") as f:
+        return sorted(l for l in f if l.strip())
+
+
+def _wc_got(out_dir: str, n_reduce: int = 10) -> list:
+    got = []
+    for r in range(n_reduce):
+        with open(os.path.join(out_dir, f"mr-out-{r}"),
+                  encoding="utf-8") as f:
+            got.extend(l for l in f if l.strip())
+    return sorted(got)
+
+
+def _grep_oracle_bytes(path: str, pattern: str) -> bytes:
+    """The daemon's ``grep.json`` ground truth: ``grep_host_oracle``
+    serialized exactly as ``ServeDaemon._write_grep_result`` spells
+    it."""
+    from dsi_tpu.parallel.grepstream import grep_host_oracle
+
+    with open(path, "rb") as f:
+        r = grep_host_oracle([f.read()], pattern)
+    return json.dumps(
+        {"lines": r.lines, "matched": r.matched,
+         "occurrences": r.occurrences, "hist": list(r.hist),
+         "topk": [list(t) for t in r.topk]},
+        sort_keys=True).encode("utf-8")
+
+
+def run_soak(tenants: int, *, timeout_s: float = None,
+             workdir: str = None, submit_threads: int = 16) -> dict:
+    """The soak body (importable: the slow pytest soak calls it with
+    1000).  Returns the JSON summary; raises AssertionError on any
+    contract violation."""
+    from dsi_tpu.serve import client
+    from dsi_tpu.serve.daemon import ServeDaemon
+
+    if timeout_s is None:
+        timeout_s = max(240.0, 1.2 * tenants)
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dsi-soak-")
+    spool = os.path.join(workdir, "spool")
+    sock = os.path.join(tempfile.mkdtemp(prefix="dsi-soak-sv-"), "s.sock")
+
+    # The tenant mix: 1/2 wc, ~1/2 literal grep (two pattern lengths so
+    # the packer runs >1 shape group), every 16th grep NON-literal (the
+    # hostpath arm must survive the same churn).
+    plan = []  # (tenant, app, pattern, path)
+    for i in range(tenants):
+        t = f"s{i}"
+        path = os.path.join(workdir, f"{t}.txt")
+        if i % 2 == 0:
+            _mk_corpus(path, t, i)
+            plan.append((t, "wc", None, path))
+        else:
+            if i % 16 == 15:
+                pat = "q.*z"          # regex meta: forced host path
+                _mk_corpus(path, t, i, grep_pat="qaz")
+            else:
+                pat = (f"q{i:03d}" if i % 4 == 1 else f"pp{i:04d}")
+                _mk_corpus(path, t, i, grep_pat=pat)
+            plan.append((t, "grep", pat, path))
+
+    d = ServeDaemon(
+        spool, socket_path=sock, warm=False,
+        chunk_bytes=1 << 10,            # many steps per tenant
+        max_resident=8, quota_steps=2,  # evict/resume churn
+        checkpoint_every=2,
+        max_queue=max(4, tenants // 16))  # shedding MUST engage
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    accepted = {}  # tenant -> submit reply
+    errors = []
+    lock = threading.Lock()
+
+    def submitter(k: int) -> None:
+        for idx in range(k, len(plan), submit_threads):
+            t, app, pat, path = plan[idx]
+            while True:
+                try:
+                    rep = client.submit(sock, t, [path], app=app,
+                                        pattern=pat, priority=idx % 3,
+                                        retries=4, max_backoff_s=0.5)
+                    with lock:
+                        accepted[t] = rep
+                    break
+                except client.ServeBusy:
+                    if time.monotonic() > deadline:
+                        with lock:
+                            errors.append(f"{t}: shed past deadline")
+                        return
+                except Exception as e:  # noqa: BLE001 — soak reports
+                    with lock:
+                        errors.append(f"{t}: {type(e).__name__}: {e}")
+                    return
+
+    try:
+        d.start()
+        client.wait_ready(sock, timeout=min(timeout_s, 180.0))
+        threads = [threading.Thread(target=submitter, args=(k,),
+                                    daemon=True)
+                   for k in range(submit_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=timeout_s)
+        assert not errors, errors[:5]
+        assert len(accepted) == tenants, \
+            f"only {len(accepted)}/{tenants} accepted"
+
+        # One full-list RPC per poll (a per-job poll is N RPCs a tick).
+        jids = {rep["job_id"] for rep in accepted.values()}
+        while True:
+            jobs = client.status(sock)["jobs"]
+            states = {j["job_id"]: j["state"] for j in jobs
+                      if j["job_id"] in jids}
+            if all(s in ("done", "failed") for s in states.values()):
+                break
+            assert time.monotonic() < deadline, \
+                f"not drained in {timeout_s}s: " \
+                f"{sum(1 for s in states.values() if s not in ('done', 'failed'))} left"
+            time.sleep(0.5)
+        failed = [j for j, s in states.items() if s != "done"]
+        assert not failed, f"lost/failed jobs: {failed[:5]}"
+
+        # Per-tenant byte parity, every app, every arm.
+        for t, app, pat, path in plan:
+            rep = accepted[t]
+            if app == "wc":
+                assert _wc_got(rep["out_dir"]) == _wc_oracle([path]), \
+                    f"{t}: wc parity"
+            else:
+                with open(os.path.join(rep["out_dir"], "grep.json"),
+                          "rb") as f:
+                    assert f.read() == _grep_oracle_bytes(path, pat), \
+                        f"{t}: grep parity"
+
+        ping = client.ping(sock)
+        tstats = client.status(sock)["tenants"]
+        metrics = d._metrics_section()
+        mlines = len(metrics.splitlines())
+        # Bounded telemetry: the per-tenant series are capped at
+        # metrics_tenants regardless of N (7 per-tenant series + the
+        # global block).
+        bound = 7 * d.metrics_tenants + 60
+        assert mlines <= bound, f"metrics unbounded: {mlines} > {bound}"
+        assert ping["shed"] >= 1, "shedding never engaged"
+        summary = {
+            "tenants": tenants,
+            "wall_s": round(time.monotonic() - t0, 2),
+            "shed": ping["shed"],
+            "rate_limited": ping["rate_limited"],
+            "evictions": sum(s["evictions"] for s in tstats.values()),
+            "resumes": sum(s["resumes"] for s in tstats.values()),
+            "hostpath": sum(s["hostpath"] for s in tstats.values()),
+            "packed_steps": d.packer.stats["packed_steps"],
+            "grep_packed_steps":
+                d.grep_packer.stats["packed_steps"] if d.grep_packer
+                else 0,
+            "metrics_lines": mlines,
+            "parity": True,
+        }
+        assert summary["evictions"] >= 1 and summary["resumes"] >= 1, \
+            summary
+        assert summary["grep_packed_steps"] >= 1, summary
+        return summary
+    finally:
+        d.close()
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--tenants", type=int, default=64)
+    ap.add_argument("--timeout", type=float, default=None)
+    args = ap.parse_args(argv)
+    # The virtual mesh, unless the caller pinned a real one.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = \
+            (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    summary = run_soak(args.tenants, timeout_s=args.timeout)
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

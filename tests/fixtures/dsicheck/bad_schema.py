@@ -25,3 +25,14 @@ def plan_run():
         pass
     sc["plan_commit_bytez"] = 1            # EXPECT: metric-schema
     return sc
+
+
+def serve_metrics():
+    # ISSUE-19 half of the rule: dsi_serve_* literals are the daemon's
+    # /metrics surface and must come from registry.SERVE_SERIES.
+    L = ["dsi_serve_jobs_total 3"]          # clean: registered series
+    L.append("dsi_serve_junk_total 1")      # EXPECT: metric-schema
+    lab = 'tenant="a"'
+    L.append(f"dsi_serve_tenant_steps{{{lab}}} 2")  # clean: registered
+    L.append(f"dsi_serve_bogus_{lab} 1")    # EXPECT: metric-schema
+    return L

@@ -79,15 +79,22 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
                                  # measured path
                                  "DSI_BENCH_SERVE_JOBS": "2",
                                  "DSI_BENCH_SERVE_MB": "0.2",
+                                 # serve latency row at contract-test
+                                 # scale: 4 grep tenants x 4 KB keeps
+                                 # the two extra daemon boots short
+                                 # while exercising both arms
+                                 "DSI_BENCH_SERVE_LAT_TENANTS": "4",
+                                 "DSI_BENCH_SERVE_LAT_KB": "4",
                                  # plan row at contract-test scale:
                                  # 2 planrun subprocesses (chained +
                                  # staged) over a 1 MB corpus
                                  "DSI_BENCH_PLAN_MB": "1",
                                  # net row at contract-test scale: two
                                  # mrrun fleets per pass — worker boots,
-                                 # not MBs, dominate (hence run_bench's
-                                 # 420 s headroom over the old 300)
-                                 "DSI_BENCH_NET_MB": "1"})
+                                 # not MBs, dominate (hence the timeout
+                                 # headroom over run_bench's 420)
+                                 "DSI_BENCH_NET_MB": "1"},
+                      timeout=540)
     assert rc == 0
     assert v["metric"] == "wc_cpu_fallback_throughput"
     assert v["platform"] == "cpu"
@@ -182,6 +189,17 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["serve_jobs"] >= 2
         assert v["serve_oneshot_mbps"] > 0
         assert v["serve_amortized_warm_s"] >= 0
+    # The serving-QoS packed-grep latency A/B row (ISSUE 19): measured
+    # XOR skipped; a measured row carries the per-tenant byte-parity
+    # gate, BOTH arms' p50/p99, and the packing evidence.
+    assert ("serve_lat_skipped" in v) != ("serve_pack_p99_s" in v)
+    if "serve_pack_p99_s" in v:
+        assert v["serve_lat_parity"] is True
+        assert v["serve_lat_tenants"] >= 2
+        assert v["serve_pack_p50_s"] >= 0
+        assert v["serve_pack_p99_s"] >= v["serve_pack_p50_s"]
+        assert v["serve_tmux_p99_s"] >= v["serve_tmux_p50_s"] >= 0
+        assert v["serve_lat_packed_steps"] >= 1
     # The plan-layer chained-vs-staged A/B row (ISSUE 14): measured XOR
     # skipped; a measured row carries the byte-parity gate, BOTH
     # throughputs, and the zero-host-bytes invariant of the

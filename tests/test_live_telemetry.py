@@ -475,6 +475,22 @@ def test_bench_diff_missing_keys_are_unknown_not_regress(tmp_path):
     assert re.search(r"grep_mbps.*unknown", p.stdout)
 
 
+def test_bench_diff_gates_serve_latency_row(tmp_path):
+    # The ISSUE 19 tentpole number: the packed-grep arm's p99 gates
+    # lower-better (a doubled tail regresses); the parity bool rides
+    # the *_parity pattern; the tmux control arm stays ungated context.
+    _write_pair(tmp_path,
+                {"serve_pack_p99_s": 0.5, "serve_tmux_p99_s": 7.0,
+                 "serve_lat_parity": True},
+                {"serve_pack_p99_s": 1.6, "serve_tmux_p99_s": 20.0,
+                 "serve_lat_parity": True})
+    p = run_diff("--dir", str(tmp_path))
+    assert p.returncode == 1, p.stdout
+    assert re.search(r"serve_pack_p99_s.*REGRESS", p.stdout)
+    assert not re.search(r"serve_tmux_p99_s.*REGRESS", p.stdout)
+    assert re.search(r"serve_lat_parity.*ok", p.stdout)
+
+
 def test_bench_diff_passes_on_real_r04_r05_pair():
     p = run_diff(os.path.join(REPO, "BENCH_r04.json"),
                  os.path.join(REPO, "BENCH_r05.json"))

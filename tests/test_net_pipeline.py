@@ -261,6 +261,80 @@ def test_slow_peer_overlap_attribution(tmp_path):
             srv.close()
 
 
+# ── adaptive fetch window (ISSUE 19 satellite) ─────────────────────────
+
+
+def test_fetch_window_max_from_env(monkeypatch):
+    from dsi_tpu.net.fetch import fetch_window_max_from_env
+
+    monkeypatch.delenv("DSI_NET_FETCH_WINDOW_MAX", raising=False)
+    assert fetch_window_max_from_env(4) == 4      # unset: widening off
+    monkeypatch.setenv("DSI_NET_FETCH_WINDOW_MAX", "16")
+    assert fetch_window_max_from_env(4) == 16
+    monkeypatch.setenv("DSI_NET_FETCH_WINDOW_MAX", "2")
+    assert fetch_window_max_from_env(4) == 4      # clamped >= window
+    monkeypatch.setenv("DSI_NET_FETCH_WINDOW_MAX", "garbage")
+    assert fetch_window_max_from_env(4) == 4      # malformed: off
+
+
+def test_adaptive_window_widens_on_slow_peers(tmp_path):
+    # slow producers (injected per-chunk serve latency) starve the
+    # consumer → the wait-dominated pipeline widens toward the ceiling,
+    # attributes the final width, and the bytes stay identical to the
+    # window-1 serial loop (the parity-grid transitivity claim extends
+    # to ANY widening schedule, because decode order is submission
+    # order regardless of width)
+    from dsi_tpu.mr.plugin import load_plugin
+
+    _mapf, reducef = load_plugin("wc")
+    map_locs, servers = _spool_partitions(tmp_path, n_maps=8)
+    for srv in servers:
+        srv._chunk_sleep_s = 0.05
+    try:
+        wd1 = str(tmp_path / "serial")
+        os.makedirs(wd1)
+        serial: dict = {}
+        run_reduce_task_net(reducef, 0, map_locs, workdir=wd1,
+                            stats=serial, window=1)
+        assert serial["net_prefetch_window"] == 1
+        wda = str(tmp_path / "adaptive")
+        os.makedirs(wda)
+        adaptive: dict = {}
+        run_reduce_task_net(reducef, 0, map_locs, workdir=wda,
+                            stats=adaptive, window=2, max_window=8)
+        assert adaptive["net_prefetch_window"] > 2    # it widened
+        assert adaptive["net_prefetch_window"] <= 8   # bounded
+        with open(os.path.join(wd1, "mr-out-0"), "rb") as a, \
+                open(os.path.join(wda, "mr-out-0"), "rb") as b:
+            assert a.read() == b.read()
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_adaptive_window_off_at_ceiling_and_serial(tmp_path):
+    # max_window == window → no widening no matter how slow the peers;
+    # window 1 ignores any ceiling (serial stays the literal serial
+    # loop, the parity grid's anchor)
+    map_locs, servers = _spool_partitions(tmp_path, n_maps=4)
+    for srv in servers:
+        srv._chunk_sleep_s = 0.05
+    try:
+        items = [(m, map_locs[str(m)], f"mr-{m}-0") for m in range(4)]
+        pipe = FetchPipeline(items, window=2, max_window=2)
+        list(pipe)
+        assert pipe.window_effective == 2
+        stats: dict = {}
+        pipe1 = FetchPipeline(items, window=1, max_window=8,
+                              stats=stats)
+        list(pipe1)
+        assert pipe1.window_effective == 1
+        assert stats["net_prefetch_window"] == 1
+    finally:
+        for srv in servers:
+            srv.close()
+
+
 # ── journal × net (satellite): replayed location registry ──────────────
 
 
