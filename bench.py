@@ -2330,6 +2330,135 @@ def run_net_pipeline_row() -> dict:
     return row
 
 
+def run_replica_row() -> dict:
+    """The replicated-control-plane A/B (ISSUE 20): the same shard job
+    run in fresh subprocess fleets three ways — a single in-process
+    coordinator (``replica_single_mbps``), a 3-replica Raft group with
+    nothing failing (``replica_group_mbps`` — its wall over the single
+    arm's is ``replica_overhead_pct``, the price of majority-committing
+    every journal record), and the same group with the LEADER kill -9'd
+    mid-job.  The chaos arm reports ``replica_failover_s`` (kill
+    instant → the first coordinator answer served by the NEW leader —
+    THE tentpole number, gates lower-better in bench_diff) and the term
+    handoff.  ``replica_exactly_once`` is the bool gate: zero duplicate
+    commits in every arm's stats AND no shard with two commit records
+    in ANY replica's journal across both group arms.  Every arm is
+    parity-gated against the sequential host oracle by ``shardrun
+    --check`` (exit 2 = mismatch).  Chip-independent (1-device CPU
+    workers), measured keys XOR ``replica_skipped``.
+    ``DSI_BENCH_REPLICA_MB`` (default 4; 0 disables) sizes it."""
+    mb = env_float("DSI_BENCH_REPLICA_MB", 4.0)
+    if mb <= 0:
+        return {"replica_skipped": "disabled (DSI_BENCH_REPLICA_MB=0)"}
+    budget = env_float("DSI_BENCH_REPLICA_TIMEOUT", 300.0)
+    import shutil
+
+    rdir = os.path.join(WORKDIR, "replica-row")
+    shutil.rmtree(rdir, ignore_errors=True)
+    os.makedirs(rdir)
+    corpus_path = os.path.join(rdir, "corpus.txt")
+    with open(corpus_path, "w") as f:
+        i = 0
+        written = 0
+        target = mb * 1e6
+        while written < target:
+            line = (" ".join(
+                "rep" + chr(ord("a") + (i + j) % 19) * 2
+                for j in range(9)) + "\n")
+            f.write(line)
+            written += len(line)
+            i += 1
+    total_mb = os.path.getsize(corpus_path) / 1e6
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1-device CPU workers
+    env["DSI_AOT_FRESH"] = "1"  # the stream rows' CPU flake hygiene
+
+    def one(mode: str) -> dict:
+        wd = os.path.join(rdir, mode)
+        sj = os.path.join(rdir, f"{mode}.stats.json")
+        e = dict(env)
+        cmd = [sys.executable, "-m", "dsi_tpu.cli.shardrun",
+               "--workers", "2", "--shards", "4",
+               "--workdir", wd, "--chunk-bytes", str(1 << 16),
+               "--progress-s", "0.1", "--shard-timeout", "120",
+               "--check", "--stats-json", sj, corpus_path]
+        if mode == "single":
+            e["DSI_MR_SOCKET"] = os.path.join(rdir, "single.sock")
+        else:
+            cmd[-1:-1] = ["--replicas", "3"]
+            if mode == "failover":
+                cmd[-1:-1] = ["--kill-leader-after", "1.0"]
+        r = subprocess.run(cmd, env=e,
+                           cwd=os.path.dirname(os.path.abspath(__file__)),
+                           capture_output=True, text=True,
+                           timeout=budget)
+        if r.returncode == 2:
+            raise RuntimeError(f"{mode} arm parity mismatch")
+        if r.returncode != 0:
+            raise RuntimeError(f"{mode} shardrun rc={r.returncode}: "
+                               f"{r.stderr[-300:]}")
+        with open(sj, encoding="utf-8") as f:
+            return json.load(f)
+
+    def journal_dups(mode: str) -> int:
+        """Shard records appearing MORE than once in any one replica
+        journal — the cross-term first-commit-wins audit."""
+        import glob
+
+        dups = 0
+        for path in sorted(glob.glob(
+                os.path.join(rdir, mode, "replica-*.journal"))):
+            per: dict = {}
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("kind") == "shard":
+                        per[rec["task"]] = per.get(rec["task"], 0) + 1
+            dups += sum(n - 1 for n in per.values() if n > 1)
+        return dups
+
+    try:
+        single = one("single")
+        group = one("group")
+        failover = one("failover")
+    except Exception as e:
+        return {"replica_skipped": f"replica row failed: "
+                                   f"{type(e).__name__}: {e}"}
+    dup = (int(single.get("duplicate_commits", 0))
+           + int(group.get("duplicate_commits", 0))
+           + int(failover.get("duplicate_commits", 0))
+           + journal_dups("group") + journal_dups("failover"))
+    single_s = float(single.get("wall_s", 0.0)) or 1e-9
+    group_s = float(group.get("wall_s", 0.0)) or 1e-9
+    failover_s_wall = float(failover.get("wall_s", 0.0)) or 1e-9
+    row = {"replica_mb": round(total_mb, 2), "replica_parity": True,
+           "replica_single_mbps": round(total_mb / single_s, 2),
+           "replica_group_mbps": round(total_mb / group_s, 2),
+           "replica_chaos_mbps": round(total_mb / failover_s_wall, 2),
+           "replica_overhead_pct": round(
+               (group_s - single_s) / single_s * 100.0, 1),
+           "replica_failover_s": float(
+               failover.get("replica_failover_s", 0.0)),
+           "replica_terms": [int(failover.get("replica_old_term", 0)),
+                             int(failover.get("replica_new_term", 0))],
+           "replica_duplicate_commits": dup,
+           # Bool twin for the bench_diff gate (the spec_exactly_once
+           # precedent): a healthy old value of 0 reads "unknown" under
+           # the numeric lower-better rule, so the bool carries the
+           # first-commit-wins-across-terms regression gate.
+           "replica_exactly_once": dup == 0}
+    log(f"replica row: {total_mb:.1f} MB — single {row['replica_single_mbps']} "
+        f"MB/s ({single_s:.2f}s) vs 3-replica group "
+        f"{row['replica_group_mbps']} MB/s ({group_s:.2f}s, "
+        f"+{row['replica_overhead_pct']}%); leader kill -9 arm "
+        f"{row['replica_chaos_mbps']} MB/s ({failover_s_wall:.2f}s), "
+        f"failover {row['replica_failover_s']}s (term "
+        f"{row['replica_terms'][0]} -> {row['replica_terms'][1]}), "
+        f"duplicate commits {dup}")
+    return row
+
+
 def run_native_oracle_row(files, oracle_out, total_mb, native_ok,
                           fw_oracle_mbps) -> dict:
     """Sequential run of the SAME C++ task bodies the native-backend
@@ -2746,6 +2875,17 @@ def main() -> None:
                                           f"{type(e).__name__}: {e}")
     else:
         fw["net_pipeline_skipped"] = f"budget {budget_s:.0f}s < 30s"
+    # The replicated-control-plane A/B row (ISSUE 20): chip-independent
+    # (shardrun subprocess fleets on 1-device CPU, replicad coordinator
+    # groups), rides every branch.
+    if budget_s >= 60 or "DSI_BENCH_REPLICA_MB" in os.environ:
+        try:
+            fw.update(run_replica_row())
+        except Exception as e:
+            fw["replica_skipped"] = (f"replica row failed: "
+                                     f"{type(e).__name__}: {e}")
+    else:
+        fw["replica_skipped"] = f"budget {budget_s:.0f}s < 60s"
     if "error" in res:
         out = {"metric": "wc_tpu_throughput", "value": 0,
                "unit": "MB/s", "vs_baseline": 0,

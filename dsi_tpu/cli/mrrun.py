@@ -49,6 +49,14 @@ def main(argv=None) -> int:
                         "coordinator resumes from any EXISTING --journal "
                         "either way — this flag adds the assertion, and "
                         "mrrun warns when resuming implicitly without it")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="replicated control plane (dsi_tpu/replica): "
+                        "run the coordinator as an N-member Raft group; "
+                        "workers follow NotLeader redirects, so a dead "
+                        "leader is an election, not a dead job")
+    p.add_argument("--kill-leader-after", type=float, default=0.0,
+                   help="chaos (needs --replicas): SIGKILL the leader "
+                        "this many seconds in; measure failover")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="whole-job wall budget, seconds")
     p.add_argument("--net", action="store_true",
@@ -123,6 +131,23 @@ def main(argv=None) -> int:
                 os.remove(os.path.join(workdir, name))
             except OSError:
                 pass
+
+    if args.replicas:
+        if args.net:
+            p.error("--net does not support --replicas yet")
+        if args.replicas < 2:
+            p.error("--replicas wants >= 2 (3 tolerates one kill)")
+        rc = _replica_job(args, workdir, files, app, env)
+        if args.trace_dir:
+            from dsi_tpu.obs import flush_tracing, trace_event
+
+            trace_event("mrrun.exit", rc=rc, replicas=args.replicas)
+            flush_tracing()
+        if rc != 0:
+            return rc
+        return _parity_check(args, workdir, files) if args.check else 0
+    if args.kill_leader_after:
+        p.error("--kill-leader-after needs --replicas")
 
     if args.net:
         rc = _net_job(args, workdir, files, app, env, journal)
@@ -281,6 +306,119 @@ def _parity_check(args, workdir: str, files: list) -> int:
         return 2
     print("mrrun: parity OK", file=sys.stderr)
     return 0
+
+
+def _replica_job(args, workdir: str, files: list, app: str,
+                 env: dict) -> int:
+    """Classic map/reduce under the replicated control plane: the
+    coordinator is an N-member ``replicad`` group, workers dial the
+    whole group (``DSI_MR_SOCKET`` comma list) and follow redirects,
+    and an optional mid-job ``kill -9`` of the leader exercises the
+    failover the single-coordinator plane cannot survive."""
+    import json as _json
+
+    from dsi_tpu.mr import rpc as _rpc
+    from dsi_tpu.replica.driver import ReplicaGroup
+
+    env = dict(env)
+    # replicad + workers must import the package from any cwd.
+    import dsi_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    # Fresh-run hygiene the leader coordinator skips in replica mode
+    # (its resuming check sees the always-present replica journal).
+    if not os.path.exists(os.path.join(workdir, "replica-0.journal")):
+        for name in os.listdir(workdir):
+            if name.startswith("mr-out-"):
+                try:
+                    os.remove(os.path.join(workdir, name))
+                except OSError:
+                    pass
+    group = ReplicaGroup(
+        "classic", workdir, replicas=args.replicas, files=files,
+        n_reduce=args.nreduce,
+        config={"n_reduce": args.nreduce,
+                "task_timeout_s": args.task_timeout},
+        env=env)
+    env["DSI_MR_SOCKET"] = group.spec
+    worker_cmd = [sys.executable, "-m", "dsi_tpu.cli.mrworker",
+                  "--backend", args.backend, app]
+    t0 = time.monotonic()
+    deadline = t0 + args.timeout
+    workers = [subprocess.Popen(worker_cmd, env=env, cwd=workdir)
+               for _ in range(args.workers)]
+    respawn_budget = max(16, 2 * (len(files) + args.nreduce))
+    failover = None
+    rc = 0
+    try:
+        while True:
+            if time.monotonic() > deadline:
+                print("mrrun: job exceeded --timeout; killing",
+                      file=sys.stderr)
+                rc = 1
+                break
+            if args.kill_leader_after > 0 and failover is None \
+                    and time.monotonic() - t0 >= args.kill_leader_after:
+                print("mrrun: chaos: kill -9 the leader replica",
+                      file=sys.stderr)
+                try:
+                    failover = group.kill_leader()
+                except _rpc.CoordinatorGone as e:
+                    print(f"mrrun: failover FAILED: {e}",
+                          file=sys.stderr)
+                    rc = 1
+                    break
+                print(f"mrrun: failover in {failover['failover_s']}s "
+                      f"(term {failover['old_term']} -> "
+                      f"{failover['new_term']})", file=sys.stderr)
+            if group.done():
+                break
+            for i, w in enumerate(workers):
+                if w.poll() is not None and w.returncode != 0:
+                    if respawn_budget <= 0:
+                        print("mrrun: workers failing repeatedly; "
+                              "giving up", file=sys.stderr)
+                        rc = 1
+                        break
+                    respawn_budget -= 1
+                    workers[i] = subprocess.Popen(worker_cmd, env=env,
+                                                  cwd=workdir)
+            if rc:
+                break
+            time.sleep(0.2)
+    finally:
+        run_stats = {"wall_s": round(time.monotonic() - t0, 3),
+                     "replicas": args.replicas,
+                     "replica_kills": group.kills}
+        try:
+            run_stats.update(group.spec_stats())
+        except _rpc.CoordinatorGone:
+            pass
+        if failover is not None:
+            run_stats["replica_failover_s"] = failover["failover_s"]
+            run_stats["replica_old_term"] = failover["old_term"]
+            run_stats["replica_new_term"] = failover["new_term"]
+        group.close()
+        for w in workers:
+            if w.poll() is None:
+                w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                w.kill()
+    if args.stats_json:
+        # dsicheck: allow[raw-write] bench/CI parse surface, not
+        # durable state
+        with open(args.stats_json, "w", encoding="utf-8") as f:
+            _json.dump(run_stats, f, sort_keys=True, indent=1)
+    print(f"mrrun: replicated run done rc={rc} "
+          f"(c_map={run_stats.get('c_map')}, "
+          f"c_reduce={run_stats.get('c_reduce')}, "
+          f"wall {run_stats['wall_s']}s)", file=sys.stderr)
+    return rc
 
 
 def _net_job(args, workdir: str, files: list, app: str,

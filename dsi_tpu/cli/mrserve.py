@@ -81,7 +81,21 @@ def main(argv=None) -> int:
                    help="unified trace output dir (dsi_tpu/obs)")
     p.add_argument("--no-warm", action="store_true",
                    help="skip the boot-time AOT warm (tests)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="run N coordinator replicas (Raft group, "
+                        "dsi_tpu/replica) instead of one daemon; the "
+                        "leader hosts the daemon, admissions commit to "
+                        "the replicated log before acking, and clients "
+                        "dial the printed comma-separated socket list")
     args = p.parse_args(argv)
+
+    if args.replicas:
+        if args.replicas < 2:
+            p.error("--replicas needs >= 2 (3 for kill-tolerance)")
+        if args.socket:
+            p.error("--socket conflicts with --replicas (each replica "
+                    "binds <spool>/replica-<i>.sock)")
+        return _replica_serve(args)
 
     if args.trace_dir:
         from dsi_tpu.obs import configure_tracing
@@ -135,6 +149,78 @@ def main(argv=None) -> int:
             flush_tracing_report(args.trace_dir, "mrserve")
     print("mrserve: stopped", file=sys.stderr, flush=True)
     return 0
+
+
+def _replica_serve(args) -> int:
+    """``--replicas N``: spawn the coordinator group and supervise it.
+
+    The leader replica hosts the real ServeDaemon; this process only
+    writes the group spec, babysits the N ``replicad`` children, and
+    prints the comma-separated socket spec clients (``serve/client.py``,
+    ``mrsubmit``) dial — the group dialer follows leader redirects, so
+    a ``kill -9`` of the leader is invisible to submitters beyond the
+    election wall."""
+    import time as _time
+
+    from dsi_tpu.replica.driver import ReplicaGroup
+
+    spool = os.path.abspath(args.spool)
+    serve_kw = {
+        "n_reduce": args.nreduce, "chunk_bytes": args.chunk_bytes,
+        "devices": args.devices, "max_resident": args.max_resident,
+        "quota_steps": args.quota_steps,
+        "checkpoint_every": args.checkpoint_every,
+        "retention_s": args.retention_days * 86400.0,
+        "warm": not args.no_warm, "max_queue": args.max_queue,
+        "rate_limit": args.rate_limit, "rate_burst": args.rate_burst,
+        "pack_grep": False if args.no_pack_grep else None,
+    }
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    if args.trace_dir:
+        env["DSI_TRACE_DIR"] = os.path.abspath(args.trace_dir)
+
+    group = ReplicaGroup("serve", spool, replicas=args.replicas,
+                         spool=spool, serve=serve_kw, env=env)
+    stop = {"flag": False}
+
+    def _stop(_sig, _frm):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    rc = 0
+    try:
+        info = group.wait_leader(timeout=180.0)
+        print(f"mrserve: replica group up, leader is replica "
+              f"{info['index']} (term {info['term']})",
+              file=sys.stderr, flush=True)
+        print(f"mrserve: sockets {group.spec}", file=sys.stderr,
+              flush=True)
+        print("mrserve: ready", file=sys.stderr, flush=True)
+        while not stop["flag"]:
+            _time.sleep(0.2)
+            for i, proc in group.procs.items():
+                code = proc.poll()
+                if code not in (None, 0, -signal.SIGTERM):
+                    # A replica died outside our control (OOM, chaos
+                    # harness): respawn it — the group tolerates a
+                    # minority down, but not forever.
+                    group.spawn(i)
+                    group.respawns += 1
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:  # no leader ever emerged: say so, clean up
+        print(f"mrserve: replica group failed: {e}", file=sys.stderr,
+              flush=True)
+        rc = 1
+    finally:
+        group.close()
+    print("mrserve: stopped", file=sys.stderr, flush=True)
+    return rc
 
 
 if __name__ == "__main__":

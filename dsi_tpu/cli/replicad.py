@@ -1,0 +1,253 @@
+"""One coordinator-group replica process (``python -m
+dsi_tpu.cli.replicad --index I --spec spec.json``).
+
+``mrrun/shardrun/mrserve --replicas N`` spawn N of these.  Each hosts
+a :class:`dsi_tpu.replica.node.ReplicaNode` — the deterministic Raft
+core pumped over the real ``mr/rpc.py`` transport — plus the
+mode-specific applier and leader application:
+
+* ``shard`` / ``classic`` — a :class:`JournalApplier` appends every
+  majority-committed journal record to this replica's OWN
+  ``replica-<i>.journal``; the elected leader builds a ``Coordinator``
+  whose injected :class:`ReplicatedJournal` turns each ``record*``
+  call into a propose-and-wait.  The coordinator is built WITHOUT its
+  own socket: its RPC surface is registered on the replica node, so
+  followers answer every coordinator method with the typed
+  ``NotLeader{hint}`` redirect.
+* ``serve`` — an :class:`AdmissionApplier` materializes accepted jobs
+  into the shared spool on every replica; the leader boots the
+  ``ServeDaemon`` whose ``admit_hook`` proposes each admission before
+  it is persisted or acked (and whose ``_load_journal`` re-queues
+  everything earlier leaders accepted).
+
+The spec file carries everything three replicas must agree on (input
+files, shard plan inputs, knobs) so the group is started with three
+identical commands differing only in ``--index``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _load_spec(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        spec = json.load(f)
+    if not isinstance(spec, dict):
+        raise SystemExit(f"replicad: malformed spec {path!r}")
+    for key in ("mode", "addrs", "workdir"):
+        if key not in spec:
+            raise SystemExit(f"replicad: spec missing {key!r}")
+    return spec
+
+
+def _coordinator_factory(spec: dict, node, journal_path: str):
+    """``app_factory`` for shard/classic mode: a Coordinator over the
+    replicated journal, its wire methods keyed exactly as
+    ``Coordinator.serve()`` registers them (plus the driver-facing
+    Done/Stats/Outputs polls the in-process driver used to read as
+    attributes)."""
+    from dsi_tpu.config import JobConfig
+    from dsi_tpu.mr import shards as sh
+    from dsi_tpu.mr.coordinator import Coordinator
+    from dsi_tpu.replica.node import ReplicatedJournal
+
+    mode = spec["mode"]
+    files = [str(f) for f in spec.get("files") or []]
+    n_reduce = int(spec.get("n_reduce") or 0)
+    n_shards = int(spec.get("n_shards") or 0)
+    cfg_kw = dict(spec.get("config") or {})
+    cfg_kw.setdefault("workdir", spec["workdir"])
+    # journal_path points at THIS replica's applier journal: the
+    # resuming check must see it (it exists — the applier created it at
+    # boot), or a new leader's Coordinator would clear the committed
+    # mr-*-out files of the term it is taking over.  The injected
+    # journal below is what actually gets written.
+    cfg_kw["journal_path"] = journal_path
+    cfg = JobConfig(**cfg_kw)
+
+    def factory():
+        jr = ReplicatedJournal(journal_path, files, n_reduce,
+                               n_shards, node.propose_and_wait)
+        if mode == "shard":
+            plan = sh.plan_shards(files, n_shards)
+            coord = Coordinator(files, 0, cfg, shard_plan=plan,
+                                shard_opts={"knobs":
+                                            dict(spec.get("knobs") or {})},
+                                journal=jr)
+        else:
+            coord = Coordinator(files, n_reduce, cfg, journal=jr)
+        methods = {
+            "Coordinator.RequestTask": coord.request_task,
+            "Coordinator.RecieveMapComplete": coord.map_complete,
+            "Coordinator.RecieveReduceComplete": coord.reduce_complete,
+            "Coordinator.MapComplete": coord.map_complete,
+            "Coordinator.ReduceComplete": coord.reduce_complete,
+            "Coordinator.FetchFailed": coord.fetch_failed,
+            "Coordinator.Done": lambda a: {"done": coord.done()},
+            "Coordinator.Stats": lambda a: {"stats": dict(
+                coord.spec_stats(), c_map=coord.c_map,
+                c_reduce=coord.c_reduce)},
+            "Coordinator.Outputs": lambda a: (
+                {"outputs": coord.final_outputs()} if coord.done()
+                else {"error": "job not done"}),
+        }
+        if mode == "shard":
+            methods.update({
+                "Coordinator.RequestShard": coord.request_shard,
+                "Coordinator.ShardProgress": coord.shard_progress,
+                "Coordinator.CommitShard": coord.commit_shard,
+                "Coordinator.ShardFailed": coord.shard_failed,
+            })
+        return coord, methods
+
+    return factory
+
+
+#: Every coordinator method a replica must answer (with a redirect,
+#: before any app exists) — superset of both modes; an off-mode call on
+#: the leader gets the app's method table, which simply lacks it.
+COORD_METHODS = (
+    "Coordinator.RequestTask", "Coordinator.RecieveMapComplete",
+    "Coordinator.RecieveReduceComplete", "Coordinator.MapComplete",
+    "Coordinator.ReduceComplete", "Coordinator.FetchFailed",
+    "Coordinator.RequestShard", "Coordinator.ShardProgress",
+    "Coordinator.CommitShard", "Coordinator.ShardFailed",
+    "Coordinator.Done", "Coordinator.Stats", "Coordinator.Outputs",
+)
+
+SERVE_METHODS = ("Submit", "Status", "Ping", "Shutdown")
+
+
+def _serve_factory(spec: dict, node, index: int):
+    """``app_factory`` for serve mode: the resident daemon, admission
+    gated through the replicated log.  Deferred import — the daemon
+    pulls the device stack; followers must stay cheap."""
+
+    def factory():
+        from dsi_tpu.serve.daemon import ServeDaemon
+
+        kw = dict(spec.get("serve") or {})
+        # Per-replica daemon socket: a new leader's daemon must not
+        # unlink the socket of a predecessor still tearing down.
+        # Clients never dial it — they dial the replica group.
+        kw.setdefault("socket_path",
+                      os.path.join(spec["workdir"],
+                                   f"mrserve-{index}.sock"))
+        daemon = ServeDaemon(
+            spec["spool"],
+            admit_hook=lambda rec: node.propose_and_wait({"admit": rec}),
+            **kw)
+        daemon.start()
+        methods = {
+            "Submit": daemon._rpc_submit,
+            "Status": daemon._rpc_status,
+            "Ping": daemon._rpc_ping,
+            "Shutdown": daemon._rpc_shutdown,
+        }
+        return daemon, methods
+
+    return factory
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--index", type=int, required=True,
+                   help="this replica's slot in the address list")
+    p.add_argument("--spec", required=True,
+                   help="group spec JSON (mode, addrs, workdir, job)")
+    args = p.parse_args(argv)
+
+    spec = _load_spec(args.spec)
+    mode = spec["mode"]
+    if mode not in ("shard", "classic", "serve"):
+        raise SystemExit(f"replicad: unknown mode {mode!r}")
+    addrs = [str(a) for a in spec["addrs"]]
+    i = args.index
+    if not 0 <= i < len(addrs):
+        raise SystemExit(f"replicad: --index {i} outside group "
+                         f"of {len(addrs)}")
+    workdir = os.path.abspath(spec["workdir"])
+    os.makedirs(workdir, exist_ok=True)
+
+    trace_dir = os.environ.get("DSI_TRACE_DIR")
+    if trace_dir:
+        from dsi_tpu.obs import configure_tracing
+
+        configure_tracing(trace_dir=trace_dir,
+                          basename=f"trace-replicad-{i}")
+
+    from dsi_tpu.replica.node import (ELECTION_TIMEOUT_S, AdmissionApplier,
+                                      JournalApplier, ReplicaNode)
+
+    store_path = os.path.join(workdir, f"replica-{i}.rlog")
+    if mode == "serve":
+        applier = AdmissionApplier(spec["spool"])
+        node_ref: list = []
+        factory = _serve_factory(spec, _Late(node_ref), i)
+        app_methods = SERVE_METHODS
+    else:
+        journal_path = os.path.join(workdir, f"replica-{i}.journal")
+        applier = JournalApplier(journal_path,
+                                 [str(f) for f in spec.get("files") or []],
+                                 int(spec.get("n_reduce") or 0),
+                                 int(spec.get("n_shards") or 0))
+        node_ref = []
+        factory = _coordinator_factory(spec, _Late(node_ref),
+                                       journal_path)
+        app_methods = COORD_METHODS
+
+    timeouts = spec.get("election_timeout_s")
+    node = ReplicaNode(
+        i, addrs, store_path,
+        applier=applier,
+        app_factory=factory,
+        app_methods=tuple(app_methods),
+        secret=spec.get("secret"),
+        election_timeout_s=(tuple(float(t) for t in timeouts)
+                            if timeouts else ELECTION_TIMEOUT_S),
+        heartbeat_s=float(spec.get("heartbeat_s") or 0.1))
+    node_ref.append(node)
+    node.start()
+    print(f"replicad: replica {i}/{len(addrs)} up on {node.address} "
+          f"(mode {mode}, pid {os.getpid()})", file=sys.stderr)
+
+    stop = {"flag": False}
+
+    def _term(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.1)
+    finally:
+        node.close()
+        applier.close()
+        if trace_dir:
+            from dsi_tpu.obs import flush_tracing
+
+            flush_tracing()
+    return 0
+
+
+class _Late:
+    """Forward the app factory's ``propose_and_wait`` to the node that
+    is constructed AFTER the factory (the factory only runs on
+    election, long after the list is populated)."""
+
+    def __init__(self, ref: list):
+        self._ref = ref
+
+    def propose_and_wait(self, data, timeout: float = 15.0):
+        return self._ref[0].propose_and_wait(data, timeout=timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

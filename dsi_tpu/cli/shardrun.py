@@ -104,6 +104,17 @@ def main(argv=None) -> int:
                         "from each worker's spool and fetched by the "
                         "driver over the stream transport — the share-"
                         "nothing multi-host shape on one machine")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="replicated control plane (dsi_tpu/replica): "
+                        "run the coordinator as an N-member Raft group "
+                        "of replicad processes; workers discover the "
+                        "leader via NotLeader redirects, and a dead "
+                        "leader is an election away instead of job-over")
+    p.add_argument("--kill-leader-after", type=float, default=0.0,
+                   help="chaos (needs --replicas): SIGKILL the leader "
+                        "this many seconds into the job, measure the "
+                        "kill->served failover wall, respawn the "
+                        "victim as a follower")
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--check", action="store_true",
                    help="byte-compare the merged output vs the "
@@ -121,6 +132,13 @@ def main(argv=None) -> int:
     if args.hosts and args.resplit:
         p.error("--hosts does not support --resplit (the sub-range "
                 "merge reads committed files from a shared directory)")
+    if args.replicas and args.hosts:
+        p.error("--hosts does not support --replicas yet (the driver "
+                "reads the coordinator's location registry in-process)")
+    if args.replicas and args.replicas < 2:
+        p.error("--replicas wants >= 2 (3 tolerates one kill)")
+    if args.kill_leader_after and not args.replicas:
+        p.error("--kill-leader-after needs --replicas")
     journal = os.path.abspath(args.journal) if args.journal \
         else os.path.join(workdir, "shards.journal")
 
@@ -170,9 +188,42 @@ def main(argv=None) -> int:
                     shard_progress_s=args.progress_s,
                     net_shuffle=args.hosts,
                     net_fetch_window=_fetch_window())
-    coord = Coordinator(files, 0, cfg, shard_plan=plan,
-                        shard_opts={"knobs": knobs})
-    coord.serve()
+    group = None
+    failover = None
+    if args.replicas:
+        # Replicated control plane: no in-process coordinator — an
+        # N-member replicad group owns the task table, and this driver
+        # talks to whoever leads.  Fresh-run hygiene the single-node
+        # coordinator does itself (clearing a PREVIOUS job's outputs)
+        # happens here: the leader's resuming check sees the replica
+        # journal, which the appliers create at boot, so it never
+        # clears — exactly what failover needs and fresh runs don't.
+        if not os.path.exists(os.path.join(workdir,
+                                           "replica-0.journal")):
+            for name in os.listdir(workdir):
+                if name.startswith(("mr-out-", "mr-shard-out-")):
+                    try:
+                        os.remove(os.path.join(workdir, name))
+                    except OSError:
+                        pass
+        from dsi_tpu.replica.driver import ReplicaGroup
+
+        group = ReplicaGroup(
+            "shard", workdir, replicas=args.replicas, files=files,
+            n_shards=n_shards, knobs=knobs,
+            config={"shard_timeout_s": args.shard_timeout,
+                    "spec_backup": not args.no_spec,
+                    "spec_floor_s": args.spec_floor,
+                    "spec_resplit": args.resplit,
+                    "spec_resplit_ways": args.resplit_ways,
+                    "shard_progress_s": args.progress_s},
+            env=env)
+        env["DSI_MR_SOCKET"] = group.spec
+        coord = group
+    else:
+        coord = Coordinator(files, 0, cfg, shard_plan=plan,
+                            shard_opts={"knobs": knobs})
+        coord.serve()
     if args.hosts:
         # Workers dial the coordinator's REAL TCP port, not a path.
         env["DSI_MR_SOCKET"] = coord.address()
@@ -308,6 +359,26 @@ def main(argv=None) -> int:
             if args.hosts and not fetch_committed():
                 rc = 1
                 break
+            if group is not None and args.kill_leader_after > 0 \
+                    and failover is None \
+                    and time.monotonic() - t0 >= args.kill_leader_after:
+                print("shardrun: chaos: kill -9 the leader replica",
+                      file=sys.stderr)
+                from dsi_tpu.mr import rpc as _rpc
+
+                try:
+                    failover = group.kill_leader()
+                except _rpc.CoordinatorGone as e:
+                    print(f"shardrun: failover FAILED: {e}",
+                          file=sys.stderr)
+                    rc = 1
+                    break
+                print(f"shardrun: failover in "
+                      f"{failover['failover_s']}s (term "
+                      f"{failover['old_term']} -> "
+                      f"{failover['new_term']}, leader "
+                      f"{failover['killed_index']} -> "
+                      f"{failover['new_index']})", file=sys.stderr)
             if coord.done() and (not args.hosts
                                  or len(fetched) == len(plan)
                                  or coord.spec_stats()["job_failed"]):
@@ -336,7 +407,16 @@ def main(argv=None) -> int:
                 break
             time.sleep(0.1)
     finally:
-        run_stats = coord.spec_stats()
+        if group is not None:
+            try:
+                run_stats = coord.spec_stats()
+            except Exception as e:  # noqa: BLE001 — group dead late
+                print(f"shardrun: replica group unreachable at exit: "
+                      f"{e}", file=sys.stderr)
+                run_stats = {"job_failed": True, "shards": len(plan)}
+                rc = rc or 1
+        else:
+            run_stats = coord.spec_stats()
         if args.hosts:
             run_stats.update(coord.net_stats())
             # The shard plane's only remote reads are the DRIVER's
@@ -355,9 +435,26 @@ def main(argv=None) -> int:
             run_stats["net_ratio"] = round(
                 run_stats["net_bytes_raw"] / wire, 3) if wire else 0.0
         run_stats["wall_s"] = round(time.monotonic() - t0, 3)
+        if group is not None:
+            run_stats["replicas"] = args.replicas
+            run_stats["replica_kills"] = group.kills
+            if failover is not None:
+                run_stats["replica_failover_s"] = failover["failover_s"]
+                run_stats["replica_old_term"] = failover["old_term"]
+                run_stats["replica_new_term"] = failover["new_term"]
         # A re-split shard commits as SUB-RANGE files, not one full-
         # range file: the coordinator knows the committed layout.
-        out_paths = coord.final_outputs()
+        if group is not None:
+            out_paths = []
+            if rc == 0 and not run_stats.get("job_failed"):
+                try:
+                    out_paths = coord.final_outputs()
+                except Exception as e:  # noqa: BLE001
+                    print(f"shardrun: could not read final outputs "
+                          f"from the group: {e}", file=sys.stderr)
+                    rc = 1
+        else:
+            out_paths = coord.final_outputs()
         coord.close()
         for w in workers:
             if w.poll() is None:

@@ -65,7 +65,8 @@ class Coordinator:
     def __init__(self, files: List[str], n_reduce: int,
                  config: JobConfig | None = None,
                  shard_plan: Optional[List[ShardSpec]] = None,
-                 shard_opts: Optional[dict] = None):
+                 shard_opts: Optional[dict] = None,
+                 journal: Optional[Journal] = None):
         self.config = config or JobConfig(n_reduce=n_reduce)
         self.files = list(files)
         self.n_map = len(files)
@@ -203,10 +204,16 @@ class Coordinator:
 
         # Optional checkpoint/resume (journal.py; disabled by default — the
         # reference keeps coordinator state purely in-memory).
-        self._journal: Optional[Journal] = None
-        if self.config.journal_path:
-            self._journal = Journal(self.config.journal_path, self.files,
-                                    self.n_reduce, n_shards=self.n_shards)
+        # An INJECTED journal (replica mode) swaps the local append-only
+        # file for the replicated log's propose-and-wait path: same
+        # record surface, but a record is durable only once a majority
+        # of the coordinator group holds it (replica/node.py).
+        self._journal: Optional[Journal] = journal
+        if self.config.journal_path or journal is not None:
+            if self._journal is None:
+                self._journal = Journal(self.config.journal_path,
+                                        self.files, self.n_reduce,
+                                        n_shards=self.n_shards)
             done_maps, done_reduces = self._journal.replay()
             for t in done_maps:
                 if self.map_log[t] != LOG_COMPLETED:

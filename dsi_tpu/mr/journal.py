@@ -23,9 +23,51 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import List, Optional, TextIO
 
 from dsi_tpu.utils.atomicio import fsync_dir
+
+# ---- replicated-record framing (ISSUE 20) ----
+#
+# Every record now carries a record-level CRC32 under the ``rcrc`` key,
+# computed over the record's CANONICAL serialization (sorted keys,
+# compact separators) without ``rcrc`` itself.  Torn tails were always
+# caught by the newline discipline; the frame additionally catches
+# in-place corruption of a MIDDLE record — which matters once the same
+# lines are replicated verbatim into follower journals (replica/), where
+# a silently divergent record would mean two coordinators replaying to
+# DIFFERENT task tables.  Records without ``rcrc`` (journals written
+# before this framing) still replay: the CRC is only checked when
+# present, so old spools resume unchanged.
+
+RECORD_CRC_KEY = "rcrc"
+
+
+def frame_record(rec: dict) -> str:
+    """Serialize one record with its framing CRC appended (no newline)."""
+    body = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+    out = dict(rec)
+    out[RECORD_CRC_KEY] = zlib.crc32(body.encode("utf-8"))
+    return json.dumps(out, sort_keys=True, separators=(",", ":"))
+
+
+def unframe_record(rec: dict) -> Optional[dict]:
+    """Validate and strip a parsed record's framing CRC.
+
+    Returns the record without ``rcrc`` (legacy records pass through
+    unchanged), or ``None`` when the CRC does not match — the caller
+    treats that exactly like unparseable JSON (truncate-and-refuse, not
+    best-effort repair)."""
+    if RECORD_CRC_KEY not in rec:
+        return rec
+    body = {k: v for k, v in rec.items() if k != RECORD_CRC_KEY}
+    want = rec[RECORD_CRC_KEY]
+    canon = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    if (not isinstance(want, int) or isinstance(want, bool)
+            or zlib.crc32(canon.encode("utf-8")) != want):
+        return None
+    return body
 
 
 class Journal:
@@ -118,6 +160,10 @@ class Journal:
                 self._trunc_at = rec_start
                 break
             if not isinstance(rec, dict):  # valid JSON but not an object
+                self._trunc_at = rec_start
+                break
+            rec = unframe_record(rec)
+            if rec is None:  # framed record whose CRC does not match
                 self._trunc_at = rec_start
                 break
             if not saw_header:  # first non-blank record must be a header
@@ -283,9 +329,18 @@ class Journal:
             self._write({"kind": "subshard", "task": sid, "sub": int(sub),
                          "attempt": attempt, "crc": int(crc)})
 
+    def append_replicated(self, rec: dict) -> None:
+        """Append one already-arbitrated record from the replicated log
+        (replica/node.py's applier).  The record was framed, majority-
+        committed, and ordered by Raft — this is the LOCAL durable copy
+        every replica keeps so a follower that wins an election replays
+        its own file to the exact task table the dead leader had."""
+        if self._fh is not None:
+            self._write(dict(rec))
+
     def _write(self, rec: dict) -> None:
         assert self._fh is not None
-        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.write(frame_record(rec) + "\n")
         self._fh.flush()
         os.fsync(self._fh.fileno())
 

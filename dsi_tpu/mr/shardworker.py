@@ -42,6 +42,9 @@ from dsi_tpu.config import JobConfig
 from dsi_tpu.mr import rpc
 from dsi_tpu.mr import shards as sh
 from dsi_tpu.mr.types import TaskStatus
+# Leader discovery (dsi_tpu/replica): DSI_MR_SOCKET may be a comma-
+# separated replica group; a single address is a plain rpc.call.
+from dsi_tpu.replica.client import group_call
 from dsi_tpu.utils.atomicio import atomic_write
 
 #: advance() turns between straggler-sleep/checkpoint/heartbeat checks.
@@ -164,7 +167,7 @@ def run_shard_attempt(reply: dict, cfg: JobConfig, worker_id: str,
         args = dict(args)
         args.update({"WorkerId": worker_id, "Shard": sid,
                      "Attempt": aid, "Sub": sub})
-        return rpc.call(sock, method, args)
+        return group_call(sock, method, args)
 
     def report_failed(reason: str) -> None:
         try:
@@ -355,7 +358,7 @@ def shard_worker_loop(config: Optional[JobConfig] = None,
         if serve_addr:
             req["Addr"] = serve_addr
         try:
-            ok, reply = rpc.call(sock, "Coordinator.RequestShard", req)
+            ok, reply = group_call(sock, "Coordinator.RequestShard", req)
         except rpc.CoordinatorGone as e:
             if shards_done == 0 or isinstance(e, rpc.AuthError):
                 print(f"shardworker: coordinator unreachable: {e}",

@@ -34,6 +34,11 @@ from typing import Callable, List, Sequence
 from dsi_tpu.config import JobConfig
 from dsi_tpu.mr import rpc
 from dsi_tpu.mr.types import KeyValue, TaskStatus
+# Leader-discovery shim (dsi_tpu/replica): DSI_MR_SOCKET may name a
+# comma-separated coordinator GROUP; group_call follows NotLeader
+# redirects and rides out elections.  A single address passes straight
+# through to rpc.call, so the classic plane is unchanged.
+from dsi_tpu.replica.client import group_call
 from dsi_tpu.utils.atomicio import atomic_write
 from dsi_tpu.utils.tracing import Span
 
@@ -233,7 +238,7 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
         if extra:
             args.update(extra)
         try:
-            rpc.call(sock, method, args)
+            group_call(sock, method, args)
             return True
         except rpc.AuthError as e:
             print(f"mrworker: {e}", file=sys.stderr)
@@ -277,7 +282,7 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
         if addr:
             req["Addr"] = addr
         try:
-            ok, reply = rpc.call(sock, "Coordinator.RequestTask", req)
+            ok, reply = group_call(sock, "Coordinator.RequestTask", req)
         except rpc.CoordinatorGone as e:
             # Coordinator exited; the reference worker dies here
             # (worker.go:176-178).  Normal at end-of-job; noteworthy if this
@@ -342,9 +347,11 @@ def worker_loop(mapf: MapFn, reducef: ReduceFn,
                     # and go back to the well — this reduce re-runs
                     # after the map barrier reopens.
                     try:
-                        rpc.call(sock, "Coordinator.FetchFailed",
-                                 {"Map": e.task, "Reduce": reply["CReduce"],
-                                  "WorkerId": worker_id, "Addr": e.addr})
+                        group_call(sock, "Coordinator.FetchFailed",
+                                   {"Map": e.task,
+                                    "Reduce": reply["CReduce"],
+                                    "WorkerId": worker_id,
+                                    "Addr": e.addr})
                     except rpc.CoordinatorGone:
                         break
                     print(f"mrworker: fetch failed ({e}); reported, "

@@ -209,6 +209,10 @@ COUNTER_KEYS = (
     # overlapped shuffle (ISSUE 18): the effective prefetch window
     # (gauge — 1 means the serial path ran)
     "net_prefetch_window",
+    # replicated control plane (ISSUE 20, dsi_tpu/replica): the Raft
+    # node's status surface — log-application progress and leadership
+    # churn per replica (group_status / the failover harness read them)
+    "applied_index", "failovers",
 )
 
 #: THE schema: every key an engine scope may carry, under its unified
@@ -241,6 +245,17 @@ SERVE_SERIES = (
     "dsi_serve_tenant_done",
     "dsi_serve_tenant_resume_gap_seconds",
     "dsi_serve_tenant_p99_ms",
+)
+
+#: Every ``dsi_replica_*`` gauge the replicated control plane
+#: (``dsi_tpu/replica/node.py``) publishes — pinned alongside
+#: SERVE_SERIES for the same reason: the failover evidence surface
+#: (``scripts/tracecat.py`` replica lane, the tier-1 replication smoke)
+#: keys on these names, so growing one is a schema change that starts
+#: here.
+REPLICA_SERIES = (
+    "dsi_replica_term", "dsi_replica_elections",
+    "dsi_replica_applied_index",
 )
 
 

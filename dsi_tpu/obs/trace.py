@@ -65,7 +65,7 @@ _HOT_STAGES = frozenset(_hist.HIST_STAGES)
 LANES = (
     "materialize", "upload", "dispatch", "kernel", "pull", "merge",
     "replay", "shuffle", "fold", "sync", "widen", "ckpt", "plan",
-    "net", "control", "counters",
+    "net", "replica", "control", "counters",
 )
 
 #: The pinned span-name schema: every span opened anywhere in the repo

@@ -1,0 +1,27 @@
+"""Replicated control plane (ISSUE 20).
+
+A 3-process Raft-style coordinator group: leader election and log
+replication over the existing ``mr/rpc.py`` transport, with the
+replicated log subsuming the ``mr/journal.py`` commit records so a
+follower that wins an election replays to the exact task table the
+dead leader had.  Commit arbitration moves INSIDE the replicated log —
+a record is final only once a majority holds it, so two leaders across
+a partition can never both finalize a shard.
+
+Layering (each importable on a bare interpreter, no jax):
+
+* :mod:`dsi_tpu.replica.raft` — the deterministic election/replication
+  state machine (injectable clock + rng, message dicts in / message
+  dicts out; unit-tested like 6.5840 Lab 2);
+* :mod:`dsi_tpu.replica.rlog` — the durable per-node Raft state
+  (term/vote + log entries) under the journal's CRC record framing;
+* :mod:`dsi_tpu.replica.node` — the process harness: RPC transport,
+  tick thread, leader-side application hosting (shard/classic
+  coordinator or serve admission), committed-entry application into
+  the local journal;
+* :mod:`dsi_tpu.replica.client` — leader discovery for workers and
+  drivers (dial the group, follow ``NotLeader{hint}`` redirects).
+"""
+
+from dsi_tpu.replica.raft import (CANDIDATE, FOLLOWER, LEADER,  # noqa: F401
+                                  RaftCore)

@@ -1,0 +1,133 @@
+"""Leader discovery for workers and drivers dialing a replica group.
+
+The wire convention (replica/node.py): an application RPC sent to a
+FOLLOWER is answered with a typed redirect instead of being served —
+
+    {"error": "...", "error_type": "not_leader", "hint": "<addr or ''>"}
+
+and a freshly elected leader whose coordinator is still replaying the
+log answers ``{"error_type": "retry"}``.  :func:`group_call` hides
+both: give it a comma-separated address list (the ``DSI_MR_SOCKET``
+a ``--replicas`` driver exports) and it dials the cached leader first,
+follows redirect hints, rotates through the group on dead sockets, and
+only raises :class:`rpc.CoordinatorGone` once the WHOLE group stayed
+unreachable past the failover budget — a single dead coordinator used
+to be job-over; a dead leader is now just an election away.
+
+With a single address (no comma) this is a plain ``rpc.call``
+passthrough, so the worker loops run one code path in both modes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dsi_tpu.mr import rpc
+
+#: How long a caller keeps cycling a group that answers nothing before
+#: concluding the GROUP is gone.  Covers several election timeouts plus
+#: leader app rebuild (journal replay) with margin.
+GROUP_GIVE_UP_S = 30.0
+
+#: error_type values on the redirect protocol (single-sourced here;
+#: node.py imports them).
+NOT_LEADER = "not_leader"
+RETRY = "retry"
+
+# Last-known leader per address-list (workers dial per-call, so the
+# cache is what turns N redirects into one).
+_mu = threading.Lock()
+_leader_cache: Dict[str, str] = {}
+
+
+def split_group(spec: str):
+    """``"a,b,c"`` -> ["a", "b", "c"] (single address -> [it])."""
+    return [a for a in (spec or "").split(",") if a]
+
+
+def forget_leader(spec: str) -> None:
+    with _mu:
+        _leader_cache.pop(spec, None)
+
+
+def group_call(spec: str, method: str, args: dict | None = None,
+               timeout: float = 60.0, give_up_s: float = GROUP_GIVE_UP_S,
+               sleep=time.sleep, clock=time.monotonic):
+    """``rpc.call`` against a replica group (see module docstring).
+
+    Returns the served ``(ok, reply)``; raises ``rpc.CoordinatorGone``
+    when no replica serves within ``give_up_s``.  ``sleep``/``clock``
+    are injectable for tests.
+    """
+    addrs = split_group(spec)
+    if len(addrs) <= 1:
+        return rpc.call(spec, method, args, timeout=timeout)
+    deadline = clock() + give_up_s
+    rr = 0  # round-robin cursor for leaderless probing
+    last_err: Optional[Exception] = None
+    while True:
+        with _mu:
+            leader = _leader_cache.get(spec)
+        addr = leader if leader else addrs[rr % len(addrs)]
+        try:
+            ok, reply = rpc.call(addr, method, args, timeout=timeout)
+        except rpc.AuthError:
+            raise  # wrong secret never self-heals; stay loud
+        except rpc.CoordinatorGone as e:
+            last_err = e
+            if leader == addr:
+                forget_leader(spec)
+            else:
+                rr += 1
+            if clock() >= deadline:
+                raise rpc.CoordinatorGone(
+                    f"replica group {spec}: no reachable leader within "
+                    f"{give_up_s:.0f}s (last: {last_err})") from e
+            sleep(0.05)
+            continue
+        etype = reply.get("error_type") if isinstance(reply, dict) else None
+        if etype == NOT_LEADER:
+            hint = str(reply.get("hint") or "")
+            with _mu:
+                if hint and hint != addr:
+                    _leader_cache[spec] = hint
+                else:
+                    _leader_cache.pop(spec, None)
+            if not hint or hint == addr:
+                rr += 1
+            if clock() >= deadline:
+                raise rpc.CoordinatorGone(
+                    f"replica group {spec}: no leader emerged within "
+                    f"{give_up_s:.0f}s")
+            sleep(0.02 if hint else 0.05)
+            continue
+        if etype == RETRY:
+            # A real leader, app still replaying the log: short wait.
+            with _mu:
+                _leader_cache[spec] = addr
+            if clock() >= deadline:
+                raise rpc.CoordinatorGone(
+                    f"replica group {spec}: leader stuck replaying "
+                    f"({reply.get('error')})")
+            sleep(0.05)
+            continue
+        with _mu:
+            _leader_cache[spec] = addr
+        return ok, reply
+
+
+def group_status(spec: str, timeout: float = 2.0):
+    """``Replica.Status`` from every reachable replica — the driver's
+    leader-finding/kill-9 surface: ``{addr: status-dict}``."""
+    out = {}
+    for addr in split_group(spec):
+        try:
+            ok, reply = rpc.call(addr, "Replica.Status", {},
+                                 timeout=timeout)
+        except rpc.CoordinatorGone:
+            continue
+        if ok and isinstance(reply, dict) and "status" in reply:
+            out[addr] = reply
+    return out

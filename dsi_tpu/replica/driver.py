@@ -1,0 +1,187 @@
+"""Driver-side handle on a spawned coordinator-replica group.
+
+``shardrun/mrrun/mrserve --replicas N`` use this to (1) write the group
+spec and spawn N ``dsi_tpu.cli.replicad`` processes, (2) stand in for
+the in-process coordinator the single-node drivers poll directly
+(``done()/spec_stats()/final_outputs()`` ride ``Coordinator.*`` RPCs
+through :func:`replica.client.group_call`), and (3) run the chaos the
+differential harness and the bench row need: ``kill -9`` the CURRENT
+leader and measure the failover wall — kill instant to the first
+successful post-kill coordinator answer from the NEW leader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from dsi_tpu.mr import rpc
+from dsi_tpu.replica import client as rclient
+
+
+class ReplicaGroup:
+    """N ``replicad`` subprocesses plus the RPC plumbing to drive them.
+
+    ``config`` is the JobConfig-kwarg subset every replica's leader
+    coordinator is built with; it must be identical across replicas
+    (it ships via the one shared spec file, so it is)."""
+
+    def __init__(self, mode: str, workdir: str, *, replicas: int = 3,
+                 files: Optional[List[str]] = None, n_reduce: int = 0,
+                 n_shards: int = 0, knobs: Optional[dict] = None,
+                 config: Optional[dict] = None,
+                 spool: Optional[str] = None,
+                 serve: Optional[dict] = None,
+                 env: Optional[dict] = None,
+                 election_timeout_s: Optional[tuple] = None,
+                 heartbeat_s: Optional[float] = None):
+        if replicas < 2:
+            raise ValueError("a replica group needs >= 2 members "
+                             "(3 for kill-tolerance)")
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.addrs = [os.path.join(self.workdir, f"replica-{i}.sock")
+                      for i in range(replicas)]
+        self.spec = ",".join(self.addrs)
+        self.env = dict(env if env is not None else os.environ)
+        spec_doc = {"mode": mode, "addrs": self.addrs,
+                    "workdir": self.workdir}
+        if mode in ("shard", "classic"):
+            spec_doc.update({"files": list(files or []),
+                             "n_reduce": int(n_reduce),
+                             "n_shards": int(n_shards),
+                             "knobs": dict(knobs or {}),
+                             "config": dict(config or {})})
+        else:
+            spec_doc.update({"spool": spool, "serve": dict(serve or {})})
+        if election_timeout_s is not None:
+            spec_doc["election_timeout_s"] = list(election_timeout_s)
+        if heartbeat_s is not None:
+            spec_doc["heartbeat_s"] = heartbeat_s
+        self.spec_path = os.path.join(self.workdir, "replica-spec.json")
+        # dsicheck: allow[raw-write] process-spawn config, consumed
+        # immediately by the children; not durable job state
+        with open(self.spec_path, "w", encoding="utf-8") as f:
+            json.dump(spec_doc, f, sort_keys=True, indent=1)
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.kills = 0
+        self.respawns = 0
+        for i in range(replicas):
+            self.spawn(i)
+
+    # ---- process control ----
+
+    def spawn(self, i: int) -> None:
+        cmd = [sys.executable, "-m", "dsi_tpu.cli.replicad",
+               "--index", str(i), "--spec", self.spec_path]
+        self.procs[i] = subprocess.Popen(cmd, env=self.env,
+                                         cwd=self.workdir)
+
+    def statuses(self, timeout: float = 2.0) -> Dict[str, dict]:
+        return rclient.group_status(self.spec, timeout=timeout)
+
+    def leader(self) -> Optional[dict]:
+        """``{"index", "addr", "pid", "term", "app_ready"}`` of the
+        replica that currently believes it leads, or None."""
+        for addr, st in self.statuses().items():
+            s = st.get("status") or {}
+            if s.get("role") == "leader":
+                return {"index": int(s.get("node", -1)), "addr": addr,
+                        "pid": int(st.get("pid", 0)),
+                        "term": int(s.get("term", 0)),
+                        "app_ready": bool(st.get("app_ready"))}
+        return None
+
+    def wait_leader(self, timeout: float = 30.0, *,
+                    app_ready: bool = True) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.leader()
+            if info is not None and (info["app_ready"]
+                                     or not app_ready):
+                return info
+            time.sleep(0.05)
+        raise rpc.CoordinatorGone(
+            f"replica group {self.spec}: no leader within {timeout:.0f}s")
+
+    def kill_leader(self, *, respawn: bool = True,
+                    probe_method: str = "Coordinator.Stats",
+                    probe_args: Optional[dict] = None,
+                    timeout: float = 60.0) -> dict:
+        """The differential-harness chaos move: SIGKILL the current
+        leader and measure kill→served failover.  Returns
+        ``{"killed_index", "old_term", "new_term", "new_index",
+        "failover_s"}``.  ``respawn`` brings the killed replica back
+        (as a follower that catches up from the new leader's log)."""
+        info = self.wait_leader(timeout=timeout)
+        os.kill(info["pid"], signal.SIGKILL)
+        self.kills += 1
+        t_kill = time.monotonic()
+        try:
+            self.procs[info["index"]].wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        rclient.forget_leader(self.spec)
+        ok, reply = rclient.group_call(self.spec, probe_method,
+                                       probe_args or {},
+                                       give_up_s=timeout)
+        failover_s = time.monotonic() - t_kill
+        if not ok:
+            raise rpc.CoordinatorGone(
+                f"post-kill probe failed: {reply!r}")
+        new = self.wait_leader(timeout=timeout)
+        if respawn:
+            self.spawn(info["index"])
+            self.respawns += 1
+        return {"killed_index": info["index"],
+                "old_term": info["term"],
+                "new_term": new["term"], "new_index": new["index"],
+                "failover_s": round(failover_s, 4)}
+
+    def close(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # ---- the in-process coordinator's driver surface, over RPC ----
+
+    def _call(self, method: str, args: Optional[dict] = None,
+              give_up_s: float = 30.0):
+        return rclient.group_call(self.spec, method, args or {},
+                                  give_up_s=give_up_s)
+
+    def done(self) -> bool:
+        try:
+            ok, reply = self._call("Coordinator.Done", give_up_s=10.0)
+        except rpc.CoordinatorGone:
+            return False  # mid-election; the driver loop polls again
+        return bool(ok and isinstance(reply, dict) and reply.get("done"))
+
+    def spec_stats(self) -> dict:
+        ok, reply = self._call("Coordinator.Stats")
+        if not ok or not isinstance(reply, dict) or "stats" not in reply:
+            raise rpc.CoordinatorGone(f"Coordinator.Stats: {reply!r}")
+        return reply["stats"]
+
+    def final_outputs(self) -> List[str]:
+        ok, reply = self._call("Coordinator.Outputs")
+        if not ok or not isinstance(reply, dict) \
+                or "outputs" not in reply:
+            raise rpc.CoordinatorGone(f"Coordinator.Outputs: {reply!r}")
+        return list(reply["outputs"])
+
+    # ---- the replication-audit surface (tests, CI smoke) ----
+
+    def journal_paths(self) -> List[str]:
+        return [os.path.join(self.workdir, f"replica-{i}.journal")
+                for i in range(len(self.addrs))]

@@ -1,0 +1,488 @@
+"""The replica process harness: RaftCore + RPC transport + application.
+
+One :class:`ReplicaNode` per coordinator process.  It pumps the
+deterministic core (``replica/raft.py``) with real traffic over the
+existing ``mr/rpc.py`` transport (same auth, same framing, same
+``DSI_MR_SECRET``), applies committed log entries to the node's LOCAL
+journal file, and hosts the application — the shard/classic
+``Coordinator`` or the serve daemon — on the leader only.
+
+The contract every piece of the failover story hangs off:
+
+* **Appliers run on every replica**, leader or not: each committed
+  entry lands in each node's own journal file (``replica-<i>.journal``)
+  in log order, so the journal a follower replays on winning an
+  election IS the task table the dead leader acked.
+* **The application exists only on the leader**, and only once the
+  node has applied up to its own election no-op — i.e. once its local
+  journal provably contains every record any previous leader ever
+  acked.  Application RPCs reaching a follower get the typed
+  ``NotLeader{hint}`` redirect (``replica/client.py``).
+* :meth:`propose_and_wait` is the exactly-once arbitration point: the
+  coordinator's journal writes block here until the record is
+  replicated to a MAJORITY and applied locally.  A leader cut off from
+  the majority times out instead of acking — it cannot finalize a
+  shard, which is precisely what keeps ``duplicate_commits == 0``
+  across a partition (tests/test_raft.py pins the core property,
+  tests/test_replica_group.py the end-to-end one).
+
+Threads: one ticker (timers, apply, leadership transitions — the only
+thread that touches the application lifecycle), one sender per peer
+(latest-message slot: Raft state is cumulative, so a superseded
+message is garbage, not loss), plus the RpcServer's handler threads
+feeding ``on_message``.  All core state is guarded by ``self.mu``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from dsi_tpu.mr import rpc
+from dsi_tpu.mr.journal import Journal
+from dsi_tpu.obs import get_registry, trace_event
+from dsi_tpu.replica import client as rclient
+from dsi_tpu.replica.raft import (APPEND, APPEND_RESP, LEADER, RaftCore,
+                                  VOTE_REQ, VOTE_RESP)
+from dsi_tpu.replica.rlog import RaftStore
+
+
+class NotLeaderError(Exception):
+    """Raised by propose on a non-leader; carries the redirect hint."""
+
+    def __init__(self, hint: str = ""):
+        super().__init__(f"not leader (hint={hint or '?'})")
+        self.hint = hint
+
+
+class ReplicationError(Exception):
+    """A proposal that could not reach a majority (partition, lost
+    leadership, group death).  The record was NOT acked — the caller's
+    commit is not final and must not be reported as such."""
+
+
+#: Election timeouts for real process groups (seconds).  Wide enough
+#: that one scheduling hiccup doesn't trigger spurious elections on a
+#: loaded CI box, tight enough that failover lands well under the
+#: shard watchdog's presumed-dead window.
+ELECTION_TIMEOUT_S = (0.4, 0.9)
+HEARTBEAT_S = 0.1
+TICK_S = 0.02
+
+_RAFT_METHOD = {VOTE_REQ: "Raft.RequestVote", VOTE_RESP: "Raft.RequestVote",
+                APPEND: "Raft.AppendEntries",
+                APPEND_RESP: "Raft.AppendEntries"}
+
+
+class ReplicaNode:
+    """One replica of the coordinator group (see module docstring).
+
+    ``applier(index, data)`` is called for every committed entry in
+    log order (Raft no-ops included) on whichever thread advances the
+    commit — always serialized, never concurrently.
+
+    ``app_factory() -> (app, {rpc_name: handler})`` builds the
+    leader-side application once leadership is stable;
+    ``app.close()`` tears it down on loss.  ``app_methods`` names the
+    RPC surface to register up front (followers must answer those
+    methods with redirects before any app exists anywhere).
+    """
+
+    def __init__(self, index: int, addrs: List[str], store_path: str, *,
+                 applier: Callable[[int, Any], None],
+                 app_factory: Optional[Callable[[], Tuple[Any, Dict]]] = None,
+                 app_methods: Tuple[str, ...] = (),
+                 secret: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng=None,
+                 election_timeout_s: Tuple[float, float] = ELECTION_TIMEOUT_S,
+                 heartbeat_s: float = HEARTBEAT_S):
+        self.index = index
+        self.addrs = list(addrs)
+        self.clock = clock
+        self.applier = applier
+        self.app_factory = app_factory
+        self.secret = secret
+        self.mu = threading.Lock()
+        self._applied_cv = threading.Condition(self.mu)
+        self.store = RaftStore(store_path)
+        self.core = RaftCore(
+            index, len(addrs),
+            rng=rng if rng is not None else random.Random(
+                os.getpid() * 1000003 + index),
+            now=clock(), store=self.store,
+            election_timeout_s=election_timeout_s,
+            heartbeat_s=heartbeat_s)
+        self.applied_index = 0
+        self._app: Any = None
+        self._app_methods: Optional[Dict[str, Callable]] = None
+        self._lead_barrier: Optional[int] = None
+        self._role_seen = self.core.role
+        self._term_seen = self.core.current_term
+        self._failovers = 0
+        self._closing = False
+
+        methods: Dict[str, Callable] = {
+            "Raft.RequestVote": self._rpc_raft,
+            "Raft.AppendEntries": self._rpc_raft,
+            "Replica.Status": self._rpc_status,
+        }
+        for name in app_methods:
+            methods[name] = (lambda args, _n=name:
+                             self._app_call(_n, args))
+        self._server = rpc.RpcServer(addrs[index], methods, secret=secret)
+
+        # Per-peer latest-message slots + sender threads.
+        self._slots: Dict[int, Optional[dict]] = {}
+        self._slot_cv = threading.Condition()
+        self._senders = []
+        for p in range(len(addrs)):
+            if p == index:
+                continue
+            self._slots[p] = None
+            t = threading.Thread(target=self._sender, args=(p,),
+                                 name=f"dsi-replica-send-{p}",
+                                 daemon=True)
+            self._senders.append(t)
+        self._ticker = threading.Thread(target=self._tick_loop,
+                                        name="dsi-replica-tick",
+                                        daemon=True)
+
+    # ---- lifecycle ----
+
+    def start(self) -> "ReplicaNode":
+        self._server.start()
+        for t in self._senders:
+            t.start()
+        self._ticker.start()
+        return self
+
+    def close(self) -> None:
+        with self.mu:
+            self._closing = True
+            self._applied_cv.notify_all()
+        with self._slot_cv:
+            self._slot_cv.notify_all()
+        self._ticker.join(timeout=5.0)
+        self._server.close()
+        with self.mu:
+            app, self._app, self._app_methods = self._app, None, None
+        if app is not None:  # closed outside mu: app teardown joins
+            app.close()      # threads that may still take RPCs
+        self.store.close()
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def app(self):
+        """The live leader application, or None (driver convenience)."""
+        return self._app
+
+    # ---- RPC handlers ----
+
+    def _rpc_raft(self, args: dict) -> dict:
+        with self.mu:
+            out = self.core.on_message(args, self.clock())
+        frm = args.get("from")
+        back = [m for m in out if m.get("to") == frm]
+        rest = [m for m in out if m.get("to") != frm]
+        if rest:
+            self._post(rest)
+        return {"msgs": back}
+
+    def _rpc_status(self, args: dict) -> dict:
+        with self.mu:
+            st = self.core.status()
+            st["applied_index"] = self.applied_index
+            st["failovers"] = self._failovers
+            app_ready = self._app is not None
+        return {"status": st, "pid": os.getpid(), "addr": self.address,
+                "app_ready": app_ready}
+
+    def _leader_hint_locked(self) -> str:
+        lid = self.core.leader_id
+        if lid is None or not 0 <= lid < len(self.addrs):
+            return ""
+        return self.addrs[lid]
+
+    def _app_call(self, name: str, args: dict) -> dict:
+        with self.mu:
+            app_methods = self._app_methods
+            is_leader = self.core.is_leader()
+            hint = self._leader_hint_locked()
+        if app_methods is None:
+            if is_leader:
+                return {"error": "leader is replaying the log",
+                        "error_type": rclient.RETRY}
+            return {"error": "not leader", "error_type": rclient.NOT_LEADER,
+                    "hint": hint}
+        fn = app_methods.get(name)
+        if fn is None:
+            return {"error": f"no such app method {name!r}"}
+        try:
+            return fn(args)
+        except NotLeaderError as e:
+            return {"error": str(e), "error_type": rclient.NOT_LEADER,
+                    "hint": e.hint}
+        except ReplicationError as e:
+            # The commit did not finalize; the worker retries and the
+            # (possibly new) leader re-arbitrates.
+            return {"error": f"replication stalled: {e}",
+                    "error_type": rclient.RETRY}
+
+    # ---- proposals (the ReplicatedJournal hook) ----
+
+    def propose_and_wait(self, data: Any, timeout: float = 15.0) -> int:
+        """Append ``data`` to the replicated log; block until it is
+        majority-committed AND applied locally.  Returns the log index.
+        Raises :class:`NotLeaderError` / :class:`ReplicationError`."""
+        with self.mu:
+            now = self.clock()
+            idx, msgs = self.core.propose(data, now)
+            if idx is None:
+                raise NotLeaderError(self._leader_hint_locked())
+            term = self.core.current_term
+        self._post(msgs)
+        deadline = self.clock() + timeout
+        with self._applied_cv:
+            while self.applied_index < idx:
+                if self._closing:
+                    raise ReplicationError("node closing")
+                if (self.core.current_term != term
+                        or not self.core.is_leader()):
+                    raise NotLeaderError(self._leader_hint_locked())
+                left = deadline - self.clock()
+                if left <= 0:
+                    raise ReplicationError(
+                        f"no majority within {timeout:.0f}s "
+                        f"(entry {idx}, term {term})")
+                self._applied_cv.wait(min(left, 0.05))
+            # Committed — but OUR entry, not a same-index survivor of a
+            # truncation race (impossible while we stayed leader in
+            # ``term``; belt and braces against future edits).
+            if self.core._term_at(idx) != term:
+                raise ReplicationError(
+                    f"entry {idx} superseded (term {term} -> "
+                    f"{self.core._term_at(idx)})")
+        return idx
+
+    # ---- ticker: timers, apply, leadership ----
+
+    def _tick_loop(self) -> None:
+        while True:
+            with self.mu:
+                if self._closing:
+                    return
+                now = self.clock()
+                msgs = self.core.tick(now)
+                committed = self.core.take_committed()
+                for idx, data in committed:
+                    # The applier is journal appends + spool writes —
+                    # holding mu serializes it with propose/apply
+                    # waiters, which is exactly the ordering we want.
+                    self.applier(idx, data)
+                    self.applied_index = idx
+                if committed:
+                    self._applied_cv.notify_all()
+                role = self.core.role
+                term = self.core.current_term
+                barrier_ok = (self._lead_barrier is not None
+                              and self.applied_index >= self._lead_barrier)
+            self._post(msgs)
+            self._leadership(role, term, barrier_ok)
+            time.sleep(TICK_S)
+
+    def _leadership(self, role: str, term: int, barrier_ok: bool) -> None:
+        """Application lifecycle — ticker thread only."""
+        if term != self._term_seen:
+            trace_event("replica.term", lane="replica", node=self.index,
+                        term=term, role=role)
+            get_registry().set_gauge("dsi_replica_term", term)
+            self._term_seen = term
+        if role != self._role_seen:
+            if role == LEADER:
+                with self.mu:
+                    self._lead_barrier = self.core.last_index()
+                self._failovers += 1
+                trace_event("replica.elected", lane="replica",
+                            node=self.index, term=term,
+                            barrier=self._lead_barrier)
+                get_registry().set_gauge("dsi_replica_elections",
+                                         self.core.elections_won)
+                print(f"replica {self.index}: elected leader "
+                      f"(term {term})", file=sys.stderr)
+            elif self._role_seen == LEADER:
+                trace_event("replica.stepdown", lane="replica",
+                            node=self.index, term=term)
+                print(f"replica {self.index}: stepped down "
+                      f"(term {term})", file=sys.stderr)
+            self._role_seen = role
+        if role != LEADER and self._app is not None:
+            app = self._app
+            with self.mu:
+                self._app = None
+                self._app_methods = None
+                self._lead_barrier = None
+            app.close()
+            trace_event("replica.app_down", lane="replica",
+                        node=self.index, term=term)
+        elif (role == LEADER and self._app is None
+                and self.app_factory is not None and barrier_ok):
+            t0 = self.clock()
+            app, methods = self.app_factory()
+            with self.mu:
+                if self.core.is_leader():
+                    self._app, self._app_methods = app, methods
+                    app = None
+            if app is not None:  # lost leadership mid-build
+                app.close()
+            else:
+                trace_event("replica.app_up", lane="replica",
+                            node=self.index, term=term,
+                            build_s=round(self.clock() - t0, 4),
+                            applied=self.applied_index)
+                get_registry().set_gauge("dsi_replica_applied_index",
+                                         self.applied_index)
+
+    # ---- outbound raft traffic ----
+
+    def _post(self, msgs: List[dict]) -> None:
+        if not msgs:
+            return
+        with self._slot_cv:
+            for m in msgs:
+                to = int(m["to"])
+                if to in self._slots:
+                    self._slots[to] = m  # latest message supersedes
+            self._slot_cv.notify_all()
+
+    def _sender(self, peer: int) -> None:
+        while True:
+            with self._slot_cv:
+                while self._slots.get(peer) is None and not self._closing:
+                    self._slot_cv.wait(0.5)
+                if self._closing:
+                    return
+                msg = self._slots[peer]
+                self._slots[peer] = None
+            try:
+                ok, reply = rpc.call(self.addrs[peer],
+                                     _RAFT_METHOD[msg["type"]], msg,
+                                     timeout=2.0, secret=self.secret)
+            except rpc.CoordinatorGone:
+                continue  # dead peer; the next timer regenerates state
+            if not ok or not isinstance(reply, dict):
+                continue
+            for m in reply.get("msgs") or []:
+                with self.mu:
+                    out = self.core.on_message(m, self.clock())
+                self._post(out)
+
+
+class ReplicatedJournal(Journal):
+    """The leader coordinator's journal whose writes are replicated log
+    proposals.  Same record surface as :class:`Journal` — every
+    ``record*`` call funnels through ``_write`` — but a record is
+    durable (and the call returns) only once a MAJORITY of replicas
+    committed it and this node applied it to its local journal file
+    (the applier owns the actual file handle; this class never writes
+    bytes itself).  ``replay()`` is inherited and reads that same local
+    file, which is how a follower-turned-leader reconstructs the exact
+    task table."""
+
+    def __init__(self, path: str, files: List[str], n_reduce: int,
+                 n_shards: int, propose: Callable[[Any], int]):
+        super().__init__(path, files, n_reduce, n_shards=n_shards)
+        self._propose = propose
+
+    def open(self) -> None:
+        # The applier created the file + header before any leadership;
+        # arm the record*() gate with a non-file sentinel — _write is
+        # overridden, so nothing ever treats it as a handle.
+        self._fh = self  # type: ignore[assignment]
+
+    def _write(self, rec: dict) -> None:
+        if rec.get("kind") == "header":
+            return  # the applier journal owns the header
+        self._propose({"j": rec})
+
+    def close(self) -> None:
+        self._fh = None
+
+
+class JournalApplier:
+    """Committed-entry applier for coordinator groups: every replica
+    appends each arbitrated journal record to its OWN journal file,
+    deduplicating on record identity so a restart (which re-delivers
+    the whole committed log) or a crash between append and ack never
+    yields a double record — ``duplicate_commits`` stays structurally
+    0 in every replica's journal, not just the leader's."""
+
+    def __init__(self, path: str, files: List[str], n_reduce: int,
+                 n_shards: int):
+        self.journal = Journal(path, files, n_reduce, n_shards=n_shards)
+        maps, reduces = self.journal.replay()
+        self.seen = {("map", t) for t in maps}
+        self.seen.update(("reduce", t) for t in reduces)
+        self.seen.update(("shard", s) for s in self.journal.shard_commits)
+        self.seen.update(("resplit", s) for s in self.journal.resplits)
+        self.seen.update(("subshard", s, k)
+                         for s, k in self.journal.subshard_commits)
+        self.journal.open()
+
+    @staticmethod
+    def _key(rec: dict):
+        kind = rec.get("kind")
+        if kind == "subshard":
+            return (kind, rec.get("task"), rec.get("sub"))
+        return (kind, rec.get("task"))
+
+    def __call__(self, index: int, data: Any) -> None:
+        if not isinstance(data, dict):
+            return
+        rec = data.get("j")
+        if not isinstance(rec, dict):
+            return  # raft no-op or a foreign entry kind
+        key = self._key(rec)
+        if key in self.seen:
+            return
+        self.seen.add(key)
+        self.journal.append_replicated(rec)
+
+    def close(self) -> None:
+        self.journal.close()
+
+
+class AdmissionApplier:
+    """Committed-entry applier for serve groups: an ``admit`` entry
+    materializes the accepted job's spool record on every replica, so
+    the daemon a new leader boots (``ServeDaemon._load_journal``)
+    re-queues every job any previous leader ever acked."""
+
+    def __init__(self, spool: str):
+        self.jobs_dir = os.path.join(os.path.abspath(spool), "jobs")
+        os.makedirs(self.jobs_dir, exist_ok=True)
+
+    def __call__(self, index: int, data: Any) -> None:
+        if not isinstance(data, dict):
+            return
+        job = data.get("admit")
+        if not isinstance(job, dict) or not job.get("job_id"):
+            return
+        import json
+
+        from dsi_tpu.utils.atomicio import write_bytes_durable
+
+        path = os.path.join(self.jobs_dir, f"{job['job_id']}.json")
+        if os.path.exists(path):
+            return  # the leader's own _persist (or a replay) beat us
+        write_bytes_durable(
+            path, json.dumps(job, sort_keys=True).encode("utf-8"))
+
+    def close(self) -> None:
+        pass

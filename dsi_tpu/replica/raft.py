@@ -1,0 +1,375 @@
+"""The Raft election/replication state machine — deterministic core.
+
+This is the 6.5840-Lab-2 shape: ONE single-threaded state machine per
+node, driven entirely from outside by ``tick(now)`` (timers) and
+``on_message(msg, now)`` (peer traffic), both returning the outbound
+messages to deliver.  No sockets, no threads, no wall clock, no jax —
+the election timeout is drawn from an INJECTED rng and every time
+comparison uses the caller's ``now``, so a unit test can play out a
+split vote, a partition, or a log-divergence healing byte-for-byte
+reproducibly (tests/test_raft.py).  The process harness that pumps
+real RPC traffic through this core lives in :mod:`replica.node`.
+
+Safety properties this module owns (Raft §5, the ones the failover
+harness leans on):
+
+* **Election safety** — one leader per term: a vote is granted at most
+  once per term (``voted_for`` is persisted BEFORE the grant leaves).
+* **Leader completeness** — a candidate whose log is behind (last term,
+  then last index) is refused, so a winner holds every committed entry.
+* **Commit = majority replication, current term only** (§5.4.2): the
+  leader advances ``commit_index`` only over entries of ITS OWN term
+  replicated on a majority.  This is exactly why a partitioned old
+  leader can never finalize a shard commit: its appends cannot reach a
+  majority, and the new leader's first no-op entry commits the log the
+  majority agreed on.
+* **Log matching** — a follower truncates its log at the first entry
+  conflicting with the leader's and never rewrites a committed prefix.
+
+Entries are ``{"term": int, "data": <json>}``; the log is 1-indexed
+(index 0 is the empty sentinel).  Durability is delegated to an
+optional ``store`` (``rlog.RaftStore``): ``save_term`` before any
+message that reveals a vote or term bump, ``append``/``truncate``
+before an append-entries reply acknowledges the entries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+# Message types on the wire (replica/node.py maps these onto
+# ``Raft.RequestVote`` / ``Raft.AppendEntries`` RPC methods).
+VOTE_REQ = "vote_req"
+VOTE_RESP = "vote_resp"
+APPEND = "append"
+APPEND_RESP = "append_resp"
+
+#: The entry a fresh leader appends immediately on winning: committing
+#: it (its own term) is the §5.4.2-safe way to also commit every older
+#: inherited entry — without it, a failover with no new client traffic
+#: would leave the dead leader's tail uncommitted forever.
+NOOP = {"kind": "raft_noop"}
+
+
+class RaftCore:
+    """One node's Raft state machine (see module docstring).
+
+    ``rng`` needs only ``uniform(a, b)`` (``random.Random`` works);
+    ``store`` (optional) persists term/vote and the log.  All state
+    lives on the instance; the caller serializes access (the node
+    harness holds one lock, tests are single-threaded).
+    """
+
+    def __init__(self, node_id: int, n_nodes: int, *,
+                 rng, now: float = 0.0,
+                 election_timeout_s: Tuple[float, float] = (0.15, 0.30),
+                 heartbeat_s: float = 0.05,
+                 store=None):
+        if not 0 <= node_id < n_nodes:
+            raise ValueError(f"node_id {node_id} out of group "
+                             f"0..{n_nodes - 1}")
+        self.node_id = node_id
+        self.n_nodes = n_nodes
+        self.peers = [i for i in range(n_nodes) if i != node_id]
+        self.rng = rng
+        self.election_timeout_s = election_timeout_s
+        self.heartbeat_s = heartbeat_s
+        self.store = store
+
+        self.role = FOLLOWER
+        self.current_term = 0
+        self.voted_for: Optional[int] = None
+        #: entries[i] is log index i+1.
+        self.log: List[Dict[str, Any]] = []
+        self.commit_index = 0
+        #: Highest index already handed to :meth:`take_committed`.
+        self.delivered_index = 0
+        #: The node we last heard a valid append from this term — the
+        #: redirect hint followers serve to lost workers.
+        self.leader_id: Optional[int] = None
+
+        # Leader volatile state.
+        self.next_index: Dict[int, int] = {}
+        self.match_index: Dict[int, int] = {}
+        self._votes: set = set()
+
+        # Counters the obs/replica lane and Replica.Status export.
+        self.elections_started = 0
+        self.elections_won = 0
+        self.stepdowns = 0
+
+        if store is not None:
+            term, voted, entries = store.load()
+            self.current_term = term
+            self.voted_for = voted
+            self.log = list(entries)
+
+        self._election_due = now + self._timeout()
+        self._hb_due = now
+
+    # ---- small helpers ----
+
+    def _timeout(self) -> float:
+        lo, hi = self.election_timeout_s
+        return self.rng.uniform(lo, hi)
+
+    def _majority(self) -> int:
+        return self.n_nodes // 2 + 1
+
+    def last_index(self) -> int:
+        return len(self.log)
+
+    def _term_at(self, index: int) -> int:
+        if index <= 0 or index > len(self.log):
+            return 0
+        return int(self.log[index - 1]["term"])
+
+    def _persist_term(self) -> None:
+        if self.store is not None:
+            self.store.save_term(self.current_term, self.voted_for)
+
+    def _msg(self, mtype: str, to: int, **fields) -> Dict[str, Any]:
+        m = {"type": mtype, "from": self.node_id, "to": to,
+             "term": self.current_term}
+        m.update(fields)
+        return m
+
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    def status(self) -> Dict[str, Any]:
+        """The ``Replica.Status`` surface (any replica answers it)."""
+        return {"node": self.node_id, "role": self.role,
+                "term": self.current_term,
+                "leader": self.leader_id,
+                "last_index": self.last_index(),
+                "commit_index": self.commit_index,
+                "elections_started": self.elections_started,
+                "elections_won": self.elections_won,
+                "stepdowns": self.stepdowns}
+
+    # ---- timers ----
+
+    def tick(self, now: float) -> List[Dict[str, Any]]:
+        """Advance timers; returns messages to send."""
+        if self.role == LEADER:
+            if now >= self._hb_due:
+                self._hb_due = now + self.heartbeat_s
+                return self._appends_for_all()
+            return []
+        if now >= self._election_due:
+            return self._start_election(now)
+        return []
+
+    def _start_election(self, now: float) -> List[Dict[str, Any]]:
+        self.role = CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.node_id
+        self.leader_id = None
+        self._persist_term()
+        self._votes = {self.node_id}
+        self.elections_started += 1
+        self._election_due = now + self._timeout()
+        if self._majority() == 1:  # single-node group
+            return self._become_leader(now)
+        li = self.last_index()
+        return [self._msg(VOTE_REQ, p, last_log_index=li,
+                          last_log_term=self._term_at(li))
+                for p in self.peers]
+
+    def _become_leader(self, now: float) -> List[Dict[str, Any]]:
+        self.role = LEADER
+        self.leader_id = self.node_id
+        self.elections_won += 1
+        nxt = self.last_index() + 1
+        self.next_index = {p: nxt for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        # The no-op that makes the inherited tail committable (§5.4.2).
+        self._append_local({"term": self.current_term, "data": dict(NOOP)})
+        self._maybe_advance_commit()
+        self._hb_due = now + self.heartbeat_s
+        return self._appends_for_all()
+
+    # ---- client proposals (leader only) ----
+
+    def propose(self, data: Any,
+                now: float) -> Tuple[Optional[int], List[Dict[str, Any]]]:
+        """Append ``data`` to the leader's log; returns ``(index,
+        immediate replication traffic)``, or ``(None, [])`` when this
+        node is not the leader (the caller redirects)."""
+        if self.role != LEADER:
+            return None, []
+        self._append_local({"term": self.current_term, "data": data})
+        self._maybe_advance_commit()  # 1-node group commits instantly
+        self._hb_due = now + self.heartbeat_s
+        return self.last_index(), self._appends_for_all()
+
+    def _append_local(self, entry: Dict[str, Any]) -> None:
+        self.log.append(entry)
+        if self.store is not None:
+            self.store.append(self.last_index(), [entry])
+
+    def _appends_for_all(self) -> List[Dict[str, Any]]:
+        return [self._append_for(p) for p in self.peers]
+
+    def _append_for(self, peer: int) -> Dict[str, Any]:
+        nxt = self.next_index[peer]
+        prev = nxt - 1
+        entries = self.log[prev:]
+        return self._msg(APPEND, peer, prev_index=prev,
+                         prev_term=self._term_at(prev),
+                         entries=list(entries),
+                         commit=self.commit_index)
+
+    # ---- message handling ----
+
+    def on_message(self, msg: Dict[str, Any],
+                   now: float) -> List[Dict[str, Any]]:
+        """Feed one peer message in; returns messages to send."""
+        term = int(msg.get("term", 0))
+        if term > self.current_term:
+            # §5.1: any newer term demotes us on the spot.
+            if self.role != FOLLOWER:
+                self.stepdowns += 1
+            self.role = FOLLOWER
+            self.current_term = term
+            self.voted_for = None
+            self.leader_id = None
+            self._persist_term()
+        mtype = msg.get("type")
+        if mtype == VOTE_REQ:
+            return self._on_vote_req(msg, now)
+        if mtype == VOTE_RESP:
+            return self._on_vote_resp(msg, now)
+        if mtype == APPEND:
+            return self._on_append(msg, now)
+        if mtype == APPEND_RESP:
+            return self._on_append_resp(msg)
+        return []
+
+    def _on_vote_req(self, msg: Dict[str, Any],
+                     now: float) -> List[Dict[str, Any]]:
+        frm = int(msg["from"])
+        term = int(msg["term"])
+        if term < self.current_term:
+            # Stale-term candidate: refuse, teach it the current term.
+            return [self._msg(VOTE_RESP, frm, granted=False)]
+        li, lt = self.last_index(), self._term_at(self.last_index())
+        cand_lt = int(msg.get("last_log_term", 0))
+        cand_li = int(msg.get("last_log_index", 0))
+        up_to_date = (cand_lt, cand_li) >= (lt, li)
+        if self.voted_for in (None, frm) and up_to_date:
+            self.voted_for = frm
+            self._persist_term()  # the vote must be durable before it leaves
+            self._election_due = now + self._timeout()
+            return [self._msg(VOTE_RESP, frm, granted=True)]
+        return [self._msg(VOTE_RESP, frm, granted=False)]
+
+    def _on_vote_resp(self, msg: Dict[str, Any],
+                      now: float) -> List[Dict[str, Any]]:
+        if (self.role != CANDIDATE
+                or int(msg["term"]) != self.current_term
+                or not msg.get("granted")):
+            return []
+        self._votes.add(int(msg["from"]))
+        if len(self._votes) >= self._majority():
+            return self._become_leader(now)
+        return []
+
+    def _on_append(self, msg: Dict[str, Any],
+                   now: float) -> List[Dict[str, Any]]:
+        frm = int(msg["from"])
+        term = int(msg["term"])
+        if term < self.current_term:
+            return [self._msg(APPEND_RESP, frm, ok=False,
+                              hint=self.last_index() + 1)]
+        # A valid leader for our term: (re)settle into follower.
+        if self.role != FOLLOWER:
+            self.stepdowns += 1
+            self.role = FOLLOWER
+        self.leader_id = frm
+        self._election_due = now + self._timeout()
+        prev = int(msg["prev_index"])
+        if prev > self.last_index():
+            # We are missing the predecessor entirely: hint our end so
+            # the leader skips the one-at-a-time walk.
+            return [self._msg(APPEND_RESP, frm, ok=False,
+                              hint=self.last_index() + 1)]
+        if prev >= 1 and self._term_at(prev) != int(msg["prev_term"]):
+            # Conflicting predecessor: hint the FIRST index of the
+            # conflicting term (§5.3's fast backoff).
+            bad_term = self._term_at(prev)
+            first = prev
+            while first > 1 and self._term_at(first - 1) == bad_term:
+                first -= 1
+            return [self._msg(APPEND_RESP, frm, ok=False, hint=first)]
+        entries = list(msg.get("entries") or [])
+        idx = prev
+        for k, entry in enumerate(entries):
+            idx = prev + 1 + k
+            if idx <= self.last_index():
+                if self._term_at(idx) == int(entry["term"]):
+                    continue  # already have it (duplicate append)
+                # Divergence: drop OUR uncommitted suffix, take theirs.
+                assert idx > self.commit_index, \
+                    "leader tried to rewrite a committed entry"
+                del self.log[idx - 1:]
+                if self.store is not None:
+                    self.store.truncate(idx)
+            self.log.append(dict(entry))
+            if self.store is not None:
+                self.store.append(idx, [entry])
+        match = prev + len(entries)
+        leader_commit = int(msg.get("commit", 0))
+        if leader_commit > self.commit_index:
+            self.commit_index = min(leader_commit, match,
+                                    self.last_index())
+        return [self._msg(APPEND_RESP, frm, ok=True, match=match)]
+
+    def _on_append_resp(self, msg: Dict[str, Any]) -> List[Dict[str, Any]]:
+        if self.role != LEADER or int(msg["term"]) != self.current_term:
+            return []
+        frm = int(msg["from"])
+        if msg.get("ok"):
+            match = int(msg.get("match", 0))
+            if match > self.match_index.get(frm, 0):
+                self.match_index[frm] = match
+            self.next_index[frm] = max(self.next_index.get(frm, 1),
+                                       match + 1)
+            self._maybe_advance_commit()
+            if self.next_index[frm] <= self.last_index():
+                return [self._append_for(frm)]  # more to stream
+            return []
+        # Rejected: jump back to the follower's hint and retry now.
+        hint = int(msg.get("hint", 0)) or (self.next_index.get(frm, 2) - 1)
+        self.next_index[frm] = max(1, min(hint, self.last_index() + 1))
+        return [self._append_for(frm)]
+
+    def _maybe_advance_commit(self) -> None:
+        """§5.4.2: commit the highest index of OUR term a majority
+        holds (self counts).  Never moves backwards."""
+        for n in range(self.last_index(), self.commit_index, -1):
+            if self._term_at(n) != self.current_term:
+                break  # older-term entries commit only via a newer one
+            held = 1 + sum(1 for p in self.peers
+                           if self.match_index.get(p, 0) >= n)
+            if held >= self._majority():
+                self.commit_index = n
+                break
+
+    # ---- committed-entry delivery ----
+
+    def take_committed(self) -> List[Tuple[int, Any]]:
+        """Newly committed ``(index, data)`` pairs since the last call
+        — the apply stream (exactly once, in order, no-ops included so
+        the applier can track the applied index densely)."""
+        out = []
+        while self.delivered_index < self.commit_index:
+            self.delivered_index += 1
+            out.append((self.delivered_index,
+                        self.log[self.delivered_index - 1]["data"]))
+        return out

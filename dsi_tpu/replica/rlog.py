@@ -1,0 +1,144 @@
+"""Durable per-node Raft state under the journal's record framing.
+
+Each replica persists exactly what Raft §5 requires before a message
+leaves the node: ``current_term`` + ``voted_for`` (a vote revealed and
+then forgotten could elect two leaders in one term) and the log
+entries themselves (an acknowledged append that evaporates breaks the
+majority-commit arbitration the shard journal now rides on).
+
+The file is append-only JSON lines with the ``mr/journal.py``
+replicated-record framing (``rcrc`` CRC32 per record, torn tail
+truncated on load) — three record kinds:
+
+* ``{"kind": "term", "term": T, "voted": id-or-null}`` — last wins;
+* ``{"kind": "entry", "index": i, "term": t, "data": ...}`` — must
+  extend the log densely (``index == len+1``) or overwrite a truncated
+  suffix previously cut by
+* ``{"kind": "trunc", "from": i}`` — drop every entry ``>= i`` (the
+  log-divergence repair a new leader forces on a stale follower).
+
+A record that parses but does not FIT (gap in indexes, bad types) is
+corruption, not a logical state: load() stops there and truncates, so
+replay is always a clean prefix — the same contract the task journal's
+property test pins (tests/test_journal_framing.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, TextIO, Tuple
+
+from dsi_tpu.mr.journal import frame_record, unframe_record
+from dsi_tpu.utils.atomicio import fsync_dir
+
+
+class RaftStore:
+    """Durable (term, voted_for, log) for one replica."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh: Optional[TextIO] = None
+        self._term = 0
+        self._voted: Optional[int] = None
+        self._entries: List[Dict[str, Any]] = []
+
+    # ---- load ----
+
+    def load(self) -> Tuple[int, Optional[int], List[Dict[str, Any]]]:
+        """Replay the file (truncating at the first corrupt/torn
+        record), open for appending, and return
+        ``(term, voted_for, entries)``."""
+        trunc_at: Optional[int] = None
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos < len(data):
+                nl = data.find(b"\n", pos)
+                rec_start = pos
+                if nl == -1:
+                    trunc_at = rec_start
+                    break
+                line = data[rec_start:nl].strip()
+                pos = nl + 1
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    trunc_at = rec_start
+                    break
+                if not isinstance(rec, dict):
+                    trunc_at = rec_start
+                    break
+                rec = unframe_record(rec)
+                if rec is None or not self._apply(rec):
+                    trunc_at = rec_start
+                    break
+            if trunc_at is not None:
+                # dsicheck: allow[raw-write] in-place truncation IS the
+                # torn-tail repair, same as the task journal's open()
+                with open(self.path, "rb+") as f:
+                    f.truncate(trunc_at)
+        # dsicheck: allow[raw-write] append-only raft log: per-record
+        # fsync + parent-dir fsync below; rename cannot express appends
+        self._fh = open(self.path, "a")
+        fsync_dir(os.path.dirname(os.path.abspath(self.path)) or ".")
+        return self._term, self._voted, list(self._entries)
+
+    def _apply(self, rec: Dict[str, Any]) -> bool:
+        """Fold one replayed record; False == structurally corrupt."""
+        kind = rec.get("kind")
+        if kind == "term":
+            term, voted = rec.get("term"), rec.get("voted")
+            if (not isinstance(term, int) or isinstance(term, bool)
+                    or term < 0):
+                return False
+            if voted is not None and (not isinstance(voted, int)
+                                      or isinstance(voted, bool)):
+                return False
+            self._term, self._voted = term, voted
+            return True
+        if kind == "trunc":
+            frm = rec.get("from")
+            if (not isinstance(frm, int) or isinstance(frm, bool)
+                    or frm < 1):
+                return False
+            del self._entries[frm - 1:]
+            return True
+        if kind == "entry":
+            idx, term = rec.get("index"), rec.get("term")
+            if any(not isinstance(v, int) or isinstance(v, bool) or v < 0
+                   for v in (idx, term)):
+                return False
+            if idx != len(self._entries) + 1:  # gaps are corruption
+                return False
+            self._entries.append({"term": term, "data": rec.get("data")})
+            return True
+        return False
+
+    # ---- writes (RaftCore persistence hooks) ----
+
+    def save_term(self, term: int, voted: Optional[int]) -> None:
+        self._term, self._voted = term, voted
+        self._write({"kind": "term", "term": int(term), "voted": voted})
+
+    def append(self, start_index: int, entries) -> None:
+        for k, e in enumerate(entries):
+            self._write({"kind": "entry", "index": int(start_index + k),
+                         "term": int(e["term"]), "data": e["data"]})
+
+    def truncate(self, from_index: int) -> None:
+        self._write({"kind": "trunc", "from": int(from_index)})
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        assert self._fh is not None, "RaftStore.load() before writes"
+        self._fh.write(frame_record(rec) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

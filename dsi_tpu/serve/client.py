@@ -22,6 +22,7 @@ import time
 from typing import Dict, List, Optional
 
 from dsi_tpu.mr.rpc import CoordinatorGone, call
+from dsi_tpu.replica.client import group_call
 
 
 class ServeBusy(RuntimeError):
@@ -41,7 +42,14 @@ def default_socket(spool: str) -> str:
 
 def _call(socket_path: str, method: str, args: dict,
           timeout: float = 30.0) -> dict:
-    ok, reply = call(socket_path, method, args, timeout=timeout)
+    if "," in socket_path:
+        # A replica-group spec (mrserve --replicas): ride the
+        # leader-tracking group dialer, which hides NotLeader redirects
+        # and mid-election retries.  Backpressure still surfaces below.
+        ok, reply = group_call(socket_path, method, args,
+                               timeout=timeout)
+    else:
+        ok, reply = call(socket_path, method, args, timeout=timeout)
     if not ok or not isinstance(reply, dict):
         raise CoordinatorGone(f"mrserve RPC {method} failed at "
                               f"{socket_path}")

@@ -108,7 +108,8 @@ class ServeDaemon:
                  pack_grep: Optional[bool] = None,
                  evict_min_samples: int = 8,
                  metrics_tenants: Optional[int] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 admit_hook=None):
         self.spool = os.path.abspath(spool)
         self.jobs_dir = os.path.join(self.spool, "jobs")
         self.tenants_dir = os.path.join(self.spool, "tenants")
@@ -139,6 +140,12 @@ class ServeDaemon:
             metrics_tenants = _env_int("DSI_SERVE_METRICS_TENANTS", 32)
         self.metrics_tenants = max(1, int(metrics_tenants))
         self._clock = clock
+        # Replicated control plane (dsi_tpu/replica): called with the
+        # persisted job record BEFORE the local journal write and the
+        # ack — it blocks until the admission is majority-replicated,
+        # or raises, in which case the submission is NOT admitted (no
+        # spool state, typed error to the client).  None = single-node.
+        self.admit_hook = admit_hook
 
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -157,6 +164,12 @@ class ServeDaemon:
         # Per-tenant packed-step wall distributions — the eviction
         # policy's evidence and the bounded /metrics tenant selector.
         self._hist = KeyedHistograms()
+        # Job-completion gap distribution (separate instance: _hist is
+        # keyed by tenant and drives EVICTION — a pseudo-key there
+        # would become an eviction candidate).  Feeds the measured
+        # drain rate behind the queue-full retry-after hint.
+        self._drain_hist = KeyedHistograms()
+        self._last_done_ts: Optional[float] = None
         self._seq = 0
         self.packer = None
         self.grep_packer = None
@@ -271,6 +284,18 @@ class ServeDaemon:
             os.path.join(self.jobs_dir, f"{job['job_id']}.json"),
             json.dumps(rec, sort_keys=True).encode("utf-8"))
 
+    def _drain_jobs_per_sec(self) -> float:
+        """The measured service rate behind ``qos.shed_retry_after``:
+        the median completion gap inverted (KeyedHistograms evidence,
+        same instrument the eviction policy trusts).  0.0 until at
+        least two jobs finished — callers fall back to the cold-start
+        linear hint."""
+        h = self._drain_hist.get("gap")
+        if h is None or h.count < 2:
+            return 0.0
+        p50 = h.percentile(0.5)
+        return 1.0 / p50 if p50 > 0.0 else 0.0
+
     # ── RPC handlers (no jax; scheduler owns the device) ──
 
     def _rpc_submit(self, args: dict) -> dict:
@@ -332,9 +357,11 @@ class ServeDaemon:
             queued = len(self._queue)
             if queued >= self.max_queue:
                 self._qos["shed"] += 1
-                # Deeper backlog → longer hint: drain-proportional,
-                # clamped so clients neither stampede nor stall.
-                hint = max(0.2, min(5.0, 0.005 * queued))
+                # Deeper backlog → longer hint, scaled by the MEASURED
+                # drain rate (qos.shed_retry_after): the hint predicts
+                # when a slot plausibly opens, not a fixed slope.
+                hint = qos.shed_retry_after(queued,
+                                            self._drain_jobs_per_sec())
                 return qos.backpressure_reply(
                     f"queue full ({queued} >= {self.max_queue})", hint)
             jid = f"{tenant}-{self._seq:06d}"
@@ -346,6 +373,14 @@ class ServeDaemon:
                    "state": "queued",
                    "submitted_ts": round(time.time(), 3),
                    "done_ts": None, "error": None, "stats": {}}
+            if self.admit_hook is not None:
+                # Replicated admission (dsi_tpu/replica): majority-
+                # commit the record BEFORE any local state, so a leader
+                # cut off from its group cannot ack a job the group
+                # never heard of.  Raises on failure — caught by the
+                # replica node's typed-reply wrapper; no spool state
+                # was created, same shed contract as above.
+                self.admit_hook({k: job.get(k) for k in _JOB_FIELDS})
             self._persist(job)  # durable BEFORE the ack
             self._jobs[jid] = job
             self._tenant(tenant)["jobs"] += 1
@@ -637,6 +672,14 @@ class ServeDaemon:
                 ts["done"] += 1
                 ts["steps"] += int(stats.get("steps") or 0)
                 ts["rows"] += int(stats.get("rows") or 0)
+            # Drain-rate evidence: the gap between consecutive job
+            # completions (any outcome — a failed job still drained a
+            # queue slot) feeds the queue-full retry-after hint.
+            now = self._clock()
+            if self._last_done_ts is not None:
+                self._drain_hist.record("gap",
+                                        max(1e-6, now - self._last_done_ts))
+            self._last_done_ts = now
         self._persist(job)
 
     @staticmethod

@@ -128,6 +128,37 @@ class TokenBucket:
             return round((1.0 - self._tokens) / self.rate, 4)
 
 
+#: Shed-hint clamp: clients neither stampede (floor) nor stall on a
+#: transient spike (ceiling) — the same bounds the old linear rule used.
+SHED_HINT_FLOOR_S = 0.2
+SHED_HINT_CEIL_S = 5.0
+
+#: Cold-start fallback slope, seconds of hint per queued job, used only
+#: until the daemon has MEASURED its own drain rate.  5 ms/job was the
+#: original hard-coded guess; it survives as the no-evidence default.
+SHED_HINT_COLD_S_PER_JOB = 0.005
+
+
+def shed_retry_after(queued: int, drained_jobs_per_sec: float,
+                     floor_s: float = SHED_HINT_FLOOR_S,
+                     ceil_s: float = SHED_HINT_CEIL_S) -> float:
+    """The queue-full retry-after hint, from measured evidence.
+
+    When the daemon knows how fast it actually drains jobs (the
+    KeyedHistograms-backed completion-gap estimate,
+    ``ServeDaemon._drain_jobs_per_sec``), the hint is the honest
+    prediction ``queued / rate`` — a fast daemon under a burst hands
+    out short hints, a daemon grinding through multi-GB jobs hands out
+    the ceiling instead of inviting a 200 ms stampede.  With no
+    evidence yet (fresh boot, nothing finished) the linear
+    ``0.005 * queued`` guess stands in.  Clamped either way."""
+    if drained_jobs_per_sec > 0.0:
+        hint = queued / drained_jobs_per_sec
+    else:
+        hint = SHED_HINT_COLD_S_PER_JOB * queued
+    return max(floor_s, min(ceil_s, hint))
+
+
 def backpressure_reply(msg: str, retry_after_s: float) -> dict:
     """The one spelling of the typed backpressure RPC error — the
     client (``serve/client.py ServeBusy``) keys on ``error_type`` and

@@ -130,6 +130,17 @@ GATES: List[Tuple[str, str, float]] = [
     # not); net_fetch_wait_s stays info-only — the throughput gate
     # already owns that trade.
     ("net_overlap_s", "higher", 0.90),
+    # Replicated control plane (ISSUE 20): the *_mbps/*_parity patterns
+    # above already gate the single/group/chaos arm throughputs and
+    # oracle parity, and *_overhead_pct gates the majority-commit cost.
+    # The failover wall is THE tentpole number — lower-better, so an
+    # election-timeout or log-replay regression that doubles the
+    # leaderless window fails the diff.  Exactly-once across terms is a
+    # BOOL gate (the spec_exactly_once precedent: the healthy old
+    # duplicate count is 0, which the numeric rule reads as "unknown" —
+    # the bool regresses on the first cross-term duplicate ever seen).
+    ("replica_failover_s", "lower", 1.00),
+    ("replica_exactly_once", "bool", 0.0),
 ]
 
 

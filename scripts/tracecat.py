@@ -406,6 +406,58 @@ def elastic(events, metas, out) -> bool:
     return True
 
 
+def replica(events, metas, out) -> bool:
+    """The replicated control plane (ISSUE 20): terms, elections,
+    app rebuild walls, the measured failover gap (last event of the
+    dying term -> the next ``replica.elected``), and per-replica
+    replication lag from the ``dsi_replica_applied_index`` gauges."""
+    evs = sorted((e for e in events
+                  if str(e.get("name", "")).startswith("replica.")),
+                 key=lambda e: e.get("ts", 0.0))
+    applied = []
+    for meta in metas:
+        gauges = (meta.get("registry") or {}).get("gauges") or {}
+        if "dsi_replica_applied_index" in gauges:
+            applied.append((meta.get("_file", "?"),
+                            gauges.get("dsi_replica_applied_index"),
+                            gauges.get("dsi_replica_term"),
+                            gauges.get("dsi_replica_elections")))
+    if not (evs or applied):
+        return False
+    terms = sorted({int(e.get("term", 0)) for e in evs})
+    elected = [e for e in evs if e["name"] == "replica.elected"]
+    steps = sum(1 for e in evs if e["name"] == "replica.stepdown")
+    print(f"  terms seen: {terms}  elections={len(elected)} "
+          f"stepdowns={steps}", file=out)
+    for e in elected:
+        # Failover wall as the trace sees it: the gap from the last
+        # event of ANY older term to this election.  A kill -9 leader
+        # emits nothing on death, so this spans the election timeout.
+        prev = [p for p in evs if p.get("ts", 0.0) < e.get("ts", 0.0)
+                and int(p.get("term", 0)) < int(e.get("term", 0))]
+        gap = (e.get("ts", 0.0) - prev[-1].get("ts", 0.0)) if prev \
+            else None
+        ups = [u for u in evs if u["name"] == "replica.app_up"
+               and int(u.get("term", 0)) == int(e.get("term", 0))]
+        build = ups[0].get("build_s") if ups else None
+        line = (f"  term {e.get('term')}: replica {e.get('node')} "
+                f"elected @ {e.get('ts', 0.0):.3f}s "
+                f"barrier={e.get('barrier')}")
+        if gap is not None:
+            line += f" failover_gap={gap:.3f}s"
+        if build is not None:
+            line += f" app_build={build:.3f}s"
+        print(line, file=out)
+    if applied:
+        top = max(a[1] or 0 for a in applied)
+        for fname, idx, term, elections in sorted(applied):
+            lag = top - (idx or 0)
+            print(f"  {fname}: applied_index={idx} term={term} "
+                  f"elections_won={elections}"
+                  + (f" lag={lag}" if lag else ""), file=out)
+    return True
+
+
 def histograms(metas, out) -> bool:
     """The stage latency percentile table (obs/hist.py) embedded in
     each trace's registry snapshot."""
@@ -489,6 +541,8 @@ def main(argv=None) -> int:
                        lambda o: plan(events, metas, o)),
                       ("elastic dataflow",
                        lambda o: elastic(events, metas, o)),
+                      ("replica control plane",
+                       lambda o: replica(events, metas, o)),
                       ("stage latency histograms",
                        lambda o: histograms(metas, o))):
         buf = io.StringIO()

@@ -93,8 +93,14 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
                                  # mrrun fleets per pass — worker boots,
                                  # not MBs, dominate (hence the timeout
                                  # headroom over run_bench's 420)
-                                 "DSI_BENCH_NET_MB": "1"},
-                      timeout=540)
+                                 "DSI_BENCH_NET_MB": "1",
+                                 # replica row at contract-test scale:
+                                 # three shardrun fleets (one single,
+                                 # two 3-replica groups incl. a leader
+                                 # kill) — election walls, not MBs,
+                                 # dominate
+                                 "DSI_BENCH_REPLICA_MB": "0.5"},
+                      timeout=600)
     assert rc == 0
     assert v["metric"] == "wc_cpu_fallback_throughput"
     assert v["platform"] == "cpu"
@@ -262,6 +268,22 @@ def test_failed_attempts_fall_back_to_labeled_cpu_verdict(tmp_path):
         assert v["net_pipe_mb"] > 0
         assert v["net_overlap_s"] >= 0
         assert v["net_fetch_wait_s"] >= 0
+    # The replicated-control-plane A/B row (ISSUE 20): measured XOR
+    # skipped; a measured row carries all three arms' throughput
+    # (single coordinator, 3-replica group, group with the leader
+    # kill -9'd), the majority-commit overhead, the failover wall with
+    # its term handoff, and the exactly-once-across-terms bool (stats
+    # plus every replica journal audited inside the row).
+    assert ("replica_skipped" in v) != ("replica_failover_s" in v)
+    if "replica_failover_s" in v:
+        assert v["replica_parity"] is True
+        assert v["replica_single_mbps"] > 0
+        assert v["replica_group_mbps"] > 0
+        assert v["replica_chaos_mbps"] > 0
+        assert v["replica_failover_s"] > 0
+        assert v["replica_terms"][1] > v["replica_terms"][0] >= 1
+        assert v["replica_duplicate_commits"] == 0
+        assert v["replica_exactly_once"] is True
 
 
 def test_engine_phase_dicts_come_from_the_registry(tmp_path):

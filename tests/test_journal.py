@@ -100,10 +100,10 @@ def test_corrupt_midfile_record_truncated_for_future_appends(tmp_path):
     path = os.path.join(str(tmp_path), "journal")
     with open(path, "rb+") as f:
         data = f.read()
-        # corrupt the SECOND map record (flip its task id out of range),
-        # keeping valid JSON + trailing newline
-        bad = data.replace(b'{"kind": "map", "task": 1}',
-                           b'{"kind": "map", "task": 9}')
+        # corrupt the SECOND map record (flip its task id), keeping
+        # valid JSON + trailing newline — the record's rcrc no longer
+        # matches its payload, so replay must treat it as corrupt
+        bad = data.replace(b'"task":1}', b'"task":9}')
         assert bad != data
         f.seek(0)
         f.truncate()

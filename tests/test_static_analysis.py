@@ -133,8 +133,13 @@ def test_tree_is_clean():
     # 13: +1 for the stage-host console capture (cli/planrun.py — a
     # subprocess stdout handle held open for the child's lifetime, so
     # atomic_write's rename-on-close contract cannot apply)
+    # 17: +4 for the replicated control plane — replica/rlog.py's
+    # append + in-place-truncation pair (per-record CRC framing IS the
+    # durability story, same idiom as mr/journal.py), the replicad
+    # spec file (replica/driver.py — consumed once by a child the
+    # parent waits on), and mrrun's --stats-json parse surface
     sup = [f for f in findings if f.suppressed]
-    assert len(sup) <= 13, (
+    assert len(sup) <= 17, (
         "suppression inventory grew suspiciously large — are "
         "annotations being used where a fix belongs?\n"
         + "\n".join(f.render() for f in sup))
